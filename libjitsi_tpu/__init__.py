@@ -1,0 +1,77 @@
+"""libjitsi_tpu — a TPU-native secure real-time media framework.
+
+A from-scratch rebuild of the capabilities of bgrozev/libjitsi
+(`org.jitsi.service.libjitsi.LibJitsi` et al.) designed TPU-first:
+
+- dense batched per-stream state (struct-of-arrays) instead of
+  lock-per-object Java instances,
+- packet transform chains as composed, batched JAX functions instead of
+  per-packet `PacketTransformer.transform()` virtual calls,
+- crypto (SRTP AES-CTR/GCM keystream + HMAC-SHA1 auth) as vectorized
+  XLA/Pallas device kernels with a C++ host fallback,
+- conference mixing as a single segment-sum kernel with mesh collectives
+  for cross-chip participant sharding.
+
+Public API shape mirrors the reference so capability parity is auditable:
+``init()`` ↔ ``LibJitsi.start()``; ``media_service()`` ↔
+``LibJitsi.getMediaService()`` (reference:
+org/jitsi/service/libjitsi/LibJitsi.java).
+"""
+
+__version__ = "0.1.0"
+
+from libjitsi_tpu.core.packet import PacketBatch  # noqa: F401
+
+_media_service = None
+_config_service = None
+_started = False
+
+
+def init(config=None):
+    """Start the framework (reference: LibJitsi.start()).
+
+    Lazily builds the service singletons.  Unlike the reference's OSGi /
+    static-service-map split (LibJitsiImpl vs LibJitsiOSGiImpl), there is a
+    single functional implementation; DI frameworks can simply construct
+    `MediaService` directly.
+    """
+    global _started, _config_service
+    if _started:
+        # Re-init with explicit config merges into the live store rather
+        # than silently dropping it (easy to hit: any accessor auto-inits).
+        if config:
+            for k, v in config.items():
+                _config_service.set(k, v)
+        return
+    from libjitsi_tpu.core.config import ConfigurationService
+
+    _config_service = ConfigurationService(overrides=config)
+    _started = True
+
+
+def stop():
+    """Stop the framework (reference: LibJitsi.stop())."""
+    global _started, _media_service, _config_service
+    _media_service = None
+    _config_service = None
+    _started = False
+
+
+def media_service():
+    """Return the MediaService (reference: LibJitsi.getMediaService())."""
+    global _media_service
+    if not _started:
+        init()
+    if _media_service is None:
+        from libjitsi_tpu.service.media_service import MediaService
+
+        _media_service = MediaService(configuration_service())
+    return _media_service
+
+
+def configuration_service():
+    """Return the ConfigurationService
+    (reference: LibJitsi.getConfigurationService())."""
+    if not _started:
+        init()
+    return _config_service

@@ -1,0 +1,95 @@
+"""PacketBatch — the struct-of-arrays currency of the framework.
+
+The reference's `RawPacket` (org/jitsi/service/neomedia/RawPacket.java) is a
+zero-copy ``byte[] + offset + length`` view over one UDP datagram, mutated in
+place by each `PacketTransformer`.  On TPU the per-packet object inverts into
+one dense batch: a ``uint8 [B, capacity]`` payload matrix plus int32 vectors
+for lengths and parsed header fields.  Every transform is a batched function
+``PacketBatch -> PacketBatch``; a "packet" is a row index.
+
+Capacity is fixed (default MTU-sized 1504, a multiple of 8) so shapes are
+static under `jit`; variable sizes are handled by the `length` vector and
+masking, with optional size-class bucketing done by the I/O layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+DEFAULT_CAPACITY = 1504  # >= Ethernet MTU payload, multiple of 8
+
+# RTP fixed header (RFC 3550 §5.1)
+RTP_FIXED_HEADER_LEN = 12
+RTP_VERSION = 2
+
+
+@dataclasses.dataclass
+class PacketBatch:
+    """A batch of packets as dense arrays (NumPy on host, JAX on device).
+
+    Attributes
+    ----------
+    data : uint8 [B, capacity]
+        Raw datagram bytes, zero-padded past `length`.
+    length : int32 [B]
+        Valid byte count per row.
+    stream : int32 [B]
+        Owning stream id (row into the framework's per-stream state
+        tables); -1 when unmapped.  This replaces the reference's
+        per-`MediaStreamImpl` object identity.
+    """
+
+    data: np.ndarray
+    length: np.ndarray
+    stream: np.ndarray
+
+    # ---- constructors -------------------------------------------------
+    @staticmethod
+    def empty(batch: int, capacity: int = DEFAULT_CAPACITY) -> "PacketBatch":
+        return PacketBatch(
+            data=np.zeros((batch, capacity), dtype=np.uint8),
+            length=np.zeros((batch,), dtype=np.int32),
+            stream=np.full((batch,), -1, dtype=np.int32),
+        )
+
+    @staticmethod
+    def from_payloads(
+        payloads: Sequence[bytes],
+        capacity: int = DEFAULT_CAPACITY,
+        stream: Optional[Sequence[int]] = None,
+    ) -> "PacketBatch":
+        b = PacketBatch.empty(len(payloads), capacity)
+        for i, p in enumerate(payloads):
+            if len(p) > capacity:
+                raise ValueError(f"packet {i} ({len(p)}B) exceeds capacity {capacity}")
+            b.data[i, : len(p)] = np.frombuffer(p, dtype=np.uint8)
+            b.length[i] = len(p)
+        if stream is not None:
+            b.stream[:] = np.asarray(stream, dtype=np.int32)
+        return b
+
+    # ---- accessors ----------------------------------------------------
+    @property
+    def batch_size(self) -> int:
+        return int(self.data.shape[0])
+
+    @property
+    def capacity(self) -> int:
+        return int(self.data.shape[1])
+
+    def to_bytes(self, i: int) -> bytes:
+        return bytes(np.asarray(self.data[i, : int(self.length[i])]))
+
+    def to_payloads(self) -> List[bytes]:
+        return [self.to_bytes(i) for i in range(self.batch_size)]
+
+    def copy(self) -> "PacketBatch":
+        return PacketBatch(self.data.copy(), self.length.copy(), self.stream.copy())
+
+    def mask(self) -> np.ndarray:
+        """bool [B, capacity]: True where a byte is within `length`."""
+        idx = np.arange(self.capacity, dtype=np.int32)[None, :]
+        return idx < np.asarray(self.length)[:, None]

@@ -1,0 +1,24 @@
+"""MediaService — the top-level factory of the public API.
+
+Mirrors the surface of the reference's
+`org.jitsi.service.neomedia.MediaService` /
+`org.jitsi.impl.neomedia.MediaServiceImpl`: stream creation, format
+registry, and access to conferencing devices.  Grows with the framework;
+round-1 milestones land stream/mixer/SFU factories here as they are built.
+"""
+
+from __future__ import annotations
+
+from libjitsi_tpu.core.config import ConfigurationService
+
+
+class MediaService:
+    def __init__(self, config: ConfigurationService):
+        self.config = config
+
+    def create_media_stream(self, *args, **kwargs):
+        """Reference: MediaService.createMediaStream.  Lands with the
+        stream core milestone (SURVEY §2.3)."""
+        from libjitsi_tpu.service.media_stream import create_media_stream
+
+        return create_media_stream(self.config, *args, **kwargs)

@@ -1,0 +1,15 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip TPU hardware is not available in CI; per the build contract the
+sharded paths are validated on a virtual CPU mesh
+(`--xla_force_host_platform_device_count=8`).  Must run before jax imports.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")

@@ -1,0 +1,45 @@
+import os
+
+import libjitsi_tpu
+from libjitsi_tpu.core.config import ConfigurationService
+
+
+def test_precedence_and_types(monkeypatch):
+    monkeypatch.setenv("LIBJITSI_TPU_A_B", "42")
+    c = ConfigurationService(overrides={"x.y": 7})
+    c.register_default("a.b", 1)
+    c.register_default("z", "true")
+    assert c.get_int("a.b") == 42  # env beats default
+    assert c.get_int("x.y") == 7  # override beats all
+    assert c.get_bool("z") is True
+    c.set("a.b", 99)
+    assert c.get_int("a.b") == 99  # explicit set beats env
+
+
+def test_bad_env_value_falls_back(monkeypatch):
+    monkeypatch.setenv("LIBJITSI_TPU_FOO_BAR", "not-a-number")
+    c = ConfigurationService()
+    assert c.get_int("foo.bar", 7) == 7
+    assert c.get_float("foo.bar", 2.5) == 2.5
+    monkeypatch.setenv("LIBJITSI_TPU_EMPTY", "")
+    assert c.get_bool("empty", True) is True  # empty env == unset
+
+
+def test_listeners_and_prefix(monkeypatch):
+    monkeypatch.setenv("LIBJITSI_TPU_SRTP_WINDOW", "128")
+    c = ConfigurationService()
+    seen = []
+    c.add_listener(lambda k, old, new: seen.append((k, old, new)))
+    c.set("srtp.replay", 1)
+    assert seen == [("srtp.replay", None, 1)]
+    props = c.properties_by_prefix("srtp.")
+    assert props["srtp.replay"] == 1
+    assert props["srtp.window"] == "128"  # env-only key included
+
+
+def test_reinit_merges_config():
+    libjitsi_tpu.stop()
+    libjitsi_tpu.configuration_service()  # auto-init with empty config
+    libjitsi_tpu.init({"mixer.frame_ms": 10})  # must merge, not drop
+    assert libjitsi_tpu.configuration_service().get_int("mixer.frame_ms") == 10
+    libjitsi_tpu.stop()

@@ -1,0 +1,124 @@
+import struct
+
+import numpy as np
+
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.rtp import header as H
+
+
+def scalar_parse(pkt: bytes):
+    """Independent scalar reference parser for differential testing."""
+    b0, b1 = pkt[0], pkt[1]
+    out = {
+        "version": b0 >> 6,
+        "padding": (b0 >> 5) & 1,
+        "extension": (b0 >> 4) & 1,
+        "cc": b0 & 0xF,
+        "marker": b1 >> 7,
+        "pt": b1 & 0x7F,
+        "seq": struct.unpack(">H", pkt[2:4])[0],
+        "ts": struct.unpack(">I", pkt[4:8])[0],
+        "ssrc": struct.unpack(">I", pkt[8:12])[0],
+    }
+    off = 12 + 4 * out["cc"]
+    ext_words = 0
+    if out["extension"]:
+        ext_words = struct.unpack(">H", pkt[off + 2 : off + 4])[0]
+        off += 4 + 4 * ext_words
+    out["header_len"] = off
+    out["pad_len"] = pkt[-1] if out["padding"] else 0
+    out["payload_len"] = len(pkt) - off - out["pad_len"]
+    return out
+
+
+def random_packet(rng):
+    cc = int(rng.integers(0, 4))
+    has_ext = bool(rng.integers(0, 2))
+    has_pad = bool(rng.integers(0, 2))
+    payload = bytes(rng.integers(0, 256, size=int(rng.integers(0, 200)), dtype=np.uint8))
+    hdr = bytearray(12)
+    hdr[0] = (2 << 6) | (int(has_pad) << 5) | (int(has_ext) << 4) | cc
+    hdr[1] = (int(rng.integers(0, 2)) << 7) | int(rng.integers(0, 128))
+    hdr[2:4] = struct.pack(">H", int(rng.integers(0, 65536)))
+    hdr[4:8] = struct.pack(">I", int(rng.integers(0, 2**32)))
+    hdr[8:12] = struct.pack(">I", int(rng.integers(0, 2**32)))
+    pkt = bytes(hdr)
+    for _ in range(cc):
+        pkt += struct.pack(">I", int(rng.integers(0, 2**32)))
+    if has_ext:
+        words = int(rng.integers(0, 4))
+        pkt += struct.pack(">HH", 0xBEDE, words)
+        pkt += bytes(rng.integers(0, 256, size=4 * words, dtype=np.uint8))
+    pkt += payload
+    if has_pad:
+        pad = int(rng.integers(1, 5))
+        pkt += b"\x00" * (pad - 1) + bytes([pad])
+    return pkt
+
+
+def test_parse_differential_random():
+    rng = np.random.default_rng(42)
+    pkts = [random_packet(rng) for _ in range(256)]
+    batch = PacketBatch.from_payloads(pkts)
+    h = H.parse(batch)
+    for i, p in enumerate(pkts):
+        ref = scalar_parse(p)
+        assert h.version[i] == ref["version"]
+        assert h.padding[i] == ref["padding"]
+        assert h.extension[i] == ref["extension"]
+        assert h.cc[i] == ref["cc"]
+        assert h.marker[i] == ref["marker"]
+        assert h.pt[i] == ref["pt"]
+        assert h.seq[i] == ref["seq"]
+        assert h.ts[i] == ref["ts"]
+        assert h.ssrc[i] == ref["ssrc"]
+        assert h.header_len[i] == ref["header_len"]
+        assert h.pad_len[i] == ref["pad_len"]
+        assert h.payload_len[i] == ref["payload_len"]
+        assert bool(h.valid[i])
+
+
+def test_build_then_parse_roundtrip():
+    payloads = [b"hello", b"", b"x" * 100]
+    batch = H.build(
+        payloads,
+        seq=[1, 65535, 7],
+        ts=[0, 2**32 - 1, 12345],
+        ssrc=0xDEADBEEF,
+        pt=111,
+        marker=[1, 0, 0],
+        csrcs=[[], [1, 2], [0xFFFFFFFF]],
+    )
+    h = H.parse(batch)
+    np.testing.assert_array_equal(h.seq, [1, 65535, 7])
+    np.testing.assert_array_equal(h.ts, [0, 2**32 - 1, 12345])
+    assert all(h.ssrc == 0xDEADBEEF)
+    assert all(h.pt == 111)
+    np.testing.assert_array_equal(h.marker, [1, 0, 0])
+    np.testing.assert_array_equal(h.cc, [0, 2, 1])
+    np.testing.assert_array_equal(
+        h.payload_len, [len(p) for p in payloads]
+    )
+    assert batch.to_bytes(0)[h.payload_off[0] :] == b"hello"
+
+
+def test_mutators():
+    batch = H.build([b"abc"] * 4, seq=0, ts=0, ssrc=0, pt=0)
+    H.set_seq(batch.data, [10, 20, 30, 65535])
+    H.set_ts(batch.data, 0xCAFEBABE)
+    H.set_ssrc(batch.data, [1, 2, 3, 4])
+    H.set_pt(batch.data, 96)
+    H.set_marker(batch.data, [0, 1, 0, 1])
+    h = H.parse(batch)
+    np.testing.assert_array_equal(h.seq, [10, 20, 30, 65535])
+    assert all(h.ts == 0xCAFEBABE)
+    np.testing.assert_array_equal(h.ssrc, [1, 2, 3, 4])
+    assert all(h.pt == 96)
+    np.testing.assert_array_equal(h.marker, [0, 1, 0, 1])
+
+
+def test_invalid_flagged():
+    batch = PacketBatch.from_payloads([b"\x00" * 12, b"short"])
+    h = H.parse(batch)
+    assert not h.valid[0]  # version 0
+    assert not h.valid[1]  # too short

@@ -1,0 +1,95 @@
+import numpy as np
+import pytest
+
+from libjitsi_tpu.core import rtp_math as rm
+
+
+def test_seq_delta_basic():
+    assert rm.seq_delta(10, 5) == 5
+    assert rm.seq_delta(5, 10) == -5
+    # wrap
+    assert rm.seq_delta(2, 65534) == 4
+    assert rm.seq_delta(65534, 2) == -4
+    # extremes
+    assert rm.seq_delta(0x8000, 0) == -32768
+    assert rm.seq_delta(0, 0) == 0
+
+
+def test_seq_delta_vectorized():
+    a = np.array([10, 2, 65534, 0])
+    b = np.array([5, 65534, 2, 0x8000])
+    np.testing.assert_array_equal(rm.seq_delta(a, b), [5, 4, -4, -32768])
+
+
+def test_is_newer_seq():
+    assert rm.is_newer_seq(1, 65535)
+    assert not rm.is_newer_seq(65535, 1)
+    assert not rm.is_newer_seq(7, 7)
+
+
+def test_ts_delta_wrap():
+    assert rm.ts_delta(5, 0xFFFFFFFF) == 6
+    assert rm.ts_delta(0xFFFFFFFF, 5) == -6
+    assert rm.ts_delta(123, 123) == 0
+
+
+@pytest.mark.parametrize(
+    "seq,s_l,roc,expect_v",
+    [
+        (100, 50, 0, 0),  # in order, same roc
+        (5, 65000, 3, 4),  # just wrapped: guess roc+1
+        (65000, 5, 4, 3),  # late packet from before wrap: guess roc-1
+        (40000, 30000, 2, 2),  # large forward jump, no wrap (s_l < 32768... no)
+    ],
+)
+def test_estimate_packet_index(seq, s_l, roc, expect_v):
+    v, idx = rm.estimate_packet_index(seq, s_l, roc)
+    assert int(v) == expect_v
+    assert int(idx) == expect_v * 65536 + seq
+
+
+def test_estimate_index_never_negative_roc():
+    v, idx = rm.estimate_packet_index(65000, 5, 0)
+    assert int(v) == 0  # clamped; a "before stream start" packet
+    assert int(idx) == 65000
+
+
+def test_update_index_state():
+    # normal advance
+    assert rm.update_index_state(100, 0, 50, 0) == (100, 0)
+    # reordered old packet: no update
+    assert rm.update_index_state(40, 0, 50, 0) == (50, 0)
+    # rollover commit
+    assert rm.update_index_state(3, 1, 65530, 0) == (3, 1)
+
+
+def test_unwrapper_monotone_and_reorder():
+    u = rm.SeqNumUnwrapper()
+    seqs = [65530, 65531, 65535, 0, 1, 65533, 2, 3]
+    exts = [u.unwrap(s) for s in seqs]
+    assert exts[0] == 65530
+    assert exts[3] == 65536  # wrapped
+    assert exts[5] == 65533  # reordered pre-wrap packet keeps old epoch
+    assert exts[-1] == 65536 + 3
+
+
+def test_unwrapper_many_cycles():
+    u = rm.SeqNumUnwrapper()
+    ext = 0
+    rng = np.random.default_rng(0)
+    seq = 0
+    last = 0
+    for _ in range(5000):
+        step = int(rng.integers(1, 50))
+        seq = (seq + step) % 65536
+        ext = u.unwrap(seq)
+        assert ext > last
+        last = ext
+
+
+def test_unwrapper_pre_start_reorder_keeps_ordering():
+    u = rm.SeqNumUnwrapper()
+    assert u.unwrap(5) == 5
+    # reordered packet from before stream start must not jump to the future
+    assert u.unwrap(65530) == 0
+    assert u.unwrap(6) == 6
