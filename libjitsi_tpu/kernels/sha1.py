@@ -1,0 +1,185 @@
+"""Batched SHA-1 / HMAC-SHA1 as pure-JAX vectorized kernels.
+
+This is the auth half of the SRTP hot path: the reference computes
+HMAC-SHA1-80/32 per packet in `org.jitsi.impl.neomedia.transform.srtp`
+(`HMACSHA1` / OpenSSL JNI under `.srtp.crypto`).  On TPU the per-packet
+loop inverts into one batched computation: `[B, L]` message bytes ->
+`[B, 20]` digests, entirely uint32 VPU bitwise math with no data-dependent
+control flow (variable message lengths are handled by masking), so XLA can
+fuse and tile it.
+
+Design notes
+- The block loop is a `lax.fori_loop` over the *maximum* block count for the
+  buffer width; rows with fewer blocks mask their state updates.  This keeps
+  shapes static under jit at any batch size.
+- The 80-round compression is unrolled at trace time (pure Python loop) —
+  constant trip count, XLA sees straight-line code.
+- HMAC precomputes the ipad/opad midstates per key (host side, tiny) so the
+  device path is exactly two SHA-1 tails; per-packet keys are row-gathered
+  midstates, which is how per-stream SRTP auth keys batch across streams.
+- Messages up to 2^29-1 bytes (bit length fits in 32 bits) — plenty for MTU
+  sized packets; asserted at trace time.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_H0 = np.array([0x67452301, 0xEFCDAB89, 0x98BADCFE, 0x10325476, 0xC3D2E1F0],
+               dtype=np.uint32)
+_K = np.array([0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6], dtype=np.uint32)
+
+BLOCK = 64  # bytes
+DIGEST = 20  # bytes
+
+
+def _rotl(x, n):
+    return (x << np.uint32(n)) | (x >> np.uint32(32 - n))
+
+
+def _compress_block(h, w16):
+    """One SHA-1 compression: h [..., 5] uint32, w16 [..., 16] uint32."""
+    w = [w16[..., t] for t in range(16)]
+    for t in range(16, 80):
+        w.append(_rotl(w[t - 3] ^ w[t - 8] ^ w[t - 14] ^ w[t - 16], 1))
+    a, b, c, d, e = (h[..., i] for i in range(5))
+    for t in range(80):
+        if t < 20:
+            f = (b & c) | (~b & d)
+            k = _K[0]
+        elif t < 40:
+            f = b ^ c ^ d
+            k = _K[1]
+        elif t < 60:
+            f = (b & c) | (b & d) | (c & d)
+            k = _K[2]
+        else:
+            f = b ^ c ^ d
+            k = _K[3]
+        tmp = _rotl(a, 5) + f + e + jnp.uint32(k) + w[t]
+        e, d, c, b, a = d, c, _rotl(b, 30), a, tmp
+    return jnp.stack(
+        [h[..., 0] + a, h[..., 1] + b, h[..., 2] + c, h[..., 3] + d, h[..., 4] + e],
+        axis=-1,
+    )
+
+
+def _pad_and_blockify(data, lengths, bit_offset):
+    """Build padded message blocks: [B, nblk, 16] uint32 + per-row block counts.
+
+    `bit_offset` is added to the encoded bit length (512 for HMAC tails whose
+    key block was already compressed into the midstate).
+    """
+    bsz, width = data.shape
+    max_total = ((width + 9 + BLOCK - 1) // BLOCK) * BLOCK
+    nblk_max = max_total // BLOCK
+    assert width < (1 << 29), "message too long for 32-bit bit-length encoding"
+
+    lengths = lengths.astype(jnp.int32)
+    nblocks = (lengths + 9 + BLOCK - 1) // BLOCK  # per-row used blocks
+    total = nblocks * BLOCK
+
+    idx = jnp.arange(max_total, dtype=jnp.int32)[None, :]
+    ln = lengths[:, None]
+    buf = jnp.zeros((bsz, max_total), dtype=jnp.uint8)
+    buf = buf.at[:, :width].set(data)
+    # zero everything at/after length, then place 0x80 terminator
+    buf = jnp.where(idx < ln, buf, jnp.uint8(0))
+    buf = jnp.where(idx == ln, jnp.uint8(0x80), buf)
+    # 64-bit big-endian bit length in the last 8 bytes of the last used block;
+    # high word is always 0 (width < 2^29).
+    bitlen = (lengths * 8 + bit_offset).astype(jnp.uint32)[:, None]
+    tpos = total[:, None] - 8 + jnp.arange(8, dtype=jnp.int32)[None, :]  # [B, 8]
+    shift = (jnp.uint32(7) - jnp.arange(8, dtype=jnp.uint32)[None, :]) * 8
+    lenbytes = jnp.where(
+        shift >= 32, jnp.uint32(0), (bitlen >> jnp.minimum(shift, 31)) & 0xFF
+    ).astype(jnp.uint8)
+    buf = buf.at[jnp.arange(bsz)[:, None], tpos].set(lenbytes)
+
+    words = buf.reshape(bsz, nblk_max, 16, 4).astype(jnp.uint32)
+    w16 = (
+        (words[..., 0] << 24) | (words[..., 1] << 16) | (words[..., 2] << 8)
+        | words[..., 3]
+    )
+    return w16, nblocks, nblk_max
+
+
+def _sha1_core(w16, nblocks, nblk_max, h0):
+    """Run masked compression over blocks. h0: [B, 5] or [5]."""
+    bsz = w16.shape[0]
+    h = jnp.broadcast_to(h0, (bsz, 5)).astype(jnp.uint32)
+
+    def body(i, h):
+        hn = _compress_block(h, w16[:, i, :])
+        active = (i < nblocks)[:, None]
+        return jnp.where(active, hn, h)
+
+    return jax.lax.fori_loop(0, nblk_max, body, h)
+
+
+def _digest_bytes(h):
+    """[B, 5] uint32 -> [B, 20] uint8 big-endian."""
+    shifts = jnp.array([24, 16, 8, 0], dtype=jnp.uint32)
+    return ((h[:, :, None] >> shifts[None, None, :]) & 0xFF).astype(jnp.uint8).reshape(
+        h.shape[0], DIGEST
+    )
+
+
+@functools.partial(jax.jit, static_argnames=())
+def sha1(data, lengths):
+    """Batched SHA-1.  data: [B, L] uint8; lengths: [B] int. -> [B, 20] uint8."""
+    w16, nblocks, nblk_max = _pad_and_blockify(
+        jnp.asarray(data, dtype=jnp.uint8), jnp.asarray(lengths), 0
+    )
+    h = _sha1_core(w16, nblocks, nblk_max, jnp.asarray(_H0))
+    return _digest_bytes(h)
+
+
+# ---------------------------------------------------------------------------
+# HMAC-SHA1
+# ---------------------------------------------------------------------------
+
+def hmac_precompute(key: bytes) -> np.ndarray:
+    """Host-side: compress ipad/opad blocks once per key.
+
+    Returns a [2, 5] uint32 midstate array (row 0 = inner, row 1 = outer).
+    Per-stream keys stack into [S, 2, 5]; the device path gathers rows by
+    stream id.  (Reference analog: per-`SRTPCryptoContext` derived auth key.)
+    """
+    if len(key) > BLOCK:
+        import hashlib
+
+        key = hashlib.sha1(key).digest()
+    k = np.zeros(BLOCK, dtype=np.uint8)
+    k[: len(key)] = np.frombuffer(key, dtype=np.uint8)
+    states = []
+    for pad in (0x36, 0x5C):
+        blk = (k ^ pad).astype(np.uint32).reshape(16, 4)
+        w16 = (blk[:, 0] << 24) | (blk[:, 1] << 16) | (blk[:, 2] << 8) | blk[:, 3]
+        h = np.asarray(
+            _compress_block(jnp.asarray(_H0), jnp.asarray(w16, dtype=jnp.uint32))
+        )
+        states.append(h)
+    return np.stack(states).astype(np.uint32)
+
+
+@jax.jit
+def hmac_sha1(midstates, data, lengths):
+    """Batched HMAC-SHA1 with precomputed key midstates.
+
+    midstates: [B, 2, 5] uint32 (per-row key, from `hmac_precompute`);
+    data: [B, L] uint8; lengths: [B].  -> [B, 20] uint8 tags.
+    """
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    midstates = jnp.asarray(midstates, dtype=jnp.uint32)
+    # inner: continue from ipad midstate; bit length offset = 512 (key block)
+    w16, nblocks, nblk_max = _pad_and_blockify(data, jnp.asarray(lengths), 512)
+    inner = _digest_bytes(_sha1_core(w16, nblocks, nblk_max, midstates[:, 0, :]))
+    # outer: 20-byte inner digest as message
+    lens20 = jnp.full((data.shape[0],), DIGEST, dtype=jnp.int32)
+    w16o, nbo, nbmo = _pad_and_blockify(inner, lens20, 512)
+    return _digest_bytes(_sha1_core(w16o, nbo, nbmo, midstates[:, 1, :]))

@@ -1,0 +1,81 @@
+import hashlib
+import hmac as hmac_mod
+
+import numpy as np
+
+from libjitsi_tpu.kernels import sha1 as K
+
+
+def _batchify(msgs, width=None):
+    width = width or max((len(m) for m in msgs), default=1) or 1
+    data = np.zeros((len(msgs), width), dtype=np.uint8)
+    lengths = np.zeros((len(msgs),), dtype=np.int32)
+    for i, m in enumerate(msgs):
+        data[i, : len(m)] = np.frombuffer(m, dtype=np.uint8)
+        lengths[i] = len(m)
+    return data, lengths
+
+
+def test_sha1_fips_vectors():
+    msgs = [b"abc", b"", b"abcdbcdecdefdefgefghfghighijhijkijkljklmklmnlmnomnopnopq"]
+    data, lengths = _batchify(msgs, 64)
+    out = np.asarray(K.sha1(data, lengths))
+    for i, m in enumerate(msgs):
+        assert bytes(out[i]) == hashlib.sha1(m).digest(), f"vector {i}"
+
+
+def test_sha1_block_boundaries():
+    # 55/56/57/63/64/65 bytes hit the padding-block split cases
+    msgs = [b"a" * n for n in (0, 1, 55, 56, 57, 63, 64, 65, 119, 120, 128, 200)]
+    data, lengths = _batchify(msgs, 256)
+    out = np.asarray(K.sha1(data, lengths))
+    for i, m in enumerate(msgs):
+        assert bytes(out[i]) == hashlib.sha1(m).digest(), f"len {len(m)}"
+
+
+def test_sha1_random_differential():
+    rng = np.random.default_rng(7)
+    msgs = [
+        bytes(rng.integers(0, 256, size=int(rng.integers(0, 1500)), dtype=np.uint8))
+        for _ in range(64)
+    ]
+    data, lengths = _batchify(msgs, 1504)
+    out = np.asarray(K.sha1(data, lengths))
+    for i, m in enumerate(msgs):
+        assert bytes(out[i]) == hashlib.sha1(m).digest()
+
+
+def test_hmac_rfc2202_vectors():
+    # RFC 2202 test cases 1-7 for HMAC-SHA1
+    cases = [
+        (b"\x0b" * 20, b"Hi There"),
+        (b"Jefe", b"what do ya want for nothing?"),
+        (b"\xaa" * 20, b"\xdd" * 50),
+        (bytes(range(1, 26)), b"\xcd" * 50),
+        (b"\x0c" * 20, b"Test With Truncation"),
+        (b"\xaa" * 80, b"Test Using Larger Than Block-Size Key - Hash Key First"),
+        (
+            b"\xaa" * 80,
+            b"Test Using Larger Than Block-Size Key and Larger Than One Block-Size Data",
+        ),
+    ]
+    mids = np.stack([K.hmac_precompute(k) for k, _ in cases])
+    data, lengths = _batchify([m for _, m in cases], 128)
+    out = np.asarray(K.hmac_sha1(mids, data, lengths))
+    for i, (k, m) in enumerate(cases):
+        expect = hmac_mod.new(k, m, hashlib.sha1).digest()
+        assert bytes(out[i]) == expect, f"RFC2202 case {i + 1}"
+
+
+def test_hmac_per_row_keys_random():
+    rng = np.random.default_rng(11)
+    keys = [bytes(rng.integers(0, 256, size=20, dtype=np.uint8)) for _ in range(32)]
+    msgs = [
+        bytes(rng.integers(0, 256, size=int(rng.integers(1, 1400)), dtype=np.uint8))
+        for _ in range(32)
+    ]
+    mids = np.stack([K.hmac_precompute(k) for k in keys])
+    data, lengths = _batchify(msgs, 1504)
+    out = np.asarray(K.hmac_sha1(mids, data, lengths))
+    for i in range(32):
+        assert bytes(out[i]) == hmac_mod.new(keys[i], msgs[i], hashlib.sha1).digest()
