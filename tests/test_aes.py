@@ -1,0 +1,109 @@
+"""AES kernel KATs (FIPS-197, NIST SP 800-38A) + OpenSSL differential tests."""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.kernels import aes
+
+
+def test_sbox_known_values():
+    assert aes._SBOX[0x00] == 0x63
+    assert aes._SBOX[0x01] == 0x7C
+    assert aes._SBOX[0x53] == 0xED
+    assert aes._SBOX[0xFF] == 0x16
+    # S-box is a permutation
+    assert len(set(aes._SBOX.tolist())) == 256
+
+
+def _encrypt_one(key: bytes, block: bytes) -> bytes:
+    rk = aes.expand_key(key)[None]
+    out = aes.aes_encrypt(rk, np.frombuffer(block, dtype=np.uint8)[None])
+    return bytes(np.asarray(out)[0])
+
+
+def test_fips197_aes128():
+    key = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    assert _encrypt_one(key, pt).hex() == "69c4e0d86a7b0430d8cdb78070b4c55a"
+
+
+def test_fips197_aes256():
+    key = bytes.fromhex(
+        "000102030405060708090a0b0c0d0e0f101112131415161718191a1b1c1d1e1f"
+    )
+    pt = bytes.fromhex("00112233445566778899aabbccddeeff")
+    assert _encrypt_one(key, pt).hex() == "8ea2b7ca516745bfeafc49904b496089"
+
+
+def test_nist_sp800_38a_ctr128():
+    # SP 800-38A F.5.1 CTR-AES128.Encrypt, first two blocks
+    key = bytes.fromhex("2b7e151628aed2a6abf7158809cf4f3c")
+    iv = bytes.fromhex("f0f1f2f3f4f5f6f7f8f9fafbfcfdfeff")
+    pt = bytes.fromhex(
+        "6bc1bee22e409f96e93d7e117393172a"
+        "ae2d8a571e03ac9c9eb76fac45af8e51"
+    )
+    rk = aes.expand_key(key)[None]
+    ks = np.asarray(
+        aes.ctr_keystream(rk, np.frombuffer(iv, dtype=np.uint8)[None], 2)
+    )[0]
+    ct = bytes(a ^ b for a, b in zip(pt, bytes(ks)))
+    assert ct.hex() == (
+        "874d6191b620e3261bef6864990db6ce"
+        "9806f66b7970fdff8617187bb9fffdff"
+    )
+
+
+def test_ctr_counter_carry():
+    """128-bit counter increment must carry across limb boundaries."""
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    key = bytes(range(16))
+    iv = bytes.fromhex("00000000000000000000000000ffffff")  # carries into limb 2
+    rk = aes.expand_key(key)[None]
+    ks = bytes(
+        np.asarray(
+            aes.ctr_keystream(rk, np.frombuffer(iv, dtype=np.uint8)[None], 4)
+        )[0]
+    )
+    enc = Cipher(algorithms.AES(key), modes.CTR(iv)).encryptor()
+    assert ks == enc.update(b"\x00" * 64)
+
+
+@pytest.mark.parametrize("keylen", [16, 32])
+def test_differential_vs_openssl(keylen):
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    rng = np.random.default_rng(1234 + keylen)
+    bsz = 8
+    keys = rng.integers(0, 256, (bsz, keylen), dtype=np.uint8)
+    ivs = rng.integers(0, 256, (bsz, 16), dtype=np.uint8)
+    rk = aes.expand_keys_batch(keys)
+    ks = np.asarray(aes.ctr_keystream(rk, ivs, 8))  # 128 bytes per row
+    for i in range(bsz):
+        enc = Cipher(
+            algorithms.AES(bytes(keys[i])), modes.CTR(bytes(ivs[i]))
+        ).encryptor()
+        assert bytes(ks[i]) == enc.update(b"\x00" * 128), f"row {i}"
+
+
+def test_ctr_crypt_offset_window():
+    """Keystream must align to each row's offset and leave outside bytes."""
+    rng = np.random.default_rng(7)
+    bsz, width = 4, 96
+    keys = rng.integers(0, 256, (bsz, 16), dtype=np.uint8)
+    ivs = rng.integers(0, 256, (bsz, 16), dtype=np.uint8)
+    data = rng.integers(0, 256, (bsz, width), dtype=np.uint8)
+    offset = np.array([12, 16, 0, 40], dtype=np.int32)
+    length = np.array([60, 80, 96, 13], dtype=np.int32)
+    rk = aes.expand_keys_batch(keys)
+    out = np.asarray(aes.ctr_crypt_offset(rk, ivs, data, offset, length))
+    ks = np.asarray(aes.ctr_keystream(rk, ivs, (width + 15) // 16))
+    for i in range(bsz):
+        o, l = int(offset[i]), int(length[i])
+        expect = data[i].copy()
+        expect[o : o + l] ^= ks[i, :l]
+        np.testing.assert_array_equal(out[i], expect)
+    # decrypt round-trips
+    back = np.asarray(aes.ctr_crypt_offset(rk, ivs, out, offset, length))
+    np.testing.assert_array_equal(back, data)
