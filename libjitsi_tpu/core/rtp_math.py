@@ -99,6 +99,64 @@ def update_index_state(seq, v, s_l, roc):
     return s_l, roc
 
 
+def segment_ranks(stream):
+    """Per-stream occurrence rank (0,1,2,...) in stable batch order.
+
+    Shared segment machinery for batched per-stream sequencing (SRTCP index
+    assignment, in-batch chaining).  stream: [B] -> rank [B] int64.
+    """
+    stream = np.asarray(stream, dtype=np.int64)
+    n = len(stream)
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    order = np.lexsort((np.arange(n), stream))
+    s_o = stream[order]
+    first = np.ones(n, dtype=bool)
+    first[1:] = s_o[1:] != s_o[:-1]
+    grp = np.cumsum(first) - 1
+    fpos = np.where(first)[0]
+    rank = np.empty(n, dtype=np.int64)
+    rank[order] = np.arange(n) - fpos[grp]
+    return rank
+
+
+def chain_packet_indices(stream, seq, base_ext):
+    """Batched per-stream sequential packet-index estimation (RFC 3711 App A).
+
+    Estimating every packet of a batch against the *pre-batch* state breaks
+    when one stream wraps its 16-bit seq inside a single batch (e.g. a stream
+    whose random initial seq is near 65535).  This chains the estimate
+    within the batch instead: each packet's 48-bit index extends from the
+    previous packet of the *same stream* in the batch (the first one extends
+    from `base_ext`, the pre-batch per-stream extended index, -1 = unseen).
+    This reproduces the reference's strictly sequential
+    `SRTPCryptoContext.guessIndex` behavior on a whole batch at once —
+    O(B log B) sort + segment prefix-sum, no Python loop.
+
+    stream/seq: [B]; base_ext: [S] int64.  Returns ext [B] int64 (>= 0).
+    """
+    stream = np.asarray(stream, dtype=np.int64)
+    seq = np.asarray(seq, dtype=np.int64)
+    n = len(seq)
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    order = np.lexsort((np.arange(n), stream))
+    s_o, q_o = stream[order], seq[order]
+    first = np.ones(n, dtype=bool)
+    first[1:] = s_o[1:] != s_o[:-1]
+    d = np.zeros(n, dtype=np.int64)
+    d[1:] = np.where(first[1:], 0, seq_delta(q_o[1:], q_o[:-1]))
+    base = base_ext[np.maximum(s_o, 0)]
+    start = np.where(base >= 0, base + seq_delta(q_o, base & 0xFFFF), q_o)
+    grp = np.cumsum(first) - 1
+    fpos = np.where(first)[0]
+    c = np.cumsum(d)
+    ext_o = start[fpos][grp] + (c - c[fpos][grp])
+    ext = np.empty(n, dtype=np.int64)
+    ext[order] = np.maximum(ext_o, 0)
+    return ext
+
+
 class SeqNumUnwrapper:
     """Extend 16-bit sequence numbers to a monotone 64-bit index.
 

@@ -106,6 +106,52 @@ def expand_keys_batch(keys: np.ndarray) -> np.ndarray:
 
 
 # ---------------------------------------------------------------------------
+# Host cipher (NumPy mirror of the device core — cold paths only)
+# ---------------------------------------------------------------------------
+
+def _xtime_np(x):
+    return ((x << 1) ^ (np.uint8(0x1B) * (x >> 7))).astype(np.uint8)
+
+
+def aes_encrypt_np(round_keys: np.ndarray, blocks: np.ndarray) -> np.ndarray:
+    """Host-side batched AES block encrypt (NumPy; mirrors `aes_encrypt`).
+
+    Used by the cold paths that must not touch the device: RFC 3711 key
+    derivation at stream setup, KATs, and the CPU fallback backend (the
+    reference keeps a pure-Java AES fallback beside the OpenSSL JNI path in
+    `.srtp.crypto.Aes`).  round_keys: [R, 16] or [B, R, 16]; blocks: [B, 16].
+    """
+    rk = np.asarray(round_keys, dtype=np.uint8)
+    if rk.ndim == 2:
+        rk = np.broadcast_to(rk, (blocks.shape[0],) + rk.shape)
+    st = np.asarray(blocks, dtype=np.uint8) ^ rk[:, 0, :]
+    nr = rk.shape[1] - 1
+    for r in range(1, nr):
+        st = _SBOX[st][:, _SHIFT_IDX]
+        s = st.reshape(-1, 4, 4)
+        x = _xtime_np(s)
+        r0 = x[..., 0] ^ x[..., 1] ^ s[..., 1] ^ s[..., 2] ^ s[..., 3]
+        r1 = s[..., 0] ^ x[..., 1] ^ x[..., 2] ^ s[..., 2] ^ s[..., 3]
+        r2 = s[..., 0] ^ s[..., 1] ^ x[..., 2] ^ x[..., 3] ^ s[..., 3]
+        r3 = x[..., 0] ^ s[..., 0] ^ s[..., 1] ^ s[..., 2] ^ x[..., 3]
+        st = np.stack([r0, r1, r2, r3], axis=-1).reshape(st.shape) ^ rk[:, r, :]
+    return (_SBOX[st][:, _SHIFT_IDX] ^ rk[:, nr, :]).astype(np.uint8)
+
+
+def ctr_keystream_np(round_keys: np.ndarray, iv16: np.ndarray, nbytes: int) -> np.ndarray:
+    """Host AES-CTR keystream from one IV block: [R,16] keys, [16] iv -> [nbytes]."""
+    nblocks = (nbytes + 15) // 16
+    iv = np.asarray(iv16, dtype=np.uint8)
+    ctrs = np.zeros((nblocks, 16), dtype=np.uint8)
+    val = int.from_bytes(bytes(iv), "big")
+    for j in range(nblocks):
+        ctrs[j] = np.frombuffer(
+            ((val + j) % (1 << 128)).to_bytes(16, "big"), dtype=np.uint8
+        )
+    return aes_encrypt_np(np.asarray(round_keys), ctrs).reshape(-1)[:nbytes]
+
+
+# ---------------------------------------------------------------------------
 # Device cipher core
 # ---------------------------------------------------------------------------
 

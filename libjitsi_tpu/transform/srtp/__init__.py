@@ -1,0 +1,2 @@
+from libjitsi_tpu.transform.srtp.policy import SrtpPolicy, SrtpProfile  # noqa: F401
+from libjitsi_tpu.transform.srtp.context import SrtpStreamTable  # noqa: F401

@@ -1,0 +1,83 @@
+"""RFC 3711 §4.3 session-key derivation (AES-CM PRF), host-side.
+
+Rebuilds the derivation performed at context init by the reference's
+`org.jitsi.impl.neomedia.transform.srtp.SRTPCryptoContext.deriveSrtpKeys` /
+`SRTCPCryptoContext.deriveSrtcpKeys`: session encryption key, authentication
+key and salt are each one short AES-CM keystream keyed by the master key,
+with the IV formed from the master salt, a per-component label, and
+(index DIV key_derivation_rate).
+
+Cold path (runs once per stream / per re-key), so pure NumPy on host; the
+derived keys are then packed into the dense device tensors by
+`SrtpStreamTable`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from libjitsi_tpu.kernels.aes import ctr_keystream_np, expand_key
+
+# RFC 3711 §4.3.1 / §4.3.2 labels
+LABEL_RTP_ENC = 0x00
+LABEL_RTP_AUTH = 0x01
+LABEL_RTP_SALT = 0x02
+LABEL_RTCP_ENC = 0x03
+LABEL_RTCP_AUTH = 0x04
+LABEL_RTCP_SALT = 0x05
+
+
+@dataclasses.dataclass
+class SessionKeys:
+    rtp_enc: bytes
+    rtp_auth: bytes
+    rtp_salt: bytes
+    rtcp_enc: bytes
+    rtcp_auth: bytes
+    rtcp_salt: bytes
+
+
+def _derive_one(
+    round_keys: np.ndarray, master_salt: bytes, label: int, index_over_kdr: int, n: int
+) -> bytes:
+    # x = (label || index DIV kdr) XOR master_salt ; IV = x * 2^16
+    salt = np.zeros(16, dtype=np.uint8)
+    salt[: len(master_salt)] = np.frombuffer(master_salt, dtype=np.uint8)
+    # label sits at byte 7 of the 14-byte salt-aligned value; index DIV kdr
+    # (48-bit) occupies bytes 8..13 (RFC 3711 §4.3.1 key_id layout).
+    key_id = (label << 48) | (index_over_kdr & ((1 << 48) - 1))
+    kid = np.frombuffer(key_id.to_bytes(7, "big"), dtype=np.uint8)
+    iv = salt.copy()
+    iv[7:14] ^= kid
+    return bytes(ctr_keystream_np(round_keys, iv, n))
+
+
+def derive_session_keys(
+    master_key: bytes,
+    master_salt: bytes,
+    *,
+    enc_key_len: int = 16,
+    auth_key_len: int = 20,
+    salt_len: int = 14,
+    kdr: int = 0,
+    index: int = 0,
+    srtcp_index: int = 0,
+) -> SessionKeys:
+    """Derive all six session keys.
+
+    `kdr` (key derivation rate) of 0 means derive once (index DIV kdr == 0),
+    matching the reference's common configuration.
+    """
+    rk = expand_key(master_key)
+    r = (index // kdr) if kdr else 0
+    rc = (srtcp_index // kdr) if kdr else 0
+    return SessionKeys(
+        rtp_enc=_derive_one(rk, master_salt, LABEL_RTP_ENC, r, enc_key_len),
+        rtp_auth=_derive_one(rk, master_salt, LABEL_RTP_AUTH, r, auth_key_len),
+        rtp_salt=_derive_one(rk, master_salt, LABEL_RTP_SALT, r, salt_len),
+        rtcp_enc=_derive_one(rk, master_salt, LABEL_RTCP_ENC, rc, enc_key_len),
+        rtcp_auth=_derive_one(rk, master_salt, LABEL_RTCP_AUTH, rc, auth_key_len),
+        rtcp_salt=_derive_one(rk, master_salt, LABEL_RTCP_SALT, rc, salt_len),
+    )

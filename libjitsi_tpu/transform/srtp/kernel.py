@@ -1,0 +1,194 @@
+"""Batched SRTP/SRTCP protect/unprotect device kernels (JAX).
+
+The per-packet crypto of the reference's
+`org.jitsi.impl.neomedia.transform.srtp.{SRTPCryptoContext,SRTCPCryptoContext}`
+(AES-CM keystream XOR over the payload + HMAC-SHA1 tag over the
+authenticated portion || ROC) inverted into one batched device computation:
+every argument is a per-row array, per-stream key material arrives as
+row-gathered dense tensors, and the whole batch is one fused XLA program.
+
+Host (context.py) is responsible for: index/ROC estimation, replay windows,
+IV construction — the sequential, branchy, tiny-state machine.  Device does
+all the byte crunching.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from libjitsi_tpu.kernels.aes import ctr_crypt_offset
+from libjitsi_tpu.kernels.sha1 import hmac_sha1
+
+
+def _scatter_word(data, pos, word):
+    """Write 4 bytes `word` [B, 4] at per-row byte offset `pos` [B]."""
+    col = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
+    pos = pos[:, None]
+    rel = jnp.clip(col - pos, 0, 3)
+    w = jnp.take_along_axis(word, rel, axis=1)
+    return jnp.where((col >= pos) & (col < pos + 4), w, data)
+
+
+def _scatter_tag(data, pos, tag, tag_len: int):
+    """Write tag[:, :tag_len] at per-row byte offset `pos`."""
+    col = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
+    pos = pos[:, None]
+    rel = jnp.clip(col - pos, 0, tag.shape[1] - 1)
+    t = jnp.take_along_axis(tag, rel, axis=1)
+    return jnp.where((col >= pos) & (col < pos + tag_len), t, data)
+
+
+def _gather_span(data, pos, n: int):
+    """Read n bytes at per-row byte offset `pos` -> [B, n]."""
+    idx = pos[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(idx, 0, data.shape[1] - 1)
+    return jnp.take_along_axis(data, idx, axis=1)
+
+
+def _auth_tags(data, mlen, extra_word, midstates):
+    """HMAC-SHA1 over data[:mlen] || extra_word (4 bytes), per row.
+
+    `_pad_and_blockify` masks bytes at/after the length argument, so stale
+    bytes past `mlen` in `data` never leak into the MAC.
+    """
+    buf = _scatter_word(data, mlen, extra_word)
+    return hmac_sha1(midstates, buf, mlen + 4)
+
+
+def _u32_bytes(x):
+    """[B] int -> [B, 4] uint8 big-endian."""
+    x = x.astype(jnp.uint32)
+    shifts = jnp.array([24, 16, 8, 0], dtype=jnp.uint32)
+    return ((x[:, None] >> shifts[None, :]) & 0xFF).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
+def srtp_protect(
+    data,
+    length,
+    payload_off,
+    round_keys,
+    iv,
+    midstates,
+    roc,
+    tag_len: int,
+    encrypt: bool = True,
+):
+    """Batched SRTP protect (reference: SRTPCryptoContext.transformPacket).
+
+    data [B, W] uint8, length/payload_off [B] int32, round_keys [B, R, 16],
+    iv [B, 16], midstates [B, 2, 5], roc [B] (guessed ROC v per packet).
+    Returns (data', length') with payload encrypted in place and the
+    HMAC-SHA1 tag (truncated to tag_len) appended; the MAC covers
+    header||ciphertext||ROC per RFC 3711 §4.2.
+    """
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    payload_off = jnp.asarray(payload_off, dtype=jnp.int32)
+    if encrypt:
+        data = ctr_crypt_offset(
+            round_keys, iv, data, payload_off, length - payload_off
+        )
+    if tag_len:
+        tags = _auth_tags(data, length, _u32_bytes(jnp.asarray(roc)), midstates)
+        data = _scatter_tag(data, length, tags, tag_len)
+        length = length + tag_len
+    return data, length
+
+
+@functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
+def srtp_unprotect(
+    data,
+    length,
+    payload_off,
+    round_keys,
+    iv,
+    midstates,
+    roc,
+    tag_len: int,
+    encrypt: bool = True,
+):
+    """Batched SRTP unprotect (reference: SRTPCryptoContext.reverseTransformPacket).
+
+    Returns (data', length', auth_ok).  Decrypt always runs (rows that fail
+    auth are masked by the caller — keeps the program branch-free); auth_ok
+    is the constant-pattern tag comparison result per row.
+    """
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    payload_off = jnp.asarray(payload_off, dtype=jnp.int32)
+    mlen = length - tag_len
+    if tag_len:
+        tags = _auth_tags(data, mlen, _u32_bytes(jnp.asarray(roc)), midstates)
+        stored = _gather_span(data, mlen, tag_len)
+        auth_ok = jnp.all(stored == tags[:, :tag_len], axis=1)
+    else:
+        auth_ok = jnp.ones((data.shape[0],), dtype=bool)
+    if encrypt:
+        out = ctr_crypt_offset(round_keys, iv, data, payload_off, mlen - payload_off)
+    else:
+        out = data
+    return out, mlen, auth_ok
+
+
+@functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
+def srtcp_protect(
+    data, length, round_keys, iv, midstates, index_word, tag_len: int,
+    encrypt: bool = True,
+):
+    """Batched SRTCP protect (reference: SRTCPCryptoContext.transformPacket).
+
+    Encrypts everything after the 8-byte header (first RTCP header + sender
+    SSRC stay clear per RFC 3711 §3.4), appends the E||SRTCP-index word
+    (already OR-ed with the E bit by the caller) and the tag.
+    """
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    off = jnp.full_like(length, 8)
+    if encrypt:
+        data = ctr_crypt_offset(round_keys, iv, data, off, length - off)
+    word = _u32_bytes(jnp.asarray(index_word))
+    tags = _auth_tags(data, length, word, midstates)
+    data = _scatter_word(data, length, word)
+    length = length + 4
+    if tag_len:
+        data = _scatter_tag(data, length, tags, tag_len)
+        length = length + tag_len
+    return data, length
+
+
+@functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
+def srtcp_unprotect(
+    data, length, round_keys, iv, midstates, tag_len: int, encrypt: bool = True
+):
+    """Batched SRTCP unprotect.  Returns (data', length', auth_ok, e_bit, index).
+
+    The caller re-derives the IV from the parsed index; this kernel is called
+    twice per batch in principle — in practice the host parses the trailer
+    with NumPy first (cheap column reads) and calls this once with the right
+    IVs; the index/E returned here are for verification.
+    """
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    mlen = length - tag_len - 4  # bytes covered by encryption (packet proper)
+    word = _gather_span(data, mlen, 4).astype(jnp.uint32)
+    index_word = (word[:, 0] << 24) | (word[:, 1] << 16) | (word[:, 2] << 8) | word[:, 3]
+    e_bit = index_word >> 31
+    index = index_word & 0x7FFFFFFF
+    if tag_len:
+        tags = hmac_sha1(midstates, data, mlen + 4)  # MAC covers packet || index word
+        stored = _gather_span(data, mlen + 4, tag_len)
+        auth_ok = jnp.all(stored == tags[:, :tag_len], axis=1)
+    else:
+        auth_ok = jnp.ones((data.shape[0],), dtype=bool)
+    off = jnp.full_like(mlen, 8)
+    if encrypt:
+        out = ctr_crypt_offset(round_keys, iv, data, off, mlen - off)
+        # rows with E=0 were sent unencrypted: pass through
+        out = jnp.where((e_bit == 1)[:, None], out, data)
+    else:
+        out = data
+    return out, mlen, auth_ok, e_bit, index

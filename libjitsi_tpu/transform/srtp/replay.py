@@ -1,0 +1,79 @@
+"""Vectorized anti-replay windows (RFC 3711 §3.3.2), host-side.
+
+The reference keeps a 64-bit `replayWindow` plus highest-index per
+`SRTPCryptoContext`/`SRTCPCryptoContext` instance.  Here the state for all
+S streams is two dense arrays — ``max_index [S] int64`` (highest
+authenticated packet index; -1 = nothing seen) and ``mask [S] uint64``
+(bit k set = index ``max_index - k`` seen) — and both check and update are
+batched NumPy ops over a whole packet batch, including in-batch duplicate
+detection (two copies of one packet arriving in the same batch window must
+still yield exactly one accept).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+WINDOW = 64
+
+
+def check(max_index: np.ndarray, mask: np.ndarray, stream: np.ndarray,
+          index: np.ndarray) -> np.ndarray:
+    """Pre-auth replay check.  True where the packet is NOT a replay.
+
+    max_index/mask: per-stream state [S]; stream/index: per-packet [B].
+    Also rejects in-batch duplicates: for equal (stream, index) pairs only
+    the first occurrence (in batch order) passes.
+    """
+    stream = np.asarray(stream, dtype=np.int64)
+    index = np.asarray(index, dtype=np.int64)
+    mx = max_index[stream]
+    delta = mx - index  # >0: behind the leading edge
+    behind = delta > 0
+    too_old = delta >= WINDOW
+    bit = (mask[stream] >> np.minimum(np.maximum(delta, 0), WINDOW - 1).astype(
+        np.uint64)) & np.uint64(1)
+    seen = behind & ((bit == 1) | too_old)
+    dup_of_max = (mx >= 0) & (index == mx)  # leading edge itself was seen
+    ok = ~(seen | dup_of_max)
+
+    # in-batch duplicates: stable-sort by (stream, index), equal neighbours
+    # after the first are replays
+    order = np.lexsort((np.arange(len(index)), index, stream))
+    s_sorted, i_sorted = stream[order], index[order]
+    dup_sorted = np.zeros(len(index), dtype=bool)
+    if len(index) > 1:
+        dup_sorted[1:] = (s_sorted[1:] == s_sorted[:-1]) & (
+            i_sorted[1:] == i_sorted[:-1])
+    dup = np.zeros(len(index), dtype=bool)
+    dup[order] = dup_sorted
+    return ok & ~dup
+
+
+def update(max_index: np.ndarray, mask: np.ndarray, stream: np.ndarray,
+           index: np.ndarray, accept: np.ndarray) -> None:
+    """Post-auth window update, in place, for packets with accept=True.
+
+    Handles multiple packets per stream per batch: the window slides by the
+    per-stream max accepted index, and every accepted index within WINDOW of
+    the new edge gets its bit set.
+    """
+    stream = np.asarray(stream, dtype=np.int64)[accept]
+    index = np.asarray(index, dtype=np.int64)[accept]
+    if len(stream) == 0:
+        return
+    old_max = max_index.copy()
+    np.maximum.at(max_index, stream, index)
+    # slide masks for streams whose edge advanced
+    touched = np.unique(stream)
+    shift = (max_index[touched] - np.maximum(old_max[touched], 0)).astype(np.int64)
+    shift = np.where(old_max[touched] < 0, np.int64(WINDOW), shift)  # first packets
+    shifted = np.where(
+        shift >= WINDOW, np.uint64(0),
+        mask[touched] << np.minimum(shift, WINDOW - 1).astype(np.uint64))
+    mask[touched] = shifted
+    # set bits for each accepted index relative to the new edge
+    pos = max_index[stream] - index
+    in_win = pos < WINDOW
+    bits = np.where(in_win, np.uint64(1) << pos.astype(np.uint64), np.uint64(0))
+    np.bitwise_or.at(mask, stream, bits)

@@ -2,7 +2,12 @@
 
 Multi-chip TPU hardware is not available in CI; per the build contract the
 sharded paths are validated on a virtual CPU mesh
-(`--xla_force_host_platform_device_count=8`).  Must run before jax imports.
+(`--xla_force_host_platform_device_count=8`).
+
+Note: this environment's sitecustomize imports jax at interpreter start
+with JAX_PLATFORMS=axon (the TPU tunnel), so mutating os.environ here is
+too late for the platform choice — use jax.config.update, which still
+takes effect because no backend has been initialized before conftest runs.
 """
 
 import os
@@ -12,4 +17,8 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
