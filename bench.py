@@ -1,0 +1,108 @@
+"""Headline benchmark: SRTP protect throughput at 10k streams on one chip.
+
+Mirrors BASELINE.json's metric ("SRTP packets/sec/chip @ 10k streams") and
+config #1's CPU reference: the vs_baseline denominator is a single-thread
+OpenSSL SRTP protect (AES-128-CTR + HMAC-SHA1-80 via the `cryptography`
+package — the same libcrypto the reference's fastest JNI provider binds).
+
+Prints exactly one JSON line.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+N_STREAMS = 10_240
+BATCH = 2048
+WIDTH = 192          # capacity; 20 ms Opus packet ≈ 12B header + 160B payload
+PKT_LEN = 172
+TAG_LEN = 10
+ITERS = 20
+
+
+def tpu_pps() -> tuple[float, float]:
+    import jax
+    import jax.numpy as jnp
+
+    from libjitsi_tpu.transform.srtp import kernel
+
+    rng = np.random.default_rng(3)
+    tab_rk = rng.integers(0, 256, (N_STREAMS, 11, 16), dtype=np.uint8)
+    tab_mid = rng.integers(0, 2**32, (N_STREAMS, 2, 5), dtype=np.uint64
+                           ).astype(np.uint32)
+    stream = rng.integers(0, N_STREAMS, BATCH).astype(np.int32)
+    data = rng.integers(0, 256, (BATCH, WIDTH), dtype=np.uint8)
+    length = np.full(BATCH, PKT_LEN, dtype=np.int32)
+    payload_off = np.full(BATCH, 12, dtype=np.int32)
+    iv = rng.integers(0, 256, (BATCH, 16), dtype=np.uint8)
+    roc = np.zeros(BATCH, dtype=np.uint32)
+
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=())
+    def step(tab_rk, tab_mid, stream, data, length, payload_off, iv, roc):
+        return kernel.srtp_protect(
+            data, length, payload_off, tab_rk[stream], iv, tab_mid[stream],
+            roc, TAG_LEN, True)
+
+    args = [jnp.asarray(a) for a in
+            (tab_rk, tab_mid, stream, data, length, payload_off, iv, roc)]
+    out = step(*args)
+    jax.block_until_ready(out)          # compile
+    lat = []
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        t1 = time.perf_counter()
+        out = step(*args)
+        jax.block_until_ready(out)
+        lat.append(time.perf_counter() - t1)
+    dt = time.perf_counter() - t0
+    pps = BATCH * ITERS / dt
+    p99_ms = float(np.percentile(np.asarray(lat), 99) * 1e3)
+    return pps, p99_ms
+
+
+def cpu_pps() -> float:
+    """Single-thread OpenSSL SRTP protect (keystream XOR + HMAC-SHA1-80)."""
+    import hmac as pyhmac
+    import hashlib
+
+    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+
+    rng = np.random.default_rng(4)
+    n = 2000
+    pkts = [rng.integers(0, 256, PKT_LEN, dtype=np.uint8).tobytes()
+            for _ in range(n)]
+    keys = [rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+            for _ in range(64)]
+    akeys = [rng.integers(0, 256, 20, dtype=np.uint8).tobytes()
+             for _ in range(64)]
+    iv = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+    t0 = time.perf_counter()
+    for i, p in enumerate(pkts):
+        enc = Cipher(algorithms.AES(keys[i % 64]), modes.CTR(iv)).encryptor()
+        ct = p[:12] + enc.update(p[12:]) + enc.finalize()
+        tag = pyhmac.new(akeys[i % 64], ct + b"\x00\x00\x00\x00",
+                         hashlib.sha1).digest()[:TAG_LEN]
+        _ = ct + tag
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    pps, p99_ms = tpu_pps()
+    base = cpu_pps()
+    print(json.dumps({
+        "metric": "srtp_protect_pps_at_10k_streams",
+        "value": round(pps, 1),
+        "unit": "packets/sec/chip",
+        "vs_baseline": round(pps / base, 3),
+        "extra": {"batch": BATCH, "pkt_len": PKT_LEN, "p99_batch_ms":
+                  round(p99_ms, 3), "cpu_openssl_pps": round(base, 1)},
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+from libjitsi_tpu.conference.mixer import AudioMixer, mix_minus  # noqa: F401

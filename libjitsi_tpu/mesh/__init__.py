@@ -1,0 +1,6 @@
+from libjitsi_tpu.mesh.sharded import (  # noqa: F401
+    make_media_mesh,
+    sharded_mix_minus,
+    sharded_srtp_protect,
+    sharded_media_step,
+)

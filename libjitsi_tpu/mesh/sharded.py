@@ -1,0 +1,132 @@
+"""Multi-chip execution: stream sharding + cross-chip mixer collective.
+
+The reference is a single-process library whose "distributed backend" is
+UDP sockets (SURVEY §2.7); scaling libjitsi means running more JVMs.  The
+TPU rebuild scales inside the framework instead, with the two parallel
+axes BASELINE.json asks for:
+
+- **streams axis (data parallel)**: per-stream crypto state and packet
+  batches are sharded across chips.  SRTP is row-local (each packet's key
+  material travels with its row), so protect/unprotect needs *no*
+  collectives — XLA just partitions the batch over ICI-connected chips.
+- **participants axis (the mixer collective)**: the conference mix's
+  ``total = sum_j pcm_j`` becomes a `psum` over the mesh axis when one
+  conference's participants live on different chips (the reference's
+  single-threaded `AudioMixer` loop has no analog — this is the part that
+  makes 1k-participant rooms possible).
+
+Everything is expressed with `shard_map` over a 1-D `Mesh` whose axis is
+named ``"streams"``; multi-host DCN scale-out reuses the same code with a
+2-D ``(dcn, streams)`` mesh (partition streams by host first).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from libjitsi_tpu.conference.mixer import I16_MAX, I16_MIN, audio_levels
+from libjitsi_tpu.transform.srtp import kernel
+
+AXIS = "streams"
+
+
+def make_media_mesh(devices=None) -> Mesh:
+    """1-D mesh over all (or the given) devices, axis name "streams"."""
+    if devices is None:
+        devices = jax.devices()
+    return Mesh(np.asarray(devices), (AXIS,))
+
+
+# --------------------------------------------------------------------- SRTP
+
+def sharded_srtp_protect(mesh: Mesh, tag_len: int = 10, encrypt: bool = True):
+    """Returns a jitted batch-sharded SRTP protect.
+
+    All row arguments are sharded on the batch axis; key material is
+    pre-gathered per row (``round_keys [B, R, 16]``, ``midstates
+    [B, 2, 5]``) so the computation is embarrassingly parallel across
+    chips.  The host control plane keeps each stream's packets on the
+    chip that owns the stream's row range, so the gather never crosses
+    ICI.
+    """
+    fn = functools.partial(kernel.srtp_protect, tag_len=tag_len,
+                           encrypt=encrypt)
+    row = P(AXIS)
+    specs = (P(AXIS, None), row, row, P(AXIS, None, None), P(AXIS, None),
+             P(AXIS, None, None), row)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=specs, out_specs=(P(AXIS, None), row),
+        check_vma=False,
+    ))
+
+
+# -------------------------------------------------------------------- mixer
+
+def sharded_mix_minus(mesh: Mesh):
+    """Returns a jitted mixer whose participant axis spans the mesh.
+
+    pcm int16 [N, F] and active bool [N] sharded on N; per-shard partial
+    sums are combined with one `psum` over ICI, then subtract-self/clip
+    run shard-locally.  Output sharding matches input row sharding.
+    """
+
+    def _mix(pcm, active):
+        pcm = pcm.astype(jnp.int32)
+        contrib = jnp.where(active[:, None], pcm, 0)
+        local = jnp.sum(contrib, axis=0, keepdims=True)
+        total = jax.lax.psum(local, AXIS)
+        out = jnp.clip(total - contrib, I16_MIN, I16_MAX).astype(jnp.int16)
+        return out, audio_levels(pcm, active)
+
+    return jax.jit(jax.shard_map(
+        _mix, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS)),
+        out_specs=(P(AXIS, None), P(AXIS)), check_vma=False,
+    ))
+
+
+# ---------------------------------------------------------- full media step
+
+def sharded_media_step(mesh: Mesh, tag_len: int = 10):
+    """One full conference tick, jitted over the mesh — the framework's
+    "training step" equivalent (used by the driver's multi-chip dry run).
+
+    Per chip-local shard: SRTP-unprotect the inbound batch, mix the
+    decoded PCM with the cross-chip psum, SRTP-protect the outbound
+    batch.  Packet rows and participant rows use the same axis (a
+    participant's media stays on its owning chip end to end).
+    """
+
+    def _step(data, length, payload_off, round_keys, iv, midstates, roc,
+              pcm, active,
+              out_data, out_length, out_payload_off, out_rk, out_iv,
+              out_mid, out_roc):
+        dec, dec_len, auth_ok = kernel.srtp_unprotect(
+            data, length, payload_off, round_keys, iv, midstates, roc,
+            tag_len, True)
+        pcm = pcm.astype(jnp.int32)
+        contrib = jnp.where(active[:, None], pcm, 0)
+        total = jax.lax.psum(jnp.sum(contrib, axis=0, keepdims=True), AXIS)
+        mixed = jnp.clip(total - contrib, I16_MIN, I16_MAX).astype(jnp.int16)
+        levels = audio_levels(pcm, active)
+        enc, enc_len = kernel.srtp_protect(
+            out_data, out_length, out_payload_off, out_rk, out_iv, out_mid,
+            out_roc, tag_len, True)
+        return dec, dec_len, auth_ok, mixed, levels, enc, enc_len
+
+    row = P(AXIS)
+    mat = P(AXIS, None)
+    key3 = P(AXIS, None, None)
+    in_specs = (mat, row, row, key3, mat, key3, row,
+                mat, row,
+                mat, row, row, key3, mat, key3, row)
+    out_specs = (mat, row, row, mat, row, mat, row)
+    return jax.jit(jax.shard_map(
+        _step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False,
+    ))

@@ -1,0 +1,71 @@
+"""Conference mixer: mix-minus math, clipping, RFC 6465 levels.
+
+Reference behavior under test: org.jitsi.impl.neomedia.conference.AudioMixer
+(total-sum-minus-self with int16 saturation) and
+org.jitsi.impl.neomedia.audiolevel.AudioLevelCalculator (0..127 dBov).
+"""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.conference import AudioMixer, mix_minus
+
+
+def test_mix_minus_matches_naive():
+    rng = np.random.default_rng(1)
+    n, f = 16, 160
+    pcm = rng.integers(-1000, 1000, (n, f)).astype(np.int16)
+    out, levels = mix_minus(pcm)
+    out = np.asarray(out, dtype=np.int64)
+    for i in range(n):
+        want = pcm.astype(np.int64).sum(axis=0) - pcm[i]
+        np.testing.assert_array_equal(out[i], want)
+
+
+def test_mix_minus_saturates():
+    pcm = np.full((4, 8), 30000, dtype=np.int16)
+    out, _ = mix_minus(pcm)
+    assert np.all(np.asarray(out) == 32767)
+    pcm = np.full((4, 8), -30000, dtype=np.int16)
+    out, _ = mix_minus(pcm)
+    assert np.all(np.asarray(out) == -32768)
+
+
+def test_inactive_rows_excluded_but_hear_all():
+    pcm = np.stack([np.full(8, 100, np.int16),
+                    np.full(8, 200, np.int16),
+                    np.full(8, 999, np.int16)])  # row 2 inactive
+    active = np.array([True, True, False])
+    out, levels = mix_minus(pcm, active)
+    out = np.asarray(out)
+    assert np.all(out[0] == 200)
+    assert np.all(out[1] == 100)
+    assert np.all(out[2] == 300)          # full mix, self not in it
+    assert levels[2] == 127               # inactive reports silence
+
+
+def test_levels_scale():
+    f = 480
+    full = (np.sin(np.linspace(0, 40 * np.pi, f)) * 32767).astype(np.int16)
+    quiet = (full / 1000).astype(np.int16)
+    silent = np.zeros(f, np.int16)
+    _, levels = mix_minus(np.stack([full, quiet, silent]))
+    levels = np.asarray(levels)
+    assert levels[0] <= 5                  # ~ -3 dBov sine
+    assert 55 <= levels[1] <= 75           # ~ -63 dBov
+    assert levels[2] == 127
+
+
+def test_audio_mixer_device():
+    m = AudioMixer(capacity=8, frame_samples=16)
+    m.add_participant(0)
+    m.add_participant(1)
+    m.push(0, np.full(16, 10, np.int16))
+    m.push(1, np.full(16, 20, np.int16))
+    out, levels = m.mix()
+    assert np.all(out[0] == 20) and np.all(out[1] == 10)
+    # frames are consumed: next tick without push mixes silence
+    out, _ = m.mix()
+    assert np.all(out[:2] == 0)
+    with pytest.raises(ValueError):
+        m.push(0, np.zeros(8, np.int16))
