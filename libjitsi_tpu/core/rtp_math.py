@@ -99,22 +99,36 @@ def update_index_state(seq, v, s_l, roc):
     return s_l, roc
 
 
-def segment_ranks(stream):
-    """Per-stream occurrence rank (0,1,2,...) in stable batch order.
+def _segments(stream):
+    """Stable segmentation of a stream-id vector.
 
-    Shared segment machinery for batched per-stream sequencing (SRTCP index
-    assignment, in-batch chaining).  stream: [B] -> rank [B] int64.
+    Returns ``(order, s_o, first, grp, fpos)``: the stable sort order by
+    stream id, sorted ids, first-of-segment flags, segment index per sorted
+    position, and first-position of each segment.  Shared by every batched
+    per-stream sequencing op (rank assignment, in-batch index chaining).
     """
     stream = np.asarray(stream, dtype=np.int64)
     n = len(stream)
-    if n == 0:
-        return np.zeros(0, dtype=np.int64)
     order = np.lexsort((np.arange(n), stream))
     s_o = stream[order]
     first = np.ones(n, dtype=bool)
     first[1:] = s_o[1:] != s_o[:-1]
     grp = np.cumsum(first) - 1
     fpos = np.where(first)[0]
+    return order, s_o, first, grp, fpos
+
+
+def segment_ranks(stream):
+    """Per-stream occurrence rank (0,1,2,...) in stable batch order.
+
+    Used for batched per-stream sequencing (SRTCP index assignment).
+    stream: [B] -> rank [B] int64.
+    """
+    stream = np.asarray(stream, dtype=np.int64)
+    n = len(stream)
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    order, _, _, grp, fpos = _segments(stream)
     rank = np.empty(n, dtype=np.int64)
     rank[order] = np.arange(n) - fpos[grp]
     return rank

@@ -56,6 +56,11 @@ def _u32_fixed(data, off: int):
     return (_u16_fixed(data, off) << 16) | _u16_fixed(data, off + 2)
 
 
+# public alias: big-endian u32 column read at a constant offset (used by
+# SRTCP's SSRC extraction and other fixed-layout parsers)
+read_u32 = _u32_fixed
+
+
 def parse(batch: PacketBatch) -> RtpHeaders:
     """Parse all RTP headers in the batch (vectorized, no per-packet loop)."""
     d = batch.data

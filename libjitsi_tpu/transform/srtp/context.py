@@ -156,6 +156,20 @@ class SrtpStreamTable:
             )
         return self._dev
 
+    def _require_active(self, stream: np.ndarray) -> None:
+        """Protect-path guard: every row must map to an installed stream.
+
+        Unmapped rows (stream=-1, the PacketBatch default) would otherwise
+        wrap via negative indexing and corrupt another row's tx state; the
+        reference throws for a missing forward context likewise.
+        """
+        bad = (stream < 0) | (stream >= self.capacity) | ~self.active[
+            np.clip(stream, 0, self.capacity - 1)]
+        if np.any(bad):
+            raise KeyError(
+                f"protect on unmapped/inactive stream ids "
+                f"{np.unique(stream[bad]).tolist()}")
+
     # ------------------------------------------------------------------ IVs
     def _cm_iv(self, salt16: np.ndarray, ssrc: np.ndarray,
                index: np.ndarray) -> np.ndarray:
@@ -177,6 +191,7 @@ class SrtpStreamTable:
         """
         hdr = rtp_header.parse(batch)
         stream = np.asarray(batch.stream, dtype=np.int64)
+        self._require_active(stream)
         max_len = int(np.max(batch.length, initial=0))
         if max_len + self.policy.auth_tag_len > batch.capacity:
             raise ValueError(
@@ -241,6 +256,10 @@ class SrtpStreamTable:
             jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
             p.auth_tag_len, p.cipher != Cipher.NULL)
         ok = valid & not_replayed & np.asarray(auth_ok)
+        # in-batch duplicate indices: keep the first *authenticated*
+        # occurrence (a forged front-runner fails auth and must not block
+        # the genuine copy later in the batch)
+        ok &= ~replay.dedup_first(stream, idx, ok)
         replay.update(self.rx_max, self.rx_mask, stream, idx, ok)
 
         data = np.asarray(data)
@@ -258,6 +277,7 @@ class SrtpStreamTable:
         session encrypts (RFC 3711 §3.4).
         """
         stream = np.asarray(batch.stream, dtype=np.int64)
+        self._require_active(stream)
         max_len = int(np.max(batch.length, initial=0))
         if max_len + 4 + self.policy.auth_tag_len > batch.capacity:
             raise ValueError(
@@ -265,11 +285,7 @@ class SrtpStreamTable:
                 f"{batch.capacity}")
         # per-stream sequential index assignment, stable in batch order
         index = self.rtcp_tx_index[stream] + 1 + segment_ranks(stream)
-
-        ssrc = (batch.data[:, 4].astype(np.int64) << 24) | \
-               (batch.data[:, 5].astype(np.int64) << 16) | \
-               (batch.data[:, 6].astype(np.int64) << 8) | \
-               batch.data[:, 7].astype(np.int64)
+        ssrc = rtp_header.read_u32(batch.data, 4)
         iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
         encrypting = self.policy.cipher != Cipher.NULL
         e = np.int64(1 << 31) if encrypting else np.int64(0)
@@ -302,10 +318,7 @@ class SrtpStreamTable:
             word = (word << 8) | np.take_along_axis(
                 batch.data, col[:, None].astype(np.int32), axis=1)[:, 0]
         index = word & 0x7FFFFFFF
-        ssrc = (batch.data[:, 4].astype(np.int64) << 24) | \
-               (batch.data[:, 5].astype(np.int64) << 16) | \
-               (batch.data[:, 6].astype(np.int64) << 8) | \
-               batch.data[:, 7].astype(np.int64)
+        ssrc = rtp_header.read_u32(batch.data, 4)
         not_replayed = replay.check(self.rtcp_rx_max, self.rtcp_rx_mask,
                                     stream, index)
         iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
@@ -316,6 +329,7 @@ class SrtpStreamTable:
             jnp.asarray(batch.data), jnp.asarray(length), jnp.asarray(iv),
             p.auth_tag_len, p.cipher != Cipher.NULL)
         ok = valid & not_replayed & np.asarray(auth_ok)
+        ok &= ~replay.dedup_first(stream, index, ok)
         replay.update(self.rtcp_rx_max, self.rtcp_rx_mask, stream, index, ok)
 
         data = np.asarray(data)

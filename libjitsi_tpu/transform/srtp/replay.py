@@ -19,11 +19,14 @@ WINDOW = 64
 
 def check(max_index: np.ndarray, mask: np.ndarray, stream: np.ndarray,
           index: np.ndarray) -> np.ndarray:
-    """Pre-auth replay check.  True where the packet is NOT a replay.
+    """Pre-auth replay check against the window.  True = NOT a replay.
 
     max_index/mask: per-stream state [S]; stream/index: per-packet [B].
-    Also rejects in-batch duplicates: for equal (stream, index) pairs only
-    the first occurrence (in batch order) passes.
+    In-batch duplicates are NOT handled here: that must happen after
+    authentication (`dedup_first` on the auth-passing rows), otherwise a
+    forged copy front-running the genuine packet in the same batch would
+    knock out the authentic one — the reference only marks indices seen
+    *after* auth (SRTPCryptoContext.checkReplay/update order).
     """
     stream = np.asarray(stream, dtype=np.int64)
     index = np.asarray(index, dtype=np.int64)
@@ -35,19 +38,30 @@ def check(max_index: np.ndarray, mask: np.ndarray, stream: np.ndarray,
         np.uint64)) & np.uint64(1)
     seen = behind & ((bit == 1) | too_old)
     dup_of_max = (mx >= 0) & (index == mx)  # leading edge itself was seen
-    ok = ~(seen | dup_of_max)
+    return ~(seen | dup_of_max)
 
-    # in-batch duplicates: stable-sort by (stream, index), equal neighbours
-    # after the first are replays
-    order = np.lexsort((np.arange(len(index)), index, stream))
-    s_sorted, i_sorted = stream[order], index[order]
-    dup_sorted = np.zeros(len(index), dtype=bool)
-    if len(index) > 1:
-        dup_sorted[1:] = (s_sorted[1:] == s_sorted[:-1]) & (
-            i_sorted[1:] == i_sorted[:-1])
-    dup = np.zeros(len(index), dtype=bool)
-    dup[order] = dup_sorted
-    return ok & ~dup
+
+def dedup_first(stream: np.ndarray, index: np.ndarray,
+                candidate: np.ndarray) -> np.ndarray:
+    """True where a row duplicates an EARLIER candidate row's (stream, index).
+
+    Applied to the auth-passing rows of one batch so exactly one copy of a
+    packet index is accepted; rows with candidate=False never block others
+    and are never marked.
+    """
+    stream = np.asarray(stream, dtype=np.int64)
+    index = np.asarray(index, dtype=np.int64)
+    dup = np.zeros(len(stream), dtype=bool)
+    rows = np.where(np.asarray(candidate, dtype=bool))[0]
+    if len(rows) < 2:
+        return dup
+    s, i = stream[rows], index[rows]
+    order = np.lexsort((rows, i, s))
+    s_o, i_o = s[order], i[order]
+    d = np.zeros(len(rows), dtype=bool)
+    d[1:] = (s_o[1:] == s_o[:-1]) & (i_o[1:] == i_o[:-1])
+    dup[rows[order]] = d
+    return dup
 
 
 def update(max_index: np.ndarray, mask: np.ndarray, stream: np.ndarray,

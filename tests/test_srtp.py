@@ -314,3 +314,34 @@ def test_snapshot_restore_preserves_replay_and_roc():
     p6 = t_tx.protect_rtp(PacketBatch.from_payloads([rtp_pkt(5)], stream=[0]))
     _, ok = t_rx2.unprotect_rtp(p6)
     assert ok.all()
+
+
+def test_forged_frontrunner_does_not_block_genuine_duplicate_index():
+    """A forged copy of a packet arriving EARLIER in the same batch must not
+    knock out the authentic one (post-auth dedup, not pre-auth)."""
+    t_tx, t_rx = make_table(), make_table()
+    p = rtp_pkt(700)
+    prot = t_tx.protect_rtp(PacketBatch.from_payloads([p], stream=[0]))
+    genuine = prot.to_bytes(0)
+    forged = bytearray(genuine)
+    forged[14] ^= 0xFF  # corrupt payload -> auth fails, same seq/ssrc
+    batch = PacketBatch.from_payloads([bytes(forged), genuine], stream=[0, 0])
+    dec, ok = t_rx.unprotect_rtp(batch)
+    assert not ok[0] and ok[1]
+    assert dec.to_bytes(1) == p
+
+
+def test_protect_rejects_unmapped_stream():
+    """Protect must raise on stream=-1 / inactive rows instead of silently
+    corrupting another row's tx state via negative indexing."""
+    t = make_table(n=4)
+    t.remove_stream(3)
+    before = t.tx_ext.copy()
+    p = rtp_pkt(1)
+    with pytest.raises(KeyError):
+        t.protect_rtp(PacketBatch.from_payloads([p], stream=[-1]))
+    with pytest.raises(KeyError):
+        t.protect_rtp(PacketBatch.from_payloads([p], stream=[3]))  # inactive
+    with pytest.raises(KeyError):
+        t.protect_rtp(PacketBatch.from_payloads([p], stream=[99]))  # range
+    np.testing.assert_array_equal(t.tx_ext, before)
