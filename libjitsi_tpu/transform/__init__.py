@@ -1,0 +1,13 @@
+from libjitsi_tpu.transform.engine import (  # noqa: F401
+    PacketTransformer,
+    TransformEngine,
+    TransformEngineChain,
+)
+from libjitsi_tpu.transform.header_ext import (  # noqa: F401
+    AbsSendTimeEngine,
+    CsrcAudioLevelEngine,
+    PayloadTypeTransformEngine,
+    SsrcRewriteEngine,
+    TransportCCEngine,
+)
+from libjitsi_tpu.transform.srtp.engine import SrtpTransformEngine  # noqa: F401

@@ -1,0 +1,145 @@
+"""Transform pipeline core: batched engines and chain composition.
+
+Rebuilds the reference's central plugin surface —
+`org.jitsi.impl.neomedia.transform.{TransformEngine,PacketTransformer,
+TransformEngineChain,SinglePacketTransformer}` — with the per-packet
+virtual calls inverted into batched functions:
+
+- a `PacketTransformer` maps a whole `PacketBatch` to a transformed batch
+  plus a per-row keep mask (the reference signals "drop" by returning
+  null from `transform()`; here a False row is the same verdict without
+  losing batch shape);
+- a `TransformEngine` pairs an RTP and an RTCP transformer;
+- `TransformEngineChain` composes engines: send direction runs engines in
+  order, receive direction in reverse order (reference:
+  TransformEngineChain.getRTPTransformer's forward/reverse iteration).
+
+Rows dropped by an earlier engine still flow through later engines (shape
+is static under jit) but their mask bit is off and the I/O layer discards
+them at scatter time; engines may use the mask to skip state updates.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from libjitsi_tpu.core.packet import PacketBatch
+
+Mask = np.ndarray  # bool [B]
+
+
+class PacketTransformer:
+    """Batched transformer: PacketBatch -> (PacketBatch, keep mask).
+
+    Reference: org.jitsi.impl.neomedia.transform.PacketTransformer (the
+    batch `RawPacket[]` variant — the reference's API is already plural;
+    `SinglePacketTransformer` is its per-packet adapter, which has no
+    analog here because everything is batched).
+    """
+
+    def transform(self, batch: PacketBatch,
+                  mask: Optional[Mask] = None) -> Tuple[PacketBatch, Mask]:
+        """Outbound direction.  Default: identity."""
+        return batch, _ones(batch) if mask is None else mask
+
+    def reverse_transform(self, batch: PacketBatch,
+                          mask: Optional[Mask] = None
+                          ) -> Tuple[PacketBatch, Mask]:
+        """Inbound direction.  Default: identity."""
+        return batch, _ones(batch) if mask is None else mask
+
+    def close(self) -> None:
+        pass
+
+
+def _ones(batch: PacketBatch) -> Mask:
+    return np.ones(batch.batch_size, dtype=bool)
+
+
+class TransformEngine:
+    """An RTP + RTCP transformer pair (reference: TransformEngine)."""
+
+    @property
+    def rtp_transformer(self) -> Optional[PacketTransformer]:
+        return None
+
+    @property
+    def rtcp_transformer(self) -> Optional[PacketTransformer]:
+        return None
+
+    def close(self) -> None:
+        for t in (self.rtp_transformer, self.rtcp_transformer):
+            if t is not None:
+                t.close()
+
+
+class _ChainTransformer(PacketTransformer):
+    """Composes the per-engine transformers of a chain, with error/drop
+    accounting per engine (reference: TransformEngineChain's packet loop +
+    SinglePacketTransformer's exception counting)."""
+
+    def __init__(self, transformers: Sequence[Tuple[str, PacketTransformer]]):
+        self._ts = list(transformers)
+        self.dropped = {name: 0 for name, _ in self._ts}
+
+    def transform(self, batch, mask=None):
+        mask = _ones(batch) if mask is None else mask.copy()
+        for name, t in self._ts:
+            before = mask.sum()
+            batch, ok = t.transform(batch, mask)
+            mask &= ok
+            self.dropped[name] += int(before - mask.sum())
+        return batch, mask
+
+    def reverse_transform(self, batch, mask=None):
+        mask = _ones(batch) if mask is None else mask.copy()
+        for name, t in reversed(self._ts):
+            before = mask.sum()
+            batch, ok = t.reverse_transform(batch, mask)
+            mask &= ok
+            self.dropped[name] += int(before - mask.sum())
+        return batch, mask
+
+
+class TransformEngineChain(TransformEngine):
+    """Ordered engine composition (reference: TransformEngineChain).
+
+    The send path runs `engines` first-to-last; the receive path runs
+    them last-to-first — so with SRTP last, outgoing packets are
+    encrypted as the final step and incoming are decrypted first, exactly
+    the reference's chain discipline.
+    """
+
+    def __init__(self, engines: Sequence[TransformEngine],
+                 names: Optional[Sequence[str]] = None):
+        self.engines = list(engines)
+        names = list(names) if names is not None else [
+            type(e).__name__ for e in self.engines]
+        self._rtp = _ChainTransformer(
+            [(n, e.rtp_transformer) for n, e in zip(names, self.engines)
+             if e.rtp_transformer is not None])
+        self._rtcp = _ChainTransformer(
+            [(n, e.rtcp_transformer) for n, e in zip(names, self.engines)
+             if e.rtcp_transformer is not None])
+
+    @property
+    def rtp_transformer(self) -> PacketTransformer:
+        return self._rtp
+
+    @property
+    def rtcp_transformer(self) -> PacketTransformer:
+        return self._rtcp
+
+    @property
+    def drop_counts(self) -> dict:
+        """Per-engine drop counters {name: count} summed over directions."""
+        out = dict(self._rtp.dropped)
+        for k, v in self._rtcp.dropped.items():
+            out[k] = out.get(k, 0) + v
+        return out
+
+    def close(self) -> None:
+        for e in self.engines:
+            e.close()

@@ -1,0 +1,177 @@
+"""Transform chain composition + header-extension engines.
+
+Reference behaviors under test: TransformEngineChain ordering (send runs
+engines first→last, receive last→first, SRTP outermost on the wire),
+AbsSendTimeEngine/TransportCCEngine/CsrcAudioLevel stamping, PT remap,
+SSRC rewrite, and the RFC 5285 one-byte extension codec.
+"""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.rtp import ext as rtp_ext
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.transform import (
+    AbsSendTimeEngine,
+    CsrcAudioLevelEngine,
+    PayloadTypeTransformEngine,
+    SrtpTransformEngine,
+    SsrcRewriteEngine,
+    TransformEngineChain,
+    TransportCCEngine,
+)
+from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+MK, MS = bytes(range(16)), bytes(range(100, 114))
+
+
+def make_batch(n=4, seq0=100, ssrc=0x42, stream=0):
+    return rtp_header.build(
+        [b"payload-%02d" % i for i in range(n)],
+        [seq0 + i for i in range(n)], [0] * n, [ssrc] * n, [96] * n,
+        stream=[stream] * n)
+
+
+def make_srtp(n=8):
+    tx, rx = SrtpStreamTable(capacity=n), SrtpStreamTable(capacity=n)
+    for t in (tx, rx):
+        for i in range(n):
+            t.add_stream(i, MK, MS)
+    return SrtpTransformEngine(tx, rx)
+
+
+# --------------------------------------------------------- one-byte exts ---
+
+def test_ext_set_and_find_fresh():
+    b = make_batch()
+    hdr = rtp_header.parse(b)
+    pay = np.tile(np.array([1, 2, 3], np.uint8), (b.batch_size, 1))
+    out = rtp_ext.set_one_byte_ext(b, hdr, 3, pay)
+    h2 = rtp_header.parse(out)
+    assert np.all(h2.extension == 1)
+    assert np.all(h2.ext_profile == 0xBEDE)
+    off, ln, found = rtp_ext.find_one_byte_ext(out, h2, 3)
+    assert found.all() and np.all(ln == 3)
+    got = np.stack([out.data[i, off[i]:off[i] + 3] for i in range(4)])
+    np.testing.assert_array_equal(got, pay)
+    # payload follows intact
+    assert out.to_bytes(0).endswith(b"payload-00")
+
+
+def test_ext_append_to_existing_block_and_rewrite():
+    b = make_batch()
+    hdr = rtp_header.parse(b)
+    p1 = np.full((4, 2), 7, np.uint8)
+    out = rtp_ext.set_one_byte_ext(b, hdr, 2, p1)
+    # append a second element
+    h2 = rtp_header.parse(out)
+    p2 = np.full((4, 3), 9, np.uint8)
+    out2 = rtp_ext.set_one_byte_ext(out, h2, 5, p2)
+    h3 = rtp_header.parse(out2)
+    for eid, pay in ((2, p1), (5, p2)):
+        off, ln, found = rtp_ext.find_one_byte_ext(out2, h3, eid)
+        assert found.all() and np.all(ln == pay.shape[1])
+    # rewrite element 2 in place: length unchanged
+    p1b = np.full((4, 2), 8, np.uint8)
+    out3 = rtp_ext.set_one_byte_ext(out2, rtp_header.parse(out2), 2, p1b)
+    assert np.all(np.asarray(out3.length) == np.asarray(out2.length))
+    off, _, found = rtp_ext.find_one_byte_ext(out3, rtp_header.parse(out3), 2)
+    assert found.all()
+    assert np.all(out3.data[np.arange(4), off] == 8)
+    assert out3.to_bytes(0).endswith(b"payload-00")
+
+
+def test_abs_send_time_stamp():
+    eng = AbsSendTimeEngine(ext_id=4, clock=lambda: 1.5)
+    b = make_batch()
+    out, ok = eng.rtp_transformer.transform(b)
+    assert ok.all()
+    h = rtp_header.parse(out)
+    off, ln, found = rtp_ext.find_one_byte_ext(out, h, 4)
+    assert found.all() and np.all(ln == 3)
+    v = int(1.5 * (1 << 18)) & 0xFFFFFF
+    want = [(v >> 16) & 0xFF, (v >> 8) & 0xFF, v & 0xFF]
+    np.testing.assert_array_equal(out.data[0, off[0]:off[0] + 3], want)
+
+
+def test_transport_cc_seq_and_send_times():
+    eng = TransportCCEngine(ext_id=5, clock=lambda: 2.0)
+    b1, b2 = make_batch(3), make_batch(2, seq0=200)
+    o1, _ = eng.rtp_transformer.transform(b1)
+    o2, _ = eng.rtp_transformer.transform(b2)
+    h = rtp_header.parse(o2)
+    off, _, found = rtp_ext.find_one_byte_ext(o2, h, 5)
+    assert found.all()
+    got = [(int(o2.data[i, off[i]]) << 8) | int(o2.data[i, off[i] + 1])
+           for i in range(2)]
+    assert got == [3, 4]          # continues across batches
+    assert eng.lookup_send_time(0) == 2.0
+    assert eng.lookup_send_time(4) == 2.0
+    assert eng.lookup_send_time(99) is None
+
+
+def test_audio_level_stamp_and_extract():
+    levels = np.array([13] + [127] * 7, np.uint8)
+    tx = CsrcAudioLevelEngine(ext_id=1, capacity=8,
+                              level_of=lambda sid: levels[sid])
+    rx = CsrcAudioLevelEngine(ext_id=1, capacity=8)
+    b = make_batch(stream=0)
+    out, _ = tx.rtp_transformer.transform(b)
+    _, ok = rx.rtp_transformer.reverse_transform(out)
+    assert ok.all()
+    assert rx.last_levels[0] == 13
+
+
+def test_pt_remap_and_ssrc_rewrite():
+    pt = PayloadTypeTransformEngine(capacity=8)
+    pt.add_mapping(0, 96, 100)
+    b = make_batch()
+    out, _ = pt.rtp_transformer.transform(b)
+    assert np.all(rtp_header.parse(out).pt == 100)
+
+    sw = SsrcRewriteEngine(capacity=8)
+    sw.set_mapping(0, 0xCAFEBABE)
+    out2, _ = sw.rtp_transformer.transform(b)
+    assert np.all(rtp_header.parse(out2).ssrc == 0xCAFEBABE)
+
+
+# ---------------------------------------------------------------- chain ---
+
+def test_chain_srtp_roundtrip_with_extensions():
+    """Send chain: abs-send-time → TCC → SRTP; receive chain reverses and
+    the decrypted packets still carry the stamped extensions."""
+    srtp = make_srtp()
+    chain_tx = TransformEngineChain([
+        AbsSendTimeEngine(ext_id=4, clock=lambda: 1.0),
+        TransportCCEngine(ext_id=5, clock=lambda: 1.0),
+        srtp,
+    ])
+    b = make_batch()
+    wire, ok = chain_tx.rtp_transformer.transform(b)
+    assert ok.all()
+    # on the wire the packets are encrypted: payload differs
+    assert wire.to_bytes(0)[-10:] != b.to_bytes(0)[-10:]
+
+    srtp2 = make_srtp()
+    rx_levels = CsrcAudioLevelEngine(ext_id=1, capacity=8)
+    chain_rx = TransformEngineChain([rx_levels, srtp2])
+    dec, ok = chain_rx.rtp_transformer.reverse_transform(wire)
+    assert ok.all()
+    h = rtp_header.parse(dec)
+    for eid in (4, 5):
+        _, _, found = rtp_ext.find_one_byte_ext(dec, h, eid)
+        assert found.all()
+    assert dec.to_bytes(0).endswith(b"payload-00")
+
+
+def test_chain_drop_accounting():
+    srtp_tx, srtp_rx = make_srtp(), make_srtp()
+    chain = TransformEngineChain([srtp_rx], names=["srtp"])
+    b = make_batch()
+    wire, _ = TransformEngineChain([srtp_tx]).rtp_transformer.transform(b)
+    tampered = wire.copy()
+    tampered.data[1, 20] ^= 0xFF
+    dec, ok = chain.rtp_transformer.reverse_transform(tampered)
+    assert ok.tolist() == [True, False, True, True]
+    assert chain.drop_counts["srtp"] == 1
