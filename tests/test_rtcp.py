@@ -1,0 +1,133 @@
+"""RTCP codec roundtrips + vectorized stats (jitter, loss, RTT).
+
+Reference behaviors: RTCPPacketParserEx/RTCPREMBPacket/RTCPTCCPacket/
+NACKPacket encode-decode; MediaStreamStatsImpl counters per RFC 3550.
+"""
+
+import numpy as np
+
+from libjitsi_tpu.rtp import rtcp
+from libjitsi_tpu.rtp.stats import StreamStatsTable, ntp_middle32
+
+
+def test_sr_rr_roundtrip():
+    rb = rtcp.ReportBlock(ssrc=7, fraction_lost=12, cumulative_lost=34,
+                          highest_seq=70000, jitter=55, lsr=0xAABBCCDD,
+                          dlsr=123)
+    sr = rtcp.SenderReport(ssrc=1, ntp_sec=100, ntp_frac=200, rtp_ts=300,
+                           packet_count=40, octet_count=50, reports=[rb])
+    out = rtcp.parse_compound(rtcp.build_sr(sr))
+    assert out == [sr]
+    rr = rtcp.ReceiverReport(ssrc=2, reports=[rb])
+    assert rtcp.parse_compound(rtcp.build_rr(rr)) == [rr]
+    # negative cumulative lost survives (24-bit signed)
+    rb2 = rtcp.ReportBlock(7, 0, -5, 100, 0, 0, 0)
+    got = rtcp.parse_compound(
+        rtcp.build_rr(rtcp.ReceiverReport(2, [rb2])))[0]
+    assert got.reports[0].cumulative_lost == -5
+
+
+def test_sdes_bye_compound():
+    sd = [rtcp.SdesChunk(ssrc=9, items=[(1, b"user@host")])]
+    bye = rtcp.Bye(ssrcs=[9], reason=b"leaving")
+    blob = rtcp.build_compound([rtcp.build_sdes(sd), rtcp.build_bye(bye)])
+    got = rtcp.parse_compound(blob)
+    assert got[0] == sd and got[1] == bye
+
+
+def test_nack_encode_decode():
+    lost = [100, 101, 105, 116, 300]
+    n = rtcp.Nack(sender_ssrc=1, media_ssrc=2, lost_seqs=lost)
+    got = rtcp.parse_compound(rtcp.build_nack(n))[0]
+    assert sorted(got.lost_seqs) == sorted(lost)
+    assert (got.sender_ssrc, got.media_ssrc) == (1, 2)
+
+
+def test_remb_roundtrip():
+    r = rtcp.Remb(sender_ssrc=3, bitrate_bps=2_500_000, ssrcs=[10, 11])
+    got = rtcp.parse_compound(rtcp.build_remb(r))[0]
+    assert got.ssrcs == [10, 11]
+    assert abs(got.bitrate_bps - 2_500_000) / 2_500_000 < 0.01  # mantissa rounding
+
+
+def test_pli_fir():
+    assert rtcp.parse_compound(rtcp.build_pli(rtcp.Pli(1, 2)))[0] == rtcp.Pli(1, 2)
+    f = rtcp.Fir(1, 0, [(5, 9)])
+    assert rtcp.parse_compound(rtcp.build_fir(f))[0] == f
+
+
+def test_tcc_roundtrip():
+    received = np.array([True, False, True, True, False, True, True])
+    arrival = np.array([4, 0, 8, 1000, 0, 1004, 1010], dtype=np.int64)
+    fb = rtcp.TccFeedback(sender_ssrc=1, media_ssrc=2, base_seq=65530,
+                          reference_time=5, fb_pkt_count=3,
+                          received=received, arrival_250us=arrival)
+    got = rtcp.parse_compound(rtcp.build_tcc(fb))[0]
+    assert got.base_seq == 65530 and got.reference_time == 5
+    np.testing.assert_array_equal(got.received, received)
+    np.testing.assert_array_equal(got.arrival_250us[received],
+                                  arrival[received])
+    assert got.seqs()[-1] == (65530 + 6) & 0xFFFF
+
+
+def test_unknown_packet_skipped():
+    weird = bytes([0x80, 195, 0, 1]) + b"\x00" * 4
+    blob = weird + rtcp.build_pli(rtcp.Pli(1, 2))
+    got = rtcp.parse_compound(blob)
+    assert got == [rtcp.Pli(1, 2)]
+
+
+# ------------------------------------------------------------------ stats --
+
+def test_stats_loss_and_ext_seq():
+    t = StreamStatsTable(capacity=4)
+    # stream 0: seqs 65534..65537 wrapping, one gap (65536 missing)
+    seqs = np.array([65534, 65535, 1])  # ext: 65534, 65535, 65537
+    t.on_received(np.zeros(3, np.int64), seqs,
+                  np.array([0, 160, 480]), np.array([100, 100, 100]),
+                  arrival=np.zeros(3))
+    assert t.expected(0) == 4
+    assert t.cumulative_lost(0) == 1
+    rb = t.make_report_block(0, remote_ssrc=9, now=0.0)
+    assert rb.cumulative_lost == 1
+    assert rb.fraction_lost == (1 << 8) // 4
+    assert rb.highest_seq == 65537
+
+
+def test_stats_jitter_ewma():
+    t = StreamStatsTable(capacity=2)
+    t.clock_rate[0] = 8000
+    # packets 20 ms apart in RTP time but arriving 25 ms apart:
+    # |D| = 0.005 s * 8000 = 40 units each step
+    n = 10
+    arrival = np.arange(n) * 0.025
+    ts = np.arange(n) * 160
+    t.on_received(np.zeros(n, np.int64), np.arange(n), ts,
+                  np.full(n, 100), arrival)
+    j = t.jitter[0]
+    # EWMA converging toward 40: after 9 steps it's 40*(1-(15/16)^9)
+    want = 40 * (1 - (15 / 16) ** (n - 1))
+    assert abs(j - want) < 1e-6
+
+
+def test_stats_rtt_from_rr():
+    t = StreamStatsTable(capacity=1)
+    now = 1000.0
+    sr = t.make_sr(0, ssrc=1, rtp_ts=0, now=now)
+    # remote echoes our SR after holding it 0.1 s; RR arrives 0.3 s later
+    rb = rtcp.ReportBlock(ssrc=1, fraction_lost=0, cumulative_lost=0,
+                          highest_seq=0, jitter=0,
+                          lsr=ntp_middle32(now),
+                          dlsr=int(0.1 * 65536))
+    t.on_rr_received(0, rb, now=now + 0.3)
+    assert abs(t.rtt[0] - 0.2) < 0.01
+
+
+def test_stats_sr_contents():
+    t = StreamStatsTable(capacity=1)
+    t.on_sent(np.zeros(5, np.int64), np.full(5, 200))
+    sr = t.make_sr(0, ssrc=42, rtp_ts=999, now=123.5)
+    assert sr.packet_count == 5 and sr.octet_count == 1000
+    assert sr.ntp_sec == 123 + 2208988800
+    blob = rtcp.build_sr(sr)
+    assert rtcp.parse_compound(blob)[0].rtp_ts == 999
