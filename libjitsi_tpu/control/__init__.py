@@ -1,0 +1,1 @@
+from libjitsi_tpu.control.sdes import SdesControl, CryptoAttribute  # noqa: F401

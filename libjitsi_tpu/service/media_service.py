@@ -2,12 +2,13 @@
 
 Mirrors the surface of the reference's
 `org.jitsi.service.neomedia.MediaService` /
-`org.jitsi.impl.neomedia.MediaServiceImpl`: stream creation, format
-registry, and access to conferencing devices.  Grows with the framework;
-round-1 milestones land stream/mixer/SFU factories here as they are built.
+`org.jitsi.impl.neomedia.MediaServiceImpl`: stream creation and access to
+the shared batch domain (StreamRegistry) and conferencing devices.
 """
 
 from __future__ import annotations
+
+from typing import Optional
 
 from libjitsi_tpu.core.config import ConfigurationService
 
@@ -15,10 +16,34 @@ from libjitsi_tpu.core.config import ConfigurationService
 class MediaService:
     def __init__(self, config: ConfigurationService):
         self.config = config
+        self._registry = None
+        self._mixer = None
 
-    def create_media_stream(self, *args, **kwargs):
-        """Reference: MediaService.createMediaStream.  Lands with the
-        stream core milestone (SURVEY §2.3)."""
-        from libjitsi_tpu.service.media_stream import create_media_stream
+    @property
+    def registry(self):
+        """The default shared StreamRegistry (dense per-stream tables)."""
+        if self._registry is None:
+            from libjitsi_tpu.service.media_stream import StreamRegistry
 
-        return create_media_stream(self.config, *args, **kwargs)
+            cap = self.config.get_int("libjitsi_tpu.stream_capacity", 1024)
+            self._registry = StreamRegistry(self.config, capacity=cap)
+        return self._registry
+
+    def create_media_stream(self, **kwargs):
+        """Reference: MediaService.createMediaStream."""
+        from libjitsi_tpu.service.media_stream import MediaStream
+
+        kwargs.setdefault("registry", self.registry)
+        registry = kwargs.pop("registry")
+        return MediaStream(registry, **kwargs)
+
+    def audio_mixer(self, frame_samples: int = 960):
+        """Shared conference mixer device (reference:
+        MediaService.createMixer / AudioMixerMediaDevice)."""
+        if self._mixer is None:
+            from libjitsi_tpu.conference import AudioMixer
+
+            self._mixer = AudioMixer(
+                capacity=self.registry.capacity,
+                frame_samples=frame_samples)
+        return self._mixer

@@ -1,0 +1,313 @@
+"""MediaStream — one RTP session leg as a row in shared batched state.
+
+The reference's `org.jitsi.impl.neomedia.MediaStreamImpl` (~4k lines) owns
+sockets, an FMJ Processor, a TransformEngineChain and per-stream stats
+objects; 10k streams = 10k heavyweight object graphs.  Here a stream is a
+*row id* into dense tables owned by a shared `StreamRegistry` (crypto
+contexts, stats, levels) plus a small host control block (ssrc, seq/ts
+counters, direction, format map).  The transform chain is shared and
+batched; any number of streams' packets ride one device launch.
+
+API shape mirrors `org.jitsi.service.neomedia.MediaStream`:
+`set_direction`, `add_dynamic_rtp_payload_type`, `set_remote_ssrc`,
+`start`/`close`, plus batched `send`/`receive` (the connector read/write
+surface that the io/ layer drives).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from libjitsi_tpu.core.config import ConfigurationService
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.rtp import rtcp
+from libjitsi_tpu.rtp.stats import StreamStatsTable
+from libjitsi_tpu.control.sdes import SdesControl
+from libjitsi_tpu.transform.engine import TransformEngineChain, TransformEngine
+from libjitsi_tpu.transform.header_ext import (
+    AbsSendTimeEngine,
+    CsrcAudioLevelEngine,
+    TransportCCEngine,
+)
+from libjitsi_tpu.transform.srtp.context import SrtpStreamTable
+from libjitsi_tpu.transform.srtp.engine import SrtpTransformEngine
+from libjitsi_tpu.transform.srtp.policy import SrtpProfile
+
+
+class Direction(enum.Enum):
+    """Reference: org.jitsi.service.neomedia.MediaDirection."""
+
+    SENDRECV = "sendrecv"
+    SENDONLY = "sendonly"
+    RECVONLY = "recvonly"
+    INACTIVE = "inactive"
+
+    @property
+    def allows_sending(self) -> bool:
+        return self in (Direction.SENDRECV, Direction.SENDONLY)
+
+    @property
+    def allows_receiving(self) -> bool:
+        return self in (Direction.SENDRECV, Direction.RECVONLY)
+
+
+class StreamRegistry:
+    """Shared batch domain: dense per-stream tables + ssrc demux.
+
+    One registry per media service; all its streams' packets can share
+    device launches.  Reference analog: the MediaServiceImpl-owned
+    machinery each MediaStreamImpl hooks into.
+    """
+
+    def __init__(self, config: ConfigurationService, capacity: int = 1024):
+        self.config = config
+        self.capacity = capacity
+        self.stats = StreamStatsTable(capacity)
+        # per-profile crypto tables, created on first use (tx, rx)
+        self._srtp: Dict[SrtpProfile, Tuple[SrtpStreamTable, SrtpStreamTable]] = {}
+        self._free = list(range(capacity - 1, -1, -1))
+        self._ssrc_to_sid: Dict[int, int] = {}
+        self.streams: Dict[int, "MediaStream"] = {}
+
+    def alloc(self, stream: "MediaStream") -> int:
+        if not self._free:
+            raise RuntimeError("stream capacity exhausted")
+        sid = self._free.pop()
+        self.streams[sid] = stream
+        return sid
+
+    def release(self, sid: int) -> None:
+        self.streams.pop(sid, None)
+        for tx, rx in self._srtp.values():
+            if tx.active[sid]:
+                tx.remove_stream(sid)
+            if rx.active[sid]:
+                rx.remove_stream(sid)
+        self._free.append(sid)
+
+    def srtp_tables(self, profile: SrtpProfile
+                    ) -> Tuple[SrtpStreamTable, SrtpStreamTable]:
+        if profile not in self._srtp:
+            self._srtp[profile] = (
+                SrtpStreamTable(self.capacity, profile),
+                SrtpStreamTable(self.capacity, profile),
+            )
+        return self._srtp[profile]
+
+    # ------------------------------------------------------------- demux
+    def map_ssrc(self, ssrc: int, sid: int) -> None:
+        self._ssrc_to_sid[ssrc & 0xFFFFFFFF] = sid
+
+    def unmap_ssrc(self, ssrc: int) -> None:
+        self._ssrc_to_sid.pop(ssrc & 0xFFFFFFFF, None)
+
+    def demux(self, batch: PacketBatch) -> np.ndarray:
+        """Fill batch.stream from each packet's SSRC; returns the ids
+        (-1 where unknown — the reference drops packets of unknown SSRC
+        unless discovery is enabled)."""
+        hdr = rtp_header.parse(batch)
+        m = self._ssrc_to_sid
+        sids = np.fromiter((m.get(int(s), -1) for s in hdr.ssrc),
+                           dtype=np.int64, count=batch.batch_size)
+        batch.stream[:] = sids
+        return sids
+
+
+class MediaStream:
+    """One RTP session leg (reference: MediaStreamImpl).
+
+    Use via `MediaService.create_media_stream`.  Typical life cycle::
+
+        s = media_service().create_media_stream(profile=..., registry=...)
+        s.add_dynamic_rtp_payload_type(96, "opus", 48000)
+        s.set_remote_ssrc(0x1234)
+        offer = s.sdes.create_offer()        # -> signaling
+        s.sdes.accept_answer(answer_line)
+        s.start()
+        wire = s.send([payload0, payload1])  # protected RTP bytes out
+        pkts, ok = s.receive(incoming)       # decrypted payloads in
+    """
+
+    def __init__(self, registry: StreamRegistry,
+                 profile: SrtpProfile = SrtpProfile.AES_CM_128_HMAC_SHA1_80,
+                 direction: Direction = Direction.SENDRECV,
+                 local_ssrc: Optional[int] = None,
+                 extra_engines: Sequence[TransformEngine] = ()):
+        self.registry = registry
+        self.profile = profile
+        self.direction = direction
+        self.sid = registry.alloc(self)
+        self.local_ssrc = (int.from_bytes(os.urandom(4), "big")
+                           if local_ssrc is None else local_ssrc) & 0xFFFFFFFF
+        self.remote_ssrc: Optional[int] = None
+        self.sdes = SdesControl(profiles=[profile])
+        self._formats: Dict[int, Tuple[str, int]] = {}  # pt -> (name, rate)
+        self._tx_seq = int.from_bytes(os.urandom(2), "big")
+        self._tx_ts = int.from_bytes(os.urandom(4), "big")
+        self._extra = list(extra_engines)
+        self._chain: Optional[TransformEngineChain] = None
+        self._started = False
+
+    # ------------------------------------------------------------ control
+    def add_dynamic_rtp_payload_type(self, pt: int, encoding: str,
+                                     clock_rate: int) -> None:
+        """Reference: MediaStream.addDynamicRTPPayloadType."""
+        self._formats[pt] = (encoding, clock_rate)
+        self.registry.stats.clock_rate[self.sid] = clock_rate
+
+    def set_direction(self, d: Direction) -> None:
+        self.direction = d
+
+    def set_remote_ssrc(self, ssrc: int) -> None:
+        if self.remote_ssrc is not None:
+            self.registry.unmap_ssrc(self.remote_ssrc)
+        self.remote_ssrc = ssrc & 0xFFFFFFFF
+        self.registry.map_ssrc(self.remote_ssrc, self.sid)
+
+    def start(self) -> None:
+        """Install negotiated keys and build the transform chain.
+
+        Reference: MediaStreamImpl.start() wiring the
+        TransformEngineChain with the SrtpControl's engine last.
+        """
+        if self._started:
+            return
+        tx_tab, rx_tab = self.registry.srtp_tables(self.profile)
+        if self.sdes.negotiated:
+            lo, re = self.sdes.local, self.sdes.remote
+            tx_tab.add_stream(self.sid, lo.master_key, lo.master_salt)
+            rx_tab.add_stream(self.sid, re.master_key, re.master_salt)
+        else:
+            raise RuntimeError(
+                "no keys negotiated; complete SDES (or install keys on the "
+                "tables directly) before start()")
+        engines = list(self._extra) + [SrtpTransformEngine(tx_tab, rx_tab)]
+        self._chain = TransformEngineChain(engines)
+        self._started = True
+
+    def close(self) -> bytes:
+        """Tear down; returns an RTCP BYE to send (reference emits BYE)."""
+        bye = rtcp.build_bye(rtcp.Bye([self.local_ssrc]))
+        if self.remote_ssrc is not None:
+            self.registry.unmap_ssrc(self.remote_ssrc)
+        self.registry.release(self.sid)
+        self._started = False
+        return bye
+
+    # --------------------------------------------------------------- send
+    def send(self, payloads: Sequence[bytes], pt: int = 96,
+             ts_step: int = 960, marker=None) -> List[bytes]:
+        """Packetize + run the send chain; returns wire-ready datagrams.
+
+        ts_step defaults to 20 ms at 48 kHz.  Reference path: FMJ
+        packetizer -> RTPConnectorOutputStream.write -> chain loop
+        (SURVEY §3.2).
+        """
+        if not self.direction.allows_sending:
+            raise RuntimeError(f"direction {self.direction.value} cannot send")
+        if not self._started:
+            raise RuntimeError("start() first")
+        n = len(payloads)
+        seqs = [(self._tx_seq + i) & 0xFFFF for i in range(n)]
+        tss = [(self._tx_ts + i * ts_step) & 0xFFFFFFFF for i in range(n)]
+        self._tx_seq = (self._tx_seq + n) & 0xFFFF
+        self._tx_ts = (self._tx_ts + n * ts_step) & 0xFFFFFFFF
+        batch = rtp_header.build(payloads, seqs, tss, self.local_ssrc, pt,
+                                 marker=marker, stream=[self.sid] * n)
+        out, mask = self._chain.rtp_transformer.transform(batch)
+        self.registry.stats.on_sent(out.stream[mask],
+                                    np.asarray(out.length)[mask])
+        return [out.to_bytes(i) for i in np.nonzero(mask)[0]]
+
+    # ------------------------------------------------------------ receive
+    def receive(self, datagrams: Sequence[bytes],
+                arrival: Optional[float] = None
+                ) -> Tuple[PacketBatch, np.ndarray]:
+        """Run the receive chain on raw datagrams for this stream.
+
+        Returns (batch, ok): decrypted packets and per-row verdicts.
+        Multi-stream ingest goes through `StreamRegistry.demux` + the
+        shared chain instead (io layer / SFU path).
+        """
+        if not self.direction.allows_receiving:
+            raise RuntimeError(f"direction {self.direction.value} cannot receive")
+        if not self._started:
+            raise RuntimeError("start() first")
+        batch = PacketBatch.from_payloads(datagrams,
+                                          stream=[self.sid] * len(datagrams))
+        out, ok = self._chain.rtp_transformer.reverse_transform(batch)
+        hdr = rtp_header.parse(out)
+        if np.any(ok):
+            now = time.time() if arrival is None else arrival
+            self.registry.stats.on_received(
+                out.stream[ok], hdr.seq[ok], hdr.ts[ok],
+                np.asarray(out.length)[ok],
+                np.full(int(ok.sum()), now))
+        return out, ok
+
+    # --------------------------------------------------------------- rtcp
+    def make_rtcp_report(self, now: Optional[float] = None) -> bytes:
+        """Compound SR/RR + SDES CNAME (reference: RTCP report generation
+        the stream's RTPManager schedules)."""
+        st = self.registry.stats
+        sending = self.direction.allows_sending and st.tx_packets[self.sid] > 0
+        blocks = []
+        if self.remote_ssrc is not None and st.rx_packets[self.sid] > 0:
+            blocks = [st.make_report_block(self.sid, self.remote_ssrc, now)]
+        if sending:
+            sr = st.make_sr(self.sid, self.local_ssrc, self._tx_ts,
+                            reports=blocks, now=now)
+            main = rtcp.build_sr(sr)
+        else:
+            main = rtcp.build_rr(rtcp.ReceiverReport(self.local_ssrc, blocks))
+        cname = f"libjitsi-tpu-{self.local_ssrc:08x}".encode()
+        sdes = rtcp.build_sdes([rtcp.SdesChunk(self.local_ssrc, [(1, cname)])])
+        return rtcp.build_compound([main, sdes])
+
+    def handle_rtcp(self, blob: bytes, now: Optional[float] = None) -> list:
+        """Feed an incoming (already-unprotected) compound RTCP packet to
+        stats; returns the parsed packets for upper layers (BWE etc.)."""
+        pkts = rtcp.parse_compound(blob)
+        st = self.registry.stats
+        for p in pkts:
+            if isinstance(p, rtcp.SenderReport):
+                st.on_sr_received(self.sid, p, arrival=now)
+                for rb in p.reports:
+                    if rb.ssrc == self.local_ssrc:
+                        st.on_rr_received(self.sid, rb, now=now)
+            elif isinstance(p, rtcp.ReceiverReport):
+                for rb in p.reports:
+                    if rb.ssrc == self.local_ssrc:
+                        st.on_rr_received(self.sid, rb, now=now)
+        return pkts
+
+    # -------------------------------------------------------------- stats
+    @property
+    def stats(self) -> dict:
+        """Snapshot for this stream (reference: MediaStreamStats2)."""
+        st = self.registry.stats
+        i = self.sid
+        return {
+            "tx_packets": int(st.tx_packets[i]),
+            "tx_bytes": int(st.tx_bytes[i]),
+            "rx_packets": int(st.rx_packets[i]),
+            "rx_bytes": int(st.rx_bytes[i]),
+            "cumulative_lost": st.cumulative_lost(i),
+            "jitter_rtp_units": float(st.jitter[i]),
+            "rtt_seconds": float(st.rtt[i]),
+        }
+
+
+def create_media_stream(config: ConfigurationService,
+                        registry: Optional[StreamRegistry] = None,
+                        **kwargs) -> MediaStream:
+    if registry is None:
+        raise ValueError("a StreamRegistry is required "
+                         "(MediaService owns the default)")
+    return MediaStream(registry, **kwargs)

@@ -1,0 +1,143 @@
+"""End-to-end slice: SDES negotiation → MediaStream → SRTP wire → back.
+
+This is the framework's "one model running end-to-end" milestone
+(SURVEY §7 step 3): two MediaStreams exchange protected RTP over a
+simulated wire, byte-identical payloads come out, stats and RTCP flow.
+"""
+
+import numpy as np
+import pytest
+
+import libjitsi_tpu
+from libjitsi_tpu.control.sdes import CryptoAttribute, SdesControl
+from libjitsi_tpu.rtp import rtcp
+from libjitsi_tpu.service.media_stream import Direction
+from libjitsi_tpu.transform.srtp.policy import SrtpProfile
+
+
+@pytest.fixture()
+def svc():
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    return libjitsi_tpu.media_service()
+
+
+def make_pair(svc):
+    """Two connected streams: a (initiator) <-> b (responder)."""
+    a = svc.create_media_stream(local_ssrc=0xA)
+    b = svc.create_media_stream(local_ssrc=0xB)
+    offer = a.sdes.create_offer()
+    answer = b.sdes.create_answer(offer)
+    a.sdes.accept_answer(answer)
+    # responder's local key protects b->a; wire the crypto directions:
+    # a encrypts with a.local, b decrypts with its remote (= a.local)
+    a.set_remote_ssrc(b.local_ssrc)
+    b.set_remote_ssrc(a.local_ssrc)
+    a.start()
+    b.start()
+    return a, b
+
+
+def test_sdes_attribute_roundtrip():
+    c = SdesControl()
+    offer = c.create_offer()
+    assert all(o.split()[1] in (p.value for p in SrtpProfile) for o in offer)
+    att = CryptoAttribute.parse("a=crypto:" + offer[0])
+    assert att.profile is SrtpProfile.AES_CM_128_HMAC_SHA1_80
+    assert len(att.master_key) == 16 and len(att.master_salt) == 14
+
+
+def test_sdes_answer_selects_common_suite():
+    a = SdesControl(profiles=[SrtpProfile.AES_CM_128_HMAC_SHA1_32,
+                              SrtpProfile.AES_CM_128_HMAC_SHA1_80])
+    b = SdesControl(profiles=[SrtpProfile.AES_CM_128_HMAC_SHA1_80])
+    answer = b.create_answer(a.create_offer())
+    a.accept_answer(answer)
+    assert a.negotiated and b.negotiated
+    assert a.profile is SrtpProfile.AES_CM_128_HMAC_SHA1_80
+    assert a.local.master_key == b.remote.master_key
+    assert a.remote.master_key == b.local.master_key
+
+
+def test_e2e_media_roundtrip(svc):
+    a, b = make_pair(svc)
+    payloads = [b"opus-frame-%02d" % i for i in range(8)]
+    wire = a.send(payloads, pt=111)
+    assert len(wire) == 8
+    # ciphertext on the wire
+    assert payloads[0] not in wire[0]
+    dec, ok = b.receive(wire)
+    assert ok.all()
+    hdr_len = 12
+    got = [dec.to_bytes(i)[hdr_len:] for i in range(8)]
+    assert got == payloads
+    # stats flowed
+    assert a.stats["tx_packets"] == 8
+    assert b.stats["rx_packets"] == 8
+    assert b.stats["cumulative_lost"] == 0
+
+
+def test_e2e_bidirectional(svc):
+    a, b = make_pair(svc)
+    dec, ok = b.receive(a.send([b"ping"]))
+    assert ok.all()
+    dec2, ok2 = a.receive(b.send([b"pong"]))
+    assert ok2.all()
+    assert dec2.to_bytes(0).endswith(b"pong")
+
+
+def test_e2e_tampered_dropped(svc):
+    a, b = make_pair(svc)
+    wire = a.send([b"x" * 50, b"y" * 50])
+    bad = bytearray(wire[0])
+    bad[30] ^= 1
+    _, ok = b.receive([bytes(bad), wire[1]])
+    assert ok.tolist() == [False, True]
+
+
+def test_direction_enforcement(svc):
+    a, b = make_pair(svc)
+    a.set_direction(Direction.RECVONLY)
+    with pytest.raises(RuntimeError):
+        a.send([b"nope"])
+    a.set_direction(Direction.SENDONLY)
+    with pytest.raises(RuntimeError):
+        a.receive([b"\x80" * 40])
+
+
+def test_rtcp_report_and_rtt(svc):
+    a, b = make_pair(svc)
+    b.receive(a.send([b"data"] * 4), arrival=10.0)
+    blob = b.make_rtcp_report(now=10.5)
+    pkts = rtcp.parse_compound(blob)
+    # receiver-only b emits RR + SDES cname
+    assert isinstance(pkts[0], rtcp.ReceiverReport)
+    assert pkts[0].reports[0].ssrc == a.local_ssrc
+    assert isinstance(pkts[1], list)  # sdes chunks
+    a.handle_rtcp(blob, now=10.6)
+
+    # a (sender) emits SR after sending
+    sr_blob = a.make_rtcp_report(now=11.0)
+    sr = rtcp.parse_compound(sr_blob)[0]
+    assert isinstance(sr, rtcp.SenderReport)
+    assert sr.packet_count == 4
+    b.handle_rtcp(sr_blob, now=11.05)
+    # b echoes the SR in its next RR; a computes RTT
+    rr_blob = b.make_rtcp_report(now=11.2)
+    a.handle_rtcp(rr_blob, now=11.25)
+    assert 0 <= a.stats["rtt_seconds"] < 0.3
+
+
+def test_registry_demux_and_release(svc):
+    a, b = make_pair(svc)
+    reg = svc.registry
+    wire = a.send([b"zzz"])
+    from libjitsi_tpu.core.packet import PacketBatch
+    batch = PacketBatch.from_payloads(wire)
+    sids = reg.demux(batch)
+    assert sids[0] == b.sid  # a's ssrc routes to b (its receiver)
+    sid = a.sid
+    a.close()
+    assert sid not in reg.streams
+    c = svc.create_media_stream()
+    assert c.sid == sid  # row recycled
