@@ -1,0 +1,2 @@
+from libjitsi_tpu.sfu.cache import PacketCache  # noqa: F401
+from libjitsi_tpu.sfu.translator import RtpTranslator  # noqa: F401

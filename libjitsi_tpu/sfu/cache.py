@@ -1,0 +1,70 @@
+"""Retransmission cache (reference: `.caching.CachingTransformer` /
+`RawPacketCache`): recently-sent packets keyed (ssrc, seq), serving
+NACK-triggered retransmission (RFC 4585 NACK -> RFC 4588 RTX or verbatim
+resend).
+
+Host-side: NACKs are rare and tiny relative to media; an OrderedDict FIFO
+with byte/age bounds matches the reference's size-limited cache without
+device involvement.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import List, Optional, Sequence, Tuple
+
+
+class PacketCache:
+    def __init__(self, max_bytes: int = 4 << 20, max_age: float = 1.0):
+        self.max_bytes = max_bytes
+        self.max_age = max_age
+        self._store: "collections.OrderedDict[Tuple[int, int], Tuple[float, bytes]]" = (
+            collections.OrderedDict())
+        self._bytes = 0
+
+    def insert(self, ssrc: int, seq: int, packet: bytes,
+               now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        key = (ssrc & 0xFFFFFFFF, seq & 0xFFFF)
+        old = self._store.pop(key, None)
+        if old is not None:
+            self._bytes -= len(old[1])
+        self._store[key] = (now, packet)
+        self._bytes += len(packet)
+        self._evict(now)
+
+    def insert_batch(self, ssrcs, seqs, packets: Sequence[bytes],
+                     now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        for ssrc, seq, pkt in zip(ssrcs, seqs, packets):
+            self.insert(int(ssrc), int(seq), pkt, now)
+
+    def get(self, ssrc: int, seq: int) -> Optional[bytes]:
+        e = self._store.get((ssrc & 0xFFFFFFFF, seq & 0xFFFF))
+        return e[1] if e is not None else None
+
+    def lookup_nack(self, ssrc: int, lost_seqs: Sequence[int]) -> List[bytes]:
+        """Packets available for retransmission out of a NACK's list."""
+        out = []
+        for s in lost_seqs:
+            p = self.get(ssrc, s)
+            if p is not None:
+                out.append(p)
+        return out
+
+    def _evict(self, now: float) -> None:
+        while self._store:
+            (key, (t, pkt)) = next(iter(self._store.items()))
+            if self._bytes > self.max_bytes or now - t > self.max_age:
+                self._store.popitem(last=False)
+                self._bytes -= len(pkt)
+            else:
+                break
+
+    @property
+    def size_bytes(self) -> int:
+        return self._bytes
+
+    def __len__(self) -> int:
+        return len(self._store)

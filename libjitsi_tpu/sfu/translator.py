@@ -1,0 +1,170 @@
+"""RTP translator — the SFU fan-out primitive (BASELINE config #5).
+
+Reference: `org.jitsi.impl.neomedia.rtp.translator.RTPTranslatorImpl`
+fans each received packet from one `StreamRTPManager` to all the others,
+re-running every receiver leg's send TransformEngineChain — i.e. one SRTP
+re-encrypt *per receiver* per packet (SURVEY §3.4).  That multiplicative
+crypto load is exactly what the batch design eats: decrypt once, then one
+device launch re-encrypts the (packets x receivers) fan-out matrix.
+
+Key observations that make the dense layout small (RFC 3711):
+- session keys depend only on each receiver endpoint's master key — all
+  forwarded SSRCs on one receiver leg share that key material, so key
+  tensors are per *receiver* ([R, rounds, 16]), not per (receiver, ssrc);
+- the forwarded packet keeps the sender's SSRC/seq/ts (the SFU does not
+  rewrite them), so the SRTP packet index of every receiver copy equals
+  the sender's index — per-sender index state, shared by all legs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.kernels.aes import expand_key
+from libjitsi_tpu.kernels.sha1 import hmac_precompute
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.transform.srtp import kernel
+from libjitsi_tpu.transform.srtp.kdf import derive_session_keys
+from libjitsi_tpu.transform.srtp.policy import Cipher, SrtpProfile
+
+
+@functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"),
+                   donate_argnums=(3,))
+def _fanout_protect(tab_rk, tab_mid, recv, data, length, payload_off, iv,
+                    roc, tag_len: int, encrypt: bool):
+    return kernel.srtp_protect(
+        data, length, payload_off, tab_rk[recv], iv, tab_mid[recv], roc,
+        tag_len, encrypt)
+
+
+class RtpTranslator:
+    """Decrypt-once / re-encrypt-N fan-out over a receiver key table.
+
+    Receivers are endpoint legs with their own SRTP master keys (the
+    `MediaStream`s a videobridge conference holds per participant).
+    Senders are identified by their decrypted packets' stream ids; the
+    routing table says which receivers get which sender's media.
+    """
+
+    def __init__(self, capacity: int = 1024,
+                 profile: SrtpProfile = SrtpProfile.AES_CM_128_HMAC_SHA1_80):
+        self.profile = profile
+        self.policy = profile.policy
+        if self.policy.cipher == Cipher.AES_GCM:
+            raise NotImplementedError("AEAD-GCM fan-out lands with GCM kernel")
+        rounds = {16: 11, 32: 15}[self.policy.enc_key_len]
+        self.capacity = capacity
+        self.active = np.zeros(capacity, dtype=bool)
+        self._rk = np.zeros((capacity, rounds, 16), dtype=np.uint8)
+        self._mid = np.zeros((capacity, 2, 5), dtype=np.uint32)
+        self._salt = np.zeros((capacity, 16), dtype=np.uint8)
+        self._dev = None
+        # routing: sender sid -> sorted receiver id array
+        self._routes: Dict[int, np.ndarray] = {}
+
+    # ---------------------------------------------------------- receivers
+    def add_receiver(self, rid: int, master_key: bytes,
+                     master_salt: bytes) -> None:
+        p = self.policy
+        ks = derive_session_keys(
+            master_key, master_salt, enc_key_len=p.enc_key_len,
+            auth_key_len=p.auth_key_len, salt_len=p.salt_len)
+        self._rk[rid] = expand_key(ks.rtp_enc)
+        self._mid[rid] = hmac_precompute(ks.rtp_auth)
+        self._salt[rid, : p.salt_len] = np.frombuffer(ks.rtp_salt, np.uint8)
+        self._salt[rid, p.salt_len:] = 0
+        self.active[rid] = True
+        self._dev = None
+
+    def remove_receiver(self, rid: int) -> None:
+        self.active[rid] = False
+        self._rk[rid] = 0
+        self._mid[rid] = 0
+        self._dev = None
+        for s, rr in list(self._routes.items()):
+            self._routes[s] = rr[rr != rid]
+
+    # ------------------------------------------------------------ routing
+    def connect(self, sender_sid: int, receiver_ids: Sequence[int]) -> None:
+        """Declare that `sender_sid`'s media goes to these receivers
+        (reference: the translator's willWrite acceptance per target)."""
+        self._routes[sender_sid] = np.unique(
+            np.asarray(receiver_ids, dtype=np.int64))
+
+    def disconnect(self, sender_sid: int) -> None:
+        self._routes.pop(sender_sid, None)
+
+    def _device(self):
+        if self._dev is None:
+            self._dev = (jnp.asarray(self._rk), jnp.asarray(self._mid))
+        return self._dev
+
+    # ------------------------------------------------------------ fan-out
+    def translate(self, batch: PacketBatch, index: np.ndarray
+                  ) -> Tuple[PacketBatch, np.ndarray]:
+        """Fan out decrypted sender packets to their receivers, batched.
+
+        batch: decrypted RTP with `stream` = sender sid; `index` [B] is
+        each packet's 48-bit SRTP index (from the rx context's
+        authenticated estimate — `SrtpStreamTable.unprotect_rtp` leaves
+        it in `rx_max`; pass the per-packet values).
+
+        Returns (wire_batch, receiver_ids): P x fanout rows, each row
+        protected with its receiver's session key; `receiver_ids` says
+        which leg each row goes to.  Packets from senders with no route
+        produce no rows.
+        """
+        stream = np.asarray(batch.stream, dtype=np.int64)
+        index = np.asarray(index, dtype=np.int64)
+        # build the (packet, receiver) expansion on host
+        rows: List[int] = []
+        recvs: List[np.ndarray] = []
+        for i, sid in enumerate(stream):
+            rr = self._routes.get(int(sid))
+            if rr is None or len(rr) == 0:
+                continue
+            rows.append(i)
+            recvs.append(rr)
+        if not rows:
+            return PacketBatch.empty(0, batch.capacity), np.zeros(0, np.int64)
+        counts = np.array([len(r) for r in recvs])
+        src = np.repeat(np.array(rows, dtype=np.int64), counts)
+        recv = np.concatenate(recvs)
+        if not np.all(self.active[recv]):
+            raise KeyError("route to receiver without installed keys")
+
+        data = batch.data[src]
+        length = np.asarray(batch.length, dtype=np.int32)[src]
+        hdr = rtp_header.parse(batch)
+        payload_off = hdr.payload_off[src]
+        ssrc = hdr.ssrc[src]
+        idx = index[src]
+        if int(np.max(length, initial=0)) + self.policy.auth_tag_len > \
+                batch.capacity:
+            raise ValueError("fan-out rows need tag headroom in capacity")
+
+        # per-row IV from the receiver's salt + sender's ssrc/index
+        iv = self._salt[recv].copy()
+        for k in range(4):
+            iv[:, 4 + k] ^= ((ssrc >> (8 * (3 - k))) & 0xFF).astype(np.uint8)
+        for k in range(6):
+            iv[:, 8 + k] ^= ((idx >> (8 * (5 - k))) & 0xFF).astype(np.uint8)
+
+        tab_rk, tab_mid = self._device()
+        out, out_len = _fanout_protect(
+            tab_rk, tab_mid, jnp.asarray(recv, dtype=jnp.int32),
+            jnp.asarray(data), jnp.asarray(length),
+            jnp.asarray(payload_off), jnp.asarray(iv),
+            jnp.asarray((idx >> 16) & 0xFFFFFFFF, dtype=jnp.uint32),
+            self.policy.auth_tag_len, self.policy.cipher != Cipher.NULL)
+        wire = PacketBatch(np.asarray(out),
+                           np.asarray(out_len, dtype=np.int32),
+                           recv.astype(np.int32))
+        return wire, recv

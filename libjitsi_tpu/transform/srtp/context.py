@@ -212,12 +212,14 @@ class SrtpStreamTable:
         return PacketBatch(np.asarray(data), np.asarray(length, dtype=np.int32),
                            batch.stream)
 
-    def unprotect_rtp(self, batch: PacketBatch
-                      ) -> Tuple[PacketBatch, np.ndarray]:
+    def unprotect_rtp(self, batch: PacketBatch, return_index: bool = False):
         """Auth-check, replay-check and decrypt incoming RTP.
 
-        Returns (batch', ok).  Rows with ok=False keep their original bytes
-        (the reference drops them; callers filter by the mask).
+        Returns (batch', ok) — or (batch', ok, index) with the estimated
+        48-bit packet indices when `return_index` (the SFU translator
+        re-uses the authenticated sender index for every fan-out leg).
+        Rows with ok=False keep their original bytes (the reference drops
+        them; callers filter by the mask).
         Reference: SRTPTransformer.reverseTransform →
         SRTPCryptoContext.reverseTransformPacket.
         """
@@ -266,7 +268,10 @@ class SrtpStreamTable:
         mlen = np.asarray(mlen, dtype=np.int32)
         out_data = np.where(ok[:, None], data, batch.data)
         out_len = np.where(ok, mlen, length).astype(np.int32)
-        return PacketBatch(out_data, out_len, batch.stream), ok
+        out = PacketBatch(out_data, out_len, batch.stream)
+        if return_index:
+            return out, ok, idx
+        return out, ok
 
     # ----------------------------------------------------------------- RTCP
     def protect_rtcp(self, batch: PacketBatch) -> PacketBatch:

@@ -1,0 +1,128 @@
+"""SFU translator fan-out + retransmission cache.
+
+Reference behaviors: RTPTranslatorImpl decrypt-once/re-encrypt-per-
+receiver (SURVEY §3.4), CachingTransformer NACK service.
+"""
+
+import numpy as np
+
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.rtp import rtcp
+from libjitsi_tpu.sfu import PacketCache, RtpTranslator
+from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+MK_A = bytes(range(16))            # sender A's master key
+MS_A = bytes(range(50, 64))
+RECV_KEYS = {r: (bytes([r] * 16), bytes([r + 100] * 14)) for r in (1, 2, 3)}
+
+
+def _sender_batch(n=4, ssrc=0xAAA, sid=0):
+    return rtp_header.build(
+        [b"media-%d" % i for i in range(n)],
+        [1000 + i for i in range(n)], [i * 960 for i in range(n)],
+        [ssrc] * n, [96] * n, stream=[sid] * n)
+
+
+def test_fanout_reencrypts_per_receiver():
+    # sender -> SFU leg
+    tx = SrtpStreamTable(capacity=4)
+    tx.add_stream(0, MK_A, MS_A)
+    rx = SrtpStreamTable(capacity=4)
+    rx.add_stream(0, MK_A, MS_A)
+    wire_in = tx.protect_rtp(_sender_batch())
+    dec, ok, idx = rx.unprotect_rtp(wire_in, return_index=True)
+    assert ok.all()
+
+    # SFU -> receivers
+    tr = RtpTranslator(capacity=8)
+    for r, (mk, ms) in RECV_KEYS.items():
+        tr.add_receiver(r, mk, ms)
+    tr.connect(0, [1, 2, 3])
+    out, recv = tr.translate(dec, idx)
+    assert out.batch_size == 4 * 3
+    np.testing.assert_array_equal(np.unique(recv), [1, 2, 3])
+
+    # each receiver decrypts its copies with its own key; payloads match
+    for r, (mk, ms) in RECV_KEYS.items():
+        leg = SrtpStreamTable(capacity=8)
+        leg.add_stream(5, mk, ms)
+        rows = np.nonzero(recv == r)[0]
+        sub = PacketBatch.from_payloads(
+            [out.to_bytes(i) for i in rows], stream=[5] * len(rows))
+        dec_r, ok_r = leg.unprotect_rtp(sub)
+        assert ok_r.all()
+        for j in range(len(rows)):
+            assert dec_r.to_bytes(j) == dec.to_bytes(j)
+    # different receivers got different ciphertext for the same packet
+    c1 = out.to_bytes(int(np.nonzero(recv == 1)[0][0]))
+    c2 = out.to_bytes(int(np.nonzero(recv == 2)[0][0]))
+    assert c1 != c2
+
+
+def test_fanout_respects_routes_and_removal():
+    tr = RtpTranslator(capacity=8)
+    for r, (mk, ms) in RECV_KEYS.items():
+        tr.add_receiver(r, mk, ms)
+    tr.connect(0, [1, 2])
+    tr.connect(7, [3])          # other sender, not in this batch
+    b = _sender_batch(n=2)
+    out, recv = tr.translate(b, np.array([1000, 1001]))
+    assert sorted(np.unique(recv)) == [1, 2]
+    tr.remove_receiver(2)
+    out2, recv2 = tr.translate(b, np.array([1000, 1001]))
+    assert sorted(np.unique(recv2)) == [1]
+    # unrouted sender: nothing out
+    b2 = _sender_batch(sid=9)
+    out3, recv3 = tr.translate(b2, np.arange(4))
+    assert out3.batch_size == 0
+
+
+def test_roc_carried_into_fanout():
+    """Sender past a seq wrap (index > 2^16): receivers still decrypt."""
+    tx = SrtpStreamTable(capacity=2)
+    tx.add_stream(0, MK_A, MS_A)
+    rx = SrtpStreamTable(capacity=2)
+    rx.add_stream(0, MK_A, MS_A)
+    seqs = [65534, 65535, 0, 1]  # wraps: ROC increments mid-batch
+    b = rtp_header.build([b"wrap-%d" % s for s in seqs], seqs,
+                         [0] * 4, [0xAAA] * 4, [96] * 4, stream=[0] * 4)
+    dec, ok, idx = rx.unprotect_rtp(tx.protect_rtp(b), return_index=True)
+    assert ok.all()
+    assert idx[-1] == (1 << 16) + 1
+
+    tr = RtpTranslator(capacity=4)
+    mk, ms = RECV_KEYS[1]
+    tr.add_receiver(1, mk, ms)
+    tr.connect(0, [1])
+    out, recv = tr.translate(dec, idx)
+    leg = SrtpStreamTable(capacity=4)
+    leg.add_stream(0, mk, ms)
+    # receiver leg must accept across the wrap too
+    sub = PacketBatch.from_payloads(
+        [out.to_bytes(i) for i in range(out.batch_size)], stream=[0] * 4)
+    dec_r, ok_r = leg.unprotect_rtp(sub)
+    assert ok_r.all()
+
+
+# ------------------------------------------------------------------ cache --
+
+def test_cache_insert_lookup_nack():
+    c = PacketCache(max_bytes=10_000, max_age=10.0)
+    c.insert_batch([5, 5, 5], [100, 101, 102],
+                   [b"p100", b"p101", b"p102"], now=0.0)
+    assert c.get(5, 101) == b"p101"
+    nack = rtcp.Nack(sender_ssrc=9, media_ssrc=5, lost_seqs=[100, 102, 999])
+    got = c.lookup_nack(5, nack.lost_seqs)
+    assert got == [b"p100", b"p102"]
+
+
+def test_cache_eviction_by_bytes_and_age():
+    c = PacketCache(max_bytes=250, max_age=0.5)
+    for i in range(3):
+        c.insert(1, i, bytes(100), now=0.0)
+    assert len(c) == 2           # 300B > 250B: oldest evicted
+    assert c.get(1, 0) is None
+    c.insert(1, 50, bytes(10), now=1.0)   # age evicts the 0.0-era entries
+    assert c.get(1, 1) is None and c.get(1, 2) is None
+    assert c.get(1, 50) is not None
