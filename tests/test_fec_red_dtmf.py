@@ -1,0 +1,138 @@
+"""FEC recovery, RED encap/decap, DTMF event transforms.
+
+Reference behaviors: fec.FECReceiver single-loss XOR recovery,
+red.REDTransformEngine primary/redundant blocks, dtmf.DtmfTransformEngine
+tone lifecycle (marker on first, E-bit at end, audio suppressed).
+"""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.transform.dtmf import DtmfEvent, DtmfTransformEngine, decode_event
+from libjitsi_tpu.transform.fec import FecReceiver, FecSender, build_fec, parse_fec
+from libjitsi_tpu.transform.red import RedTransformEngine, decode_red, encode_red
+
+
+def _rtp(seq, payload, ts=1000, ssrc=5, pt=96, marker=0):
+    b = rtp_header.build([payload], [seq], [ts], [ssrc], [pt],
+                         marker=[marker])
+    return b.to_bytes(0)
+
+
+# ------------------------------------------------------------------- FEC ---
+
+def test_fec_recovers_single_loss():
+    pkts = [_rtp(100 + i, bytes([i]) * (20 + i), ts=1000 + 160 * i)
+            for i in range(5)]
+    fec = build_fec(pkts, seq_base=100)
+    rx = FecReceiver()
+    for i, p in enumerate(pkts):
+        if i != 2:
+            rx.push_media(p)
+    rec = rx.push_fec(fec, ssrc=5)
+    assert rec == pkts[2]
+    assert rx.recovered == 1
+
+
+def test_fec_no_recovery_when_two_missing():
+    pkts = [_rtp(200 + i, b"x" * 30) for i in range(4)]
+    fec = build_fec(pkts, seq_base=200)
+    rx = FecReceiver()
+    rx.push_media(pkts[0])
+    rx.push_media(pkts[3])
+    assert rx.push_fec(fec, ssrc=5) is None
+
+
+def test_fec_sender_groups():
+    tx = FecSender(k=3)
+    outs = [tx.push(_rtp(i, b"d" * 10)) for i in range(7)]
+    fecs = [o for o in outs if o is not None]
+    assert len(fecs) == 2
+    f = parse_fec(fecs[0])
+    assert f["seq_base"] == 0 and bin(f["mask"]).count("1") == 3
+
+
+def test_fec_recovers_different_lengths_and_marker():
+    pkts = [_rtp(10, b"short", marker=1), _rtp(11, b"a-much-longer-payload"),
+            _rtp(12, b"mid-size!!")]
+    fec = build_fec(pkts, seq_base=10)
+    rx = FecReceiver()
+    rx.push_media(pkts[0])
+    rx.push_media(pkts[2])
+    rec = rx.push_fec(fec, ssrc=5)
+    assert rec == pkts[1]
+
+
+# ------------------------------------------------------------------- RED ---
+
+def test_red_codec_roundtrip():
+    blob = encode_red(b"primary", 96, [(96, 960, b"older"), (96, 480, b"old")])
+    blocks = decode_red(blob)
+    assert blocks[-1] == (96, 0, b"primary")
+    assert blocks[0] == (96, 960, b"older")
+    assert blocks[1] == (96, 480, b"old")
+
+
+def test_red_engine_wrap_unwrap():
+    eng = RedTransformEngine(red_pt=104, distance=1)
+    b1 = PacketBatch.from_payloads([_rtp(1, b"frame-1", ts=960)], stream=[0])
+    b2 = PacketBatch.from_payloads([_rtp(2, b"frame-2", ts=1920)], stream=[0])
+    w1, _ = eng.rtp_transformer.transform(b1)
+    w2, _ = eng.rtp_transformer.transform(b2)
+    assert rtp_header.parse(w2).pt[0] == 104
+    # second packet carries frame-1 as redundancy
+    hdr = rtp_header.parse(w2)
+    blocks = decode_red(w2.to_bytes(0)[int(hdr.payload_off[0]):])
+    assert blocks[0][2] == b"frame-1" and blocks[-1][2] == b"frame-2"
+    assert blocks[0][1] == 960  # ts offset
+    # receiver unwraps to the primary
+    dec, ok = eng.rtp_transformer.reverse_transform(w2)
+    assert ok.all()
+    h = rtp_header.parse(dec)
+    assert h.pt[0] == 96
+    assert dec.to_bytes(0)[int(h.payload_off[0]):] == b"frame-2"
+
+
+# ------------------------------------------------------------------ DTMF ---
+
+def test_dtmf_tone_lifecycle():
+    eng = DtmfTransformEngine(dtmf_pt=101)
+    eng.start_tone(0, "5")
+    outs = []
+    for i in range(3):
+        b = PacketBatch.from_payloads(
+            [_rtp(10 + i, b"audio", ts=1000 + 160 * i)], stream=[0])
+        w, _ = eng.rtp_transformer.transform(b)
+        outs.append(w)
+    eng.stop_tone(0)
+    for i in range(3):
+        b = PacketBatch.from_payloads(
+            [_rtp(13 + i, b"audio", ts=1480 + 160 * i)], stream=[0])
+        w, _ = eng.rtp_transformer.transform(b)
+        outs.append(w)
+
+    hdrs = [rtp_header.parse(o) for o in outs]
+    assert all(h.pt[0] == 101 for h in hdrs)
+    assert hdrs[0].marker[0] == 1 and hdrs[1].marker[0] == 0
+    # all packets share the event-start timestamp
+    assert len({int(h.ts[0]) for h in hdrs}) == 1
+    evs = [decode_event(o.to_bytes(0)[int(h.payload_off[0]):])
+           for o, h in zip(outs, hdrs)]
+    assert all(e.event == 5 for e in evs)
+    assert [e.end for e in evs] == [False] * 3 + [True] * 3
+    assert evs[-1].duration > evs[0].duration
+
+
+def test_dtmf_receive_extracts_and_consumes():
+    got = []
+    eng = DtmfTransformEngine(dtmf_pt=101,
+                              on_event=lambda sid, ev: got.append((sid, ev)))
+    from libjitsi_tpu.transform.dtmf import encode_event
+    evt = _rtp(1, encode_event(DtmfEvent(7, False, 10, 320)), pt=101)
+    audio = _rtp(2, b"normal-audio", pt=96)
+    b = PacketBatch.from_payloads([evt, audio], stream=[3, 3])
+    out, ok = eng.rtp_transformer.reverse_transform(b)
+    assert ok.tolist() == [False, True]   # event consumed, audio passes
+    assert got and got[0][0] == 3 and got[0][1].event == 7
