@@ -1,0 +1,154 @@
+"""Batched AES-GCM AEAD (SP 800-38D) for SRTP/SRTCP (RFC 7714).
+
+Layout convention matches the SRTP packet: ``data[:aad_len]`` is the
+AAD (the RTP/RTCP header) and ``data[aad_len:length]`` the plaintext /
+ciphertext — encryption happens in place, the 16-byte tag is appended.
+CTR rides the existing AES kernel (J0 = IV||0x00000001; within one
+packet the 32-bit counter cannot wrap, so the full-128-bit increment is
+equivalent); the tag rides the GHASH MXU matmul kernel with the
+per-row index arithmetic building each row's ``AAD||0* || C||0* ||
+len(A)||len(C)`` block stream without host round trips.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libjitsi_tpu.kernels.aes import aes_encrypt, ctr_crypt_offset
+from libjitsi_tpu.kernels.ghash import ghash
+
+TAG_LEN = 16
+
+
+def _ceil16(x):
+    return (x + 15) & ~15
+
+
+def _ghash_width(capacity: int) -> int:
+    return 2 * _ceil16(capacity) + 16
+
+
+def _build_ghash_input(data, aad_len, ct_len, width: int):
+    """[B, W] packet bytes -> [B, width] GHASH block stream + counts.
+
+    Row layout: AAD (0-padded to 16) || ciphertext (0-padded) ||
+    be64(aad_bits) || be64(ct_bits).
+    """
+    bsz, cap = data.shape
+    a = aad_len.astype(jnp.int32)
+    c = ct_len.astype(jnp.int32)
+    ap = (a + 15) & ~15
+    cp = (c + 15) & ~15
+    cols = jnp.arange(width, dtype=jnp.int32)[None, :]
+
+    in_aad = cols < a[:, None]
+    k = cols - ap[:, None]
+    in_ct = (k >= 0) & (k < c[:, None])
+    src = jnp.where(in_aad, cols, jnp.where(in_ct, a[:, None] + k, 0))
+    gathered = jnp.take_along_axis(
+        data, jnp.clip(src, 0, cap - 1), axis=1)
+
+    # length block: be64(aad_bits) || be64(ct_bits).  Bit counts fit in
+    # 32 bits (capacity << 2^29), so bytes 0..3 of each u64 are zero and
+    # the arithmetic stays in int32.
+    lb_start = (ap + cp)[:, None]
+    p = cols - lb_start
+    abits = (a * 8)[:, None]
+    cbits = (c * 8)[:, None]
+    shift_a = jnp.clip(8 * (7 - p), 0, 24)
+    shift_c = jnp.clip(8 * (15 - p), 0, 24)
+    len_byte = jnp.where(
+        (p >= 4) & (p < 8), (abits >> shift_a) & 0xFF,
+        jnp.where((p >= 12) & (p < 16), (cbits >> shift_c) & 0xFF, 0)
+    ).astype(jnp.uint8)
+
+    out = jnp.where(in_aad | in_ct, gathered, 0).astype(jnp.uint8)
+    out = jnp.where((p >= 0) & (p < 16), len_byte, out)
+    nblocks = (ap + cp + 16) // 16
+    return out, nblocks
+
+
+def _j0(iv12):
+    """[B, 12] -> [B, 16] J0 = IV || 0x00000001."""
+    b = iv12.shape[0]
+    tail = jnp.tile(jnp.array([0, 0, 0, 1], dtype=jnp.uint8), (b, 1))
+    return jnp.concatenate([iv12.astype(jnp.uint8), tail], axis=1)
+
+
+def _inc32(block):
+    """Increment the last 32 bits (big-endian) of [B, 16] blocks."""
+    hi = block[:, :12]
+    lo = block[:, 12:].astype(jnp.uint32)
+    val = (lo[:, 0] << 24) | (lo[:, 1] << 16) | (lo[:, 2] << 8) | lo[:, 3]
+    val = val + 1  # uint32 wraps naturally
+    shifts = jnp.array([24, 16, 8, 0], dtype=jnp.uint32)
+    lo2 = ((val[:, None] >> shifts[None, :]) & 0xFF).astype(jnp.uint8)
+    return jnp.concatenate([hi, lo2], axis=1)
+
+
+def _scatter_tag(data, pos, tag):
+    col = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
+    pos = pos[:, None]
+    rel = jnp.clip(col - pos, 0, 15)
+    t = jnp.take_along_axis(tag, rel, axis=1)
+    return jnp.where((col >= pos) & (col < pos + TAG_LEN), t, data)
+
+
+def _gather_span(data, pos, n: int):
+    idx = pos[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(idx, 0, data.shape[1] - 1)
+    return jnp.take_along_axis(data, idx, axis=1)
+
+
+def _tag(round_keys, gmat, data, aad_len, ct_len, j0, width: int):
+    gin, nblk = _build_ghash_input(data, aad_len, ct_len, width)
+    s = ghash(gmat, gin, nblk, width // 16)
+    ek_j0 = aes_encrypt(round_keys, j0)
+    return jnp.bitwise_xor(s, ek_j0)
+
+
+@functools.partial(jax.jit, static_argnames=())
+def gcm_protect(data, length, aad_len, round_keys, gmat, iv12):
+    """Batched seal: encrypt data[aad:length] in place, append 16B tag.
+
+    data [B, W] uint8; length/aad_len [B] int32; round_keys [B, R, 16];
+    gmat [B, 128, 128] int8 (per-stream GHASH matrix); iv12 [B, 12].
+    Returns (data', length + 16).
+    """
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    aad_len = jnp.asarray(aad_len, dtype=jnp.int32)
+    j0 = _j0(jnp.asarray(iv12))
+    ctr0 = _inc32(j0)
+    ct_len = length - aad_len
+    enc = ctr_crypt_offset(round_keys, ctr0, data, aad_len, ct_len)
+    width = _ghash_width(data.shape[1])
+    tag = _tag(round_keys, gmat, enc, aad_len, ct_len, j0, width)
+    out = _scatter_tag(enc, length, tag)
+    return out, length + TAG_LEN
+
+
+@functools.partial(jax.jit, static_argnames=())
+def gcm_unprotect(data, length, aad_len, round_keys, gmat, iv12):
+    """Batched open: verify tag, decrypt in place.
+
+    Returns (data', length - 16, auth_ok).  Decrypt always runs
+    (branch-free); callers mask failed rows.
+    """
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    aad_len = jnp.asarray(aad_len, dtype=jnp.int32)
+    mlen = length - TAG_LEN
+    ct_len = mlen - aad_len
+    j0 = _j0(jnp.asarray(iv12))
+    width = _ghash_width(data.shape[1])
+    want = _tag(round_keys, gmat, data, aad_len, ct_len, j0, width)
+    stored = _gather_span(data, mlen, TAG_LEN)
+    auth_ok = jnp.all(stored == want, axis=1)
+    ctr0 = _inc32(j0)
+    dec = ctr_crypt_offset(round_keys, ctr0, data, aad_len, ct_len)
+    return dec, mlen, auth_ok

@@ -1,0 +1,108 @@
+"""GHASH (GCM's GF(2^128) universal hash) as batched MXU bit-matrix math.
+
+No CLMUL instruction exists on TPU; the usual software fallbacks are
+bit-serial loops or 4-bit Shoup tables (gather-heavy).  The TPU-native
+observation: multiplication by the *fixed* hash key H is GF(2)-linear,
+so the whole Horner step ``Y <- (Y xor X) * H`` is one 128x128 Boolean
+matrix applied to a 128-bit vector — i.e. an int8 matmul (mod 2) that
+maps straight onto the MXU, batched over packets.  The matrix M_H
+(including polynomial reduction) is precomputed on host per session key
+(H = AES_K(0^128)), exactly the kind of per-stream constant the SRTP
+tables already gather per row.
+
+Bit order follows NIST SP 800-38D: bit 0 = MSB of byte 0.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_R = 0xE1 << 120  # reduction polynomial bits (11100001 || 0^120)
+
+
+def gf_mult(x: int, y: int) -> int:
+    """SP 800-38D §6.3 multiplication on 128-bit ints (b0 = MSB)."""
+    z = 0
+    v = y
+    for i in range(128):
+        if (x >> (127 - i)) & 1:
+            z ^= v
+        lsb = v & 1
+        v >>= 1
+        if lsb:
+            v ^= _R
+    return z
+
+
+def ghash_matrix(h_block: bytes) -> np.ndarray:
+    """[128, 128] uint8 matrix M with (M @ bits(X)) % 2 == bits(X * H).
+
+    h_block: the 16-byte hash subkey H = AES_K(0^128).
+    """
+    h = int.from_bytes(h_block, "big")
+    m = np.zeros((128, 128), dtype=np.uint8)
+    for j in range(128):
+        col = gf_mult(1 << (127 - j), h)
+        for i in range(128):
+            m[i, j] = (col >> (127 - i)) & 1
+    return m
+
+
+def ghash_ref(h_block: bytes, data: bytes) -> bytes:
+    """Host reference GHASH over a whole (block-aligned) byte string."""
+    if len(data) % 16:
+        raise ValueError("ghash input must be block-aligned")
+    h = int.from_bytes(h_block, "big")
+    y = 0
+    for i in range(0, len(data), 16):
+        y = gf_mult(y ^ int.from_bytes(data[i:i + 16], "big"), h)
+    return y.to_bytes(16, "big")
+
+
+# ------------------------------------------------------------------ device
+
+def _bytes_to_bits(blk):
+    """uint8 [B, 16] -> int8 bits [B, 128] (bit 0 = MSB of byte 0)."""
+    shifts = jnp.arange(7, -1, -1, dtype=jnp.uint8)
+    bits = (blk[:, :, None] >> shifts[None, None, :]) & 1
+    return bits.reshape(blk.shape[0], 128).astype(jnp.int8)
+
+
+def _bits_to_bytes(bits):
+    w = (jnp.uint8(1) << jnp.arange(7, -1, -1, dtype=jnp.uint8))
+    b = bits.reshape(bits.shape[0], 16, 8).astype(jnp.uint8) * w[None, None, :]
+    return jnp.sum(b, axis=2).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("nblk_max",))
+def ghash(matrices, data, nblocks, nblk_max: int):
+    """Batched GHASH.
+
+    matrices: int8 [B, 128, 128] per-row M_H (gathered per stream);
+    data: uint8 [B, nblk_max*16] block-aligned, zero-padded;
+    nblocks: int32 [B] actual block count per row.
+    Returns uint8 [B, 16] digests.
+
+    The Horner loop is sequential in blocks (data dependence) but each
+    step is one batched MXU matmul over the whole packet batch; rows
+    shorter than the running block index take identity steps.
+    """
+    b = data.shape[0]
+    y = jnp.zeros((b, 128), dtype=jnp.int8)
+
+    def body(i, y):
+        blk = jax.lax.dynamic_slice_in_dim(data, i * 16, 16, axis=1)
+        x = _bytes_to_bits(blk)
+        t = jnp.bitwise_xor(y, x)
+        prod = jnp.einsum("bij,bj->bi", matrices, t,
+                          preferred_element_type=jnp.int32)
+        y2 = (prod & 1).astype(jnp.int8)
+        active = (i < nblocks)[:, None]
+        return jnp.where(active, y2, y)
+
+    y = jax.lax.fori_loop(0, nblk_max, body, y)
+    return _bits_to_bytes(y)

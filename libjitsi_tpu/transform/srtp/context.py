@@ -35,7 +35,9 @@ from libjitsi_tpu.core.rtp_math import (
     estimate_packet_index,
     segment_ranks,
 )
-from libjitsi_tpu.kernels.aes import expand_key
+from libjitsi_tpu.kernels import gcm as gcm_kernel
+from libjitsi_tpu.kernels.aes import aes_encrypt_np, expand_key
+from libjitsi_tpu.kernels.ghash import ghash_matrix
 from libjitsi_tpu.kernels.sha1 import hmac_precompute
 from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.transform.srtp import kernel, replay
@@ -76,6 +78,18 @@ def _unprotect_rtcp_dev(tab_rk, tab_mid, stream, data, length, iv,
         data, length, tab_rk[stream], iv, tab_mid[stream], tag_len, encrypt)
 
 
+@jax.jit
+def _protect_gcm_dev(tab_rk, tab_gm, stream, data, length, aad_len, iv12):
+    return gcm_kernel.gcm_protect(
+        data, length, aad_len, tab_rk[stream], tab_gm[stream], iv12)
+
+
+@jax.jit
+def _unprotect_gcm_dev(tab_rk, tab_gm, stream, data, length, aad_len, iv12):
+    return gcm_kernel.gcm_unprotect(
+        data, length, aad_len, tab_rk[stream], tab_gm[stream], iv12)
+
+
 class SrtpStreamTable:
     """Batched crypto contexts for up to `capacity` streams of one profile."""
 
@@ -84,8 +98,7 @@ class SrtpStreamTable:
         self.profile = profile
         self.policy: SrtpPolicy = profile.policy
         self.capacity = capacity
-        if self.policy.cipher == Cipher.AES_GCM:
-            raise NotImplementedError("AEAD-GCM arrives with the GCM kernel")
+        self._gcm = self.policy.cipher == Cipher.AES_GCM
         rounds = {16: 11, 32: 15}[self.policy.enc_key_len]
 
         s = capacity
@@ -95,6 +108,11 @@ class SrtpStreamTable:
         self._mid_rtp = np.zeros((s, 2, 5), dtype=np.uint32)
         self._rk_rtcp = np.zeros((s, rounds, 16), dtype=np.uint8)
         self._mid_rtcp = np.zeros((s, 2, 5), dtype=np.uint32)
+        if self._gcm:
+            # per-stream GHASH matrices (H = AES_K(0), RFC 7714): the MXU
+            # form of the GF(2^128) multiply — see kernels/ghash.py
+            self._gm_rtp = np.zeros((s, 128, 128), dtype=np.int8)
+            self._gm_rtcp = np.zeros((s, 128, 128), dtype=np.int8)
         self._dev = None  # cached jnp copies
         # host-side IV salts (16B, low 2 bytes zero)
         self._salt_rtp = np.zeros((s, 16), dtype=np.uint8)
@@ -125,8 +143,15 @@ class SrtpStreamTable:
             auth_key_len=p.auth_key_len, salt_len=p.salt_len, kdr=kdr)
         self._rk_rtp[sid] = expand_key(ks.rtp_enc)
         self._rk_rtcp[sid] = expand_key(ks.rtcp_enc)
-        self._mid_rtp[sid] = hmac_precompute(ks.rtp_auth)
-        self._mid_rtcp[sid] = hmac_precompute(ks.rtcp_auth)
+        if self._gcm:
+            for rk, gm in ((self._rk_rtp, self._gm_rtp),
+                           (self._rk_rtcp, self._gm_rtcp)):
+                h = bytes(aes_encrypt_np(rk[sid],
+                                         np.zeros((1, 16), np.uint8))[0])
+                gm[sid] = ghash_matrix(h).astype(np.int8)
+        else:
+            self._mid_rtp[sid] = hmac_precompute(ks.rtp_auth)
+            self._mid_rtcp[sid] = hmac_precompute(ks.rtcp_auth)
         self._salt_rtp[sid, : p.salt_len] = np.frombuffer(ks.rtp_salt, np.uint8)
         self._salt_rtp[sid, p.salt_len:] = 0
         self._salt_rtcp[sid, : p.salt_len] = np.frombuffer(ks.rtcp_salt, np.uint8)
@@ -146,13 +171,18 @@ class SrtpStreamTable:
         self._rk_rtcp[sid] = 0
         self._mid_rtp[sid] = 0
         self._mid_rtcp[sid] = 0
+        if self._gcm:
+            self._gm_rtp[sid] = 0
+            self._gm_rtcp[sid] = 0
         self._dev = None
 
     def _device(self):
         if self._dev is None:
+            aux_rtp = self._gm_rtp if self._gcm else self._mid_rtp
+            aux_rtcp = self._gm_rtcp if self._gcm else self._mid_rtcp
             self._dev = (
-                jnp.asarray(self._rk_rtp), jnp.asarray(self._mid_rtp),
-                jnp.asarray(self._rk_rtcp), jnp.asarray(self._mid_rtcp),
+                jnp.asarray(self._rk_rtp), jnp.asarray(aux_rtp),
+                jnp.asarray(self._rk_rtcp), jnp.asarray(aux_rtcp),
             )
         return self._dev
 
@@ -183,6 +213,30 @@ class SrtpStreamTable:
             iv[:, 8 + k] ^= ((index >> (8 * (5 - k))) & 0xFF).astype(np.uint8)
         return iv
 
+    def _gcm_rtp_iv(self, salt: np.ndarray, ssrc: np.ndarray,
+                    index: np.ndarray) -> np.ndarray:
+        """RFC 7714 §8.1: IV = (00 00 || SSRC || ROC || SEQ) XOR salt."""
+        iv = salt[:, :12].copy()
+        ssrc = np.asarray(ssrc, dtype=np.int64)
+        index = np.asarray(index, dtype=np.int64)
+        for k in range(4):
+            iv[:, 2 + k] ^= ((ssrc >> (8 * (3 - k))) & 0xFF).astype(np.uint8)
+        for k in range(6):
+            iv[:, 6 + k] ^= ((index >> (8 * (5 - k))) & 0xFF).astype(np.uint8)
+        return iv
+
+    def _gcm_rtcp_iv(self, salt: np.ndarray, ssrc: np.ndarray,
+                     index: np.ndarray) -> np.ndarray:
+        """RFC 7714 §9.1: IV = (00 00 || SSRC || 00 00 || index) XOR salt."""
+        iv = salt[:, :12].copy()
+        ssrc = np.asarray(ssrc, dtype=np.int64)
+        index = np.asarray(index, dtype=np.int64)
+        for k in range(4):
+            iv[:, 2 + k] ^= ((ssrc >> (8 * (3 - k))) & 0xFF).astype(np.uint8)
+        for k in range(4):
+            iv[:, 8 + k] ^= ((index >> (8 * (3 - k))) & 0xFF).astype(np.uint8)
+        return iv
+
     # ------------------------------------------------------------------ RTP
     def protect_rtp(self, batch: PacketBatch) -> PacketBatch:
         """Encrypt + tag a batch of outgoing RTP (rows in send order).
@@ -199,15 +253,22 @@ class SrtpStreamTable:
                 f"exceeds batch capacity {batch.capacity}")
         idx = chain_packet_indices(stream, hdr.seq, self.tx_ext)
         v = idx >> 16
-        iv = self._cm_iv(self._salt_rtp[stream], hdr.ssrc, idx)
 
-        tab_rk, tab_mid, _, _ = self._device()
-        data, length = _protect_rtp_dev(
-            tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
-            jnp.asarray(batch.data), jnp.asarray(batch.length),
-            jnp.asarray(hdr.payload_off), jnp.asarray(iv),
-            jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
-            self.policy.auth_tag_len, self.policy.cipher != Cipher.NULL)
+        tab_rk, tab_aux, _, _ = self._device()
+        if self._gcm:
+            iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
+            data, length = _protect_gcm_dev(
+                tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+                jnp.asarray(batch.data), jnp.asarray(batch.length),
+                jnp.asarray(hdr.payload_off), jnp.asarray(iv12))
+        else:
+            iv = self._cm_iv(self._salt_rtp[stream], hdr.ssrc, idx)
+            data, length = _protect_rtp_dev(
+                tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+                jnp.asarray(batch.data), jnp.asarray(batch.length),
+                jnp.asarray(hdr.payload_off), jnp.asarray(iv),
+                jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
+                self.policy.auth_tag_len, self.policy.cipher != Cipher.NULL)
         np.maximum.at(self.tx_ext, stream, idx)
         return PacketBatch(np.asarray(data), np.asarray(length, dtype=np.int32),
                            batch.stream)
@@ -248,15 +309,22 @@ class SrtpStreamTable:
         idx = np.where(base >= 0, idx_est, idx_chain)
         v = idx >> 16
         not_replayed = replay.check(self.rx_max, self.rx_mask, stream, idx)
-        iv = self._cm_iv(self._salt_rtp[stream], hdr.ssrc, idx)
 
-        tab_rk, tab_mid, _, _ = self._device()
-        data, mlen, auth_ok = _unprotect_rtp_dev(
-            tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
-            jnp.asarray(batch.data), jnp.asarray(length),
-            jnp.asarray(hdr.payload_off), jnp.asarray(iv),
-            jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
-            p.auth_tag_len, p.cipher != Cipher.NULL)
+        tab_rk, tab_aux, _, _ = self._device()
+        if self._gcm:
+            iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
+            data, mlen, auth_ok = _unprotect_gcm_dev(
+                tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+                jnp.asarray(batch.data), jnp.asarray(length),
+                jnp.asarray(hdr.payload_off), jnp.asarray(iv12))
+        else:
+            iv = self._cm_iv(self._salt_rtp[stream], hdr.ssrc, idx)
+            data, mlen, auth_ok = _unprotect_rtp_dev(
+                tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+                jnp.asarray(batch.data), jnp.asarray(length),
+                jnp.asarray(hdr.payload_off), jnp.asarray(iv),
+                jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
+                p.auth_tag_len, p.cipher != Cipher.NULL)
         ok = valid & not_replayed & np.asarray(auth_ok)
         # in-batch duplicate indices: keep the first *authenticated*
         # occurrence (a forged front-runner fails auth and must not block
@@ -291,6 +359,10 @@ class SrtpStreamTable:
         # per-stream sequential index assignment, stable in batch order
         index = self.rtcp_tx_index[stream] + 1 + segment_ranks(stream)
         ssrc = rtp_header.read_u32(batch.data, 4)
+        if self._gcm:
+            out = self._protect_rtcp_gcm(batch, stream, ssrc, index)
+            np.maximum.at(self.rtcp_tx_index, stream, index)
+            return out
         iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
         encrypting = self.policy.cipher != Cipher.NULL
         e = np.int64(1 << 31) if encrypting else np.int64(0)
@@ -306,6 +378,48 @@ class SrtpStreamTable:
         return PacketBatch(np.asarray(data), np.asarray(length, dtype=np.int32),
                            batch.stream)
 
+    def _protect_rtcp_gcm(self, batch: PacketBatch, stream, ssrc, index
+                          ) -> PacketBatch:
+        """RFC 7714 §9: AAD = RTCP header(8) || ESRTCP word; the index
+        word rides *after* the ciphertext+tag on the wire.  Host shuffles
+        the layout around the batched kernel (RTCP is low-rate)."""
+        n = batch.batch_size
+        cap = batch.capacity
+        length = np.asarray(batch.length, dtype=np.int32)
+        plen = length - 8
+        word = (index | (1 << 31)).astype(np.int64)  # E always set: AEAD
+        wb = np.zeros((n, 4), dtype=np.uint8)
+        for k in range(4):
+            wb[:, k] = (word >> (8 * (3 - k))) & 0xFF
+        kin = np.zeros_like(batch.data)
+        kin[:, :8] = batch.data[:, :8]
+        kin[:, 8:12] = wb
+        cols = np.arange(cap, dtype=np.int64)[None, :]
+        src = np.clip(cols - 4, 0, cap - 1)
+        shifted = np.take_along_axis(batch.data, src, axis=1)
+        sel = (cols >= 12) & (cols < (12 + plen)[:, None])
+        kin = np.where(sel, shifted, kin).astype(np.uint8)
+
+        iv12 = self._gcm_rtcp_iv(self._salt_rtcp[stream], ssrc, index)
+        tab_rk, tab_aux = self._device()[2], self._device()[3]
+        out, out_len = _protect_gcm_dev(
+            tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+            jnp.asarray(kin), jnp.asarray(12 + plen, dtype=jnp.int32),
+            jnp.asarray(np.full(n, 12, np.int32)), jnp.asarray(iv12))
+        out = np.asarray(out)
+        # wire: hdr8 || ct || tag || word
+        wire = np.zeros_like(out)
+        wire[:, :8] = out[:, :8]
+        sel2 = (cols >= 8) & (cols < (8 + plen + 16)[:, None])
+        unshift = np.take_along_axis(out, np.minimum(cols + 4, cap - 1),
+                                     axis=1)
+        wire = np.where(sel2, unshift, wire).astype(np.uint8)
+        wpos = 8 + plen + 16
+        for k in range(4):
+            np.put_along_axis(wire, (wpos + k)[:, None].astype(np.int64),
+                              wb[:, k][:, None], axis=1)
+        return PacketBatch(wire, (wpos + 4).astype(np.int32), batch.stream)
+
     def unprotect_rtcp(self, batch: PacketBatch
                        ) -> Tuple[PacketBatch, np.ndarray]:
         """Auth-check, replay-check and decrypt incoming SRTCP."""
@@ -315,8 +429,10 @@ class SrtpStreamTable:
         valid = (length >= 8 + 4 + p.auth_tag_len) & self.active[stream] & (
             stream >= 0)
 
-        # host-parse the trailer: E||index at length - tag - 4
-        tpos = np.maximum(length - p.auth_tag_len - 4, 0)
+        # host-parse the trailer: E||index (GCM: after the tag, RFC 7714;
+        # CM: before the tag, RFC 3711)
+        tpos = np.maximum(length - (4 if self._gcm
+                                    else p.auth_tag_len + 4), 0)
         word = np.zeros(len(stream), dtype=np.int64)
         for k in range(4):
             col = np.minimum(tpos + k, batch.capacity - 1)
@@ -326,13 +442,17 @@ class SrtpStreamTable:
         ssrc = rtp_header.read_u32(batch.data, 4)
         not_replayed = replay.check(self.rtcp_rx_max, self.rtcp_rx_mask,
                                     stream, index)
-        iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
 
-        _, _, tab_rk, tab_mid = self._device()
-        data, mlen, auth_ok, _e, _idx = _unprotect_rtcp_dev(
-            tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
-            jnp.asarray(batch.data), jnp.asarray(length), jnp.asarray(iv),
-            p.auth_tag_len, p.cipher != Cipher.NULL)
+        if self._gcm:
+            data, mlen, auth_ok = self._unprotect_rtcp_gcm(
+                batch, stream, ssrc, index, word, length)
+        else:
+            iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
+            _, _, tab_rk, tab_mid = self._device()
+            data, mlen, auth_ok, _e, _idx = _unprotect_rtcp_dev(
+                tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
+                jnp.asarray(batch.data), jnp.asarray(length),
+                jnp.asarray(iv), p.auth_tag_len, p.cipher != Cipher.NULL)
         ok = valid & not_replayed & np.asarray(auth_ok)
         ok &= ~replay.dedup_first(stream, index, ok)
         replay.update(self.rtcp_rx_max, self.rtcp_rx_mask, stream, index, ok)
@@ -343,11 +463,47 @@ class SrtpStreamTable:
         out_len = np.where(ok, mlen, length).astype(np.int32)
         return PacketBatch(out_data, out_len, batch.stream), ok
 
+    def _unprotect_rtcp_gcm(self, batch: PacketBatch, stream, ssrc, index,
+                            word, length):
+        """Reverse of `_protect_rtcp_gcm`: reshape wire
+        hdr8 || ct || tag || word into the kernel's hdr8 || word || ct ||
+        tag layout, open, and emit hdr8 || plaintext."""
+        n = batch.batch_size
+        cap = batch.capacity
+        ctlen = np.maximum(length - 8 - 16 - 4, 0)
+        wb = np.zeros((n, 4), dtype=np.uint8)
+        for k in range(4):
+            wb[:, k] = (np.asarray(word, np.int64) >> (8 * (3 - k))) & 0xFF
+        cols = np.arange(cap, dtype=np.int64)[None, :]
+        kin = np.zeros_like(batch.data)
+        kin[:, :8] = batch.data[:, :8]
+        kin[:, 8:12] = wb
+        shifted = np.take_along_axis(batch.data,
+                                     np.clip(cols - 4, 0, cap - 1), axis=1)
+        sel = (cols >= 12) & (cols < (12 + ctlen + 16)[:, None])
+        kin = np.where(sel, shifted, kin).astype(np.uint8)
+
+        iv12 = self._gcm_rtcp_iv(self._salt_rtcp[stream], ssrc, index)
+        tab_rk, tab_aux = self._device()[2], self._device()[3]
+        dec, _, auth_ok = _unprotect_gcm_dev(
+            tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+            jnp.asarray(kin),
+            jnp.asarray(12 + ctlen + 16, dtype=jnp.int32),
+            jnp.asarray(np.full(n, 12, np.int32)), jnp.asarray(iv12))
+        dec = np.asarray(dec)
+        out = np.zeros_like(dec)
+        out[:, :8] = dec[:, :8]
+        unshift = np.take_along_axis(dec, np.minimum(cols + 4, cap - 1),
+                                     axis=1)
+        sel2 = (cols >= 8) & (cols < (8 + ctlen)[:, None])
+        out = np.where(sel2, unshift, out).astype(np.uint8)
+        return out, (8 + ctlen).astype(np.int32), np.asarray(auth_ok)
+
     # ------------------------------------------------------------ checkpoint
     def snapshot(self) -> dict:
         """Serializable crypto-state snapshot (ROC/replay survive restarts —
         without them streams die; see SURVEY §5 checkpoint/resume)."""
-        return {
+        snap = {
             "profile": self.profile.value,
             "active": self.active.copy(),
             "rk_rtp": self._rk_rtp.copy(), "mid_rtp": self._mid_rtp.copy(),
@@ -359,6 +515,10 @@ class SrtpStreamTable:
             "rtcp_rx_max": self.rtcp_rx_max.copy(),
             "rtcp_rx_mask": self.rtcp_rx_mask.copy(),
         }
+        if self._gcm:
+            snap["gm_rtp"] = self._gm_rtp.copy()
+            snap["gm_rtcp"] = self._gm_rtcp.copy()
+        return snap
 
     @classmethod
     def restore(cls, snap: dict) -> "SrtpStreamTable":
@@ -377,5 +537,8 @@ class SrtpStreamTable:
         t.rtcp_tx_index = snap["rtcp_tx_index"].copy()
         t.rtcp_rx_max = snap["rtcp_rx_max"].copy()
         t.rtcp_rx_mask = snap["rtcp_rx_mask"].copy()
+        if t._gcm:
+            t._gm_rtp = snap["gm_rtp"].copy()
+            t._gm_rtcp = snap["gm_rtcp"].copy()
         t._dev = None
         return t

@@ -1,0 +1,214 @@
+"""AEAD AES-GCM: GHASH/GCM kernel KATs, OpenSSL differentials, and the
+AEAD_AES_128_GCM SRTP/SRTCP profile (RFC 7714) through SrtpStreamTable.
+"""
+
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.kernels import gcm as G
+from libjitsi_tpu.kernels.aes import aes_encrypt_np, expand_key
+from libjitsi_tpu.kernels.ghash import ghash, ghash_matrix, ghash_ref, gf_mult
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.transform.srtp import SrtpProfile, SrtpStreamTable
+
+MK = bytes(range(16))
+MS = bytes(range(100, 112))  # 12-byte GCM salt
+
+
+def _gm(key: bytes) -> np.ndarray:
+    h = bytes(aes_encrypt_np(expand_key(key), np.zeros((1, 16), np.uint8))[0])
+    return ghash_matrix(h).astype(np.int8)
+
+
+# ------------------------------------------------------------------ GHASH --
+
+def test_gf_mult_identity_and_commutes():
+    one = 1 << 127  # the GCM field's multiplicative identity (b0 = 1)
+    x = int.from_bytes(os.urandom(16), "big")
+    y = int.from_bytes(os.urandom(16), "big")
+    assert gf_mult(x, one) == x
+    assert gf_mult(one, y) == y
+    assert gf_mult(x, y) == gf_mult(y, x)
+
+
+def test_ghash_matrix_matches_reference():
+    h = os.urandom(16)
+    data = os.urandom(96)
+    m = ghash_matrix(h).astype(np.int8)
+    got = ghash(jnp.asarray(np.broadcast_to(m, (1, 128, 128))),
+                jnp.asarray(np.frombuffer(data, np.uint8)[None, :]),
+                jnp.asarray(np.array([6], np.int32)), 6)
+    assert bytes(np.asarray(got)[0]) == ghash_ref(h, data)
+
+
+def test_ghash_row_lengths_independent():
+    """Rows with fewer blocks take identity steps, not extra multiplies."""
+    h = os.urandom(16)
+    m = np.broadcast_to(ghash_matrix(h).astype(np.int8), (2, 128, 128))
+    long = os.urandom(64)
+    short = long[:32]
+    buf = np.zeros((2, 64), np.uint8)
+    buf[0] = np.frombuffer(long, np.uint8)
+    buf[1, :32] = np.frombuffer(short, np.uint8)
+    got = ghash(jnp.asarray(m), jnp.asarray(buf),
+                jnp.asarray(np.array([4, 2], np.int32)), 4)
+    assert bytes(np.asarray(got)[0]) == ghash_ref(h, long)
+    assert bytes(np.asarray(got)[1]) == ghash_ref(h, short)
+
+
+# ----------------------------------------------------------- GCM vs OpenSSL
+
+def test_gcm_differential_vs_openssl_mixed_lengths():
+    rng = np.random.default_rng(2)
+    B, W = 6, 160
+    keys = [os.urandom(16) for _ in range(B)]
+    ivs = [os.urandom(12) for _ in range(B)]
+    aad_lens = [12, 12, 16, 20, 12, 28]
+    pt_lens = [40, 0, 33, 77, 1, 100]
+    data = np.zeros((B, W), np.uint8)
+    for i in range(B):
+        blob = os.urandom(aad_lens[i] + pt_lens[i])
+        data[i, :len(blob)] = np.frombuffer(blob, np.uint8)
+    length = np.array([a + p for a, p in zip(aad_lens, pt_lens)], np.int32)
+    aad_len = np.array(aad_lens, np.int32)
+    rks = np.stack([expand_key(k) for k in keys])
+    gms = np.stack([_gm(k) for k in keys])
+    iv12 = np.stack([np.frombuffer(v, np.uint8) for v in ivs])
+
+    out, outlen = G.gcm_protect(data, length, aad_len, jnp.asarray(rks),
+                                jnp.asarray(gms), jnp.asarray(iv12))
+    out, outlen = np.asarray(out), np.asarray(outlen)
+    for i in range(B):
+        aad = bytes(data[i, :aad_lens[i]])
+        pt = bytes(data[i, aad_lens[i]:length[i]])
+        want = AESGCM(keys[i]).encrypt(ivs[i], pt, aad)
+        got = bytes(out[i, aad_lens[i]:length[i] + 16])
+        assert got == want, f"row {i}"
+
+    dec, mlen, ok = G.gcm_unprotect(out, outlen, aad_len, jnp.asarray(rks),
+                                    jnp.asarray(gms), jnp.asarray(iv12))
+    assert np.asarray(ok).all()
+    dec = np.asarray(dec)
+    for i in range(B):
+        assert bytes(dec[i, :length[i]]) == bytes(data[i, :length[i]])
+
+    # any flipped bit (aad, ct or tag) kills that row only
+    for pos in (2, aad_lens[0] + 3, int(length[0]) + 5):
+        bad = out.copy()
+        bad[0, pos] ^= 1
+        _, _, ok2 = G.gcm_unprotect(bad, outlen, aad_len, jnp.asarray(rks),
+                                    jnp.asarray(gms), jnp.asarray(iv12))
+        ok2 = np.asarray(ok2)
+        assert not ok2[0] and ok2[1:].all()
+
+
+# ------------------------------------------------------------ SRTP profile
+
+def make_gcm_table(n=4):
+    t = SrtpStreamTable(capacity=n, profile=SrtpProfile.AEAD_AES_128_GCM)
+    for i in range(n):
+        t.add_stream(i, MK, MS)
+    return t
+
+
+def _rtp_batch(seqs, ssrc=0x4242, stream=0):
+    return rtp_header.build([b"gcm-payload-%02d" % s for s in seqs],
+                            list(seqs), [0] * len(seqs), [ssrc] * len(seqs),
+                            [96] * len(seqs), stream=[stream] * len(seqs))
+
+
+def test_srtp_gcm_rfc7714_vector():
+    """RFC 7714 §16.1.1 AEAD_AES_128_GCM SRTP protection known answer."""
+    key = bytes.fromhex("000102030405060708090a0b0c0d0e0f")
+    salt = bytes.fromhex("517569642070726f2071756f")
+    pkt = bytes.fromhex(
+        "8040f17b8041f8d35501a0b247616c6c"
+        "696120657374206f6d6e697320646976"
+        "69736120696e207061727465732074726573")
+    roc = 0
+    # direct kernel path with the RFC's session key/iv construction:
+    # RFC 7714 uses the master key directly as session key in the example
+    iv = bytearray(salt)
+    ssrc = int.from_bytes(pkt[8:12], "big")
+    seq = int.from_bytes(pkt[2:4], "big")
+    for k in range(4):
+        iv[2 + k] ^= (ssrc >> (8 * (3 - k))) & 0xFF
+    idx = (roc << 16) | seq
+    for k in range(6):
+        iv[6 + k] ^= (idx >> (8 * (5 - k))) & 0xFF
+    data = np.zeros((1, 128), np.uint8)
+    data[0, :len(pkt)] = np.frombuffer(pkt, np.uint8)
+    out, outlen = G.gcm_protect(
+        data, np.array([len(pkt)], np.int32), np.array([12], np.int32),
+        jnp.asarray(expand_key(key)[None]), jnp.asarray(_gm(key)[None]),
+        jnp.asarray(np.frombuffer(bytes(iv), np.uint8)[None]))
+    got = bytes(np.asarray(out)[0, :int(np.asarray(outlen)[0])])
+    want = bytes.fromhex(
+        "8040f17b8041f8d35501a0b2f24de3a3"
+        "fb34de6cacba861c9d7e4bcabe633bd5"
+        "0d294e6f42a5f47a51c7d19b36de3adf"
+        "8833899d7f27beb16a9152cf765ee439"
+        "0cce")
+    assert got == want
+
+
+def test_srtp_gcm_table_roundtrip():
+    tx, rx = make_gcm_table(), make_gcm_table()
+    b = _rtp_batch(range(100, 108))
+    wire = tx.protect_rtp(b)
+    assert np.all(np.asarray(wire.length) == np.asarray(b.length) + 16)
+    dec, ok = rx.unprotect_rtp(wire)
+    assert ok.all()
+    for i in range(8):
+        assert dec.to_bytes(i) == b.to_bytes(i)
+    # replay rejected
+    _, ok2 = rx.unprotect_rtp(wire)
+    assert not ok2.any()
+    # tamper rejected
+    bad = tx.protect_rtp(_rtp_batch([200])).copy()
+    bad.data[0, 20] ^= 1
+    _, ok3 = rx.unprotect_rtp(bad)
+    assert not ok3.any()
+
+
+def test_srtp_gcm_seq_wrap_roc():
+    tx, rx = make_gcm_table(), make_gcm_table()
+    seqs = [65534, 65535, 0, 1]
+    b = rtp_header.build([b"w%d" % s for s in seqs], seqs, [0] * 4,
+                         [0x99] * 4, [96] * 4, stream=[0] * 4)
+    dec, ok = rx.unprotect_rtp(tx.protect_rtp(b))
+    assert ok.all()
+    assert rx.rx_max[0] == (1 << 16) + 1
+
+
+def test_srtcp_gcm_roundtrip():
+    tx, rx = make_gcm_table(), make_gcm_table()
+    from libjitsi_tpu.rtp import rtcp
+    sr = rtcp.build_sr(rtcp.SenderReport(0x77, 1, 2, 3, 4, 5, []))
+    b = PacketBatch.from_payloads([sr, sr], stream=[0, 1])
+    wire = tx.protect_rtcp(b)
+    assert np.all(np.asarray(wire.length) == len(sr) + 16 + 4)
+    dec, ok = rx.unprotect_rtcp(wire)
+    assert ok.all()
+    assert dec.to_bytes(0) == sr and dec.to_bytes(1) == sr
+    # replay
+    _, ok2 = rx.unprotect_rtcp(wire)
+    assert not ok2.any()
+
+
+def test_gcm_snapshot_restore():
+    tx = make_gcm_table()
+    rx = make_gcm_table()
+    wire = tx.protect_rtp(_rtp_batch([5]))
+    rx.unprotect_rtp(wire)
+    rx2 = SrtpStreamTable.restore(rx.snapshot())
+    # replay still rejected after restore; next packet accepted
+    _, ok = rx2.unprotect_rtp(wire)
+    assert not ok.any()
+    dec, ok2 = rx2.unprotect_rtp(tx.protect_rtp(_rtp_batch([6])))
+    assert ok2.all()
