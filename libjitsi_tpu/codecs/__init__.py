@@ -1,0 +1,1 @@
+from libjitsi_tpu.codecs.opus import OpusDecoder, OpusEncoder, opus_available  # noqa: F401

@@ -1,0 +1,72 @@
+"""Polyphase audio resampler as an XLA convolution.
+
+Rebuilds the role of the reference's Speex resampler (`src/native/speex`,
+used to normalize all conference inputs to one rate before mixing —
+SURVEY §2.5 "the resampler matters for the mixer").  A windowed-sinc FIR
+evaluated polyphase: for conversion L/M, output phase p uses filter bank
+row p; the whole batch of streams resamples in one `conv_general_dilated`
+(MXU-friendly: [B, 1, T] x [phases, taps]).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _design(l: int, m: int, taps_per_phase: int = 16,
+            cutoff_scale: float = 0.9) -> np.ndarray:
+    """[L, taps] polyphase bank of a Kaiser-windowed sinc low-pass."""
+    ntaps = taps_per_phase * l
+    cutoff = cutoff_scale * 0.5 / max(l, m)  # in units of upsampled rate
+    n = np.arange(ntaps) - (ntaps - 1) / 2.0
+    h = 2 * cutoff * np.sinc(2 * cutoff * n)
+    h *= np.kaiser(ntaps, beta=8.0)
+    h *= l  # gain compensation for zero-stuffing
+    # phase p takes taps h[p], h[p+L], ...
+    bank = np.zeros((l, taps_per_phase), dtype=np.float32)
+    for p in range(l):
+        bank[p] = h[p::l][:taps_per_phase]
+    return bank
+
+
+@functools.partial(jax.jit, static_argnames=("l", "m", "taps_per_phase"))
+def _resample_jit(pcm, l: int, m: int, taps_per_phase: int):
+    b, t = pcm.shape
+    bank = jnp.asarray(_design(l, m, taps_per_phase))
+    out_len = (t * l) // m
+    # output sample j sits at upsampled position j*M = phase + L*shift
+    j = jnp.arange(out_len)
+    pos = j * m
+    phase = (pos % l).astype(jnp.int32)
+    base = (pos // l).astype(jnp.int32)
+    # gather input windows [B, out_len, taps]
+    k = jnp.arange(taps_per_phase, dtype=jnp.int32)
+    idx = base[None, :, None] - k[None, None, :] + (taps_per_phase // 2)
+    idx = jnp.clip(idx, 0, t - 1)
+    x = pcm.astype(jnp.float32)[:, None, :]
+    win = jnp.take_along_axis(jnp.broadcast_to(x, (b, out_len, t)), idx,
+                              axis=2)
+    coef = bank[phase]  # [out_len, taps]
+    y = jnp.einsum("bot,ot->bo", win, coef)
+    return jnp.clip(jnp.round(y), -32768, 32767).astype(jnp.int16)
+
+
+def resample(pcm, rate_in: int, rate_out: int,
+             taps_per_phase: int = 16):
+    """int16 [B, T] at rate_in -> int16 [B, T*L//M] at rate_out.
+
+    L/M reduced from the rate ratio; supports the conference-relevant
+    conversions (8k/16k/24k/44.1k <-> 48k).
+    """
+    if rate_in == rate_out:
+        return jnp.asarray(pcm, dtype=jnp.int16)
+    g = math.gcd(rate_in, rate_out)
+    l, m = rate_out // g, rate_in // g
+    if l > 480:
+        raise ValueError(f"unreasonable ratio {rate_out}/{rate_in}")
+    return _resample_jit(jnp.asarray(pcm), l, m, taps_per_phase)

@@ -1,0 +1,175 @@
+"""Codecs: Opus binding, VP8 depacketizer, G.711 kernels, resampler.
+
+Reference behaviors: opus.Opus JNI surface, vp8.DePacketizer descriptor
+logic, alaw/ulaw codecs (differential vs stdlib audioop-style math),
+speex resampler role (spectral fidelity on a sine).
+"""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.codecs import OpusDecoder, OpusEncoder, opus_available
+from libjitsi_tpu.codecs.vp8 import (
+    SimulcastReceiver,
+    build_descriptor,
+    parse_descriptors,
+)
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.kernels.g711 import (
+    alaw_decode,
+    alaw_encode,
+    ulaw_decode,
+    ulaw_encode,
+)
+from libjitsi_tpu.kernels.resample import resample
+from libjitsi_tpu.rtp import header as rtp_header
+
+
+# ------------------------------------------------------------------ Opus ---
+
+@pytest.mark.skipif(not opus_available(), reason="libopus not present")
+def test_opus_roundtrip_sine():
+    enc = OpusEncoder()
+    enc.set_bitrate(64000)
+    enc.set_complexity(5)
+    dec = OpusDecoder()
+    t = np.arange(960) / 48000.0
+    pcm = (np.sin(2 * np.pi * 440 * t) * 10000).astype(np.int16)
+    # prime the codec, then check correlation on a steady frame
+    out = None
+    for _ in range(5):
+        pkt = enc.encode(pcm)
+        out = dec.decode(pkt, 960)
+    assert out.shape == (960,)
+    # Opus has ~6.5 ms algorithmic lookahead: compare spectra, not samples
+    spec = np.abs(np.fft.rfft(out * np.hanning(960)))
+    peak = np.argmax(spec) * 48000 / 960
+    assert abs(peak - 440) < 60
+    # decoded energy in the same ballpark as the input
+    assert 0.5 < np.std(out.astype(float)) / np.std(pcm.astype(float)) < 2.0
+    assert 10 < len(pkt) < 400
+
+
+@pytest.mark.skipif(not opus_available(), reason="libopus not present")
+def test_opus_plc():
+    dec = OpusDecoder()
+    out = dec.decode(None, 960)   # concealment with no prior audio
+    assert out.shape == (960,)
+
+
+# ------------------------------------------------------------------- VP8 ---
+
+def _vp8_pkt(desc: bytes, payload: bytes, seq=1, ssrc=0x10):
+    b = rtp_header.build([desc + payload], [seq], [0], [ssrc], [100])
+    return b.to_bytes(0)
+
+
+def test_vp8_descriptor_roundtrip_parse():
+    desc = build_descriptor(start=True, picture_id=345, tl0picidx=7, tid=2)
+    payload = bytes([0x00, 0xAA, 0xBB])  # P bit 0 -> keyframe candidate
+    pkt = _vp8_pkt(desc, payload)
+    batch = PacketBatch.from_payloads([pkt])
+    d = parse_descriptors(batch)
+    assert d.valid[0]
+    assert d.start_of_partition[0] == 1 and d.partition_id[0] == 0
+    assert d.picture_id[0] == 345
+    assert d.tl0picidx[0] == 7 and d.tid[0] == 2
+    assert d.is_keyframe[0]
+    assert d.desc_len[0] == len(desc)
+
+
+def test_vp8_short_picture_id_and_interframe():
+    desc = build_descriptor(start=True, picture_id=5)
+    payload = bytes([0x01])  # P=1 -> interframe
+    d = parse_descriptors(PacketBatch.from_payloads([_vp8_pkt(desc, payload)]))
+    assert d.picture_id[0] == 5
+    assert not d.is_keyframe[0]
+    # continuation packet (S=0)
+    d2 = parse_descriptors(PacketBatch.from_payloads(
+        [_vp8_pkt(build_descriptor(start=False), b"\x00\xff")]))
+    assert d2.start_of_partition[0] == 0
+    assert not d2.is_keyframe[0]
+
+
+def test_simulcast_receiver_layers():
+    ssrcs = [0x100, 0x200, 0x300]
+    rx = SimulcastReceiver(ssrcs)
+    pkts = []
+    for layer, ssrc in enumerate(ssrcs):
+        key = bytes([0x00])
+        desc = build_descriptor(start=True, picture_id=10 + layer,
+                                tl0picidx=layer)
+        pkts.append(_vp8_pkt(desc, key, seq=layer, ssrc=ssrc))
+    rx.ingest(PacketBatch.from_payloads(pkts))
+    assert rx.keyframe_seen.all()
+    np.testing.assert_array_equal(rx.last_picture_id, [10, 11, 12])
+    assert rx.select_layer(5e6, [0.5e6, 1.5e6, 3e6]) == 2
+    assert rx.select_layer(1e6, [0.5e6, 1.5e6, 3e6]) == 0
+
+
+# ----------------------------------------------------------------- G.711 ---
+
+def _g711_ref_ulaw(x: int) -> int:
+    """Scalar reference µ-law encoder straight from G.711."""
+    BIAS, CLIP = 0x84, 32635
+    sign = 0x80 if x < 0 else 0
+    x = min(abs(x), CLIP) + BIAS
+    exp = 7
+    mask = 0x4000
+    while exp > 0 and not (x & mask):
+        exp -= 1
+        mask >>= 1
+    mant = (x >> (exp + 3)) & 0x0F
+    return ~(sign | (exp << 4) | mant) & 0xFF
+
+
+def test_ulaw_encode_matches_scalar_reference():
+    rng = np.random.default_rng(3)
+    pcm = rng.integers(-32768, 32768, 500).astype(np.int16)
+    got = np.asarray(ulaw_encode(pcm))
+    want = np.array([_g711_ref_ulaw(int(v)) for v in pcm], dtype=np.uint8)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_g711_roundtrip_error_bounds():
+    pcm = np.linspace(-30000, 30000, 2000).astype(np.int16)
+    for enc, dec in ((ulaw_encode, ulaw_decode), (alaw_encode, alaw_decode)):
+        back = np.asarray(dec(enc(pcm))).astype(np.int64)
+        err = np.abs(back - pcm)
+        # logarithmic quantization: error scales with magnitude
+        assert np.all(err <= np.maximum(np.abs(pcm) // 16, 64))
+        # codec is idempotent through a second pass
+        again = np.asarray(dec(enc(back.astype(np.int16))))
+        np.testing.assert_array_equal(again, back)
+
+
+# ------------------------------------------------------------- resampler ---
+
+def _tone(rate, freq, seconds=0.1):
+    t = np.arange(int(rate * seconds)) / rate
+    return (np.sin(2 * np.pi * freq * t) * 8000).astype(np.int16)
+
+
+@pytest.mark.parametrize("rate_in", [8000, 16000, 24000])
+def test_resample_preserves_tone(rate_in):
+    freq = 440.0
+    x = _tone(rate_in, freq)[None, :]
+    y = np.asarray(resample(x, rate_in, 48000))[0]
+    assert abs(len(y) - len(x[0]) * 48000 // rate_in) <= 1
+    # dominant frequency survives
+    spec = np.abs(np.fft.rfft(y * np.hanning(len(y))))
+    peak = np.argmax(spec) * 48000 / len(y)
+    assert abs(peak - freq) < 15
+    # energy preserved within 3 dB (ignore edges)
+    mid = slice(len(y) // 4, 3 * len(y) // 4)
+    ratio = np.std(y[mid].astype(float)) / np.std(x[0].astype(float))
+    assert 0.7 < ratio < 1.4
+
+
+def test_resample_identity_and_batch():
+    x = _tone(48000, 1000)[None, :]
+    y = resample(x, 48000, 48000)
+    np.testing.assert_array_equal(np.asarray(y), x)
+    xb = np.vstack([_tone(16000, 300), _tone(16000, 1200)])
+    yb = np.asarray(resample(xb, 16000, 48000))
+    assert yb.shape == (2, xb.shape[1] * 3)
