@@ -1,1 +1,6 @@
-from libjitsi_tpu.control.sdes import SdesControl, CryptoAttribute  # noqa: F401
+from libjitsi_tpu.control.dtls import (  # noqa: F401
+    DtlsSrtpEndpoint,
+    generate_certificate,
+    is_dtls,
+)
+from libjitsi_tpu.control.sdes import CryptoAttribute, SdesControl  # noqa: F401

@@ -1,0 +1,241 @@
+"""DTLS-SRTP control plane (RFC 5764), host-side.
+
+Rebuilds the reference's `org.jitsi.impl.neomedia.transform.dtls.
+{DtlsControlImpl,DtlsPacketTransformer,TlsClientImpl,TlsServerImpl,
+DatagramTransportImpl}` (BouncyCastle-based) on OpenSSL's DTLS via the
+`cryptography` package's FFI bindings: memory-BIO packet-in/packet-out
+(no sockets — the host I/O loop feeds datagrams, exactly like the
+reference's DatagramTransportImpl), the `use_srtp` extension for profile
+negotiation, X.509 fingerprint verification against signaling, and
+RFC 5764 §4.2 "EXTRACTOR-dtls_srtp" keying-material export feeding the
+SRTP tables.  Handshake is the cold path and stays off-TPU (SURVEY
+§2.2).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import List, Optional, Tuple
+
+from cryptography import x509
+from cryptography.hazmat.bindings.openssl.binding import Binding
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.x509.oid import NameOID
+import datetime
+
+from libjitsi_tpu.transform.srtp.policy import SrtpProfile
+
+_b = Binding()
+_lib, _ffi = _b.lib, _b.ffi
+
+# RFC 5764 §4.1.2 / OpenSSL srtp.h profile registry
+_PROFILE_BY_ID = {
+    0x0001: SrtpProfile.AES_CM_128_HMAC_SHA1_80,
+    0x0002: SrtpProfile.AES_CM_128_HMAC_SHA1_32,
+    0x0007: SrtpProfile.AEAD_AES_128_GCM,
+}
+_OPENSSL_NAME = {
+    SrtpProfile.AES_CM_128_HMAC_SHA1_80: "SRTP_AES128_CM_SHA1_80",
+    SrtpProfile.AES_CM_128_HMAC_SHA1_32: "SRTP_AES128_CM_SHA1_32",
+    SrtpProfile.AEAD_AES_128_GCM: "SRTP_AEAD_AES_128_GCM",
+}
+
+
+def is_dtls(datagram: bytes) -> bool:
+    """RFC 5764 §5.1.2 demux: first byte in [20..63] = DTLS record."""
+    return len(datagram) > 0 and 20 <= datagram[0] <= 63
+
+
+def generate_certificate(cn: str = "libjitsi-tpu"
+                         ) -> Tuple[bytes, bytes, str]:
+    """Self-signed ECDSA P-256 cert: (cert_der, key_der, sha256 fp).
+
+    Reference: DtlsControlImpl generates a per-instance self-signed
+    certificate whose fingerprint goes into signaling.
+    """
+    key = ec.generate_private_key(ec.SECP256R1())
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (x509.CertificateBuilder()
+            .subject_name(name).issuer_name(name)
+            .public_key(key.public_key())
+            .serial_number(x509.random_serial_number())
+            .not_valid_before(now - datetime.timedelta(days=1))
+            .not_valid_after(now + datetime.timedelta(days=365))
+            .sign(key, hashes.SHA256()))
+    cert_der = cert.public_bytes(serialization.Encoding.DER)
+    key_der = key.private_bytes(
+        serialization.Encoding.DER,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+    return cert_der, key_der, fingerprint(cert_der)
+
+
+def fingerprint(cert_der: bytes) -> str:
+    """SDP-style uppercase colon-separated SHA-256 fingerprint."""
+    h = hashlib.sha256(cert_der).hexdigest().upper()
+    return ":".join(h[i:i + 2] for i in range(0, len(h), 2))
+
+
+class DtlsSrtpEndpoint:
+    """One DTLS-SRTP association (client or server role).
+
+    Packet-level API:
+      out = ep.handshake_packets()      # datagrams to send now
+      out = ep.feed(incoming_datagram)  # returns response datagrams
+      ep.complete                       # handshake done?
+      ep.srtp_keys()                    # (profile, tx_key, tx_salt,
+                                        #  rx_key, rx_salt) per role
+    """
+
+    EXTRACTOR = b"EXTRACTOR-dtls_srtp"
+
+    def __init__(self, role: str,
+                 profiles: Optional[List[SrtpProfile]] = None,
+                 cert_der: Optional[bytes] = None,
+                 key_der: Optional[bytes] = None,
+                 remote_fingerprint: Optional[str] = None,
+                 mtu: int = 1200):
+        if role not in ("client", "server"):
+            raise ValueError("role must be client or server")
+        self.role = role
+        self.profiles = profiles or [
+            SrtpProfile.AES_CM_128_HMAC_SHA1_80,
+            SrtpProfile.AEAD_AES_128_GCM,
+        ]
+        if cert_der is None:
+            cert_der, key_der, _ = generate_certificate()
+        self.cert_der = cert_der
+        self.local_fingerprint = fingerprint(cert_der)
+        self.remote_fingerprint = remote_fingerprint
+        self.complete = False
+        self.peer_cert_der: Optional[bytes] = None
+
+        ctx = _lib.SSL_CTX_new(_lib.DTLS_method())
+        if ctx == _ffi.NULL:
+            raise RuntimeError("SSL_CTX_new failed")
+        self._ctx = _ffi.gc(ctx, _lib.SSL_CTX_free)
+
+        # install cert + key from DER (via memory BIOs — the bindings
+        # expose only the *_bio d2i variants)
+        cbio = _lib.BIO_new_mem_buf(cert_der, len(cert_der))
+        x509p = _lib.d2i_X509_bio(cbio, _ffi.NULL)
+        _lib.BIO_free(cbio)
+        if x509p == _ffi.NULL:
+            raise RuntimeError("d2i_X509_bio failed")
+        _lib.SSL_CTX_use_certificate(self._ctx, x509p)
+        kbio = _lib.BIO_new_mem_buf(key_der, len(key_der))
+        pkey = _lib.d2i_PrivateKey_bio(kbio, _ffi.NULL)
+        _lib.BIO_free(kbio)
+        if pkey == _ffi.NULL:
+            raise RuntimeError("d2i_PrivateKey_bio failed")
+        _lib.SSL_CTX_use_PrivateKey(self._ctx, pkey)
+
+        # use_srtp extension (0 == success)
+        names = ":".join(_OPENSSL_NAME[p] for p in self.profiles)
+        if _lib.SSL_CTX_set_tlsext_use_srtp(self._ctx,
+                                            names.encode()) != 0:
+            raise RuntimeError("SSL_CTX_set_tlsext_use_srtp failed")
+
+        # request the peer's cert; actual trust = fingerprint vs signaling
+        self._verify_cb = _ffi.callback(
+            "int(int, X509_STORE_CTX *)", lambda ok, store: 1)
+        _lib.SSL_CTX_set_verify(
+            self._ctx,
+            _lib.SSL_VERIFY_PEER | (
+                _lib.SSL_VERIFY_FAIL_IF_NO_PEER_CERT
+                if role == "server" else 0),
+            self._verify_cb)
+
+        ssl = _lib.SSL_new(self._ctx)
+        self._ssl = _ffi.gc(ssl, _lib.SSL_free)
+        self._rbio = _lib.BIO_new(_lib.BIO_s_mem())
+        self._wbio = _lib.BIO_new(_lib.BIO_s_mem())
+        _lib.SSL_set_bio(self._ssl, self._rbio, self._wbio)  # SSL owns BIOs
+        if role == "client":
+            _lib.SSL_set_connect_state(self._ssl)
+        else:
+            _lib.SSL_set_accept_state(self._ssl)
+
+    # ------------------------------------------------------------- pumps
+    def _drain_out(self) -> List[bytes]:
+        out = []
+        buf = _ffi.new("char[]", 4096)
+        while True:
+            n = _lib.BIO_read(self._wbio, buf, len(buf))
+            if n <= 0:
+                break
+            out.append(_ffi.buffer(buf, n)[:])
+        return out
+
+    def _pump(self) -> None:
+        rc = _lib.SSL_do_handshake(self._ssl)
+        if rc == 1 and not self.complete:
+            self._on_complete()
+
+    def handshake_packets(self) -> List[bytes]:
+        """Kick/continue the handshake; returns datagrams to transmit."""
+        if not self.complete:
+            self._pump()
+        return self._drain_out()
+
+    def feed(self, datagram: bytes) -> List[bytes]:
+        """Process one incoming DTLS datagram; returns responses."""
+        buf = _ffi.new("char[]", datagram)
+        _lib.BIO_write(self._rbio, buf, len(datagram))
+        if not self.complete:
+            self._pump()
+        return self._drain_out()
+
+    # ---------------------------------------------------------- completion
+    def _on_complete(self) -> None:
+        cert = _lib.SSL_get_peer_certificate(self._ssl)
+        if cert != _ffi.NULL:
+            bio = _lib.BIO_new(_lib.BIO_s_mem())
+            _lib.i2d_X509_bio(bio, cert)
+            buf = _ffi.new("char[]", 8192)
+            n = _lib.BIO_read(bio, buf, len(buf))
+            self.peer_cert_der = _ffi.buffer(buf, n)[:] if n > 0 else b""
+            _lib.BIO_free(bio)
+            _lib.X509_free(cert)
+        if self.remote_fingerprint is not None:
+            got = fingerprint(self.peer_cert_der or b"")
+            if got != self.remote_fingerprint.upper():
+                raise RuntimeError(
+                    f"DTLS fingerprint mismatch: {got} != "
+                    f"{self.remote_fingerprint} (possible MITM)")
+        self.complete = True
+
+    @property
+    def selected_profile(self) -> SrtpProfile:
+        prof = _lib.SSL_get_selected_srtp_profile(self._ssl)
+        if prof == _ffi.NULL:
+            raise RuntimeError("no SRTP profile negotiated")
+        return _PROFILE_BY_ID[prof.id]
+
+    def srtp_keys(self):
+        """RFC 5764 §4.2 key export, role-resolved.
+
+        Returns (profile, tx_key, tx_salt, rx_key, rx_salt): the client
+        sends with client_write keys, the server with server_write.
+        """
+        if not self.complete:
+            raise RuntimeError("handshake not complete")
+        profile = self.selected_profile
+        p = profile.policy
+        kl, sl = p.enc_key_len, p.salt_len
+        total = 2 * (kl + sl)
+        out = _ffi.new("unsigned char[]", total)
+        rc = _lib.SSL_export_keying_material(
+            self._ssl, out, total, self.EXTRACTOR, len(self.EXTRACTOR),
+            _ffi.NULL, 0, 0)
+        if rc != 1:
+            raise RuntimeError("SSL_export_keying_material failed")
+        blob = _ffi.buffer(out, total)[:]
+        ck, sk = blob[:kl], blob[kl:2 * kl]
+        cs, ss = blob[2 * kl:2 * kl + sl], blob[2 * kl + sl:]
+        if self.role == "client":
+            return profile, ck, cs, sk, ss
+        return profile, sk, ss, ck, cs
