@@ -1,0 +1,2 @@
+from libjitsi_tpu.io.udp import UdpEngine  # noqa: F401
+from libjitsi_tpu.io.pcap import PcapReader, PcapWriter, RtpdumpReader, RtpdumpWriter  # noqa: F401

@@ -1,0 +1,136 @@
+"""Python face of the native batched UDP engine.
+
+Receives land directly in a PacketBatch-shaped buffer ([max_pkts,
+capacity] uint8 + int32 lengths) — the C engine scatters datagrams with
+recvmmsg into exactly the struct-of-arrays the device consumes, so the
+host's only per-batch work is the ssrc demux.  Reference analog:
+RTPConnectorUDPImpl's connector threads, collapsed into one
+batch-per-syscall loop (SURVEY §2.6 item 12).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+import struct
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+from libjitsi_tpu.core.packet import DEFAULT_CAPACITY, PacketBatch
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "native")
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = os.path.join(_NATIVE_DIR, "libudp_engine.so")
+    src = os.path.join(_NATIVE_DIR, "udp_engine.cpp")
+    if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+        subprocess.run(["sh", os.path.join(_NATIVE_DIR, "build.sh")],
+                       check=True, capture_output=True)
+    lib = ctypes.CDLL(so)
+    lib.udp_create.restype = ctypes.c_int
+    lib.udp_create.argtypes = [ctypes.c_char_p, ctypes.c_uint16,
+                               ctypes.c_int, ctypes.c_int]
+    lib.udp_close.argtypes = [ctypes.c_int]
+    lib.udp_local_port.restype = ctypes.c_int
+    lib.udp_local_port.argtypes = [ctypes.c_int]
+    lib.udp_recv_batch.restype = ctypes.c_int
+    lib.udp_recv_batch.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+    lib.udp_send_batch.restype = ctypes.c_int
+    lib.udp_send_batch.argtypes = [
+        ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+    _lib = lib
+    return lib
+
+
+def ip_to_u32(ip: str) -> int:
+    return struct.unpack("!I", socket.inet_aton(ip))[0]
+
+
+def u32_to_ip(v: int) -> str:
+    return socket.inet_ntoa(struct.pack("!I", v & 0xFFFFFFFF))
+
+
+class UdpEngine:
+    """One batched UDP socket (rtcp-mux style single port per engine).
+
+    SO_REUSEPORT lets several engines (host threads/processes) share a
+    port for kernel-sharded ingest — the 10k-stream single-port design
+    (SURVEY §7 "10k-socket ingest").
+    """
+
+    def __init__(self, port: int = 0, bind_ip: str = "0.0.0.0",
+                 reuseport: bool = False, capacity: int = DEFAULT_CAPACITY,
+                 max_batch: int = 1024, rcvbuf: int = 4 << 20):
+        lib = _load()
+        self.capacity = capacity
+        self.max_batch = max_batch
+        fd = lib.udp_create(bind_ip.encode(), port, int(reuseport), rcvbuf)
+        if fd < 0:
+            raise OSError(-fd, os.strerror(-fd))
+        self._fd = fd
+        self.port = lib.udp_local_port(fd)
+        # persistent receive arena (the PacketBatch SoA itself)
+        self._buf = np.zeros((max_batch, capacity), dtype=np.uint8)
+        self._len = np.zeros(max_batch, dtype=np.int32)
+        self._sip = np.zeros(max_batch, dtype=np.uint32)
+        self._sport = np.zeros(max_batch, dtype=np.uint16)
+
+    def recv_batch(self, timeout_ms: int = 1
+                   ) -> Tuple[PacketBatch, np.ndarray, np.ndarray]:
+        """One batching window: up to max_batch datagrams.
+
+        Returns (batch, src_ip_u32, src_port); batch_size 0 on timeout.
+        The batching window (timeout for the first packet + drain) is
+        the latency/throughput knob from SURVEY §7 step 4.
+        """
+        n = _load().udp_recv_batch(
+            self._fd, self._buf.ctypes.data, self.capacity, self.max_batch,
+            self._len.ctypes.data, self._sip.ctypes.data,
+            self._sport.ctypes.data, timeout_ms)
+        if n < 0:
+            raise OSError(-n, os.strerror(-n))
+        batch = PacketBatch(self._buf[:n].copy(), self._len[:n].copy(),
+                            np.full(n, -1, dtype=np.int32))
+        return batch, self._sip[:n].copy(), self._sport[:n].copy()
+
+    def send_batch(self, batch: PacketBatch, dst_ip, dst_port) -> int:
+        """Send all rows; dst_ip (u32 or dotted str) / dst_port broadcast."""
+        n = batch.batch_size
+        if n == 0:
+            return 0
+        if isinstance(dst_ip, str):
+            dst_ip = ip_to_u32(dst_ip)
+        ips = np.broadcast_to(np.asarray(dst_ip, dtype=np.uint32), (n,))
+        ports = np.broadcast_to(np.asarray(dst_port, dtype=np.uint16), (n,))
+        data = np.ascontiguousarray(batch.data)
+        lens = np.ascontiguousarray(batch.length, dtype=np.int32)
+        ips = np.ascontiguousarray(ips)
+        ports = np.ascontiguousarray(ports)
+        sent = _load().udp_send_batch(
+            self._fd, data.ctypes.data, batch.capacity, lens.ctypes.data,
+            ips.ctypes.data, ports.ctypes.data, n)
+        if sent < 0:
+            raise OSError(-sent, os.strerror(-sent))
+        return sent
+
+    def close(self) -> None:
+        if self._fd >= 0:
+            _load().udp_close(self._fd)
+            self._fd = -1
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

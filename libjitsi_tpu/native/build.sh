@@ -1,0 +1,6 @@
+#!/bin/sh
+# Build the native UDP engine (C ABI shared lib consumed via ctypes).
+set -e
+cd "$(dirname "$0")"
+g++ -O2 -Wall -shared -fPIC -o libudp_engine.so udp_engine.cpp
+echo "built $(pwd)/libudp_engine.so"
