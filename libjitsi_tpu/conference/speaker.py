@@ -1,0 +1,92 @@
+"""Dominant speaker identification (reference:
+`org.jitsi.impl.neomedia.ActiveSpeakerDetectorImpl` /
+`DominantSpeakerIdentification` — the Volfin & Cohen multi-timescale
+algorithm).
+
+Per 20 ms frame, each participant's audio level (the mixer kernel's
+by-product) feeds three exponential time scales — immediate (frame),
+medium (~200 ms) and long (~1 s) speech-activity scores.  A speaker
+becomes dominant when its long-scale activity beats the incumbent's by
+a hysteresis margin across all scales; the decision logic is a few
+vectorized array ops over all participants (levels come batched from
+the device).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+SILENCE_LEVEL = 127  # RFC 6465: 127 dBov down = silence
+
+
+class DominantSpeakerIdentification:
+    def __init__(self, capacity: int = 256,
+                 on_change: Optional[Callable[[int], None]] = None,
+                 speech_threshold: float = 0.12,
+                 margin: float = 1.15):
+        self.capacity = capacity
+        self.on_change = on_change
+        self.speech_threshold = speech_threshold
+        self.margin = margin
+        # activity in [0,1] at three time scales
+        self.immediate = np.zeros(capacity)
+        self.medium = np.zeros(capacity)
+        self.long = np.zeros(capacity)
+        self.active = np.zeros(capacity, dtype=bool)
+        self.dominant: int = -1
+        self._frames = 0
+
+    def add_participant(self, sid: int) -> None:
+        self.active[sid] = True
+        self.immediate[sid] = self.medium[sid] = self.long[sid] = 0.0
+
+    def remove_participant(self, sid: int) -> None:
+        self.active[sid] = False
+        if self.dominant == sid:
+            self.dominant = -1
+
+    def levels(self, levels: np.ndarray) -> int:
+        """Feed one frame tick of per-participant levels (uint8 dBov,
+        127 = silence); returns the current dominant sid (-1 none).
+
+        Levels array is indexed by sid (rows beyond len are inactive).
+        """
+        self._frames += 1
+        lv = np.full(self.capacity, SILENCE_LEVEL, dtype=np.float64)
+        lv[: len(levels)] = np.asarray(levels, dtype=np.float64)
+        # loudness in [0,1]: 0 dBov -> 1, silence -> 0 (perceptual-ish)
+        loud = np.clip((70.0 - lv) / 70.0, 0.0, 1.0)
+        loud[~self.active] = 0.0
+        speaking = loud > self.speech_threshold
+
+        # three exponential scales (time constants ~3 / ~10 / ~50 frames)
+        self.immediate += (loud - self.immediate) / 3.0
+        self.medium += (speaking * self.immediate - self.medium) / 10.0
+        self.long += (self.medium - self.long) / 50.0
+
+        self._decide()
+        return self.dominant
+
+    def _decide(self) -> None:
+        scores = np.where(self.active, self.long, -1.0)
+        best = int(np.argmax(scores))
+        if scores[best] <= 0:
+            return
+        if self.dominant < 0 or not self.active[self.dominant]:
+            self._switch(best)
+            return
+        cur = self.dominant
+        if best != cur:
+            # hysteresis: challenger must win on all three scales
+            if (self.long[best] > self.margin * self.long[cur]
+                    and self.medium[best] > self.margin * self.medium[cur]
+                    and self.immediate[best] > self.immediate[cur]):
+                self._switch(best)
+
+    def _switch(self, sid: int) -> None:
+        if sid != self.dominant:
+            self.dominant = sid
+            if self.on_change is not None:
+                self.on_change(sid)

@@ -1,0 +1,1 @@
+from libjitsi_tpu.recording.recorder import Recorder, Synchronizer  # noqa: F401

@@ -1,0 +1,93 @@
+"""Adaptive jitter buffer (host-side, per stream).
+
+The reference gets this from FMJ (`net.sf.fmj.media.rtp.JitterBuffer`
+family, tuned by libjitsi) — an adaptive de-jitter queue between the
+network and the decoder.  Only the decode/mix path needs it (the SFU
+path forwards without buffering, SURVEY §2.3).  Packets insert by
+sequence number; `pop()` releases the next in order once its target
+hold time has elapsed, declaring losses when the gap timer expires.
+The depth adapts to measured interarrival jitter (target =
+jitter_multiplier x EWMA jitter, clamped).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+from libjitsi_tpu.core.rtp_math import seq_delta
+
+
+@dataclasses.dataclass
+class _Entry:
+    seq: int
+    rtp_ts: int
+    payload: bytes
+    arrival: float
+
+
+class JitterBuffer:
+    def __init__(self, clock_rate: int = 48000, frame_ms: float = 20.0,
+                 min_delay_ms: float = 0.0, max_delay_ms: float = 200.0,
+                 jitter_multiplier: float = 2.0):
+        self.clock_rate = clock_rate
+        self.frame_ms = frame_ms
+        self.min_delay = min_delay_ms / 1000.0
+        self.max_delay = max_delay_ms / 1000.0
+        self.mult = jitter_multiplier
+        self._buf: Dict[int, _Entry] = {}
+        self._next_seq: Optional[int] = None
+        self._released = False
+        self._jitter_s = 0.0
+        self._last_transit: Optional[float] = None
+        self.lost = 0
+        self.late_dropped = 0
+
+    @property
+    def target_delay(self) -> float:
+        return min(max(self.mult * self._jitter_s, self.min_delay),
+                   self.max_delay)
+
+    def insert(self, seq: int, rtp_ts: int, payload: bytes,
+               now: float) -> None:
+        seq &= 0xFFFF
+        if self._next_seq is not None and seq_delta(seq, self._next_seq) < 0:
+            if self._released:
+                self.late_dropped += 1  # already released past this seq
+                return
+            self._next_seq = seq  # window not started: move start back
+        transit = now - rtp_ts / self.clock_rate
+        if self._last_transit is not None:
+            d = abs(transit - self._last_transit)
+            self._jitter_s += (d - self._jitter_s) / 16.0
+        self._last_transit = transit
+        self._buf[seq] = _Entry(seq, rtp_ts, payload, now)
+        if self._next_seq is None:
+            self._next_seq = seq
+
+    def pop(self, now: float) -> Optional[bytes]:
+        """Release the next in-order frame if due; skips a missing seq
+        (counting it lost) once its successor has waited out the target
+        delay plus one frame."""
+        if self._next_seq is None:
+            return None
+        e = self._buf.pop(self._next_seq, None)
+        if e is not None:
+            if now - e.arrival < self.target_delay:
+                self._buf[e.seq] = e  # not due yet
+                return None
+            self._next_seq = (self._next_seq + 1) & 0xFFFF
+            self._released = True
+            return e.payload
+        # gap: wait for reordering up to target + one frame, then skip
+        if self._buf:
+            oldest = min(self._buf.values(), key=lambda x: x.arrival)
+            if now - oldest.arrival > self.target_delay + \
+                    self.frame_ms / 1000.0:
+                self.lost += 1
+                self._next_seq = (self._next_seq + 1) & 0xFFFF
+                return self.pop(now)
+        return None
+
+    def __len__(self) -> int:
+        return len(self._buf)

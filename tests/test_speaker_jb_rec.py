@@ -1,0 +1,120 @@
+"""Dominant speaker detection, jitter buffer, recorder/synchronizer."""
+
+import json
+import os
+
+import numpy as np
+
+from libjitsi_tpu.conference.speaker import DominantSpeakerIdentification
+from libjitsi_tpu.recording import Recorder, Synchronizer
+from libjitsi_tpu.rtp.jitter_buffer import JitterBuffer
+from libjitsi_tpu.rtp.rtcp import SenderReport
+from libjitsi_tpu.rtp.stats import NTP_EPOCH_OFFSET
+from libjitsi_tpu.io.pcap import RtpdumpReader
+
+
+# ------------------------------------------------------------ speaker ---
+
+def test_dominant_speaker_switches_with_hysteresis():
+    changes = []
+    dsi = DominantSpeakerIdentification(capacity=4,
+                                        on_change=changes.append)
+    for s in range(3):
+        dsi.add_participant(s)
+    lv = np.full(4, 127, np.uint8)
+    # participant 0 speaks
+    lv[0] = 20
+    for _ in range(30):
+        dsi.levels(lv)
+    assert dsi.dominant == 0
+    # brief noise from 1 must NOT switch
+    lv2 = lv.copy()
+    lv2[1] = 25
+    dsi.levels(lv2)
+    assert dsi.dominant == 0
+    # sustained speech from 1 while 0 goes quiet: switch
+    lv3 = np.full(4, 127, np.uint8)
+    lv3[1] = 15
+    for _ in range(200):
+        dsi.levels(lv3)
+    assert dsi.dominant == 1
+    assert changes == [0, 1]
+
+
+def test_dominant_speaker_leaves():
+    dsi = DominantSpeakerIdentification(capacity=2)
+    dsi.add_participant(0)
+    lv = np.array([10, 127], np.uint8)
+    for _ in range(20):
+        dsi.levels(lv)
+    assert dsi.dominant == 0
+    dsi.remove_participant(0)
+    assert dsi.dominant == -1
+
+
+# ------------------------------------------------------- jitter buffer ---
+
+def test_jitter_buffer_reorders():
+    jb = JitterBuffer(clock_rate=8000, min_delay_ms=0)
+    t = 0.0
+    jb.insert(11, 160, b"b", t + 0.001)   # arrives first but is second
+    jb.insert(10, 0, b"a", t + 0.002)
+    out = [jb.pop(t + 0.01), jb.pop(t + 0.01)]
+    assert out == [b"a", b"b"]
+    assert jb.lost == 0
+
+
+def test_jitter_buffer_declares_loss_and_moves_on():
+    jb = JitterBuffer(clock_rate=8000, frame_ms=20, max_delay_ms=40)
+    jb.insert(5, 0, b"p5", 0.0)
+    assert jb.pop(0.1) == b"p5"
+    # p6 lost; p7 arrives
+    jb.insert(7, 320, b"p7", 0.12)
+    assert jb.pop(0.125) is None          # still waiting for 6
+    got = jb.pop(0.4)                     # gap timer expired
+    assert got == b"p7"
+    assert jb.lost == 1
+    # a very late p6 now gets dropped
+    jb.insert(6, 160, b"p6", 0.5)
+    assert jb.late_dropped == 1
+
+
+def test_jitter_buffer_adapts_depth():
+    jb = JitterBuffer(clock_rate=8000, min_delay_ms=0, max_delay_ms=500)
+    # feed steadily varying arrival offsets -> jitter grows
+    for i in range(50):
+        jitter = 0.03 if i % 2 else 0.0
+        jb.insert(i, i * 160, b"x", i * 0.02 + jitter)
+        jb.pop(i * 0.02 + 0.25)
+    assert jb.target_delay > 0.01
+
+
+# ------------------------------------------------------------ recorder ---
+
+def test_synchronizer_maps_rtp_to_wall_clock():
+    s = Synchronizer()
+    sr = SenderReport(ssrc=7, ntp_sec=NTP_EPOCH_OFFSET + 1000, ntp_frac=0,
+                      rtp_ts=48000, packet_count=0, octet_count=0,
+                      reports=[])
+    s.on_sender_report(7, sr, clock_rate=48000)
+    # one second of RTP time later
+    assert abs(s.wall_time(7, 96000) - 1001.0) < 1e-6
+    # half a second before the SR
+    assert abs(s.wall_time(7, 24000) - 999.5) < 1e-6
+    assert s.wall_time(99, 0) is None
+
+
+def test_recorder_writes_rtpdump_and_events(tmp_path):
+    d = str(tmp_path / "rec")
+    r = Recorder(d)
+    pkts = [b"\x80\x00" + bytes([i]) * 16 for i in range(3)]
+    for i, p in enumerate(pkts):
+        r.write_rtp(0xABC, p, ts=r._started + 0.02 * i)
+    r.on_speaker_change(0xABC)
+    meta = r.close()
+    got = [x[1] for x in RtpdumpReader(os.path.join(d, "00000abc.rtpdump"))]
+    assert got == pkts
+    events = json.load(open(meta))["events"]
+    kinds = [e["type"] for e in events]
+    assert kinds == ["RECORDING_STARTED", "STREAM_STARTED",
+                     "SPEAKER_CHANGED", "RECORDING_ENDED"]
