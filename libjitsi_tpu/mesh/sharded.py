@@ -34,6 +34,7 @@ from libjitsi_tpu.conference.mixer import I16_MAX, I16_MIN, audio_levels
 from libjitsi_tpu.transform.srtp import kernel
 
 AXIS = "streams"
+DCN_AXIS = "dcn"
 
 
 def make_media_mesh(devices=None) -> Mesh:
@@ -41,6 +42,43 @@ def make_media_mesh(devices=None) -> Mesh:
     if devices is None:
         devices = jax.devices()
     return Mesh(np.asarray(devices), (AXIS,))
+
+
+def make_multihost_mesh(n_hosts: int, devices=None) -> Mesh:
+    """2-D (dcn, streams) mesh: hosts on the outer (DCN) axis, chips on
+    the inner (ICI) axis.  Streams partition across hosts first (no
+    cross-host media dependency), then across a host's chips; mixer
+    collectives over both axes ride ICI within a host and DCN across
+    (SURVEY §2.7 DCN row).  On a single host this reshapes the local
+    devices to rehearse the layout.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if len(devices) % n_hosts:
+        raise ValueError(f"{len(devices)} devices not divisible by "
+                         f"{n_hosts} hosts")
+    arr = np.asarray(devices).reshape(n_hosts, -1)
+    return Mesh(arr, (DCN_AXIS, AXIS))
+
+
+def sharded_mix_minus_2d(mesh: Mesh):
+    """Mixer whose participants span BOTH mesh axes: partial sums psum
+    over ICI (streams axis) then over DCN — one conference spanning
+    hosts.  pcm [N, F] sharded over (dcn*streams) on N."""
+
+    def _mix(pcm, active):
+        pcm = pcm.astype(jnp.int32)
+        contrib = jnp.where(active[:, None], pcm, 0)
+        local = jnp.sum(contrib, axis=0, keepdims=True)
+        total = jax.lax.psum(jax.lax.psum(local, AXIS), DCN_AXIS)
+        out = jnp.clip(total - contrib, I16_MIN, I16_MAX).astype(jnp.int16)
+        return out, audio_levels(pcm, active)
+
+    spec_r = P((DCN_AXIS, AXIS))
+    return jax.jit(jax.shard_map(
+        _mix, mesh=mesh, in_specs=(P((DCN_AXIS, AXIS), None), spec_r),
+        out_specs=(P((DCN_AXIS, AXIS), None), spec_r), check_vma=False,
+    ))
 
 
 # --------------------------------------------------------------------- SRTP

@@ -84,13 +84,22 @@ class _ChainTransformer(PacketTransformer):
         self._ts = list(transformers)
         self.dropped = {name: 0 for name, _ in self._ts}
 
+    @staticmethod
+    def _fold(mask, ok):
+        """An engine that changes the batch size (e.g. duplication in the
+        fault injector, RED recovery emitting extra rows) returns a mask
+        for the NEW shape with the incoming mask already folded in."""
+        if ok.shape != mask.shape:
+            return ok.copy()
+        return mask & ok
+
     def transform(self, batch, mask=None):
         mask = _ones(batch) if mask is None else mask.copy()
         for name, t in self._ts:
             before = mask.sum()
             batch, ok = t.transform(batch, mask)
-            mask &= ok
-            self.dropped[name] += int(before - mask.sum())
+            mask = self._fold(mask, ok)
+            self.dropped[name] += max(0, int(before - mask.sum()))
         return batch, mask
 
     def reverse_transform(self, batch, mask=None):
@@ -98,8 +107,8 @@ class _ChainTransformer(PacketTransformer):
         for name, t in reversed(self._ts):
             before = mask.sum()
             batch, ok = t.reverse_transform(batch, mask)
-            mask &= ok
-            self.dropped[name] += int(before - mask.sum())
+            mask = self._fold(mask, ok)
+            self.dropped[name] += max(0, int(before - mask.sum()))
         return batch, mask
 
 

@@ -1,0 +1,2 @@
+from libjitsi_tpu.utils.metrics import MetricsRegistry  # noqa: F401
+from libjitsi_tpu.utils.faults import FaultInjectionEngine  # noqa: F401

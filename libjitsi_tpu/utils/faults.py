@@ -1,0 +1,79 @@
+"""Fault injection for tests (new — SURVEY §5 notes the reference has no
+fault-injection framework; our test strategy requires loss/jitter/
+reorder/duplicate injection as a chain engine).
+
+Deterministic per-seed, so failing runs replay exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.transform.engine import PacketTransformer, TransformEngine
+
+
+class FaultInjectionEngine(TransformEngine):
+    """Drops / duplicates / reorders / corrupts rows of each batch.
+
+    Installed like any other engine (usually first in the receive
+    chain, simulating the network).  Rates are per-packet
+    probabilities; reordering shuffles a window at the batch level.
+    """
+
+    def __init__(self, loss: float = 0.0, duplicate: float = 0.0,
+                 corrupt: float = 0.0, reorder: float = 0.0,
+                 seed: int = 0):
+        self.loss = loss
+        self.duplicate = duplicate
+        self.corrupt = corrupt
+        self.reorder = reorder
+        self.rng = np.random.default_rng(seed)
+        self.dropped = 0
+        self.duplicated = 0
+        self.corrupted = 0
+        eng = self
+
+        class _T(PacketTransformer):
+            def reverse_transform(self, batch, mask=None):
+                n = batch.batch_size
+                keep = np.ones(n, bool) if mask is None else mask.copy()
+                if n == 0:
+                    return batch, keep
+                r = eng.rng
+                data = batch.data.copy()
+                length = np.asarray(batch.length).copy()
+                stream = np.asarray(batch.stream).copy()
+
+                drop = r.random(n) < eng.loss
+                eng.dropped += int(drop.sum())
+                keep &= ~drop
+
+                cor = (r.random(n) < eng.corrupt) & keep
+                for i in np.nonzero(cor)[0]:
+                    if length[i] > 0:
+                        data[i, r.integers(0, length[i])] ^= 0xFF
+                eng.corrupted += int(cor.sum())
+
+                order = np.arange(n)
+                if eng.reorder > 0 and n > 1:
+                    swaps = np.nonzero(r.random(n - 1) < eng.reorder)[0]
+                    for i in swaps:
+                        order[i], order[i + 1] = order[i + 1], order[i]
+
+                dup_rows = np.nonzero((r.random(n) < eng.duplicate)
+                                      & keep)[0]
+                eng.duplicated += len(dup_rows)
+                if len(dup_rows):
+                    order = np.concatenate([order, dup_rows])
+
+                out = PacketBatch(data[order], length[order], stream[order])
+                return out, keep[order]
+
+        self._rtp = _T()
+
+    @property
+    def rtp_transformer(self):
+        return self._rtp

@@ -1,0 +1,96 @@
+"""Observability: vectorized counters + Prometheus text exposition.
+
+The reference exposes per-stream pull stats (`MediaStreamStats2`) and
+events but no metrics endpoint (SURVEY §5); server deployments of this
+framework need one.  Metrics stay what the framework already has —
+dense arrays across streams — and the exporter renders them on demand;
+there is no per-increment overhead beyond the array ops the data path
+does anyway.  A timing ring buffer gives per-batch device latency
+percentiles (the p99 the north-star metric tracks).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class TimingRing:
+    """Fixed-size ring of durations (seconds) -> percentiles."""
+
+    def __init__(self, size: int = 4096):
+        self._buf = np.zeros(size, dtype=np.float64)
+        self._n = 0
+        self._i = 0
+
+    def record(self, seconds: float) -> None:
+        self._buf[self._i] = seconds
+        self._i = (self._i + 1) % len(self._buf)
+        self._n = min(self._n + 1, len(self._buf))
+
+    def percentile(self, q: float) -> float:
+        if self._n == 0:
+            return 0.0
+        return float(np.percentile(self._buf[: self._n], q))
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.record(time.perf_counter() - self._t0)
+
+
+class MetricsRegistry:
+    """Array-backed gauges/counters with Prometheus text rendering.
+
+    register("rtp_rx_packets", stats.rx_packets, by="stream") exposes a
+    whole per-stream array; scalar callables work for totals.
+    """
+
+    def __init__(self, namespace: str = "libjitsi_tpu"):
+        self.ns = namespace
+        self._arrays: Dict[str, Tuple[np.ndarray, str, str]] = {}
+        self._scalars: Dict[str, Tuple[Callable[[], float], str]] = {}
+        self.timings: Dict[str, TimingRing] = {}
+
+    def register_array(self, name: str, arr: np.ndarray, by: str = "stream",
+                       help_: str = "") -> None:
+        self._arrays[name] = (arr, by, help_)
+
+    def register_scalar(self, name: str, fn: Callable[[], float],
+                        help_: str = "") -> None:
+        self._scalars[name] = (fn, help_)
+
+    def timing(self, name: str) -> TimingRing:
+        if name not in self.timings:
+            self.timings[name] = TimingRing()
+        return self.timings[name]
+
+    def render(self, active: Optional[np.ndarray] = None) -> str:
+        """Prometheus text format.  `active` masks which rows of the
+        per-stream arrays are exported (10k idle rows would be noise)."""
+        out: List[str] = []
+        for name, (arr, by, help_) in self._arrays.items():
+            full = f"{self.ns}_{name}"
+            if help_:
+                out.append(f"# HELP {full} {help_}")
+            out.append(f"# TYPE {full} gauge")
+            rows = np.nonzero(active)[0] if active is not None \
+                else range(len(arr))
+            for i in rows:
+                out.append(f'{full}{{{by}="{i}"}} {arr[i]}')
+        for name, (fn, help_) in self._scalars.items():
+            full = f"{self.ns}_{name}"
+            if help_:
+                out.append(f"# HELP {full} {help_}")
+            out.append(f"# TYPE {full} gauge")
+            out.append(f"{full} {fn()}")
+        for name, ring in self.timings.items():
+            for q, label in ((50, "p50"), (99, "p99")):
+                out.append(
+                    f'{self.ns}_{name}_seconds{{quantile="{label}"}} '
+                    f"{ring.percentile(q):.6g}")
+        return "\n".join(out) + "\n"

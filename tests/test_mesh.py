@@ -78,3 +78,20 @@ def test_entry_compiles():
     d, l = out
     assert d.shape == args[0].shape
     assert np.all(np.asarray(l) == args[1] + 10)
+
+
+def test_multihost_2d_mesh_mixer():
+    """(dcn, streams) mesh: conference psum over ICI then DCN."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from libjitsi_tpu.mesh import make_multihost_mesh, sharded_mix_minus_2d
+
+    mesh = make_multihost_mesh(2, jax.devices()[:8])  # 2 "hosts" x 4 chips
+    assert mesh.shape == {"dcn": 2, "streams": 4}
+    rng = np.random.default_rng(9)
+    pcm = rng.integers(-3000, 3000, (32, 64)).astype(np.int16)
+    active = np.ones(32, dtype=bool)
+    out, lvl = sharded_mix_minus_2d(mesh)(pcm, active)
+    want, want_lvl = mix_minus(pcm, active)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(lvl), np.asarray(want_lvl))
