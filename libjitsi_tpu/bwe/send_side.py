@@ -90,7 +90,10 @@ class SendSideBandwidthEstimation:
         if self.delay_cap is not None:
             b = min(b, self.delay_cap)
         b = min(max(b, self.min_bitrate), self.max_bitrate)
-        self.bitrate = min(self.bitrate, self.max_bitrate)
+        # floor the INTERNAL state too: sustained loss must not drive it
+        # toward zero, or recovery would compound up from ~nothing
+        self.bitrate = min(max(self.bitrate, self.min_bitrate),
+                           self.max_bitrate)
         return b
 
     @property

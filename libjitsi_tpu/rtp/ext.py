@@ -102,6 +102,17 @@ def set_one_byte_ext(batch: PacketBatch, hdr: RtpHeaders, ext_id: int,
     append = enable & has_block & ~rewrite
     fresh = enable & ~has_block & (hdr.extension == 0)
 
+    # same id already present at a DIFFERENT length: blank the stale
+    # element to padding zeros before appending, or receivers scanning in
+    # order would keep seeing the old value shadowing the new one
+    stale = enable & present & (elen != L)
+    if np.any(stale):
+        d = d.copy()
+        scols = np.arange(batch.capacity, dtype=np.int64)[None, :]
+        zone = (scols >= (eoff - 1)[:, None]) & \
+            (scols < (eoff + elen)[:, None]) & stale[:, None]
+        d = np.where(zone, 0, d)
+
     elem_sz = _ceil4(1 + L)
     grow = np.where(append, elem_sz, np.where(fresh, 4 + elem_sz, 0)
                     ).astype(np.int64)

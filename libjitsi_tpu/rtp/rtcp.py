@@ -140,30 +140,36 @@ def parse_compound(data: bytes) -> list:
         if version != 2 or off + plen > n:
             break
         body = data[off + 4:off + plen]
-        if pt == SR:
-            out.append(_parse_sr(body, count))
-        elif pt == RR:
-            out.append(_parse_rr(body, count))
-        elif pt == SDES:
-            out.append(_parse_sdes(body, count))
-        elif pt == BYE:
-            out.append(_parse_bye(body, count))
-        elif pt == APP:
-            out.append(_parse_app(body, count))
-        elif pt == RTPFB and count == FMT_NACK:
-            out.append(_parse_nack(body))
-        elif pt == RTPFB and count == FMT_TCC:
-            p = _parse_tcc(body)
-            if p is not None:
-                out.append(p)
-        elif pt == PSFB and count == FMT_PLI:
-            out.append(Pli(*struct.unpack("!II", body[:8])))
-        elif pt == PSFB and count == FMT_FIR:
-            out.append(_parse_fir(body))
-        elif pt == PSFB and count == FMT_REMB:
-            p = _parse_remb(body)
-            if p is not None:
-                out.append(p)
+        # a malformed-but-well-framed packet must be skipped, not crash
+        # the receive loop (the reference's parser likewise drops what it
+        # cannot read) — body-length errors surface as struct/index errors
+        try:
+            if pt == SR:
+                out.append(_parse_sr(body, count))
+            elif pt == RR:
+                out.append(_parse_rr(body, count))
+            elif pt == SDES:
+                out.append(_parse_sdes(body, count))
+            elif pt == BYE:
+                out.append(_parse_bye(body, count))
+            elif pt == APP:
+                out.append(_parse_app(body, count))
+            elif pt == RTPFB and count == FMT_NACK:
+                out.append(_parse_nack(body))
+            elif pt == RTPFB and count == FMT_TCC:
+                p = _parse_tcc(body)
+                if p is not None:
+                    out.append(p)
+            elif pt == PSFB and count == FMT_PLI:
+                out.append(Pli(*struct.unpack("!II", body[:8])))
+            elif pt == PSFB and count == FMT_FIR:
+                out.append(_parse_fir(body))
+            elif pt == PSFB and count == FMT_REMB:
+                p = _parse_remb(body)
+                if p is not None:
+                    out.append(p)
+        except (struct.error, IndexError, ValueError):
+            pass
         off += plen
     return out
 

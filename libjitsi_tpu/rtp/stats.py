@@ -70,6 +70,28 @@ class StreamStatsTable:
         self._sr_sent_mid32 = np.zeros(s, dtype=np.int64)
         self._sr_sent_time = np.zeros(s, dtype=np.float64)
 
+    def reset(self, stream: int) -> None:
+        """Zero one row (a released stream id must not leak its counters
+        into the next stream allocated on the same row)."""
+        self.rx_packets[stream] = 0
+        self.rx_bytes[stream] = 0
+        self.rx_base_ext[stream] = -1
+        self.rx_max_ext[stream] = -1
+        self.jitter[stream] = 0.0
+        self._last_transit[stream] = 0.0
+        self._has_transit[stream] = False
+        self.clock_rate[stream] = 48000
+        self._expected_prior[stream] = 0
+        self._received_prior[stream] = 0
+        self._last_sr_mid32[stream] = 0
+        self._last_sr_arrival[stream] = 0.0
+        self._has_sr[stream] = False
+        self.tx_packets[stream] = 0
+        self.tx_bytes[stream] = 0
+        self.rtt[stream] = -1.0
+        self._sr_sent_mid32[stream] = 0
+        self._sr_sent_time[stream] = 0.0
+
     # ------------------------------------------------------------- updates
     def on_sent(self, stream: np.ndarray, nbytes: np.ndarray) -> None:
         stream = np.asarray(stream, dtype=np.int64)

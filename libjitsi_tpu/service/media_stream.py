@@ -89,6 +89,7 @@ class StreamRegistry:
                 tx.remove_stream(sid)
             if rx.active[sid]:
                 rx.remove_stream(sid)
+        self.stats.reset(sid)  # a recycled row must not inherit counters
         self._free.append(sid)
 
     def srtp_tables(self, profile: SrtpProfile

@@ -144,4 +144,8 @@ class DtmfTransformEngine(TransformEngine):
     def stop_tone(self, sid: int) -> None:
         if sid in self._tone:
             del self._tone[sid]
-            self._end_left[sid] = self.END_REPEATS
+            # only emit end packets if the tone actually made it onto the
+            # wire (a start/stop with no intervening send has no event
+            # timestamp to end)
+            if sid in self._ts:
+                self._end_left[sid] = self.END_REPEATS

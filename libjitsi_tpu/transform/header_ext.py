@@ -101,8 +101,19 @@ class TransportCCEngine(_RtpOnlyEngine):
         self._rtp = _T()
 
     def lookup_send_time(self, twseq: int) -> Optional[float]:
-        slot = twseq % self.HISTORY
-        if self.sent_seq[slot] == twseq:
+        """twseq is the 16-bit wire value (TCC feedback); unwrap it
+        against the full counter before the slot lookup."""
+        base = self.next_seq - 1
+        if base < 0:
+            return None
+        diff = (twseq - base) & 0xFFFF
+        if diff >= 0x8000:
+            diff -= 0x10000
+        ext = base + diff
+        if ext < 0:
+            return None
+        slot = ext % self.HISTORY
+        if self.sent_seq[slot] == ext:
             return float(self.sent_time[slot])
         return None
 

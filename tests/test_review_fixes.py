@@ -1,0 +1,89 @@
+"""Regression tests for the round-1 code-review findings."""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.bwe import SendSideBandwidthEstimation
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.rtp import ext as rtp_ext
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.rtp import rtcp
+from libjitsi_tpu.rtp.stats import StreamStatsTable
+from libjitsi_tpu.transform.dtmf import DtmfTransformEngine
+from libjitsi_tpu.transform.header_ext import TransportCCEngine
+
+
+def test_tcc_lookup_survives_16bit_wrap():
+    """Feedback carries 16-bit seqs; lookup must unwrap past 65535."""
+    eng = TransportCCEngine(ext_id=5, clock=lambda: 3.0)
+    eng.next_seq = 70_000  # counter already past one wrap
+    b = rtp_header.build([b"x"], [1], [0], [9], [96], stream=[0])
+    eng.rtp_transformer.transform(b)  # sends ext seq 70000
+    assert eng.lookup_send_time(70_000 & 0xFFFF) == 3.0
+    assert eng.lookup_send_time(123) is None
+
+
+def test_rtcp_malformed_bodies_skipped():
+    # well-framed SR with empty body (length_words=0)
+    bad_sr = bytes([0x80, 200, 0, 0])
+    # short PLI (body 4B where 8 are required)
+    bad_pli = bytes([0x81, 206, 0, 1]) + b"\x00\x00\x00\x07"
+    # short NACK
+    bad_nack = bytes([0x81, 205, 0, 1]) + b"\x00\x00\x00\x01"
+    good = rtcp.build_pli(rtcp.Pli(1, 2))
+    got = rtcp.parse_compound(bad_sr + bad_pli + bad_nack + good)
+    # no crash, malformed bodies skipped, the good packet recovered
+    assert got == [rtcp.Pli(1, 2)]
+
+
+def test_stats_reset_on_release():
+    t = StreamStatsTable(capacity=2)
+    t.on_received(np.zeros(3, np.int64), np.array([5, 6, 9]),
+                  np.zeros(3), np.full(3, 100), arrival=np.zeros(3))
+    t.on_sent(np.zeros(2, np.int64), np.full(2, 50))
+    assert t.cumulative_lost(0) == 2
+    t.reset(0)
+    assert t.rx_packets[0] == 0 and t.tx_packets[0] == 0
+    assert t.expected(0) == 0 and t.cumulative_lost(0) == 0
+    rb = t.make_report_block(0, remote_ssrc=1, now=0.0)
+    assert rb.cumulative_lost == 0 and rb.fraction_lost == 0
+
+
+def test_dtmf_stop_before_any_send_is_noop():
+    eng = DtmfTransformEngine(dtmf_pt=101)
+    eng.start_tone(0, "1")
+    eng.stop_tone(0)  # no packet sent while the tone was active
+    b = rtp_header.build([b"audio"], [1], [0], [9], [96], stream=[0])
+    out, ok = eng.rtp_transformer.transform(b)  # must not raise
+    assert ok.all()
+    assert rtp_header.parse(out).pt[0] == 96  # plain audio, no event
+
+
+def test_send_side_internal_bitrate_floored():
+    ss = SendSideBandwidthEstimation(min_bitrate_bps=30_000,
+                                     start_bitrate_bps=100_000)
+    for i in range(50):  # sustained heavy loss
+        ss.on_receiver_report(200, now_ms=1000 + i * 400)
+    assert ss.bitrate >= 30_000
+    # prompt recovery: a few clean seconds get back above min quickly
+    b = 0
+    for i in range(5):
+        b = ss.on_receiver_report(0, now_ms=30_000 + i * 1000)
+    assert b > 30_000 * 1.2
+
+
+def test_ext_same_id_different_length_replaces_not_shadows():
+    b = rtp_header.build([b"payload"], [1], [0], [9], [96], stream=[0])
+    hdr = rtp_header.parse(b)
+    out = rtp_ext.set_one_byte_ext(b, hdr, 4,
+                                   np.full((1, 3), 0xAA, np.uint8))
+    h2 = rtp_header.parse(out)
+    # restamp id 4 with a DIFFERENT length
+    out2 = rtp_ext.set_one_byte_ext(out, h2, 4,
+                                    np.full((1, 2), 0xBB, np.uint8))
+    h3 = rtp_header.parse(out2)
+    off, ln, found = rtp_ext.find_one_byte_ext(out2, h3, 4)
+    assert found.all() and ln[0] == 2
+    np.testing.assert_array_equal(out2.data[0, off[0]:off[0] + 2],
+                                  [0xBB, 0xBB])
+    assert out2.to_bytes(0).endswith(b"payload")
