@@ -1,2 +1,3 @@
 from libjitsi_tpu.sfu.cache import PacketCache  # noqa: F401
+from libjitsi_tpu.sfu.rtcp_termination import RtcpTermination  # noqa: F401
 from libjitsi_tpu.sfu.translator import RtpTranslator  # noqa: F401

@@ -126,3 +126,50 @@ def test_cache_eviction_by_bytes_and_age():
     c.insert(1, 50, bytes(10), now=1.0)   # age evicts the 0.0-era entries
     assert c.get(1, 1) is None and c.get(1, 2) is None
     assert c.get(1, 50) is not None
+
+
+# -------------------------------------------------------- rtcp termination
+
+def test_rtcp_termination_aggregates_and_throttles():
+    from libjitsi_tpu.sfu.rtcp_termination import RtcpTermination
+
+    t = RtcpTermination(bridge_ssrc=0xBEEF, pli_interval_s=1.0)
+    media = 0xAAA
+    # three receivers report different loss about the forwarded stream
+    for rid, (fl, cum, jit) in enumerate([(10, 5, 100), (80, 50, 900),
+                                          (0, 0, 10)]):
+        rr = rtcp.ReceiverReport(0x100 + rid, [rtcp.ReportBlock(
+            media, fl, cum, 5000, jit, 0, 0)])
+        t.on_receiver_rtcp(rid, [rr])
+    t.on_receiver_rtcp(0, [rtcp.Remb(0x100, 2_000_000, [media])])
+    t.on_receiver_rtcp(1, [rtcp.Remb(0x101, 500_000, [media])])
+    t.on_receiver_rtcp(0, [rtcp.Nack(0x100, media, [10, 11])])
+    t.on_receiver_rtcp(1, [rtcp.Nack(0x101, media, [11, 12])])
+    t.on_receiver_rtcp(2, [rtcp.Pli(0x102, media)])
+    t.on_receiver_rtcp(1, [rtcp.Pli(0x101, media)])
+
+    out = t.make_sender_feedback(media, now=100.0)
+    parsed = [p for blob in out for p in rtcp.parse_compound(blob)]
+    rrs = [p for p in parsed if isinstance(p, rtcp.ReceiverReport)]
+    assert len(rrs) == 1                       # N receiver RRs -> one
+    agg = rrs[0].reports[0]
+    assert agg.fraction_lost == 80 and agg.jitter == 900
+    rembs = [p for p in parsed if isinstance(p, rtcp.Remb)]
+    assert rembs[0].bitrate_bps == 500_000     # bottleneck receiver wins
+    nacks = [p for p in parsed if isinstance(p, rtcp.Nack)]
+    assert sorted(nacks[0].lost_seqs) == [10, 11, 12]
+    plis = [p for p in parsed if isinstance(p, rtcp.Pli)]
+    assert len(plis) == 1                      # storm -> one PLI
+
+    # PLI rate limit: another request inside the interval is held
+    t.on_receiver_rtcp(0, [rtcp.Pli(0x100, media)])
+    out2 = t.make_sender_feedback(media, now=100.2)
+    assert not any(isinstance(p, rtcp.Pli) for blob in out2
+                   for p in rtcp.parse_compound(blob))
+    out3 = t.make_sender_feedback(media, now=101.5)
+    assert any(isinstance(p, rtcp.Pli) for blob in out3
+               for p in rtcp.parse_compound(blob))
+
+    # a leaving bottleneck receiver releases the REMB cap
+    t.forget_receiver(1)
+    assert t.min_remb(media) == 2_000_000
