@@ -91,6 +91,86 @@ def cpu_pps() -> float:
     return n / (time.perf_counter() - t0)
 
 
+def _time_fn(fn, args, iters=10):
+    import jax
+
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def gcm_pps() -> float:
+    """BASELINE config #2's AEAD_AES_128_GCM leg of the cipher sweep."""
+    import jax.numpy as jnp
+
+    from libjitsi_tpu.kernels import gcm as G
+
+    rng = np.random.default_rng(5)
+    b = BATCH
+    rks = rng.integers(0, 256, (b, 11, 16), dtype=np.uint8)
+    gms = rng.integers(0, 2, (b, 128, 128), dtype=np.int8)
+    data = rng.integers(0, 256, (b, WIDTH), dtype=np.uint8)
+    length = np.full(b, PKT_LEN, np.int32)
+    aad = np.full(b, 12, np.int32)
+    iv = rng.integers(0, 256, (b, 12), dtype=np.uint8)
+    args = [jnp.asarray(x) for x in (data, length, aad, rks, gms, iv)]
+    dt = _time_fn(G.gcm_protect, args)
+    return b / dt
+
+
+def mixer_mix_per_sec(n_participants: int = 256) -> float:
+    """BASELINE config #3: N-participant 48 kHz mono 20 ms mix-minus."""
+    import jax.numpy as jnp
+
+    from libjitsi_tpu.conference.mixer import _mix_jit
+
+    rng = np.random.default_rng(6)
+    pcm = jnp.asarray(rng.integers(-8000, 8000, (n_participants, 960))
+                      .astype(np.int16))
+    active = jnp.ones(n_participants, dtype=bool)
+    dt = _time_fn(_mix_jit, (pcm, active))
+    return 1.0 / dt
+
+
+def fanout_rows_per_sec(packets: int = 64, receivers: int = 128) -> float:
+    """BASELINE config #5 core: per-receiver re-encrypt of a fan-out
+    matrix (rows = packets x receivers) in one launch."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from libjitsi_tpu.transform.srtp import kernel
+
+    rng = np.random.default_rng(7)
+    rows = packets * receivers
+    tab_rk = rng.integers(0, 256, (receivers, 11, 16), dtype=np.uint8)
+    tab_mid = rng.integers(0, 2**32, (receivers, 2, 5), dtype=np.uint64
+                           ).astype(np.uint32)
+    recv = np.repeat(np.arange(receivers, dtype=np.int32), packets)
+    data = rng.integers(0, 256, (rows, WIDTH), dtype=np.uint8)
+    length = np.full(rows, PKT_LEN, np.int32)
+    off = np.full(rows, 12, np.int32)
+    iv = rng.integers(0, 256, (rows, 16), dtype=np.uint8)
+    roc = np.zeros(rows, np.uint32)
+
+    # same math as translator._fanout_protect, without buffer donation
+    # (donation would invalidate the timed args between iterations)
+    @jax.jit
+    def step(tab_rk, tab_mid, recv, data, length, off, iv, roc):
+        return kernel.srtp_protect(data, length, off, tab_rk[recv], iv,
+                                   tab_mid[recv], roc, TAG_LEN, True)
+
+    args = [jnp.asarray(x) for x in
+            (tab_rk, tab_mid, recv, data, length, off, iv, roc)]
+    dt = _time_fn(step, args)
+    return rows / dt
+
+
 def main():
     pps, p99_ms = tpu_pps()
     base = cpu_pps()
@@ -100,7 +180,11 @@ def main():
         "unit": "packets/sec/chip",
         "vs_baseline": round(pps / base, 3),
         "extra": {"batch": BATCH, "pkt_len": PKT_LEN, "p99_batch_ms":
-                  round(p99_ms, 3), "cpu_openssl_pps": round(base, 1)},
+                  round(p99_ms, 3), "cpu_openssl_pps": round(base, 1),
+                  "gcm_pps": round(gcm_pps(), 1),
+                  "mix_256p_per_sec": round(mixer_mix_per_sec(), 1),
+                  "sfu_fanout_rows_per_sec":
+                      round(fanout_rows_per_sec(), 1)},
     }))
 
 
