@@ -109,12 +109,21 @@ class StreamRegistry:
         self._ssrc_to_sid.pop(ssrc & 0xFFFFFFFF, None)
 
     def demux(self, batch: PacketBatch) -> np.ndarray:
-        """Fill batch.stream from each packet's SSRC; returns the ids
+        """Fill batch.stream from each packet's RTP SSRC; returns the ids
         (-1 where unknown — the reference drops packets of unknown SSRC
         unless discovery is enabled)."""
         hdr = rtp_header.parse(batch)
         m = self._ssrc_to_sid
         sids = np.fromiter((m.get(int(s), -1) for s in hdr.ssrc),
+                           dtype=np.int64, count=batch.batch_size)
+        batch.stream[:] = sids
+        return sids
+
+    def demux_rtcp(self, batch: PacketBatch) -> np.ndarray:
+        """Same, for RTCP rows (sender SSRC sits at byte offset 4)."""
+        ssrc = rtp_header.read_u32(batch.data, 4)
+        m = self._ssrc_to_sid
+        sids = np.fromiter((m.get(int(s), -1) for s in ssrc),
                            dtype=np.int64, count=batch.batch_size)
         batch.stream[:] = sids
         return sids
