@@ -29,12 +29,25 @@ class MediaService:
             self._registry = StreamRegistry(self.config, capacity=cap)
         return self._registry
 
-    def create_media_stream(self, **kwargs):
-        """Reference: MediaService.createMediaStream."""
+    def create_media_stream(self, media_type: str = "generic", **kwargs):
+        """Reference: MediaService.createMediaStream(device, mediaType).
+
+        media_type: "audio" -> AudioMediaStream (DTMF + level API),
+        "video" -> VideoMediaStream (keyframe/simulcast API), anything
+        else -> plain MediaStream.
+        """
         from libjitsi_tpu.service.media_stream import MediaStream
 
         kwargs.setdefault("registry", self.registry)
         registry = kwargs.pop("registry")
+        if media_type == "audio":
+            from libjitsi_tpu.service.typed_streams import AudioMediaStream
+
+            return AudioMediaStream(registry, **kwargs)
+        if media_type == "video":
+            from libjitsi_tpu.service.typed_streams import VideoMediaStream
+
+            return VideoMediaStream(registry, **kwargs)
         return MediaStream(registry, **kwargs)
 
     def audio_mixer(self, frame_samples: int = 960):
