@@ -1,0 +1,82 @@
+"""H.264 packetization (RFC 6184) + WebM muxer."""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.codecs.h264 import (
+    H264Depacketizer,
+    NAL_FU_A,
+    NAL_STAP_A,
+    is_keyframe_payload,
+    packetize,
+)
+from libjitsi_tpu.recording.webm import WebmWriter
+
+
+def _nal(typ, size, fill=0x41):
+    return bytes([0x60 | typ]) + bytes([fill]) * (size - 1)
+
+
+def test_h264_small_nals_aggregate_stap_a():
+    nals = [_nal(7, 20), _nal(8, 10), _nal(5, 40)]
+    pkts = packetize(nals, mtu=200)
+    assert len(pkts) == 1
+    assert pkts[0][0] & 0x1F == NAL_STAP_A
+    d = H264Depacketizer()
+    out = d.push(pkts[0])
+    assert out == nals
+    assert d.keyframe_seen
+    assert is_keyframe_payload(pkts[0])
+
+
+def test_h264_large_nal_fragments_fu_a():
+    nal = _nal(5, 3000)
+    pkts = packetize([nal], mtu=1200)
+    assert len(pkts) == 3
+    assert all(p[0] & 0x1F == NAL_FU_A for p in pkts)
+    assert pkts[0][1] & 0x80 and pkts[-1][1] & 0x40  # start/end bits
+    assert is_keyframe_payload(pkts[0])
+    assert not is_keyframe_payload(pkts[1])
+    d = H264Depacketizer()
+    outs = [d.push(p) for p in pkts]
+    assert outs[0] == [] and outs[1] == []
+    assert outs[2] == [nal]
+
+
+def test_h264_single_nal_and_interleaving():
+    small = _nal(1, 50)
+    big = _nal(1, 2000)
+    pkts = packetize([small, big], mtu=1200)
+    d = H264Depacketizer()
+    got = []
+    for p in pkts:
+        got += d.push(p)
+    assert got == [small, big]
+    assert not d.keyframe_seen
+    assert not is_keyframe_payload(pkts[0])
+
+
+def test_h264_mixed_aggregate_then_fragment():
+    nals = [_nal(7, 30), _nal(8, 15), _nal(5, 5000), _nal(1, 100)]
+    pkts = packetize(nals, mtu=1000)
+    d = H264Depacketizer()
+    got = []
+    for p in pkts:
+        got += d.push(p)
+    assert got == nals
+
+
+def test_webm_writer_structure(tmp_path):
+    p = str(tmp_path / "out.webm")
+    w = WebmWriter(p, width=640, height=480)
+    w.write_frame(b"\x10keyframe-data", 0, keyframe=True)
+    w.write_frame(b"\x11delta", 33, keyframe=False)
+    w.write_frame(b"\x12delta", 2500, keyframe=False)  # new cluster
+    w.close()
+    blob = open(p, "rb").read()
+    assert blob.startswith(bytes.fromhex("1a45dfa3"))  # EBML magic
+    assert b"webm" in blob[:64]
+    assert b"V_VP8" in blob
+    assert blob.count(bytes.fromhex("1f43b675")) == 2  # two clusters
+    assert b"keyframe-data" in blob and b"delta" in blob
+    assert w.frames == 3
