@@ -1,0 +1,403 @@
+"""ZRTP — media-path Diffie-Hellman key agreement (RFC 6189).
+
+Rebuilds the reference's `org.jitsi.impl.neomedia.transform.zrtp.
+{ZRTPTransformEngine,ZrtpControlImpl}` (which delegate to the zrtp4j
+library) from the RFC: the Hello/Commit/DHPart/Confirm state machine,
+the H0..H3 hash-image chain with retroactive message-HMAC verification,
+ECDH P-256 ("EC25") key agreement, the RFC 6189 §4.4.1.4 s0 / §4.5.1
+KDF derivations, Short Authentication String (B32), and SRTP master
+key/salt export feeding `SrtpStreamTable` — the same "key provider →
+SRTP context" interface SDES and DTLS-SRTP use.
+
+Packet format: ZRTP messages ride RTP-lookalike packets (version 0,
+magic cookie 0x5A525450, CRC-32C trailer) multiplexed on the media
+port, demuxed by the cookie.  Like the in-memory DTLS endpoint, this is
+packet-in/packet-out for the host I/O loop.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import os
+import struct
+from typing import Dict, List, Optional, Tuple
+
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives import serialization
+
+from libjitsi_tpu.transform.srtp.policy import SrtpProfile
+
+MAGIC = 0x5A525450  # "ZRTP"
+PREAMBLE = 0x505A
+VERSION = b"1.10"
+
+HASH_S256 = b"S256"
+CIPHER_AES1 = b"AES1"
+AUTH_HS80 = b"HS80"
+KA_EC25 = b"EC25"
+SAS_B32 = b"B32 "
+
+_B32_ALPHABET = "ybndrfg8ejkmcpqxot1uwisza345h769"  # RFC 6189 §5.1.6
+
+# CRC-32C (Castagnoli, reflected poly 0x82F63B78) — RFC 6189 §5 requires
+# the RFC 3309 CRC, not zlib's CRC-32/IEEE.
+_CRC32C_TABLE = []
+for _n in range(256):
+    _c = _n
+    for _ in range(8):
+        _c = (_c >> 1) ^ 0x82F63B78 if _c & 1 else _c >> 1
+    _CRC32C_TABLE.append(_c)
+
+
+def crc32c(data: bytes) -> int:
+    crc = 0xFFFFFFFF
+    for byte in data:
+        crc = _CRC32C_TABLE[(crc ^ byte) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+def _hmac(key: bytes, msg: bytes) -> bytes:
+    return hmac_mod.new(key, msg, hashlib.sha256).digest()
+
+
+def _kdf(ki: bytes, label: bytes, context: bytes, length_bits: int) -> bytes:
+    """RFC 6189 §4.5.1 (NIST SP 800-108 counter-mode, one block)."""
+    data = struct.pack("!I", 1) + label + b"\x00" + context + \
+        struct.pack("!I", length_bits)
+    return _hmac(ki, data)[: length_bits // 8]
+
+
+def sas_b32(sashash: bytes) -> str:
+    """Render the 20-bit short authentication string (RFC 6189 §5.1.6)."""
+    bits = int.from_bytes(sashash[:4], "big") >> 12
+    return "".join(_B32_ALPHABET[(bits >> s) & 31] for s in (15, 10, 5, 0))
+
+
+# ---------------------------------------------------------------- packets --
+
+def _wrap(msg: bytes, seq: int, ssrc: int) -> bytes:
+    """ZRTP packet: RTP-lookalike header + message + CRC-32 trailer."""
+    hdr = struct.pack("!BBH", 0x10, 0, seq & 0xFFFF) + \
+        struct.pack("!II", MAGIC, ssrc & 0xFFFFFFFF)
+    body = hdr + msg
+    return body + struct.pack("!I", crc32c(body))
+
+
+def is_zrtp(datagram: bytes) -> bool:
+    return (len(datagram) >= 12
+            and datagram[0] == 0x10
+            and datagram[4:8] == struct.pack("!I", MAGIC))
+
+
+def _unwrap(datagram: bytes) -> Optional[bytes]:
+    if not is_zrtp(datagram) or len(datagram) < 16:
+        return None
+    body, crc = datagram[:-4], struct.unpack("!I", datagram[-4:])[0]
+    if crc32c(body) != crc:
+        return None
+    return body[12:]
+
+
+def _msg(mtype: bytes, payload: bytes) -> bytes:
+    assert len(mtype) == 8
+    total_words = (12 + len(payload)) // 4
+    return struct.pack("!HH", PREAMBLE, total_words) + mtype + payload
+
+
+def _parse_msg(msg: bytes) -> Optional[Tuple[bytes, bytes]]:
+    if len(msg) < 12 or struct.unpack("!H", msg[:2])[0] != PREAMBLE:
+        return None
+    return msg[4:12], msg[12:]
+
+
+# --------------------------------------------------------------- endpoint --
+
+class ZrtpProtocolError(RuntimeError):
+    """An authenticity/protocol check failed on a received message.
+
+    Never escapes `feed()` — the offending packet is dropped and the
+    failure recorded in `ZrtpEndpoint.alerts` (an exception here would
+    hand any off-path forger a DoS on the host I/O loop)."""
+
+
+class ZrtpEndpoint:
+    """One ZRTP association.  Both sides send Hello; the side told
+    `initiate()` sends Commit and becomes the initiator.
+
+    API mirrors the DTLS endpoint: `hello_packets()`, `feed(datagram)`,
+    `complete`, `srtp_keys()`, plus `sas` for the user-verification
+    string (the MITM defense: both users compare the 4 chars).
+    """
+
+    def __init__(self, zid: Optional[bytes] = None, ssrc: int = 0):
+        self.zid = zid if zid is not None else os.urandom(12)
+        self.ssrc = ssrc
+        # hash image chain (RFC 6189 §9)
+        self._h0 = os.urandom(32)
+        self._h1 = _sha256(self._h0)
+        self._h2 = _sha256(self._h1)
+        self._h3 = _sha256(self._h2)
+        self._ec_priv = ec.generate_private_key(ec.SECP256R1())
+        self._seq = int.from_bytes(os.urandom(2), "big")
+        self.role: Optional[str] = None
+        self.complete = False
+        self.sas: Optional[str] = None
+        self._s0: Optional[bytes] = None
+        self.alerts: List[str] = []          # dropped-packet security log
+        self._peer: Dict[bytes, bytes] = {}  # raw peer messages by type
+        self._my_hello = self._make_hello()
+        self._my_commit: Optional[bytes] = None
+        self._my_dhpart: Optional[bytes] = None
+        self._peer_pub: Optional[bytes] = None
+
+    # ------------------------------------------------------------ builders
+    def _pub_bytes(self) -> bytes:
+        return self._ec_priv.public_key().public_bytes(
+            serialization.Encoding.X962,
+            serialization.PublicFormat.UncompressedPoint)[1:]  # 64B x||y
+
+    def _make_hello(self) -> bytes:
+        payload = VERSION + b"libjitsi-tpu    "[:16] + self._h3 + self.zid
+        # flags + one algorithm of each kind (0x10101011-style counts)
+        payload += bytes([0, 1, 1, 1]) + HASH_S256 + CIPHER_AES1 + \
+            AUTH_HS80 + KA_EC25 + SAS_B32
+        core = _msg(b"Hello   ", payload + b"\x00" * 8)
+        mac = _hmac(self._h2, core[:-8])[:8]
+        return core[:-8] + mac
+
+    def _make_commit(self) -> bytes:
+        dh2 = self._make_dhpart(b"DHPart2 ")
+        hvi = _sha256(dh2 + self._peer[b"Hello   "])
+        payload = self._h2 + self.zid + HASH_S256 + CIPHER_AES1 + \
+            AUTH_HS80 + KA_EC25 + SAS_B32 + hvi
+        core = _msg(b"Commit  ", payload + b"\x00" * 8)
+        mac = _hmac(self._h1, core[:-8])[:8]
+        self._my_dhpart = dh2
+        return core[:-8] + mac
+
+    def _make_dhpart(self, mtype: bytes) -> bytes:
+        rs = os.urandom(32)  # 4 independent secret-IDs (no cached secrets)
+        payload = self._h1 + rs + self._pub_bytes()
+        core = _msg(mtype, payload + b"\x00" * 8)
+        mac = _hmac(self._h0, core[:-8])[:8]
+        return core[:-8] + mac
+
+    def _make_confirm(self, mtype: bytes) -> bytes:
+        # simplified confirm: HMAC(mackey, H0||flags) — the encrypted
+        # part's semantics (cache expiry, sig) are not modeled
+        key = self._mackey_own()
+        payload = _hmac(key, self._h0)[:8] + self._h0
+        return _msg(mtype, payload)
+
+    # ----------------------------------------------------------- transport
+    def _send(self, msg: bytes) -> bytes:
+        self._seq += 1
+        return _wrap(msg, self._seq, self.ssrc)
+
+    def hello_packets(self) -> List[bytes]:
+        return [self._send(self._my_hello)]
+
+    def initiate(self) -> List[bytes]:
+        """Become initiator (requires peer Hello already seen).  Idempotent:
+        a retry resends the SAME Commit — regenerating it would fork the
+        hvi commitment the peer has already pinned."""
+        if b"Hello   " not in self._peer:
+            raise RuntimeError("peer Hello not yet received")
+        if self.role == "initiator" and self._my_commit is not None:
+            return [self._send(self._my_commit)]
+        self.role = "initiator"
+        self._my_commit = self._make_commit()
+        return [self._send(self._my_commit)]
+
+    @staticmethod
+    def _check_mac(msg: bytes, key: bytes, what: str) -> None:
+        """Retroactive message-MAC check (RFC 6189 §8.1.1): each message
+        carries HMAC(next-revealed-hash-image, message) in its last 8B."""
+        if not hmac_mod.compare_digest(_hmac(key, msg[:-8])[:8], msg[-8:]):
+            raise ZrtpProtocolError(f"ZRTP: {what} message MAC mismatch "
+                                    "(tampered in flight?)")
+
+    def feed(self, datagram: bytes) -> List[bytes]:
+        """Process one datagram; returns reply datagrams.  Never raises on
+        wire input: malformed, out-of-order, duplicate and wrong-role
+        packets are dropped (returns []), and failed authenticity checks
+        are dropped with the reason appended to `self.alerts`."""
+        msg = _unwrap(datagram)
+        if msg is None:
+            return []
+        parsed = _parse_msg(msg)
+        if parsed is None:
+            return []
+        mtype, payload = parsed
+        try:
+            return self._process(mtype, payload, msg)
+        except ZrtpProtocolError as e:
+            self.alerts.append(str(e))
+            return []
+
+    def _process(self, mtype: bytes, payload: bytes,
+                 msg: bytes) -> List[bytes]:
+        out: List[bytes] = []
+        if mtype == b"Hello   ":
+            # pin the first Hello: its H3/ZID feed the key derivation,
+            # so a mid-handshake replacement must not take effect
+            if mtype in self._peer:
+                if self._peer[mtype] != msg:
+                    return []
+            else:
+                self._peer[mtype] = msg
+            out.append(self._send(_msg(b"HelloACK", b"")))
+        elif mtype == b"Commit  ":
+            if b"Hello   " not in self._peer or self.role == "initiator":
+                return []
+            if mtype in self._peer:
+                if self._peer[mtype] != msg or self._my_dhpart is None:
+                    return []
+                # duplicate Commit: resend the SAME DHPart1 (regenerating
+                # would fork total_hash between the two sides)
+                return [self._send(self._my_dhpart)]
+            peer_h2 = payload[:32]
+            if _sha256(peer_h2) != self._peer_hello_h3():
+                raise ZrtpProtocolError("ZRTP: Commit H2 does not chain to H3")
+            # H2 now known -> verify the peer Hello's MAC retroactively
+            self._check_mac(self._peer[b"Hello   "], peer_h2, "Hello")
+            self._peer[mtype] = msg
+            self.role = "responder"
+            self._my_dhpart = self._make_dhpart(b"DHPart1 ")
+            out.append(self._send(self._my_dhpart))
+        elif mtype == b"DHPart1 ":
+            if self.role != "initiator" or self._my_dhpart is None:
+                return []
+            if mtype in self._peer:
+                if self._peer[mtype] != msg:
+                    return []
+                return [self._send(self._my_dhpart)]
+            # responder never sends Commit; its H1 chains straight to the
+            # Hello H3 and reveals H2 = sha256(H1) for the Hello MAC
+            peer_h1 = payload[:32]
+            peer_h2 = _sha256(peer_h1)
+            if _sha256(peer_h2) != self._peer_hello_h3():
+                raise ZrtpProtocolError("ZRTP: DHPart1 H1 does not chain to H3")
+            self._check_mac(self._peer[b"Hello   "], peer_h2, "Hello")
+            self._peer[mtype] = msg
+            self._peer_pub = payload[32 + 32:32 + 32 + 64]
+            out.append(self._send(self._my_dhpart))
+        elif mtype == b"DHPart2 ":
+            if self.role != "responder" or b"Commit  " not in self._peer:
+                return []
+            if mtype in self._peer:
+                if self._peer[mtype] != msg or self._s0 is None:
+                    return []
+                return [self._send(self._make_confirm(b"Confirm1"))]
+            # verify commitment: hvi in Commit == hash(DHPart2||our Hello)
+            commit = self._peer[b"Commit  "]
+            hvi = commit[12 + 32 + 12 + 20:12 + 32 + 12 + 20 + 32]
+            if _sha256(msg + self._my_hello) != hvi:
+                raise ZrtpProtocolError("ZRTP: DHPart2 does not match hvi "
+                                        "commitment (possible MITM)")
+            # H1 revealed -> chains to Commit H2, and keys the Commit MAC
+            peer_h1 = payload[:32]
+            if _sha256(peer_h1) != commit[12:44]:
+                raise ZrtpProtocolError("ZRTP: DHPart2 H1 does not chain to H2")
+            self._check_mac(commit, peer_h1, "Commit")
+            self._peer[mtype] = msg
+            self._peer_pub = payload[32 + 32:32 + 32 + 64]
+            self._derive()
+            out.append(self._send(self._make_confirm(b"Confirm1")))
+        elif mtype == b"Confirm1":
+            if self.role != "initiator" or b"DHPart1 " not in self._peer:
+                return []
+            self._derive()
+            self._verify_confirm(payload)
+            out.append(self._send(self._make_confirm(b"Confirm2")))
+            self.complete = True
+        elif mtype == b"Confirm2":
+            if self.role != "responder" or self._s0 is None:
+                return []
+            self._verify_confirm(payload)
+            out.append(self._send(_msg(b"Conf2ACK", b"")))
+            self.complete = True
+        return out
+
+    # ---------------------------------------------------------- key sched
+    def _peer_hello_h3(self) -> bytes:
+        hello = self._peer[b"Hello   "]
+        return hello[12 + 4 + 16:12 + 4 + 16 + 32]
+
+    def _dh_result(self) -> bytes:
+        peer = ec.EllipticCurvePublicKey.from_encoded_point(
+            ec.SECP256R1(), b"\x04" + self._peer_pub)
+        return self._ec_priv.exchange(ec.ECDH(), peer)
+
+    def _derive(self) -> None:
+        if self._s0 is not None:
+            return
+        if self.role == "initiator":
+            zidi, zidr = self.zid, self._peer_zid()
+            hello_r = self._peer[b"Hello   "]
+            commit = self._my_commit
+            dh1 = self._peer[b"DHPart1 "]
+            dh2 = self._my_dhpart
+        else:
+            zidi, zidr = self._peer_zid(), self.zid
+            hello_r = self._my_hello
+            commit = self._peer[b"Commit  "]
+            dh1 = self._my_dhpart
+            dh2 = self._peer[b"DHPart2 "]
+        total_hash = _sha256(hello_r + commit + dh1 + dh2)
+        dhr = self._dh_result()
+        # RFC 6189 §4.4.1.4 (no cached secrets: s1=s2=s3 null)
+        null = struct.pack("!I", 0)
+        self._s0 = _sha256(struct.pack("!I", 1) + dhr + b"ZRTP-HMAC-KDF" +
+                           zidi + zidr + total_hash + null + null + null)
+        self._ctx = zidi + zidr + total_hash
+        self.sas = sas_b32(_kdf(self._s0, b"SAS", self._ctx, 256))
+
+    def _peer_zid(self) -> bytes:
+        hello = self._peer[b"Hello   "]
+        return hello[12 + 4 + 16 + 32:12 + 4 + 16 + 32 + 12]
+
+    def _mackey_own(self) -> bytes:
+        label = b"Initiator HMAC key" if self.role == "initiator" else \
+            b"Responder HMAC key"
+        return _kdf(self._s0, label, self._ctx, 256)
+
+    def _mackey_peer(self) -> bytes:
+        label = b"Responder HMAC key" if self.role == "initiator" else \
+            b"Initiator HMAC key"
+        return _kdf(self._s0, label, self._ctx, 256)
+
+    def _verify_confirm(self, payload: bytes) -> None:
+        mac, peer_h0 = payload[:8], payload[8:40]
+        if not hmac_mod.compare_digest(
+                _hmac(self._mackey_peer(), peer_h0)[:8], mac):
+            raise ZrtpProtocolError("ZRTP: Confirm MAC mismatch")
+        # retroactive checks: H0 -> H1 seen in peer DHPart, and H0 keys
+        # the DHPart message MAC (RFC 6189 §8.1.1)
+        dh = self._peer.get(b"DHPart1 " if self.role == "initiator"
+                            else b"DHPart2 ")
+        if dh is not None:
+            if _sha256(peer_h0) != dh[12:44]:
+                raise ZrtpProtocolError(
+                    "ZRTP: H0 does not chain to DHPart H1")
+            self._check_mac(dh, peer_h0, "DHPart")
+
+    # -------------------------------------------------------------- export
+    def srtp_keys(self):
+        """(profile, tx_key, tx_salt, rx_key, rx_salt) — initiator sends
+        with the initiator key (RFC 6189 §4.5.3)."""
+        if self._s0 is None:
+            raise RuntimeError("ZRTP not negotiated")
+        ki = _kdf(self._s0, b"Initiator SRTP master key", self._ctx, 128)
+        si = _kdf(self._s0, b"Initiator SRTP master salt", self._ctx, 112)
+        kr = _kdf(self._s0, b"Responder SRTP master key", self._ctx, 128)
+        sr = _kdf(self._s0, b"Responder SRTP master salt", self._ctx, 112)
+        profile = SrtpProfile.AES_CM_128_HMAC_SHA1_80
+        if self.role == "initiator":
+            return profile, ki, si, kr, sr
+        return profile, kr, sr, ki, si

@@ -1,0 +1,216 @@
+"""ZRTP (RFC 6189): in-memory agreement, SAS, commitment/chain checks,
+retroactive message-MAC checks, robustness against malformed/out-of-order
+packets, keys driving SRTP tables.
+"""
+
+import struct
+
+from libjitsi_tpu.control.zrtp import ZrtpEndpoint, crc32c, is_zrtp, sas_b32
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+
+def _reseal(pkt: bytes) -> bytes:
+    """Recompute the CRC-32C trailer after tampering with the body."""
+    body = pkt[:-4]
+    return body + struct.pack("!I", crc32c(body))
+
+
+def run_zrtp(a: ZrtpEndpoint, b: ZrtpEndpoint):
+    """a initiates after the Hello exchange."""
+    wire = [(0, p) for p in a.hello_packets()] + \
+           [(1, p) for p in b.hello_packets()]
+    started = False
+    rounds = 0
+    while (not a.complete or not b.complete) and rounds < 30:
+        rounds += 1
+        nxt = []
+        for who, pkt in wire:
+            ep = b if who == 0 else a
+            nxt += [(1 - who, p) for p in ep.feed(pkt)]
+        wire = nxt
+        if not started and b"Hello   " in a._peer:
+            wire += [(0, p) for p in a.initiate()]
+            started = True
+    assert a.complete and b.complete, "zrtp did not complete"
+
+
+def test_crc32c_kat():
+    # the canonical CRC-32C check value (RFC 3720 §B.4)
+    assert crc32c(b"123456789") == 0xE3069283
+
+
+def test_zrtp_agreement_sas_and_keys():
+    a, b = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    run_zrtp(a, b)
+    assert a.role == "initiator" and b.role == "responder"
+    assert a.sas == b.sas and len(a.sas) == 4
+    pa, a_txk, a_txs, a_rxk, a_rxs = a.srtp_keys()
+    pb, b_txk, b_txs, b_rxk, b_rxs = b.srtp_keys()
+    assert (a_txk, a_txs) == (b_rxk, b_rxs)
+    assert (a_rxk, a_rxs) == (b_txk, b_txs)
+
+    # keys drive real SRTP tables end to end
+    tx = SrtpStreamTable(capacity=1, profile=pa)
+    tx.add_stream(0, a_txk, a_txs)
+    rx = SrtpStreamTable(capacity=1, profile=pb)
+    rx.add_stream(0, b_rxk, b_rxs)
+    pkt = rtp_header.build([b"zrtp-keyed"], [1], [0], [5], [96], stream=[0])
+    dec, ok = rx.unprotect_rtp(tx.protect_rtp(pkt))
+    assert ok.all() and dec.to_bytes(0) == pkt.to_bytes(0)
+
+
+def test_zrtp_demux_and_crc():
+    a = ZrtpEndpoint()
+    pkt = a.hello_packets()[0]
+    assert is_zrtp(pkt)
+    assert not is_zrtp(b"\x80\x60" + bytes(20))      # RTP
+    assert not is_zrtp(bytes([22, 254, 253]))        # DTLS
+    # corrupted CRC: silently dropped
+    bad = pkt[:-1] + bytes([pkt[-1] ^ 1])
+    b = ZrtpEndpoint()
+    assert b.feed(bad) == []
+
+
+def test_zrtp_commitment_binds_dhpart2():
+    """A MITM swapping DHPart2 after Commit is caught by the hvi check."""
+    a, b = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    for p in a.hello_packets():
+        b.feed(p)
+    for p in b.hello_packets():
+        a.feed(p)
+    commit = a.initiate()[0]
+    dh1 = b.feed(commit)[0]
+    dh2 = a.feed(dh1)[0]
+    # attacker substitutes a different DHPart2 (new key pair)
+    evil = ZrtpEndpoint(ssrc=1)
+    evil_dh2_msg = evil._make_dhpart(b"DHPart2 ")
+    forged = _reseal(dh2[:12] + evil_dh2_msg + dh2[12 + len(evil_dh2_msg):])
+    assert b.feed(forged) == []
+    assert any("hvi" in a_ or "MITM" in a_ for a_ in b.alerts)
+    assert not b.complete
+
+
+def test_zrtp_commit_must_chain_to_hello():
+    """A Commit whose H2 does not hash to the Hello's H3 is rejected."""
+    a, b = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    for p in a.hello_packets():
+        b.feed(p)
+    for p in b.hello_packets():
+        a.feed(p)
+    commit = bytearray(a.initiate()[0])
+    commit[12 + 12 + 5] ^= 0xFF  # corrupt H2 inside the commit message
+    assert b.feed(_reseal(bytes(commit))) == []
+    assert any("chain" in a_ for a_ in b.alerts)
+    assert b.role is None
+
+
+def test_zrtp_tampered_hello_caught_retroactively():
+    """Flipping a MAC-covered Hello field (the client-id) is detected when
+    H2 is later revealed by the Commit (RFC 6189 §8.1.1)."""
+    a, b = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    hello = bytearray(a.hello_packets()[0])
+    hello[12 + 12 + 4 + 2] ^= 0xFF   # client-id byte: not in H3/ZID/algos
+    b.feed(_reseal(bytes(hello)))
+    for p in b.hello_packets():
+        a.feed(p)
+    assert b.feed(a.initiate()[0]) == []
+    assert any("MAC" in a_ for a_ in b.alerts)
+
+
+def test_zrtp_out_of_order_and_garbage_dropped():
+    """Commit before Hello, unknown message types, and truncated or
+    non-UTF-8 types are dropped, not crashes."""
+    a, b = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    for p in b.hello_packets():
+        a.feed(p)
+    commit = a.initiate()[0]
+    fresh = ZrtpEndpoint()
+    assert fresh.feed(commit) == []           # Commit before Hello: dropped
+    # unknown/binary message type: dropped
+    from libjitsi_tpu.control import zrtp as z
+    junk = z._wrap(z._msg(b"\xff" * 8, b"pay"), 1, 0)
+    assert fresh.feed(junk) == []
+    # reflected Confirm2 at the initiator: dropped (wrong role)
+    aa, bb = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    run_zrtp(aa, bb)
+    conf2 = aa._send(aa._make_confirm(b"Confirm2"))
+    assert aa.feed(conf2) == []
+
+
+def test_zrtp_duplicate_commit_is_idempotent():
+    """A duplicated Commit must re-elicit the SAME DHPart1 (a regenerated
+    one would fork total_hash between the sides) and still converge."""
+    a, b = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    for p in a.hello_packets():
+        b.feed(p)
+    for p in b.hello_packets():
+        a.feed(p)
+    commit = a.initiate()[0]
+    dh1_first = b.feed(commit)[0]
+    dh1_dup = b.feed(commit)[0]
+    assert dh1_first[12:-4] == dh1_dup[12:-4]   # same message, new seq
+    dh2 = a.feed(dh1_first)[0]
+    conf1 = b.feed(dh2)[0]
+    conf2 = a.feed(conf1)[0]
+    b.feed(conf2)
+    assert a.complete and b.complete and a.sas == b.sas
+
+
+def test_zrtp_midhandshake_hello_replacement_ignored():
+    """A forged Hello injected after the exchange must not replace the
+    pinned first Hello that feeds the key derivation."""
+    a, b = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    for p in a.hello_packets():
+        b.feed(p)
+    for p in b.hello_packets():
+        a.feed(p)
+    pinned = b._peer[b"Hello   "]
+    forged_src = ZrtpEndpoint(ssrc=1)
+    b.feed(forged_src.hello_packets()[0])
+    assert b._peer[b"Hello   "] == pinned
+    # handshake still completes with the pinned Hello
+    commit = a.initiate()[0]
+    dh1 = b.feed(commit)[0]
+    dh2 = a.feed(dh1)[0]
+    conf1 = b.feed(dh2)[0]
+    conf2 = a.feed(conf1)[0]
+    b.feed(conf2)
+    assert a.complete and b.complete and a.sas == b.sas
+
+
+def test_sas_encoding():
+    assert len(sas_b32(bytes(32))) == 4
+    assert sas_b32(bytes.fromhex("ffffffff" + "00" * 28)) != \
+        sas_b32(bytes(32))
+
+
+def test_zrtp_initiate_is_idempotent():
+    """Retrying initiate() resends the SAME Commit (a regenerated one
+    would fork the hvi commitment the peer pinned)."""
+    a, b = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    for p in a.hello_packets():
+        b.feed(p)
+    for p in b.hello_packets():
+        a.feed(p)
+    c1 = a.initiate()[0]
+    c2 = a.initiate()[0]
+    assert c1[12:-4] == c2[12:-4]       # same message, new seq/CRC
+    dh1 = b.feed(c1)[0]
+    dh2 = a.feed(dh1)[0]
+    conf1 = b.feed(dh2)[0]
+    conf2 = a.feed(conf1)[0]
+    b.feed(conf2)
+    assert a.complete and b.complete and a.sas == b.sas
+
+
+def test_zrtp_forged_confirm_after_complete_dropped():
+    """A spoofed Confirm2 (valid CRC, random MAC) after completion is
+    dropped with an alert — it must not raise into the I/O loop."""
+    from libjitsi_tpu.control import zrtp as z
+    a, b = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    run_zrtp(a, b)
+    forged = z._wrap(z._msg(b"Confirm2", bytes(40)), 9, 2)
+    assert b.feed(forged) == []
+    assert any("Confirm MAC" in a_ for a_ in b.alerts)
+    assert b.complete                   # session state untouched
