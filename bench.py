@@ -46,7 +46,7 @@ def tpu_pps() -> tuple[float, float]:
     def step(tab_rk, tab_mid, stream, data, length, payload_off, iv, roc):
         return kernel.srtp_protect(
             data, length, payload_off, tab_rk[stream], iv, tab_mid[stream],
-            roc, TAG_LEN, True)
+            roc, TAG_LEN, True, payload_off_const=12)
 
     args = [jnp.asarray(a) for a in
             (tab_rk, tab_mid, stream, data, length, payload_off, iv, roc)]
@@ -163,7 +163,8 @@ def fanout_rows_per_sec(packets: int = 64, receivers: int = 128) -> float:
     @jax.jit
     def step(tab_rk, tab_mid, recv, data, length, off, iv, roc):
         return kernel.srtp_protect(data, length, off, tab_rk[recv], iv,
-                                   tab_mid[recv], roc, TAG_LEN, True)
+                                   tab_mid[recv], roc, TAG_LEN, True,
+                                   payload_off_const=12)
 
     args = [jnp.asarray(x) for x in
             (tab_rk, tab_mid, recv, data, length, off, iv, roc)]

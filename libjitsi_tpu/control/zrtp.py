@@ -284,8 +284,10 @@ class ZrtpEndpoint:
             if _sha256(peer_h2) != self._peer_hello_h3():
                 raise ZrtpProtocolError("ZRTP: DHPart1 H1 does not chain to H3")
             self._check_mac(self._peer[b"Hello   "], peer_h2, "Hello")
+            pub = payload[32 + 32:32 + 32 + 64]
+            self._parse_point(pub)       # reject junk at receive time
             self._peer[mtype] = msg
-            self._peer_pub = payload[32 + 32:32 + 32 + 64]
+            self._peer_pub = pub
             out.append(self._send(self._my_dhpart))
         elif mtype == b"DHPart2 ":
             if self.role != "responder" or b"Commit  " not in self._peer:
@@ -305,8 +307,10 @@ class ZrtpEndpoint:
             if _sha256(peer_h1) != commit[12:44]:
                 raise ZrtpProtocolError("ZRTP: DHPart2 H1 does not chain to H2")
             self._check_mac(commit, peer_h1, "Commit")
+            pub = payload[32 + 32:32 + 32 + 64]
+            self._parse_point(pub)
             self._peer[mtype] = msg
-            self._peer_pub = payload[32 + 32:32 + 32 + 64]
+            self._peer_pub = pub
             self._derive()
             out.append(self._send(self._make_confirm(b"Confirm1")))
         elif mtype == b"Confirm1":
@@ -329,10 +333,23 @@ class ZrtpEndpoint:
         hello = self._peer[b"Hello   "]
         return hello[12 + 4 + 16:12 + 4 + 16 + 32]
 
+    @staticmethod
+    def _parse_point(raw: bytes) -> ec.EllipticCurvePublicKey:
+        """Validate a peer's 64-byte x||y P-256 point.  Raises
+        ZrtpProtocolError (dropped+alerted by feed) on junk — an invalid
+        point must not escape as ValueError into the I/O loop, nor reach
+        the ECDH as an invalid-curve input."""
+        if len(raw) != 64:
+            raise ZrtpProtocolError("ZRTP: DHPart public value truncated")
+        try:
+            return ec.EllipticCurvePublicKey.from_encoded_point(
+                ec.SECP256R1(), b"\x04" + raw)
+        except ValueError as e:
+            raise ZrtpProtocolError(f"ZRTP: invalid EC point ({e})") from e
+
     def _dh_result(self) -> bytes:
-        peer = ec.EllipticCurvePublicKey.from_encoded_point(
-            ec.SECP256R1(), b"\x04" + self._peer_pub)
-        return self._ec_priv.exchange(ec.ECDH(), peer)
+        return self._ec_priv.exchange(ec.ECDH(),
+                                      self._parse_point(self._peer_pub))
 
     def _derive(self) -> None:
         if self._s0 is not None:

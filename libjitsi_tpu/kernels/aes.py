@@ -237,6 +237,30 @@ def ctr_keystream(round_keys, iv, nblocks: int):
     return ks.reshape(bsz, nblocks * 16)
 
 
+@functools.partial(jax.jit, static_argnames=("offset",))
+def ctr_crypt_uniform(round_keys, iv, data, offset: int, length):
+    """Uniform-offset fast path of `ctr_crypt_offset`.
+
+    When every row's payload begins at the same byte offset (the common
+    case: fixed 12-byte RTP headers, or SRTCP's constant 8), the keystream
+    alignment is a static left-pad — the per-row `take_along_axis` gather
+    in the general path is by far its dominant cost on TPU (measured ~5x
+    the AES itself), so the host picks this variant whenever the batch is
+    offset-uniform.  Encrypt == decrypt (CTR).  -> [B, W] uint8.
+    """
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    bsz, width = data.shape
+    nblocks = max(0, (width - offset + 15) // 16)
+    if nblocks == 0:            # offset beyond the buffer: nothing to crypt
+        return data
+    ks = ctr_keystream(round_keys, iv, nblocks)  # [B, nblocks*16]
+    ks_aligned = jnp.pad(ks, ((0, 0), (offset, 0)))[:, :width]
+    col = jnp.arange(width, dtype=jnp.int32)[None, :]
+    ln = jnp.asarray(length, dtype=jnp.int32)[:, None]
+    inside = (col >= offset) & (col < offset + ln)
+    return jnp.where(inside, data ^ ks_aligned, data)
+
+
 @jax.jit
 def ctr_crypt_offset(round_keys, iv, data, offset, length):
     """XOR an AES-CTR keystream into each row's [offset, offset+length) span.

@@ -47,20 +47,37 @@ from libjitsi_tpu.transform.srtp.policy import Cipher, SrtpPolicy, SrtpProfile
 
 # --- jitted wrappers: gather per-stream key material on device -------------
 
-@functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
+@functools.partial(
+    jax.jit, static_argnames=("tag_len", "encrypt", "off_const"))
 def _protect_rtp_dev(tab_rk, tab_mid, stream, data, length, payload_off, iv,
-                     roc, tag_len: int, encrypt: bool):
+                     roc, tag_len: int, encrypt: bool, off_const=None):
     return kernel.srtp_protect(
         data, length, payload_off, tab_rk[stream], iv, tab_mid[stream], roc,
-        tag_len, encrypt)
+        tag_len, encrypt, payload_off_const=off_const)
 
 
-@functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
+@functools.partial(
+    jax.jit, static_argnames=("tag_len", "encrypt", "off_const"))
 def _unprotect_rtp_dev(tab_rk, tab_mid, stream, data, length, payload_off, iv,
-                       roc, tag_len: int, encrypt: bool):
+                       roc, tag_len: int, encrypt: bool, off_const=None):
     return kernel.srtp_unprotect(
         data, length, payload_off, tab_rk[stream], iv, tab_mid[stream], roc,
-        tag_len, encrypt)
+        tag_len, encrypt, payload_off_const=off_const)
+
+
+def _uniform_off(payload_off, width: int) -> "int | None":
+    """Static payload offset when the whole batch agrees (the common case:
+    fixed 12-byte headers).  Lets the kernel use the pad-shift keystream
+    alignment instead of the per-row gather.  Out-of-range offsets (a
+    forged ext_words field can claim a header larger than the packet) fall
+    back to the gather path, which clamps per row and lets such packets
+    die on auth failure instead of crashing the trace."""
+    off = np.asarray(payload_off)
+    if off.size and np.all(off == off.flat[0]):
+        v = int(off.flat[0])
+        if 0 <= v < width:
+            return v
+    return None
 
 
 @functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
@@ -268,7 +285,8 @@ class SrtpStreamTable:
                 jnp.asarray(batch.data), jnp.asarray(batch.length),
                 jnp.asarray(hdr.payload_off), jnp.asarray(iv),
                 jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
-                self.policy.auth_tag_len, self.policy.cipher != Cipher.NULL)
+                self.policy.auth_tag_len, self.policy.cipher != Cipher.NULL,
+                off_const=_uniform_off(hdr.payload_off, batch.capacity))
         np.maximum.at(self.tx_ext, stream, idx)
         return PacketBatch(np.asarray(data), np.asarray(length, dtype=np.int32),
                            batch.stream)
@@ -324,7 +342,8 @@ class SrtpStreamTable:
                 jnp.asarray(batch.data), jnp.asarray(length),
                 jnp.asarray(hdr.payload_off), jnp.asarray(iv),
                 jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
-                p.auth_tag_len, p.cipher != Cipher.NULL)
+                p.auth_tag_len, p.cipher != Cipher.NULL,
+                off_const=_uniform_off(hdr.payload_off, batch.capacity))
         ok = valid & not_replayed & np.asarray(auth_ok)
         # in-batch duplicate indices: keep the first *authenticated*
         # occurrence (a forged front-runner fails auth and must not block

@@ -19,7 +19,7 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from libjitsi_tpu.kernels.aes import ctr_crypt_offset
+from libjitsi_tpu.kernels.aes import ctr_crypt_offset, ctr_crypt_uniform
 from libjitsi_tpu.kernels.sha1 import hmac_sha1
 
 
@@ -65,7 +65,8 @@ def _u32_bytes(x):
     return ((x[:, None] >> shifts[None, :]) & 0xFF).astype(jnp.uint8)
 
 
-@functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
+@functools.partial(
+    jax.jit, static_argnames=("tag_len", "encrypt", "payload_off_const"))
 def srtp_protect(
     data,
     length,
@@ -76,6 +77,7 @@ def srtp_protect(
     roc,
     tag_len: int,
     encrypt: bool = True,
+    payload_off_const=None,
 ):
     """Batched SRTP protect (reference: SRTPCryptoContext.transformPacket).
 
@@ -89,9 +91,14 @@ def srtp_protect(
     length = jnp.asarray(length, dtype=jnp.int32)
     payload_off = jnp.asarray(payload_off, dtype=jnp.int32)
     if encrypt:
-        data = ctr_crypt_offset(
-            round_keys, iv, data, payload_off, length - payload_off
-        )
+        if payload_off_const is not None:
+            data = ctr_crypt_uniform(
+                round_keys, iv, data, payload_off_const,
+                length - payload_off_const)
+        else:
+            data = ctr_crypt_offset(
+                round_keys, iv, data, payload_off, length - payload_off
+            )
     if tag_len:
         tags = _auth_tags(data, length, _u32_bytes(jnp.asarray(roc)), midstates)
         data = _scatter_tag(data, length, tags, tag_len)
@@ -99,7 +106,8 @@ def srtp_protect(
     return data, length
 
 
-@functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
+@functools.partial(
+    jax.jit, static_argnames=("tag_len", "encrypt", "payload_off_const"))
 def srtp_unprotect(
     data,
     length,
@@ -110,6 +118,7 @@ def srtp_unprotect(
     roc,
     tag_len: int,
     encrypt: bool = True,
+    payload_off_const=None,
 ):
     """Batched SRTP unprotect (reference: SRTPCryptoContext.reverseTransformPacket).
 
@@ -128,7 +137,13 @@ def srtp_unprotect(
     else:
         auth_ok = jnp.ones((data.shape[0],), dtype=bool)
     if encrypt:
-        out = ctr_crypt_offset(round_keys, iv, data, payload_off, mlen - payload_off)
+        if payload_off_const is not None:
+            out = ctr_crypt_uniform(
+                round_keys, iv, data, payload_off_const,
+                mlen - payload_off_const)
+        else:
+            out = ctr_crypt_offset(
+                round_keys, iv, data, payload_off, mlen - payload_off)
     else:
         out = data
     return out, mlen, auth_ok
@@ -147,9 +162,8 @@ def srtcp_protect(
     """
     data = jnp.asarray(data, dtype=jnp.uint8)
     length = jnp.asarray(length, dtype=jnp.int32)
-    off = jnp.full_like(length, 8)
     if encrypt:
-        data = ctr_crypt_offset(round_keys, iv, data, off, length - off)
+        data = ctr_crypt_uniform(round_keys, iv, data, 8, length - 8)
     word = _u32_bytes(jnp.asarray(index_word))
     tags = _auth_tags(data, length, word, midstates)
     data = _scatter_word(data, length, word)
@@ -184,9 +198,8 @@ def srtcp_unprotect(
         auth_ok = jnp.all(stored == tags[:, :tag_len], axis=1)
     else:
         auth_ok = jnp.ones((data.shape[0],), dtype=bool)
-    off = jnp.full_like(mlen, 8)
     if encrypt:
-        out = ctr_crypt_offset(round_keys, iv, data, off, mlen - off)
+        out = ctr_crypt_uniform(round_keys, iv, data, 8, mlen - 8)
         # rows with E=0 were sent unencrypted: pass through
         out = jnp.where((e_bit == 1)[:, None], out, data)
     else:

@@ -87,3 +87,23 @@ def test_ext_same_id_different_length_replaces_not_shadows():
     np.testing.assert_array_equal(out2.data[0, off[0]:off[0] + 2],
                                   [0xBB, 0xBB])
     assert out2.to_bytes(0).endswith(b"payload")
+
+
+def test_unprotect_forged_oversize_ext_header_dropped():
+    """A packet whose ext_words field claims a header beyond the buffer
+    must be dropped by auth, not crash the uniform-offset fast path
+    (single-packet batches are trivially offset-uniform)."""
+    import numpy as np
+    from libjitsi_tpu.core.packet import PacketBatch
+    from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+    rx = SrtpStreamTable(capacity=1)
+    rx.add_stream(0, bytes(16), bytes(14))
+    raw = bytearray(40)
+    raw[0] = 0x90                      # V=2, X=1
+    raw[1] = 96
+    raw[12:16] = b"\xbe\xde\xff\x00"   # ext_words = 0xff00 -> off >> width
+    batch = PacketBatch.from_payloads([bytes(raw)], capacity=64)
+    batch.stream[:] = 0
+    dec, ok = rx.unprotect_rtp(batch)
+    assert not np.asarray(ok).any()

@@ -214,3 +214,20 @@ def test_zrtp_forged_confirm_after_complete_dropped():
     assert b.feed(forged) == []
     assert any("Confirm MAC" in a_ for a_ in b.alerts)
     assert b.complete                   # session state untouched
+
+def test_zrtp_invalid_ec_point_dropped():
+    """A DHPart with a non-curve or truncated public value is dropped
+    with an alert, not a ValueError into the I/O loop."""
+    a, b = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    for p in a.hello_packets():
+        b.feed(p)
+    for p in b.hello_packets():
+        a.feed(p)
+    commit = a.initiate()[0]
+    dh1 = bytearray(b.feed(commit)[0])
+    # corrupt the x coordinate of the EC point (offset: 12B pkt hdr +
+    # 12B msg hdr + 32B H1 + 32B rs)
+    for i in range(64):
+        dh1[12 + 12 + 64 + i] = 0xFF
+    assert a.feed(_reseal(bytes(dh1))) == []
+    assert any("EC point" in x or "MAC" in x for x in a.alerts)
