@@ -74,6 +74,21 @@ def _mix_jit(pcm, active):
     return mix_minus(pcm, active)
 
 
+def _mix_pallas(pcm, active):
+    # interpret mode off-TPU (Mosaic only lowers for TPU); bit-identical
+    from libjitsi_tpu.kernels.pallas_ops import mix_minus_pallas
+    interpret = jax.default_backend() != "tpu"
+    return mix_minus_pallas(pcm, active, interpret=interpret)
+
+
+# provider registry (reference pattern: crypto.Aes benchmarks providers
+# and installs the fastest; here per shape signature on first use)
+from libjitsi_tpu.kernels import registry as _registry  # noqa: E402
+
+_registry.register("mix_minus", "xla", _mix_jit)
+_registry.register("mix_minus", "pallas", _mix_pallas)
+
+
 class AudioMixer:
     """Host-facing mixer over a fixed participant capacity.
 
@@ -93,6 +108,11 @@ class AudioMixer:
         self.frame_samples = frame_samples
         self.active = np.zeros(capacity, dtype=bool)
         self._frame = np.zeros((capacity, frame_samples), dtype=np.int16)
+        # compile + provider-benchmark NOW, at setup time — a 20 ms mix
+        # tick must never absorb jit compiles or the registry's timing
+        # runs (reference analog: crypto.Aes benches providers at startup)
+        _registry.warmup("mix_minus", jnp.asarray(self._frame),
+                         jnp.asarray(self.active))
 
     def add_participant(self, sid: int) -> None:
         self.active[sid] = True
@@ -117,7 +137,7 @@ class AudioMixer:
         contribute silence (the reference's pull model blocks briefly then
         pads silence; a server mixer must never block on a slow sender).
         """
-        out, levels = _mix_jit(jnp.asarray(self._frame),
-                               jnp.asarray(self.active))
+        out, levels = _registry.call("mix_minus", jnp.asarray(self._frame),
+                                     jnp.asarray(self.active))
         self._frame[:] = 0
         return np.asarray(out), np.asarray(levels)
