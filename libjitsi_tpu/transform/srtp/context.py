@@ -36,7 +36,7 @@ from libjitsi_tpu.core.rtp_math import (
     segment_ranks,
 )
 from libjitsi_tpu.kernels import gcm as gcm_kernel
-from libjitsi_tpu.kernels.aes import aes_encrypt_np, expand_key
+from libjitsi_tpu.kernels.aes import aes_encrypt_np, expand_key, f8_m
 from libjitsi_tpu.kernels.ghash import ghash_matrix
 from libjitsi_tpu.kernels.sha1 import hmac_precompute
 from libjitsi_tpu.rtp import header as rtp_header
@@ -50,19 +50,23 @@ from libjitsi_tpu.transform.srtp.policy import Cipher, SrtpPolicy, SrtpProfile
 @functools.partial(
     jax.jit, static_argnames=("tag_len", "encrypt", "off_const"))
 def _protect_rtp_dev(tab_rk, tab_mid, stream, data, length, payload_off, iv,
-                     roc, tag_len: int, encrypt: bool, off_const=None):
+                     roc, tag_len: int, encrypt: bool, off_const=None,
+                     tab_f8=None):
     return kernel.srtp_protect(
         data, length, payload_off, tab_rk[stream], iv, tab_mid[stream], roc,
-        tag_len, encrypt, payload_off_const=off_const)
+        tag_len, encrypt, payload_off_const=off_const,
+        f8_round_keys=None if tab_f8 is None else tab_f8[stream])
 
 
 @functools.partial(
     jax.jit, static_argnames=("tag_len", "encrypt", "off_const"))
 def _unprotect_rtp_dev(tab_rk, tab_mid, stream, data, length, payload_off, iv,
-                       roc, tag_len: int, encrypt: bool, off_const=None):
+                       roc, tag_len: int, encrypt: bool, off_const=None,
+                       tab_f8=None):
     return kernel.srtp_unprotect(
         data, length, payload_off, tab_rk[stream], iv, tab_mid[stream], roc,
-        tag_len, encrypt, payload_off_const=off_const)
+        tag_len, encrypt, payload_off_const=off_const,
+        f8_round_keys=None if tab_f8 is None else tab_f8[stream])
 
 
 def _uniform_off(payload_off, width: int) -> "int | None":
@@ -82,17 +86,19 @@ def _uniform_off(payload_off, width: int) -> "int | None":
 
 @functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
 def _protect_rtcp_dev(tab_rk, tab_mid, stream, data, length, iv, index_word,
-                      tag_len: int, encrypt: bool):
+                      tag_len: int, encrypt: bool, tab_f8=None):
     return kernel.srtcp_protect(
         data, length, tab_rk[stream], iv, tab_mid[stream], index_word,
-        tag_len, encrypt)
+        tag_len, encrypt,
+        f8_round_keys=None if tab_f8 is None else tab_f8[stream])
 
 
 @functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
 def _unprotect_rtcp_dev(tab_rk, tab_mid, stream, data, length, iv,
-                        tag_len: int, encrypt: bool):
+                        tag_len: int, encrypt: bool, tab_f8=None):
     return kernel.srtcp_unprotect(
-        data, length, tab_rk[stream], iv, tab_mid[stream], tag_len, encrypt)
+        data, length, tab_rk[stream], iv, tab_mid[stream], tag_len, encrypt,
+        f8_round_keys=None if tab_f8 is None else tab_f8[stream])
 
 
 @jax.jit
@@ -116,6 +122,7 @@ class SrtpStreamTable:
         self.policy: SrtpPolicy = profile.policy
         self.capacity = capacity
         self._gcm = self.policy.cipher == Cipher.AES_GCM
+        self._f8 = self.policy.cipher == Cipher.AES_F8
         rounds = {16: 11, 32: 15}[self.policy.enc_key_len]
 
         s = capacity
@@ -130,6 +137,11 @@ class SrtpStreamTable:
             # form of the GF(2^128) multiply — see kernels/ghash.py
             self._gm_rtp = np.zeros((s, 128, 128), dtype=np.int8)
             self._gm_rtcp = np.zeros((s, 128, 128), dtype=np.int8)
+        if self._f8:
+            # second schedule per stream: E(k_e XOR m) for IV' (RFC 3711
+            # §4.1.2.2; reference SRTPCipherF8.deriveForIV analog)
+            self._rk_f8_rtp = np.zeros((s, rounds, 16), dtype=np.uint8)
+            self._rk_f8_rtcp = np.zeros((s, rounds, 16), dtype=np.uint8)
         self._dev = None  # cached jnp copies
         # host-side IV salts (16B, low 2 bytes zero)
         self._salt_rtp = np.zeros((s, 16), dtype=np.uint8)
@@ -169,6 +181,12 @@ class SrtpStreamTable:
         else:
             self._mid_rtp[sid] = hmac_precompute(ks.rtp_auth)
             self._mid_rtcp[sid] = hmac_precompute(ks.rtcp_auth)
+        if self._f8:
+            for enc, salt, rkf in ((ks.rtp_enc, ks.rtp_salt, self._rk_f8_rtp),
+                                   (ks.rtcp_enc, ks.rtcp_salt,
+                                    self._rk_f8_rtcp)):
+                m = f8_m(enc, salt)
+                rkf[sid] = expand_key(bytes(a ^ b for a, b in zip(enc, m)))
         self._salt_rtp[sid, : p.salt_len] = np.frombuffer(ks.rtp_salt, np.uint8)
         self._salt_rtp[sid, p.salt_len:] = 0
         self._salt_rtcp[sid, : p.salt_len] = np.frombuffer(ks.rtcp_salt, np.uint8)
@@ -191,6 +209,9 @@ class SrtpStreamTable:
         if self._gcm:
             self._gm_rtp[sid] = 0
             self._gm_rtcp[sid] = 0
+        if self._f8:
+            self._rk_f8_rtp[sid] = 0
+            self._rk_f8_rtcp[sid] = 0
         self._dev = None
 
     def _device(self):
@@ -201,6 +222,9 @@ class SrtpStreamTable:
                 jnp.asarray(self._rk_rtp), jnp.asarray(aux_rtp),
                 jnp.asarray(self._rk_rtcp), jnp.asarray(aux_rtcp),
             )
+            if self._f8:
+                self._dev_f8 = (jnp.asarray(self._rk_f8_rtp),
+                                jnp.asarray(self._rk_f8_rtcp))
         return self._dev
 
     def _require_active(self, stream: np.ndarray) -> None:
@@ -228,6 +252,37 @@ class SrtpStreamTable:
             iv[:, 4 + k] ^= ((ssrc >> (8 * (3 - k))) & 0xFF).astype(np.uint8)
         for k in range(6):
             iv[:, 8 + k] ^= ((index >> (8 * (5 - k))) & 0xFF).astype(np.uint8)
+        return iv
+
+    @staticmethod
+    def _f8_rtp_iv(hdr, roc: np.ndarray) -> np.ndarray:
+        """RFC 3711 §4.1.2.1: IV = 0x00 || M,PT || SEQ || TS || SSRC || ROC."""
+        n = len(hdr.seq)
+        iv = np.zeros((n, 16), dtype=np.uint8)
+        iv[:, 1] = ((np.asarray(hdr.marker) << 7) | np.asarray(hdr.pt)
+                    ).astype(np.uint8)
+        iv[:, 2] = (hdr.seq >> 8) & 0xFF
+        iv[:, 3] = hdr.seq & 0xFF
+        ts = np.asarray(hdr.ts, dtype=np.int64)
+        ssrc = np.asarray(hdr.ssrc, dtype=np.int64)
+        roc = np.asarray(roc, dtype=np.int64)
+        for k in range(4):
+            sh = 8 * (3 - k)
+            iv[:, 4 + k] = (ts >> sh) & 0xFF
+            iv[:, 8 + k] = (ssrc >> sh) & 0xFF
+            iv[:, 12 + k] = (roc >> sh) & 0xFF
+        return iv
+
+    @staticmethod
+    def _f8_rtcp_iv(data: np.ndarray, index_word: np.ndarray) -> np.ndarray:
+        """RFC 3711 §4.1.2.4: IV = 0..0(32) || E||index || first 8 bytes of
+        the RTCP packet (V,P,RC,PT,length,SSRC)."""
+        n = len(index_word)
+        iv = np.zeros((n, 16), dtype=np.uint8)
+        w = np.asarray(index_word, dtype=np.int64)
+        for k in range(4):
+            iv[:, 4 + k] = (w >> (8 * (3 - k))) & 0xFF
+        iv[:, 8:16] = data[:, :8]
         return iv
 
     def _gcm_rtp_iv(self, salt: np.ndarray, ssrc: np.ndarray,
@@ -278,6 +333,16 @@ class SrtpStreamTable:
                 tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
                 jnp.asarray(batch.data), jnp.asarray(batch.length),
                 jnp.asarray(hdr.payload_off), jnp.asarray(iv12))
+        elif self._f8:
+            iv = self._f8_rtp_iv(hdr, v)
+            data, length = _protect_rtp_dev(
+                tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+                jnp.asarray(batch.data), jnp.asarray(batch.length),
+                jnp.asarray(hdr.payload_off), jnp.asarray(iv),
+                jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
+                self.policy.auth_tag_len, True,
+                off_const=_uniform_off(hdr.payload_off, batch.capacity),
+                tab_f8=self._dev_f8[0])
         else:
             iv = self._cm_iv(self._salt_rtp[stream], hdr.ssrc, idx)
             data, length = _protect_rtp_dev(
@@ -335,6 +400,16 @@ class SrtpStreamTable:
                 tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
                 jnp.asarray(batch.data), jnp.asarray(length),
                 jnp.asarray(hdr.payload_off), jnp.asarray(iv12))
+        elif self._f8:
+            iv = self._f8_rtp_iv(hdr, v)
+            data, mlen, auth_ok = _unprotect_rtp_dev(
+                tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+                jnp.asarray(batch.data), jnp.asarray(length),
+                jnp.asarray(hdr.payload_off), jnp.asarray(iv),
+                jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
+                p.auth_tag_len, True,
+                off_const=_uniform_off(hdr.payload_off, batch.capacity),
+                tab_f8=self._dev_f8[0])
         else:
             iv = self._cm_iv(self._salt_rtp[stream], hdr.ssrc, idx)
             data, mlen, auth_ok = _unprotect_rtp_dev(
@@ -382,17 +457,25 @@ class SrtpStreamTable:
             out = self._protect_rtcp_gcm(batch, stream, ssrc, index)
             np.maximum.at(self.rtcp_tx_index, stream, index)
             return out
-        iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
         encrypting = self.policy.cipher != Cipher.NULL
         e = np.int64(1 << 31) if encrypting else np.int64(0)
         index_word = index | e
 
         _, _, tab_rk, tab_mid = self._device()
-        data, length = _protect_rtcp_dev(
-            tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
-            jnp.asarray(batch.data), jnp.asarray(batch.length),
-            jnp.asarray(iv), jnp.asarray(index_word),
-            self.policy.auth_tag_len, encrypting)
+        if self._f8:
+            iv = self._f8_rtcp_iv(batch.data, index_word)
+            data, length = _protect_rtcp_dev(
+                tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
+                jnp.asarray(batch.data), jnp.asarray(batch.length),
+                jnp.asarray(iv), jnp.asarray(index_word),
+                self.policy.auth_tag_len, True, tab_f8=self._dev_f8[1])
+        else:
+            iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
+            data, length = _protect_rtcp_dev(
+                tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
+                jnp.asarray(batch.data), jnp.asarray(batch.length),
+                jnp.asarray(iv), jnp.asarray(index_word),
+                self.policy.auth_tag_len, encrypting)
         np.maximum.at(self.rtcp_tx_index, stream, index)
         return PacketBatch(np.asarray(data), np.asarray(length, dtype=np.int32),
                            batch.stream)
@@ -465,6 +548,14 @@ class SrtpStreamTable:
         if self._gcm:
             data, mlen, auth_ok = self._unprotect_rtcp_gcm(
                 batch, stream, ssrc, index, word, length)
+        elif self._f8:
+            iv = self._f8_rtcp_iv(batch.data, word)
+            _, _, tab_rk, tab_mid = self._device()
+            data, mlen, auth_ok, _e, _idx = _unprotect_rtcp_dev(
+                tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
+                jnp.asarray(batch.data), jnp.asarray(length),
+                jnp.asarray(iv), p.auth_tag_len, True,
+                tab_f8=self._dev_f8[1])
         else:
             iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
             _, _, tab_rk, tab_mid = self._device()
@@ -537,6 +628,9 @@ class SrtpStreamTable:
         if self._gcm:
             snap["gm_rtp"] = self._gm_rtp.copy()
             snap["gm_rtcp"] = self._gm_rtcp.copy()
+        if self._f8:
+            snap["rk_f8_rtp"] = self._rk_f8_rtp.copy()
+            snap["rk_f8_rtcp"] = self._rk_f8_rtcp.copy()
         return snap
 
     @classmethod
@@ -559,5 +653,8 @@ class SrtpStreamTable:
         if t._gcm:
             t._gm_rtp = snap["gm_rtp"].copy()
             t._gm_rtcp = snap["gm_rtcp"].copy()
+        if t._f8:
+            t._rk_f8_rtp = snap["rk_f8_rtp"].copy()
+            t._rk_f8_rtcp = snap["rk_f8_rtcp"].copy()
         t._dev = None
         return t

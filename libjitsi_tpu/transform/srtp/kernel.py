@@ -19,7 +19,8 @@ import functools
 import jax
 import jax.numpy as jnp
 
-from libjitsi_tpu.kernels.aes import ctr_crypt_offset, ctr_crypt_uniform
+from libjitsi_tpu.kernels.aes import (ctr_crypt_offset, ctr_crypt_uniform,
+                                      f8_crypt_offset, f8_crypt_uniform)
 from libjitsi_tpu.kernels.sha1 import hmac_sha1
 
 
@@ -78,6 +79,7 @@ def srtp_protect(
     tag_len: int,
     encrypt: bool = True,
     payload_off_const=None,
+    f8_round_keys=None,
 ):
     """Batched SRTP protect (reference: SRTPCryptoContext.transformPacket).
 
@@ -86,15 +88,27 @@ def srtp_protect(
     Returns (data', length') with payload encrypted in place and the
     HMAC-SHA1 tag (truncated to tag_len) appended; the MAC covers
     header||ciphertext||ROC per RFC 3711 §4.2.
+
+    `f8_round_keys` [B, R, 16] switches the cipher from AES-CM to AES-f8
+    (RFC 3711 §4.1.2, reference SRTPCipherF8): `iv` is then the f8 IV and
+    the extra schedule is E(k_e XOR m)'s (None-ness is trace-static).
     """
     data = jnp.asarray(data, dtype=jnp.uint8)
     length = jnp.asarray(length, dtype=jnp.int32)
     payload_off = jnp.asarray(payload_off, dtype=jnp.int32)
     if encrypt:
         if payload_off_const is not None:
-            data = ctr_crypt_uniform(
-                round_keys, iv, data, payload_off_const,
-                length - payload_off_const)
+            if f8_round_keys is not None:
+                data = f8_crypt_uniform(
+                    round_keys, f8_round_keys, iv, data, payload_off_const,
+                    length - payload_off_const)
+            else:
+                data = ctr_crypt_uniform(
+                    round_keys, iv, data, payload_off_const,
+                    length - payload_off_const)
+        elif f8_round_keys is not None:
+            data = f8_crypt_offset(round_keys, f8_round_keys, iv, data,
+                                   payload_off, length - payload_off)
         else:
             data = ctr_crypt_offset(
                 round_keys, iv, data, payload_off, length - payload_off
@@ -119,6 +133,7 @@ def srtp_unprotect(
     tag_len: int,
     encrypt: bool = True,
     payload_off_const=None,
+    f8_round_keys=None,
 ):
     """Batched SRTP unprotect (reference: SRTPCryptoContext.reverseTransformPacket).
 
@@ -138,9 +153,17 @@ def srtp_unprotect(
         auth_ok = jnp.ones((data.shape[0],), dtype=bool)
     if encrypt:
         if payload_off_const is not None:
-            out = ctr_crypt_uniform(
-                round_keys, iv, data, payload_off_const,
-                mlen - payload_off_const)
+            if f8_round_keys is not None:
+                out = f8_crypt_uniform(
+                    round_keys, f8_round_keys, iv, data, payload_off_const,
+                    mlen - payload_off_const)
+            else:
+                out = ctr_crypt_uniform(
+                    round_keys, iv, data, payload_off_const,
+                    mlen - payload_off_const)
+        elif f8_round_keys is not None:
+            out = f8_crypt_offset(round_keys, f8_round_keys, iv, data,
+                                  payload_off, mlen - payload_off)
         else:
             out = ctr_crypt_offset(
                 round_keys, iv, data, payload_off, mlen - payload_off)
@@ -152,7 +175,7 @@ def srtp_unprotect(
 @functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
 def srtcp_protect(
     data, length, round_keys, iv, midstates, index_word, tag_len: int,
-    encrypt: bool = True,
+    encrypt: bool = True, f8_round_keys=None,
 ):
     """Batched SRTCP protect (reference: SRTCPCryptoContext.transformPacket).
 
@@ -163,7 +186,11 @@ def srtcp_protect(
     data = jnp.asarray(data, dtype=jnp.uint8)
     length = jnp.asarray(length, dtype=jnp.int32)
     if encrypt:
-        data = ctr_crypt_uniform(round_keys, iv, data, 8, length - 8)
+        if f8_round_keys is not None:
+            data = f8_crypt_uniform(round_keys, f8_round_keys, iv, data, 8,
+                                    length - 8)
+        else:
+            data = ctr_crypt_uniform(round_keys, iv, data, 8, length - 8)
     word = _u32_bytes(jnp.asarray(index_word))
     tags = _auth_tags(data, length, word, midstates)
     data = _scatter_word(data, length, word)
@@ -176,7 +203,8 @@ def srtcp_protect(
 
 @functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"))
 def srtcp_unprotect(
-    data, length, round_keys, iv, midstates, tag_len: int, encrypt: bool = True
+    data, length, round_keys, iv, midstates, tag_len: int,
+    encrypt: bool = True, f8_round_keys=None,
 ):
     """Batched SRTCP unprotect.  Returns (data', length', auth_ok, e_bit, index).
 
@@ -199,7 +227,11 @@ def srtcp_unprotect(
     else:
         auth_ok = jnp.ones((data.shape[0],), dtype=bool)
     if encrypt:
-        out = ctr_crypt_uniform(round_keys, iv, data, 8, mlen - 8)
+        if f8_round_keys is not None:
+            out = f8_crypt_uniform(round_keys, f8_round_keys, iv, data, 8,
+                                   mlen - 8)
+        else:
+            out = ctr_crypt_uniform(round_keys, iv, data, 8, mlen - 8)
         # rows with E=0 were sent unencrypted: pass through
         out = jnp.where((e_bit == 1)[:, None], out, data)
     else:

@@ -16,6 +16,7 @@ class Cipher(enum.Enum):
     NULL = 0
     AES_CM = 1  # AES counter mode (RFC 3711 §4.1.1)
     AES_GCM = 2  # AEAD (RFC 7714)
+    AES_F8 = 3  # AES f8 mode (RFC 3711 §4.1.2; reference: SRTPCipherF8)
 
 
 class Auth(enum.Enum):
@@ -42,6 +43,7 @@ class SrtpProfile(enum.Enum):
     AES_256_CM_HMAC_SHA1_80 = "AES_256_CM_HMAC_SHA1_80"
     AES_256_CM_HMAC_SHA1_32 = "AES_256_CM_HMAC_SHA1_32"
     AEAD_AES_128_GCM = "AEAD_AES_128_GCM"
+    F8_128_HMAC_SHA1_80 = "F8_128_HMAC_SHA1_80"
     NULL_HMAC_SHA1_80 = "NULL_HMAC_SHA1_80"
 
     @property
@@ -72,6 +74,9 @@ _PROFILE_POLICIES = {
     ),
     SrtpProfile.AEAD_AES_128_GCM: SrtpPolicy(
         Cipher.AES_GCM, 16, Auth.NULL, 0, 16, 12
+    ),
+    SrtpProfile.F8_128_HMAC_SHA1_80: SrtpPolicy(
+        Cipher.AES_F8, 16, Auth.HMAC_SHA1, 20, 10, 14
     ),
     SrtpProfile.NULL_HMAC_SHA1_80: SrtpPolicy(
         Cipher.NULL, 16, Auth.HMAC_SHA1, 20, 10, 14
