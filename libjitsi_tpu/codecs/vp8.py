@@ -42,9 +42,7 @@ def parse_descriptors(batch: PacketBatch) -> Vp8Descriptors:
     off = hdr.payload_off.astype(np.int64)
 
     def byte_at(pos):
-        return np.take_along_axis(
-            d, np.clip(pos, 0, cap - 1)[:, None].astype(np.int64),
-            axis=1)[:, 0].astype(np.int64)
+        return rtp_header.byte_at(d, pos)
 
     b0 = byte_at(off)
     x = (b0 >> 7) & 1

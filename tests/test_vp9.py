@@ -1,0 +1,129 @@
+"""VP9 payload descriptor parse/build (draft-ietf-payload-vp9)."""
+
+import numpy as np
+
+from libjitsi_tpu.codecs import vp9
+from libjitsi_tpu.rtp import header as rtp_header
+
+
+def _pack(descs_payloads, seqs, ssrc=0x9999):
+    payloads = [d + p for d, p in descs_payloads]
+    return rtp_header.build(payloads, seqs, [0] * len(seqs),
+                            [ssrc] * len(seqs), [98] * len(seqs),
+                            stream=[0] * len(seqs))
+
+
+def test_parse_minimal_and_picture_ids():
+    batch = _pack([
+        (vp9.build_descriptor(begin=True, inter_predicted=False,
+                              picture_id=5), b"k" * 40),
+        (vp9.build_descriptor(begin=False, picture_id=300), b"d" * 40),
+        (vp9.build_descriptor(begin=True, end=True), b"x" * 40),
+    ], [1, 2, 3])
+    d = vp9.parse_descriptors(batch)
+    assert d.valid.all()
+    assert list(d.picture_id) == [5, 300, -1]
+    assert list(d.is_keyframe) == [True, False, False]
+    assert d.desc_len[0] == 2 and d.desc_len[1] == 3 and d.desc_len[2] == 1
+    assert d.begin_frame[0] and not d.begin_frame[1]
+    assert d.end_frame[2]
+
+
+def test_parse_layers_and_flexible_pdiffs():
+    batch = _pack([
+        (vp9.build_descriptor(begin=True, picture_id=9, tid=2, sid=1,
+                              tl0picidx=77), b"a" * 20),
+        (vp9.build_descriptor(begin=True, picture_id=9, tid=1, sid=0,
+                              flexible=True, pdiffs=[1, 4]), b"b" * 20),
+    ], [10, 11])
+    d = vp9.parse_descriptors(batch)
+    assert d.valid.all()
+    assert d.tid[0] == 2 and d.sid[0] == 1 and d.tl0picidx[0] == 77
+    assert not d.flexible[0] and d.flexible[1]
+    assert d.tid[1] == 1 and d.sid[1] == 0 and d.tl0picidx[1] == -1
+    assert d.num_pdiff[1] == 2
+    # keyframe requires SID 0 when layers present
+    assert not d.is_keyframe[0]
+
+
+def test_parse_scalability_structure_len():
+    ss = [(640, 360), (1280, 720)]
+    desc = vp9.build_descriptor(begin=True, inter_predicted=False,
+                                picture_id=1, tid=0, sid=0, tl0picidx=0,
+                                ss_sizes=ss)
+    batch = _pack([(desc, b"kf" * 30)], [20])
+    d = vp9.parse_descriptors(batch)
+    assert d.valid.all() and d.has_ss[0] and d.is_keyframe[0]
+    assert d.desc_len[0] == len(desc)
+
+
+def test_frame_assembly():
+    pid = 42
+    batch = _pack([
+        (vp9.build_descriptor(begin=True, picture_id=pid, tid=0, sid=0,
+                              tl0picidx=1), b"AAA"),
+        (vp9.build_descriptor(begin=False, picture_id=pid, tid=0, sid=0,
+                              tl0picidx=1), b"BBB"),
+        (vp9.build_descriptor(begin=False, end=True, picture_id=pid,
+                              tid=0, sid=0, tl0picidx=1), b"CCC"),
+    ], [30, 31, 32])
+    d = vp9.parse_descriptors(batch)
+    asm = vp9.Vp9FrameAssembler()
+    outs = [asm.push(d, batch, r) for r in range(3)]
+    assert outs[:2] == [None, None]
+    assert outs[2] == b"AAABBBCCC"
+    # mid-frame packet without a start is dropped
+    asm2 = vp9.Vp9FrameAssembler()
+    assert asm2.push(d, batch, 1) is None
+
+
+def test_truncated_descriptor_invalid():
+    # descriptor claims fields beyond the payload
+    desc = vp9.build_descriptor(begin=True, picture_id=300, tid=1, sid=1,
+                                tl0picidx=3)
+    batch = _pack([(desc[:1], b"")], [40])
+    d = vp9.parse_descriptors(batch)
+    assert not d.valid[0]
+
+
+def test_padding_excluded_and_ng_overflow_rejected():
+    import numpy as np
+    from libjitsi_tpu.core.packet import PacketBatch
+    # padded end packet: P bit set, 3 pad bytes; payload must exclude them
+    desc = vp9.build_descriptor(begin=True, end=True, picture_id=4,
+                                tid=0, sid=0, tl0picidx=0)
+    raw = bytearray(rtp_header.build([desc + b"PAYLOAD"], [50], [0], [9],
+                                     [98], stream=[0]).to_bytes(0))
+    raw[0] |= 0x20                                  # P bit
+    raw += bytes([0, 0, 3])                         # 3 padding bytes
+    batch = PacketBatch.from_payloads([bytes(raw)])
+    batch.stream[:] = 0
+    d = vp9.parse_descriptors(batch)
+    assert d.valid[0]
+    asm = vp9.Vp9FrameAssembler()
+    assert asm.push(d, batch, 0) == b"PAYLOAD"
+    # SS with N_G > supported entries: rejected, not mis-sized
+    ssb = bytes([0b00000001 | (0 << 5) | (1 << 3)])  # N_S=1,Y=0,G=1
+    big = bytes([0x0A | 0x02]) + ssb + bytes([200]) + bytes([0] * 250)
+    b2 = rtp_header.build([big], [51], [0], [9], [98], stream=[0])
+    d2 = vp9.parse_descriptors(b2)
+    assert not d2.valid[0]
+
+
+def test_flexible_builder_requires_pdiff_and_assembler_evicts():
+    import pytest
+    with pytest.raises(ValueError):
+        vp9.build_descriptor(begin=True, flexible=True)
+    # lost end packet: new begin on same sid evicts the stale partial
+    mk = lambda pid, begin, end, pay: (vp9.build_descriptor(
+        begin=begin, end=end, picture_id=pid, tid=0, sid=0,
+        tl0picidx=0), pay)
+    batch = _pack([mk(1, True, False, b"LOST"),
+                   mk(2, True, False, b"NEW"),
+                   mk(2, False, True, b"TAIL")], [60, 61, 62])
+    d = vp9.parse_descriptors(batch)
+    asm = vp9.Vp9FrameAssembler()
+    assert asm.push(d, batch, 0) is None
+    assert asm.push(d, batch, 1) is None
+    assert asm.push(d, batch, 2) == b"NEWTAIL"
+    assert asm._partial == {}                       # nothing leaked
