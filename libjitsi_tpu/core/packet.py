@@ -9,7 +9,10 @@ for lengths and parsed header fields.  Every transform is a batched function
 
 Capacity is fixed (default MTU-sized 1504, a multiple of 8) so shapes are
 static under `jit`; variable sizes are handled by the `length` vector and
-masking, with optional size-class bucketing done by the I/O layer.
+masking, with size-class bucketing (`bucket_by_size` below) applied inside
+the SRTP table's protect/unprotect — the device boundary — NOT around
+whole transform chains (engines may grow packets or keep order-sensitive
+state).
 """
 
 from __future__ import annotations
@@ -93,3 +96,89 @@ class PacketBatch:
         """bool [B, capacity]: True where a byte is within `length`."""
         idx = np.arange(self.capacity, dtype=np.int32)[None, :]
         return idx < np.asarray(self.length)[:, None]
+
+
+# ---------------------------------------------------------------------------
+# Size-class bucketing (SURVEY §7 "variable packet sizes: bucket into size
+# classes to bound padding waste").  Device cost scales with batch width
+# (AES blocks = width/16) and every new (rows, width) shape is a fresh XLA
+# trace, so mixed traffic is split into a few fixed shape classes: audio
+# packets run 12 AES blocks instead of 94, and the jit cache stays bounded
+# (|width classes| x |row classes|) no matter what sizes arrive.
+#
+# Used INSIDE the SRTP table's protect/unprotect (the device boundary) —
+# not around whole transform chains, whose engines may grow packets or
+# keep order-sensitive state.  Row padding REPEATS the last real row,
+# which is SRTP-state-safe: a duplicate packet index leaves the
+# per-stream max unchanged on protect and dies in replay dedup on
+# unprotect; callers drop rows >= n_real.
+# ---------------------------------------------------------------------------
+
+LENGTH_CLASSES = (192, 512, DEFAULT_CAPACITY)
+ROW_CLASSES = (16, 64, 256, 1024, 4096)
+CLASS_HEADROOM = 32   # room for auth tag + SRTCP index word growth
+
+
+def _round_rows(n: int) -> int:
+    for r in ROW_CLASSES:
+        if n <= r:
+            return r
+    return n
+
+
+def bucket_by_size(batch: "PacketBatch",
+                   length_classes=LENGTH_CLASSES,
+                   headroom: int = CLASS_HEADROOM):
+    """Split a batch into width/row-class sub-batches.
+
+    Returns a list of (orig_rows, sub_batch, n_real): `orig_rows` are the
+    source row indices (length n_real); `sub_batch` has capacity
+    class+headroom and its row count padded up to a ROW_CLASSES size by
+    repeating the last real row (see module comment for why that is
+    SRTP-state-safe).
+    """
+    ln = np.asarray(batch.length)
+    out = []
+    assigned = np.zeros(len(ln), dtype=bool)
+    classes = [c for c in length_classes if c < batch.capacity]
+    classes.append(batch.capacity)          # terminal class: full width
+    for cls in classes:
+        rows = np.nonzero(~assigned & (ln <= cls))[0]
+        assigned[rows] = True
+        if not len(rows):
+            continue
+        cap = cls + headroom
+        n_real = len(rows)
+        n_pad = _round_rows(n_real)
+        idx = np.concatenate([rows, np.full(n_pad - n_real, rows[-1])])
+        data = np.zeros((n_pad, cap), dtype=np.uint8)
+        take = min(cap, batch.capacity)
+        data[:, :take] = batch.data[idx, :take]
+        out.append((rows,
+                    PacketBatch(data, ln[idx].astype(np.int32),
+                                np.asarray(batch.stream)[idx].copy()),
+                    n_real))
+    return out
+
+
+def unbucket(parts, total_rows: int, min_capacity: int = 0, masks=None):
+    """Reassemble bucket results into one batch (+ ok mask).
+
+    parts: list of (orig_rows, sub_batch, n_real) AFTER processing.
+    The output capacity grows to fit the longest processed row (protect
+    appends tags — near-MTU packets must not be truncated).
+    masks: optional per-part row masks (aligned with each sub_batch).
+    """
+    need = max([min_capacity] + [int(np.max(sub.length[:n], initial=0))
+                                 for _, sub, n in parts])
+    need = (need + 15) & ~15       # keep downstream shapes class-bounded
+    out = PacketBatch.empty(total_rows, need)
+    ok = np.zeros(total_rows, dtype=bool)
+    for k, (rows, sub, n_real) in enumerate(parts):
+        take = min(sub.capacity, need)
+        out.data[rows, :take] = sub.data[:n_real, :take]
+        out.length[rows] = sub.length[:n_real]
+        out.stream[rows] = sub.stream[:n_real]
+        if masks is not None:
+            ok[rows] = masks[k][:n_real]
+    return out, ok

@@ -18,7 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from libjitsi_tpu.kernels.aes import aes_encrypt, ctr_crypt_offset
+from libjitsi_tpu.kernels.aes import (aes_encrypt, ctr_crypt_offset,
+                                      ctr_crypt_uniform)
 from libjitsi_tpu.kernels.ghash import ghash
 
 TAG_LEN = 16
@@ -30,6 +31,24 @@ def _ceil16(x):
 
 def _ghash_width(capacity: int) -> int:
     return 2 * _ceil16(capacity) + 16
+
+
+def _length_block(cols, ap, cp, abits, cbits):
+    """be64(aad_bits) || be64(ct_bits) bytes at columns [ap+cp, ap+cp+16).
+
+    Shared by both GHASH-input builders — the two paths MUST stay
+    bit-identical or the uniform fast path's tags stop verifying against
+    the general path's.  Bit counts fit in 32 bits (capacity << 2^29),
+    so bytes 0..3 of each u64 are zero and the math stays in int32.
+    """
+    p = cols - (ap + cp)
+    shift_a = jnp.clip(8 * (7 - p), 0, 24)
+    shift_c = jnp.clip(8 * (15 - p), 0, 24)
+    byte = jnp.where(
+        (p >= 4) & (p < 8), (abits >> shift_a) & 0xFF,
+        jnp.where((p >= 12) & (p < 16), (cbits >> shift_c) & 0xFF, 0)
+    ).astype(jnp.uint8)
+    return byte, p
 
 
 def _build_ghash_input(data, aad_len, ct_len, width: int):
@@ -52,21 +71,40 @@ def _build_ghash_input(data, aad_len, ct_len, width: int):
     gathered = jnp.take_along_axis(
         data, jnp.clip(src, 0, cap - 1), axis=1)
 
-    # length block: be64(aad_bits) || be64(ct_bits).  Bit counts fit in
-    # 32 bits (capacity << 2^29), so bytes 0..3 of each u64 are zero and
-    # the arithmetic stays in int32.
-    lb_start = (ap + cp)[:, None]
-    p = cols - lb_start
-    abits = (a * 8)[:, None]
-    cbits = (c * 8)[:, None]
-    shift_a = jnp.clip(8 * (7 - p), 0, 24)
-    shift_c = jnp.clip(8 * (15 - p), 0, 24)
-    len_byte = jnp.where(
-        (p >= 4) & (p < 8), (abits >> shift_a) & 0xFF,
-        jnp.where((p >= 12) & (p < 16), (cbits >> shift_c) & 0xFF, 0)
-    ).astype(jnp.uint8)
+    len_byte, p = _length_block(cols, ap[:, None], cp[:, None],
+                                (a * 8)[:, None], (c * 8)[:, None])
 
     out = jnp.where(in_aad | in_ct, gathered, 0).astype(jnp.uint8)
+    out = jnp.where((p >= 0) & (p < 16), len_byte, out)
+    nblocks = (ap + cp + 16) // 16
+    return out, nblocks
+
+
+def _build_ghash_input_uniform(data, aad: int, ct_len, width: int):
+    """Uniform-AAD twin of `_build_ghash_input`: with every row's AAD the
+    same static size (SRTP: the 12-byte RTP header / 8-byte RTCP prefix),
+    the AAD->padded-AAD and ciphertext shifts are static pad/slice ops —
+    no [B, width] gather (the gather dominates the general path's cost on
+    TPU, like the CTR alignment gather did)."""
+    bsz, cap = data.shape
+    c = ct_len.astype(jnp.int32)
+    ap = _ceil16(aad)
+    cp = (c + 15) & ~15
+    cols = jnp.arange(width, dtype=jnp.int32)[None, :]
+
+    # AAD bytes land at columns [0, aad); ct bytes at [ap, ap + c)
+    aad_part = jnp.pad(data[:, :aad], ((0, 0), (0, width - aad)))
+    ct_src = jnp.pad(data[:, aad:], ((0, 0), (0, max(0, width - (cap - aad)))))
+    ct_part = jnp.pad(ct_src, ((0, 0), (ap, 0)))[:, :width]
+    k = cols - ap
+    in_aad = cols < aad
+    in_ct = (k >= 0) & (k < c[:, None])
+    out = jnp.where(in_aad, aad_part,
+                    jnp.where(in_ct, ct_part, 0)).astype(jnp.uint8)
+
+    len_byte, p = _length_block(cols, ap, cp[:, None],
+                                jnp.full_like(c, aad * 8)[:, None],
+                                (c * 8)[:, None])
     out = jnp.where((p >= 0) & (p < 16), len_byte, out)
     nblocks = (ap + cp + 16) // 16
     return out, nblocks
@@ -104,15 +142,21 @@ def _gather_span(data, pos, n: int):
     return jnp.take_along_axis(data, idx, axis=1)
 
 
-def _tag(round_keys, gmat, data, aad_len, ct_len, j0, width: int):
-    gin, nblk = _build_ghash_input(data, aad_len, ct_len, width)
+def _tag(round_keys, gmat, data, aad_len, ct_len, j0, width: int,
+         aad_const=None):
+    if aad_const is not None:
+        gin, nblk = _build_ghash_input_uniform(data, aad_const, ct_len,
+                                               width)
+    else:
+        gin, nblk = _build_ghash_input(data, aad_len, ct_len, width)
     s = ghash(gmat, gin, nblk, width // 16)
     ek_j0 = aes_encrypt(round_keys, j0)
     return jnp.bitwise_xor(s, ek_j0)
 
 
-@functools.partial(jax.jit, static_argnames=())
-def gcm_protect(data, length, aad_len, round_keys, gmat, iv12):
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def gcm_protect(data, length, aad_len, round_keys, gmat, iv12,
+                aad_const=None):
     """Batched seal: encrypt data[aad:length] in place, append 16B tag.
 
     data [B, W] uint8; length/aad_len [B] int32; round_keys [B, R, 16];
@@ -125,15 +169,20 @@ def gcm_protect(data, length, aad_len, round_keys, gmat, iv12):
     j0 = _j0(jnp.asarray(iv12))
     ctr0 = _inc32(j0)
     ct_len = length - aad_len
-    enc = ctr_crypt_offset(round_keys, ctr0, data, aad_len, ct_len)
+    if aad_const is not None:
+        enc = ctr_crypt_uniform(round_keys, ctr0, data, aad_const, ct_len)
+    else:
+        enc = ctr_crypt_offset(round_keys, ctr0, data, aad_len, ct_len)
     width = _ghash_width(data.shape[1])
-    tag = _tag(round_keys, gmat, enc, aad_len, ct_len, j0, width)
+    tag = _tag(round_keys, gmat, enc, aad_len, ct_len, j0, width,
+               aad_const)
     out = _scatter_tag(enc, length, tag)
     return out, length + TAG_LEN
 
 
-@functools.partial(jax.jit, static_argnames=())
-def gcm_unprotect(data, length, aad_len, round_keys, gmat, iv12):
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def gcm_unprotect(data, length, aad_len, round_keys, gmat, iv12,
+                  aad_const=None):
     """Batched open: verify tag, decrypt in place.
 
     Returns (data', length - 16, auth_ok).  Decrypt always runs
@@ -146,9 +195,13 @@ def gcm_unprotect(data, length, aad_len, round_keys, gmat, iv12):
     ct_len = mlen - aad_len
     j0 = _j0(jnp.asarray(iv12))
     width = _ghash_width(data.shape[1])
-    want = _tag(round_keys, gmat, data, aad_len, ct_len, j0, width)
+    want = _tag(round_keys, gmat, data, aad_len, ct_len, j0, width,
+                aad_const)
     stored = _gather_span(data, mlen, TAG_LEN)
     auth_ok = jnp.all(stored == want, axis=1)
     ctr0 = _inc32(j0)
-    dec = ctr_crypt_offset(round_keys, ctr0, data, aad_len, ct_len)
+    if aad_const is not None:
+        dec = ctr_crypt_uniform(round_keys, ctr0, data, aad_const, ct_len)
+    else:
+        dec = ctr_crypt_offset(round_keys, ctr0, data, aad_len, ct_len)
     return dec, mlen, auth_ok

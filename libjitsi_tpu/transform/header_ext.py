@@ -84,11 +84,17 @@ class TransportCCEngine(_RtpOnlyEngine):
         class _T(PacketTransformer):
             def transform(self, batch, mask=None):
                 n = batch.batch_size
-                seqs = (eng.next_seq + np.arange(n, dtype=np.int64))
-                eng.next_seq = int(seqs[-1]) + 1
+                live = (np.ones(n, bool) if mask is None
+                        else np.asarray(mask, bool))
+                k = int(live.sum())
+                # masked rows (padding, dropped upstream) must not consume
+                # transport-wide seqs: a gap reads as loss at the receiver
+                seqs = np.zeros(n, dtype=np.int64)
+                seqs[live] = eng.next_seq + np.arange(k, dtype=np.int64)
+                eng.next_seq += k
                 now = eng.clock()
-                slot = seqs % eng.HISTORY
-                eng.sent_seq[slot] = seqs
+                slot = seqs[live] % eng.HISTORY
+                eng.sent_seq[slot] = seqs[live]
                 eng.sent_time[slot] = now
                 w = seqs & 0xFFFF
                 pay = np.stack([(w >> 8) & 0xFF, w & 0xFF],

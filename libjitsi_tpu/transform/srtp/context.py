@@ -29,7 +29,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.core.packet import (PacketBatch,
+                                      bucket_by_size, unbucket)
 from libjitsi_tpu.core.rtp_math import (
     chain_packet_indices,
     estimate_packet_index,
@@ -101,16 +102,20 @@ def _unprotect_rtcp_dev(tab_rk, tab_mid, stream, data, length, iv,
         f8_round_keys=None if tab_f8 is None else tab_f8[stream])
 
 
-@jax.jit
-def _protect_gcm_dev(tab_rk, tab_gm, stream, data, length, aad_len, iv12):
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def _protect_gcm_dev(tab_rk, tab_gm, stream, data, length, aad_len, iv12,
+                     aad_const=None):
     return gcm_kernel.gcm_protect(
-        data, length, aad_len, tab_rk[stream], tab_gm[stream], iv12)
+        data, length, aad_len, tab_rk[stream], tab_gm[stream], iv12,
+        aad_const=aad_const)
 
 
-@jax.jit
-def _unprotect_gcm_dev(tab_rk, tab_gm, stream, data, length, aad_len, iv12):
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def _unprotect_gcm_dev(tab_rk, tab_gm, stream, data, length, aad_len, iv12,
+                       aad_const=None):
     return gcm_kernel.gcm_unprotect(
-        data, length, aad_len, tab_rk[stream], tab_gm[stream], iv12)
+        data, length, aad_len, tab_rk[stream], tab_gm[stream], iv12,
+        aad_const=aad_const)
 
 
 class SrtpStreamTable:
@@ -313,8 +318,23 @@ class SrtpStreamTable:
     def protect_rtp(self, batch: PacketBatch) -> PacketBatch:
         """Encrypt + tag a batch of outgoing RTP (rows in send order).
 
+        Mixed-size batches are split into width/row size classes at this
+        device boundary (SURVEY §7): narrow rows run narrow kernels and
+        the jit cache stays bounded.  Padding rows repeat a real row —
+        state-safe here (duplicate index: tx max unchanged) — and are
+        dropped on reassembly.
         Reference: SRTPTransformer.transform → SRTPCryptoContext.transformPacket.
         """
+        if batch.batch_size == 0:
+            return batch
+        parts = bucket_by_size(batch)
+        done = [(rows, self._protect_rtp_direct(part), n)
+                for rows, part, n in parts]
+        out, _ = unbucket(done, batch.batch_size, batch.capacity)
+        out.stream[:] = batch.stream
+        return out
+
+    def _protect_rtp_direct(self, batch: PacketBatch) -> PacketBatch:
         hdr = rtp_header.parse(batch)
         stream = np.asarray(batch.stream, dtype=np.int64)
         self._require_active(stream)
@@ -332,7 +352,8 @@ class SrtpStreamTable:
             data, length = _protect_gcm_dev(
                 tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
                 jnp.asarray(batch.data), jnp.asarray(batch.length),
-                jnp.asarray(hdr.payload_off), jnp.asarray(iv12))
+                jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
+                aad_const=_uniform_off(hdr.payload_off, batch.capacity))
         elif self._f8:
             iv = self._f8_rtp_iv(hdr, v)
             data, length = _protect_rtp_dev(
@@ -364,9 +385,42 @@ class SrtpStreamTable:
         re-uses the authenticated sender index for every fan-out leg).
         Rows with ok=False keep their original bytes (the reference drops
         them; callers filter by the mask).
+
+        Size-class bucketed like protect_rtp; the repeated padding rows
+        are exact duplicates, which the replay dedup kills while the real
+        copy (earlier in its sub-batch) survives.
         Reference: SRTPTransformer.reverseTransform →
         SRTPCryptoContext.reverseTransformPacket.
         """
+        if batch.batch_size == 0:
+            ok0 = np.zeros(0, dtype=bool)
+            if return_index:
+                return batch, ok0, np.zeros(0, dtype=np.int64)
+            return batch, ok0
+        parts = bucket_by_size(batch)
+        done, masks = [], []
+        idx_parts = []
+        for rows, part, n in parts:
+            o, okp, idxp = self._unprotect_rtp_direct(part, True)
+            done.append((rows, o, n))
+            masks.append(np.asarray(okp))
+            idx_parts.append((rows, idxp[:n]))
+        out, ok = unbucket(done, batch.batch_size, batch.capacity, masks)
+        out.stream[:] = batch.stream
+        # ok=False rows keep their original bytes (contract above)
+        out.data[~ok, :] = 0
+        take = min(out.capacity, batch.capacity)
+        out.data[~ok, :take] = batch.data[~ok, :take]
+        out.length[~ok] = np.asarray(batch.length)[~ok]
+        if return_index:
+            idx = np.zeros(batch.batch_size, dtype=np.int64)
+            for rows, idxp in idx_parts:
+                idx[rows] = idxp
+            return out, ok, idx
+        return out, ok
+
+    def _unprotect_rtp_direct(self, batch: PacketBatch,
+                              return_index: bool = False):
         p = self.policy
         hdr = rtp_header.parse(batch)
         stream = np.asarray(batch.stream, dtype=np.int64)
@@ -399,7 +453,8 @@ class SrtpStreamTable:
             data, mlen, auth_ok = _unprotect_gcm_dev(
                 tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
                 jnp.asarray(batch.data), jnp.asarray(length),
-                jnp.asarray(hdr.payload_off), jnp.asarray(iv12))
+                jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
+                aad_const=_uniform_off(hdr.payload_off, batch.capacity))
         elif self._f8:
             iv = self._f8_rtp_iv(hdr, v)
             data, mlen, auth_ok = _unprotect_rtp_dev(
@@ -507,7 +562,8 @@ class SrtpStreamTable:
         out, out_len = _protect_gcm_dev(
             tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
             jnp.asarray(kin), jnp.asarray(12 + plen, dtype=jnp.int32),
-            jnp.asarray(np.full(n, 12, np.int32)), jnp.asarray(iv12))
+            jnp.asarray(np.full(n, 12, np.int32)), jnp.asarray(iv12),
+            aad_const=12)
         out = np.asarray(out)
         # wire: hdr8 || ct || tag || word
         wire = np.zeros_like(out)
@@ -599,7 +655,8 @@ class SrtpStreamTable:
             tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
             jnp.asarray(kin),
             jnp.asarray(12 + ctlen + 16, dtype=jnp.int32),
-            jnp.asarray(np.full(n, 12, np.int32)), jnp.asarray(iv12))
+            jnp.asarray(np.full(n, 12, np.int32)), jnp.asarray(iv12),
+            aad_const=12)
         dec = np.asarray(dec)
         out = np.zeros_like(dec)
         out[:, :8] = dec[:, :8]

@@ -1,0 +1,153 @@
+"""Size-class bucketing (SURVEY §7), applied inside the SRTP table's
+protect/unprotect: narrow rows run narrow kernels, the jit cache stays
+bounded, and chain engines never see padded/bucketed batches."""
+
+import numpy as np
+
+from libjitsi_tpu.core.packet import bucket_by_size, unbucket
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.transform.engine import TransformEngineChain
+from libjitsi_tpu.transform.header_ext import TransportCCEngine
+from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+KEY, SALT = bytes(16), bytes(14)
+
+
+def _mixed_batch(n_small=5, n_big=3, base_seq=1):
+    pls = [bytes([i]) * 100 for i in range(n_small)] + \
+          [bytes([i]) * 900 for i in range(n_big)]
+    n = n_small + n_big
+    return rtp_header.build(pls, list(range(base_seq, base_seq + n)),
+                            [0] * n, [7] * n, [96] * n, stream=[0] * n)
+
+
+def test_bucket_shapes_and_reassembly_identity():
+    batch = _mixed_batch()
+    parts = bucket_by_size(batch)
+    assert len(parts) == 2
+    for rows, p, n_real in parts:
+        assert p.batch_size in (16, 64, 256, 1024, 4096)
+        # padding repeats the last real row
+        if p.batch_size > n_real:
+            assert p.to_bytes(n_real) == p.to_bytes(n_real - 1)
+    out, _ = unbucket(parts, batch.batch_size, batch.capacity)
+    for i in range(batch.batch_size):
+        assert out.to_bytes(i) == batch.to_bytes(i)
+
+
+def test_unbucket_grows_capacity_for_near_mtu_rows():
+    # a 1500B packet + 10B tag must not be truncated on reassembly
+    batch = rtp_header.build([b"x" * 1488], [1], [0], [7], [96], stream=[0])
+    tx = SrtpStreamTable(capacity=1)
+    tx.add_stream(0, KEY, SALT)
+    prot = tx.protect_rtp(batch)
+    assert prot.length[0] == 1500 + 10
+    assert prot.capacity >= 1510
+    rx = SrtpStreamTable(capacity=1)
+    rx.add_stream(0, KEY, SALT)
+    dec, ok = rx.unprotect_rtp(prot)
+    assert ok.all() and dec.to_bytes(0) == batch.to_bytes(0)
+
+
+def test_bucketed_srtp_roundtrip_mixed_sizes():
+    tx = SrtpStreamTable(capacity=2)
+    rx = SrtpStreamTable(capacity=2)
+    for sid in (0, 1):
+        tx.add_stream(sid, KEY, SALT)
+        rx.add_stream(sid, KEY, SALT)
+    pls = [bytes([i]) * 100 for i in range(6)] + [b"v" * 1100, b"w" * 1100]
+    batch = rtp_header.build(pls, list(range(10, 18)), [0] * 8, [7, 8] * 4,
+                             [96] * 8, stream=[0, 1] * 4)
+    prot = tx.protect_rtp(batch)
+    dec, ok = rx.unprotect_rtp(prot)
+    assert ok.all()
+    for i in range(8):
+        assert dec.to_bytes(i) == batch.to_bytes(i)
+
+
+def test_bucketed_equals_wide_single_class():
+    """Same keys, same packets: a mixed batch's small row must produce
+    the exact bytes a homogeneous small batch produces."""
+    def mk():
+        t = SrtpStreamTable(capacity=1)
+        t.add_stream(0, KEY, SALT)
+        return t
+    small = rtp_header.build([b"a" * 100], [5], [0], [7], [96], stream=[0])
+    mixed = rtp_header.build([b"a" * 100, b"b" * 1100], [5, 6], [0, 0],
+                             [7, 7], [96, 96], stream=[0, 0])
+    lone = mk().protect_rtp(small)
+    both = mk().protect_rtp(mixed)
+    assert both.to_bytes(0) == lone.to_bytes(0)
+
+
+def test_padding_rows_do_not_advance_state():
+    """Row counts that force padding (5 real rows -> 16) must leave
+    tx/rx state exactly as an unpadded equivalent run."""
+    tx = SrtpStreamTable(capacity=1)
+    tx.add_stream(0, KEY, SALT)
+    batch = _mixed_batch(5, 0)
+    tx.protect_rtp(batch)
+    assert tx.tx_ext[0] == 5                 # seqs 1..5 -> max index 5
+    rx = SrtpStreamTable(capacity=1)
+    rx.add_stream(0, KEY, SALT)
+    tx2 = SrtpStreamTable(capacity=1)
+    tx2.add_stream(0, KEY, SALT)
+    dec, ok = rx.unprotect_rtp(tx2.protect_rtp(batch))
+    assert ok.all()
+    assert rx.rx_max[0] == 5
+    # replay mask counts only the 5 real packets
+    assert bin(int(rx.rx_mask[0])).count("1") == 5
+
+
+def test_sfu_translator_index_passthrough_bucketed():
+    """unprotect_rtp(return_index=True) merges per-bucket indices."""
+    tx = SrtpStreamTable(capacity=1)
+    rx = SrtpStreamTable(capacity=1)
+    tx.add_stream(0, KEY, SALT)
+    rx.add_stream(0, KEY, SALT)
+    pls = [b"s" * 90, b"L" * 1000, b"s" * 90]
+    batch = rtp_header.build(pls, [40, 41, 42], [0] * 3, [7] * 3,
+                             [96] * 3, stream=[0] * 3)
+    prot = tx.protect_rtp(batch)
+    dec, ok, idx = rx.unprotect_rtp(prot, return_index=True)
+    assert ok.all()
+    assert list(idx) == [40, 41, 42]
+
+
+def test_tcc_mask_skips_state_for_masked_rows():
+    eng = TransportCCEngine(ext_id=5)
+    chain = TransformEngineChain([eng])
+    batch = _mixed_batch(4, 0)
+    mask = np.array([True, False, True, True])
+    chain.rtp_transformer.transform(batch, mask)
+    assert eng.next_seq == 3                 # masked row consumed no seq
+
+
+def test_empty_batch_protect_unprotect():
+    from libjitsi_tpu.core.packet import PacketBatch
+    t = SrtpStreamTable(capacity=1)
+    t.add_stream(0, KEY, SALT)
+    empty = PacketBatch.empty(0)
+    out = t.protect_rtp(empty)
+    assert out.batch_size == 0
+    dec, ok = t.unprotect_rtp(empty)
+    assert dec.batch_size == 0 and len(ok) == 0
+
+
+def test_class_exact_row_count_near_mtu():
+    """Exactly ROW_CLASSES[0] near-MTU rows must still get headroom (the
+    old direct-path shortcut bypassed the padded sub-batch and raised)."""
+    tx = SrtpStreamTable(capacity=16)
+    rx = SrtpStreamTable(capacity=16)
+    for sid in range(16):
+        tx.add_stream(sid, KEY, SALT)
+        rx.add_stream(sid, KEY, SALT)
+    pls = [bytes([i]) * 1488 for i in range(16)]
+    batch = rtp_header.build(pls, list(range(16)), [0] * 16, [9] * 16,
+                             [96] * 16, stream=list(range(16)))
+    prot = tx.protect_rtp(batch)           # 1500+10 > 1504: needs headroom
+    assert (np.asarray(prot.length) == 1510).all()
+    dec, ok = rx.unprotect_rtp(prot)
+    assert ok.all()
+    for i in range(16):
+        assert dec.to_bytes(i) == batch.to_bytes(i)

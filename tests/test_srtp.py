@@ -262,11 +262,19 @@ def test_forged_packet_does_not_poison_established_stream():
     assert not ok[0] and ok[1]
 
 
-def test_protect_capacity_overflow_raises():
+def test_protect_near_capacity_grows_not_truncates():
+    """A packet whose tag would overflow the input capacity gets a
+    grown output buffer (size-class headroom), never silent truncation
+    (it used to raise ValueError before bucketing added headroom)."""
     t = make_table()
     big = rtp_pkt(1, payload=bytes(1500 - 12))
-    with pytest.raises(ValueError):
-        t.protect_rtp(PacketBatch.from_payloads([big], stream=[0]))
+    out = t.protect_rtp(PacketBatch.from_payloads([big], stream=[0]))
+    assert out.length[0] == 1500 + 10
+    assert out.capacity >= 1510
+    assert out.to_bytes(0)[:12] == big[:12]          # header intact
+    rx = make_table()
+    dec, ok = rx.unprotect_rtp(out)
+    assert ok.all() and dec.to_bytes(0) == big       # full roundtrip
 
 
 # ------------------------------------------------------------------ RTCP ---
