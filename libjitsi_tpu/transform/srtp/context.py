@@ -23,7 +23,7 @@ forward/reverse context maps.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -158,6 +158,14 @@ class SrtpStreamTable:
         self.rtcp_tx_index = np.full(s, -1, dtype=np.int64)
         self.rtcp_rx_max = np.full(s, -1, dtype=np.int64)
         self.rtcp_rx_mask = np.zeros(s, dtype=np.uint64)
+        # key-derivation-rate re-keying (reference:
+        # BaseSRTPCryptoContext.keyDerivationRate): master material is
+        # retained for kdr>0 streams and session keys are re-derived when
+        # a packet index crosses an index//kdr epoch boundary
+        self.kdr = np.zeros(s, dtype=np.int64)
+        self._epoch_rtp = np.zeros(s, dtype=np.int64)
+        self._epoch_rtcp = np.zeros(s, dtype=np.int64)
+        self._masters: Dict[int, Tuple[bytes, bytes]] = {}
 
     # ------------------------------------------------------------------ keys
     def add_stream(self, sid: int, master_key: bytes, master_salt: bytes,
@@ -175,6 +183,27 @@ class SrtpStreamTable:
         ks = derive_session_keys(
             master_key, master_salt, enc_key_len=p.enc_key_len,
             auth_key_len=p.auth_key_len, salt_len=p.salt_len, kdr=kdr)
+        self._install_session_keys(sid, ks)
+        self.tx_ext[sid] = -1
+        self.rx_max[sid] = -1
+        self.rx_mask[sid] = 0
+        self.rtcp_tx_index[sid] = -1
+        self.rtcp_rx_max[sid] = -1
+        self.rtcp_rx_mask[sid] = 0
+        self.kdr[sid] = kdr
+        self._epoch_rtp[sid] = 0
+        self._epoch_rtcp[sid] = 0
+        if kdr:
+            self._masters[sid] = (bytes(master_key), bytes(master_salt))
+        else:
+            self._masters.pop(sid, None)
+        self.active[sid] = True
+        self._dev = None
+
+    def _install_session_keys(self, sid: int, ks) -> None:
+        """Pack one stream's derived session keys into the device tables
+        (shared by add_stream and kdr epoch re-derivation)."""
+        p = self.policy
         self._rk_rtp[sid] = expand_key(ks.rtp_enc)
         self._rk_rtcp[sid] = expand_key(ks.rtcp_enc)
         if self._gcm:
@@ -194,16 +223,129 @@ class SrtpStreamTable:
                 rkf[sid] = expand_key(bytes(a ^ b for a, b in zip(enc, m)))
         self._salt_rtp[sid, : p.salt_len] = np.frombuffer(ks.rtp_salt, np.uint8)
         self._salt_rtp[sid, p.salt_len:] = 0
-        self._salt_rtcp[sid, : p.salt_len] = np.frombuffer(ks.rtcp_salt, np.uint8)
+        self._salt_rtcp[sid, : p.salt_len] = np.frombuffer(ks.rtcp_salt,
+                                                           np.uint8)
         self._salt_rtcp[sid, p.salt_len:] = 0
-        self.tx_ext[sid] = -1
-        self.rx_max[sid] = -1
-        self.rx_mask[sid] = 0
-        self.rtcp_tx_index[sid] = -1
-        self.rtcp_rx_max[sid] = -1
-        self.rtcp_rx_mask[sid] = 0
-        self.active[sid] = True
         self._dev = None
+
+    @staticmethod
+    def _row_subset(batch: PacketBatch, rows: np.ndarray) -> PacketBatch:
+        return PacketBatch(batch.data[rows].copy(),
+                           np.asarray(batch.length)[rows].copy(),
+                           np.asarray(batch.stream)[rows].copy())
+
+    def _kdr_active(self, stream: np.ndarray) -> bool:
+        valid = (stream >= 0) & (stream < self.capacity)
+        return bool((self.kdr[np.clip(stream, 0, self.capacity - 1)]
+                     * valid > 0).any())
+
+    def _epoch_plan(self, stream: np.ndarray, idx: np.ndarray,
+                    rtcp: bool):
+        """kdr re-keying plan (RFC 3711 §4.3; reference
+        keyDerivationRate): group rows into sequential WAVES such that
+        within a wave each kdr stream sits in a single key epoch
+        r = index DIV kdr.  Unmapped rows (stream<0) and kdr=0 streams
+        ride wave 0 untouched.  Returns (waves, r): `waves` is None when
+        one wave suffices (the common case — caller applies the epoch
+        and processes the whole batch), else a list of row-index arrays
+        to process in order, re-applying epochs before each.
+
+        Pre-auth caveat: on the receive side the epoch comes from the
+        index ESTIMATE (keys must exist before tags can be checked —
+        inherent to the RFC); forged wild seqs can thrash the epoch, but
+        derivation is deterministic from the retained master key, so the
+        next genuine batch re-derives correctly.
+        """
+        n = len(stream)
+        valid = (stream >= 0) & (stream < self.capacity)
+        kdr = np.where(valid, self.kdr[np.clip(stream, 0,
+                                               self.capacity - 1)], 0)
+        active = kdr > 0
+        r = np.where(active, idx // np.maximum(kdr, 1), 0)
+        if not active.any():
+            return None, r
+        waves = []
+        remaining = np.ones(n, dtype=bool)
+        first_wave = True
+        while remaining.any():
+            act = np.nonzero(remaining & active)[0]
+            wave = remaining & ~active if first_wave else                 np.zeros(n, dtype=bool)
+            if len(act):
+                s_act = stream[act]
+                uniq, first_pos = np.unique(s_act, return_index=True)
+                fr = np.full(self.capacity, -1, dtype=np.int64)
+                fr[uniq] = r[act[first_pos]]
+                wave[act[r[act] == fr[s_act]]] = True
+            waves.append(np.nonzero(wave)[0])
+            remaining &= ~wave
+            first_wave = False
+        if len(waves) == 1:
+            return None, r
+        return waves, r
+
+    def _apply_epochs(self, stream: np.ndarray, r: np.ndarray,
+                      rtcp: bool) -> None:
+        """Re-derive session keys for any kdr stream whose stored epoch
+        differs from its rows' (single) epoch in this wave."""
+        valid = (stream >= 0) & (stream < self.capacity)
+        kdr = np.where(valid, self.kdr[np.clip(stream, 0,
+                                               self.capacity - 1)], 0)
+        act = np.nonzero(kdr > 0)[0]
+        if not len(act):
+            return
+        p = self.policy
+        uniq, first_pos = np.unique(stream[act], return_index=True)
+        epochs = (self._epoch_rtcp if rtcp else self._epoch_rtp)
+        for sid, ri in zip(uniq.tolist(),
+                           r[act[first_pos]].tolist()):
+            if ri == epochs[sid] or sid not in self._masters:
+                continue
+            mk, ms = self._masters[sid]
+            kd = int(self.kdr[sid])
+            # the other plane (RTP vs RTCP) keeps ITS stored epoch —
+            # both planes' keys are reinstalled in one shot
+            r_rtp = ri if not rtcp else int(self._epoch_rtp[sid])
+            r_rtcp = ri if rtcp else int(self._epoch_rtcp[sid])
+            ks = derive_session_keys(
+                mk, ms, enc_key_len=p.enc_key_len,
+                auth_key_len=p.auth_key_len, salt_len=p.salt_len,
+                kdr=kd, index=r_rtp * kd, srtcp_index=r_rtcp * kd)
+            self._install_session_keys(sid, ks)
+            epochs[sid] = ri
+
+    @staticmethod
+    def _merge_row_results(total: int, parts):
+        """Merge [(rows, PacketBatch, ok_or_None, idx_or_None)] back into
+        one (batch, ok, idx) preserving row order (shared by the four
+        epoch-wave call sites)."""
+        need = max(o.capacity for _, o, _, _ in parts)
+        out = PacketBatch.empty(total, need)
+        ok = np.zeros(total, dtype=bool)
+        idx = np.zeros(total, dtype=np.int64)
+        for rows, o, okp, idxp in parts:
+            out.data[rows, :o.capacity] = o.data
+            out.length[rows] = o.length
+            out.stream[rows] = o.stream
+            if okp is not None:
+                ok[rows] = okp
+            if idxp is not None:
+                idx[rows] = idxp
+        return out, ok, idx
+
+    def _estimate_rx_indices(self, stream: np.ndarray,
+                             seq: np.ndarray) -> np.ndarray:
+        """Receive-side 48-bit index estimation.  Established streams:
+        RFC 3711 App A estimate against the last *authenticated* state,
+        exactly like the reference's guessIndex — immune to forged
+        packets earlier in the same batch.  Fresh streams (no
+        authenticated packet yet): chain within the batch so a seq wrap
+        right after the random initial seq still indexes correctly."""
+        base = self.rx_max[np.maximum(stream, 0)]
+        s_l = np.where(base >= 0, base & 0xFFFF, -1)
+        roc = np.where(base >= 0, base >> 16, 0)
+        _, idx_est = estimate_packet_index(seq, s_l, roc)
+        idx_chain = chain_packet_indices(stream, seq, self.rx_max)
+        return np.where(base >= 0, idx_est, idx_chain)
 
     def remove_stream(self, sid: int) -> None:
         self.active[sid] = False
@@ -217,6 +359,8 @@ class SrtpStreamTable:
         if self._f8:
             self._rk_f8_rtp[sid] = 0
             self._rk_f8_rtcp[sid] = 0
+        self._masters.pop(sid, None)
+        self.kdr[sid] = 0
         self._dev = None
 
     def _device(self):
@@ -327,11 +471,24 @@ class SrtpStreamTable:
         """
         if batch.batch_size == 0:
             return batch
+        stream0 = np.asarray(batch.stream, dtype=np.int64)
+        if self._kdr_active(stream0):
+            hdr0 = rtp_header.parse(batch)
+            idx0 = chain_packet_indices(stream0, hdr0.seq, self.tx_ext)
+            waves, r = self._epoch_plan(stream0, idx0, rtcp=False)
+            if waves is not None:
+                # one pass per epoch wave, keys re-applied before each
+                done = []
+                for w in waves:
+                    sub = self.protect_rtp(self._row_subset(batch, w))
+                    done.append((w, sub, None, None))
+                out, _, _ = self._merge_row_results(batch.batch_size, done)
+                return out
+            self._apply_epochs(stream0, r, rtcp=False)
         parts = bucket_by_size(batch)
         done = [(rows, self._protect_rtp_direct(part), n)
                 for rows, part, n in parts]
         out, _ = unbucket(done, batch.batch_size, batch.capacity)
-        out.stream[:] = batch.stream
         return out
 
     def _protect_rtp_direct(self, batch: PacketBatch) -> PacketBatch:
@@ -397,6 +554,23 @@ class SrtpStreamTable:
             if return_index:
                 return batch, ok0, np.zeros(0, dtype=np.int64)
             return batch, ok0
+        stream0 = np.asarray(batch.stream, dtype=np.int64)
+        if self._kdr_active(stream0):
+            hdr0 = rtp_header.parse(batch)
+            idx0 = self._estimate_rx_indices(stream0, hdr0.seq)
+            waves, r = self._epoch_plan(stream0, idx0, rtcp=False)
+            if waves is not None:
+                done = []
+                for w in waves:
+                    o, okp, idxp = self.unprotect_rtp(
+                        self._row_subset(batch, w), True)
+                    done.append((w, o, okp, idxp))
+                out, ok, idx = self._merge_row_results(batch.batch_size,
+                                                       done)
+                if return_index:
+                    return out, ok, idx
+                return out, ok
+            self._apply_epochs(stream0, r, rtcp=False)
         parts = bucket_by_size(batch)
         done, masks = [], []
         idx_parts = []
@@ -406,7 +580,6 @@ class SrtpStreamTable:
             masks.append(np.asarray(okp))
             idx_parts.append((rows, idxp[:n]))
         out, ok = unbucket(done, batch.batch_size, batch.capacity, masks)
-        out.stream[:] = batch.stream
         # ok=False rows keep their original bytes (contract above)
         out.data[~ok, :] = 0
         take = min(out.capacity, batch.capacity)
@@ -432,18 +605,7 @@ class SrtpStreamTable:
                  & (length >= hdr.header_len + p.auth_tag_len)
                  & self.active[stream] & (stream >= 0))
 
-        # Index estimation.  Established streams: RFC 3711 App A estimate
-        # against the last *authenticated* state, exactly like the
-        # reference's guessIndex — immune to forged packets earlier in the
-        # same batch.  Fresh streams (no authenticated packet yet): chain
-        # within the batch so a seq wrap right after the random initial seq
-        # still indexes correctly.
-        base = self.rx_max[np.maximum(stream, 0)]
-        s_l = np.where(base >= 0, base & 0xFFFF, -1)
-        roc = np.where(base >= 0, base >> 16, 0)
-        _, idx_est = estimate_packet_index(hdr.seq, s_l, roc)
-        idx_chain = chain_packet_indices(stream, hdr.seq, self.rx_max)
-        idx = np.where(base >= 0, idx_est, idx_chain)
+        idx = self._estimate_rx_indices(stream, hdr.seq)
         v = idx >> 16
         not_replayed = replay.check(self.rx_max, self.rx_mask, stream, idx)
 
@@ -507,6 +669,16 @@ class SrtpStreamTable:
                 f"{batch.capacity}")
         # per-stream sequential index assignment, stable in batch order
         index = self.rtcp_tx_index[stream] + 1 + segment_ranks(stream)
+        if self._kdr_active(stream):
+            waves, r = self._epoch_plan(stream, index, rtcp=True)
+            if waves is not None:
+                done = []
+                for w in waves:
+                    sub = self.protect_rtcp(self._row_subset(batch, w))
+                    done.append((w, sub, None, None))
+                out, _, _ = self._merge_row_results(batch.batch_size, done)
+                return out
+            self._apply_epochs(stream, r, rtcp=True)
         ssrc = rtp_header.read_u32(batch.data, 4)
         if self._gcm:
             out = self._protect_rtcp_gcm(batch, stream, ssrc, index)
@@ -597,6 +769,16 @@ class SrtpStreamTable:
             word = (word << 8) | np.take_along_axis(
                 batch.data, col[:, None].astype(np.int32), axis=1)[:, 0]
         index = word & 0x7FFFFFFF
+        if self._kdr_active(stream):
+            waves, r = self._epoch_plan(stream, index, rtcp=True)
+            if waves is not None:
+                done = []
+                for w in waves:
+                    o, kk = self.unprotect_rtcp(self._row_subset(batch, w))
+                    done.append((w, o, kk, None))
+                out, ok, _ = self._merge_row_results(batch.batch_size, done)
+                return out, ok
+            self._apply_epochs(stream, r, rtcp=True)
         ssrc = rtp_header.read_u32(batch.data, 4)
         not_replayed = replay.check(self.rtcp_rx_max, self.rtcp_rx_mask,
                                     stream, index)
@@ -688,6 +870,10 @@ class SrtpStreamTable:
         if self._f8:
             snap["rk_f8_rtp"] = self._rk_f8_rtp.copy()
             snap["rk_f8_rtcp"] = self._rk_f8_rtcp.copy()
+        snap["kdr"] = self.kdr.copy()
+        snap["epoch_rtp"] = self._epoch_rtp.copy()
+        snap["epoch_rtcp"] = self._epoch_rtcp.copy()
+        snap["masters"] = dict(self._masters)
         return snap
 
     @classmethod
@@ -713,5 +899,10 @@ class SrtpStreamTable:
         if t._f8:
             t._rk_f8_rtp = snap["rk_f8_rtp"].copy()
             t._rk_f8_rtcp = snap["rk_f8_rtcp"].copy()
+        if "kdr" in snap:
+            t.kdr = snap["kdr"].copy()
+            t._epoch_rtp = snap["epoch_rtp"].copy()
+            t._epoch_rtcp = snap["epoch_rtcp"].copy()
+            t._masters = dict(snap["masters"])
         t._dev = None
         return t
