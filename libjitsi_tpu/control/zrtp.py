@@ -17,6 +17,7 @@ packet-in/packet-out for the host I/O loop.
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import hmac as hmac_mod
 import os
@@ -148,7 +149,9 @@ class ZrtpEndpoint:
         self.complete = False
         self.sas: Optional[str] = None
         self._s0: Optional[bytes] = None
-        self.alerts: List[str] = []          # dropped-packet security log
+        # dropped-packet security log — bounded: forged packets must not
+        # grow host memory (deque evicts oldest)
+        self.alerts = collections.deque(maxlen=64)
         self._peer: Dict[bytes, bytes] = {}  # raw peer messages by type
         self._my_hello = self._make_hello()
         self._my_commit: Optional[bytes] = None
@@ -205,9 +208,14 @@ class ZrtpEndpoint:
     def initiate(self) -> List[bytes]:
         """Become initiator (requires peer Hello already seen).  Idempotent:
         a retry resends the SAME Commit — regenerating it would fork the
-        hvi commitment the peer has already pinned."""
+        hvi commitment the peer has already pinned.  A side that already
+        became responder (peer's Commit won) refuses: flipping roles
+        mid-handshake would deadlock both sides."""
         if b"Hello   " not in self._peer:
             raise RuntimeError("peer Hello not yet received")
+        if self.role == "responder":
+            raise RuntimeError(
+                "peer already committed first; this side is responder")
         if self.role == "initiator" and self._my_commit is not None:
             return [self._send(self._my_commit)]
         self.role = "initiator"
@@ -253,8 +261,20 @@ class ZrtpEndpoint:
                 self._peer[mtype] = msg
             out.append(self._send(_msg(b"HelloACK", b"")))
         elif mtype == b"Commit  ":
-            if b"Hello   " not in self._peer or self.role == "initiator":
+            if b"Hello   " not in self._peer:
                 return []
+            if self.role == "initiator":
+                # Commit contention (RFC 6189 §4.2): both sides committed.
+                # The LOWER hvi backs down to responder and processes the
+                # peer's Commit; the higher one drops the peer's.
+                hvi_off = 12 + 32 + 12 + 20
+                ours = self._my_commit[hvi_off:hvi_off + 32]
+                theirs = msg[hvi_off:hvi_off + 32]
+                if ours >= theirs:
+                    return []               # we win; peer backs down
+                self.role = None            # back down, re-process below
+                self._my_commit = None
+                self._my_dhpart = None
             if mtype in self._peer:
                 if self._peer[mtype] != msg or self._my_dhpart is None:
                     return []

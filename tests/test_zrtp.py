@@ -231,3 +231,47 @@ def test_zrtp_invalid_ec_point_dropped():
         dh1[12 + 12 + 64 + i] = 0xFF
     assert a.feed(_reseal(bytes(dh1))) == []
     assert any("EC point" in x or "MAC" in x for x in a.alerts)
+
+
+def test_zrtp_commit_contention_resolves():
+    """Both sides commit (glare): the higher hvi wins, the lower backs
+    down to responder (RFC 6189 §4.2) and the handshake completes."""
+    a, b = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    for p in a.hello_packets():
+        b.feed(p)
+    for p in b.hello_packets():
+        a.feed(p)
+    ca = a.initiate()[0]
+    cb = b.initiate()[0]
+    outs_a = a.feed(cb)       # each side sees the other's Commit
+    outs_b = b.feed(ca)
+    # exactly one side backed down and answered with DHPart1
+    roles = sorted([a.role, b.role])
+    assert roles == ["initiator", "responder"], roles
+    wire = [(a if x is b else b, pkt)
+            for x, outs in ((a, outs_a), (b, outs_b)) for pkt in outs]
+    # drive to completion
+    for _ in range(20):
+        nxt = []
+        for dst, pkt in wire:
+            for out in dst.feed(pkt):
+                nxt.append((a if dst is b else b, out))
+        wire = nxt
+        if a.complete and b.complete:
+            break
+    assert a.complete and b.complete and a.sas == b.sas
+    # loser cannot re-initiate
+    loser = a if a.role == "responder" else b
+    import pytest
+    with pytest.raises(RuntimeError, match="responder"):
+        loser.initiate()
+
+
+def test_zrtp_alerts_bounded():
+    from libjitsi_tpu.control import zrtp as z
+    a, b = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    run_zrtp(a, b)
+    forged = z._wrap(z._msg(b"Confirm2", bytes(40)), 9, 2)
+    for _ in range(300):
+        b.feed(forged)
+    assert len(b.alerts) <= 64
