@@ -1,6 +1,32 @@
 #!/bin/sh
 # Build the native UDP engine (C ABI shared lib consumed via ctypes).
+#
+#   ./build.sh          optimized build -> libudp_engine.so
+#   ./build.sh tsan     ThreadSanitizer build -> libudp_engine_tsan.so
+#                       (SURVEY section 5 race detection: the reference
+#                       ships no sanitizer builds; ours gates the C++
+#                       I/O engine)
+#   ./build.sh asan     AddressSanitizer build -> libudp_engine_asan.so
+#
+# Select a sanitized library at runtime with
+#   LIBJITSI_TPU_UDP_ENGINE=/path/to/libudp_engine_tsan.so
+# dlopen of a sanitized lib needs its runtime preloaded into the
+# (uninstrumented) Python interpreter:
+#   LD_PRELOAD=/lib/x86_64-linux-gnu/libtsan.so.2   (tsan build)
+#   LD_PRELOAD=$(g++ -print-file-name=libasan.so)   (asan build;
+#     add ASAN_OPTIONS=detect_leaks=0 — CPython itself trips LSan)
 set -e
 cd "$(dirname "$0")"
-g++ -O2 -Wall -shared -fPIC -o libudp_engine.so udp_engine.cpp
-echo "built $(pwd)/libudp_engine.so"
+case "${1:-}" in
+  tsan)
+    g++ -O1 -g -Wall -fsanitize=thread -shared -fPIC \
+        -o libudp_engine_tsan.so udp_engine.cpp
+    echo "built $(pwd)/libudp_engine_tsan.so" ;;
+  asan)
+    g++ -O1 -g -Wall -fsanitize=address -shared -fPIC \
+        -o libudp_engine_asan.so udp_engine.cpp
+    echo "built $(pwd)/libudp_engine_asan.so" ;;
+  *)
+    g++ -O2 -Wall -shared -fPIC -o libudp_engine.so udp_engine.cpp
+    echo "built $(pwd)/libudp_engine.so" ;;
+esac

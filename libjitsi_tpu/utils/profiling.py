@@ -1,0 +1,64 @@
+"""Tracing/profiling (SURVEY §5 aux subsystems).
+
+The reference has no built-in tracer beyond level-guarded logging — users
+attach JVM profilers, and `PacketLoggingService` gives pcap-level data-path
+tracing (we have the pcap tap in `io/pcap.py`).  The TPU-native equivalents
+here:
+
+- `trace(...)`: context manager around `jax.profiler.trace` — captures an
+  XLA/TPU trace viewable in TensorBoard/Perfetto (the jax trace directory
+  contains a `.trace.json.gz` Perfetto can load directly).
+- `annotate(name)`: `jax.profiler.TraceAnnotation` wrapper so host-side
+  phases (batching window, chain stages) show up on the same timeline as
+  device kernels.
+- `device_memory()`: current live-buffer stats per device, the analog of
+  eyeballing a JVM heap profiler for leaks.
+
+Per-batch wall-time rings live in `utils.metrics.MetricsRegistry.timing`
+(already wired into the host I/O loop's reverse/forward chain stages).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/libjitsi_tpu_trace",
+          create_perfetto_link: bool = False) -> Iterator[str]:
+    """Capture a jax profiler trace for the enclosed block.
+
+    Yields the log directory; load it in TensorBoard's profile plugin or
+    open the contained `*.trace.json.gz` in ui.perfetto.dev.
+    """
+    os.makedirs(log_dir, exist_ok=True)
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Name a host-side phase on the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+def device_memory(device: Optional[object] = None) -> dict:
+    """Live-buffer stats for one device (default: first)."""
+    dev = device or jax.devices()[0]
+    try:
+        stats = dev.memory_stats() or {}
+    except (AttributeError, NotImplementedError):
+        stats = {}
+    return {
+        "device": str(dev),
+        "bytes_in_use": stats.get("bytes_in_use"),
+        "peak_bytes_in_use": stats.get("peak_bytes_in_use"),
+        "num_allocs": stats.get("num_allocs"),
+    }

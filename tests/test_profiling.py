@@ -1,0 +1,26 @@
+"""Tracing/profiling hooks (SURVEY §5 aux): jax trace capture, timeline
+annotations, device memory stats."""
+
+import glob
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libjitsi_tpu.utils import profiling
+
+
+def test_trace_captures_device_work(tmp_path):
+    d = str(tmp_path / "trace")
+    with profiling.trace(d) as logdir:
+        with profiling.annotate("test-phase"):
+            x = jnp.asarray(np.arange(1024, dtype=np.float32))
+            jax.block_until_ready(jnp.dot(x, x))
+    files = glob.glob(os.path.join(logdir, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in files), "no trace artifacts"
+
+
+def test_device_memory_stats_shape():
+    info = profiling.device_memory()
+    assert "device" in info and "bytes_in_use" in info

@@ -1,0 +1,105 @@
+"""Property test of the replay-window state machine (SURVEY §5 test
+strategy: "property tests on replay-window state machine").
+
+Model: a brute-force per-stream set of accepted indices with the RFC 3711
+§3.3.2 rules, BATCH-ATOMIC: every row of a batch is checked against the
+state as of the batch start (the batched implementation's documented
+semantic — rows arrive in one batching window and are mutually
+"simultaneous"), with in-batch exact duplicates removed.  The security
+invariant — no index is ever accepted twice, nothing older than
+WINDOW-1 behind the committed max is accepted — is what the model
+enforces; it differs from a strictly sequential checker only in
+accepting distinct reordered indices that would have become "too old"
+mid-batch, which is a freshness relaxation, not a replay.
+"""
+
+import numpy as np
+
+from libjitsi_tpu.transform.srtp import replay
+
+
+def _model_check(accepted, max_idx, idx):
+    if idx in accepted:
+        return False
+    if max_idx >= 0 and max_idx - idx >= replay.WINDOW:
+        return False
+    return True
+
+
+def test_replay_window_matches_bruteforce_model():
+    rng = np.random.default_rng(42)
+    n_streams = 4
+    for trial in range(20):
+        max_index = np.full(n_streams, -1, dtype=np.int64)
+        mask = np.zeros(n_streams, dtype=np.uint64)
+        accepted = {s: set() for s in range(n_streams)}
+        model_max = {s: -1 for s in range(n_streams)}
+        ever_accepted = set()       # (stream, idx) over the whole trial
+
+        # a jumpy index sequence per stream: forward runs, reorders,
+        # duplicates, and occasional ancient indices
+        cursor = {s: int(rng.integers(0, 1000)) for s in range(n_streams)}
+        for batch_no in range(12):
+            bsz = int(rng.integers(1, 24))
+            streams = rng.integers(0, n_streams, bsz).astype(np.int64)
+            idxs = np.zeros(bsz, dtype=np.int64)
+            for i, s in enumerate(streams):
+                roll = rng.random()
+                if roll < 0.55:                       # in-order advance
+                    cursor[s] += int(rng.integers(1, 4))
+                    idxs[i] = cursor[s]
+                elif roll < 0.75 and accepted[s]:     # duplicate
+                    idxs[i] = int(rng.choice(sorted(accepted[s])))
+                elif roll < 0.9:                      # nearby reorder
+                    idxs[i] = max(0, cursor[s] - int(rng.integers(0, 20)))
+                else:                                 # ancient
+                    idxs[i] = max(0, cursor[s] - int(
+                        rng.integers(replay.WINDOW, replay.WINDOW + 50)))
+
+            fresh = replay.check(max_index, mask, streams, idxs)
+            dup = replay.dedup_first(streams, idxs, fresh)
+            ok = fresh & ~dup
+            expect = np.zeros(bsz, dtype=bool)
+            seen_in_batch = set()
+            for i in range(bsz):
+                s = int(streams[i])
+                key = (s, int(idxs[i]))
+                e = (_model_check(accepted[s], model_max[s], int(idxs[i]))
+                     and key not in seen_in_batch)
+                expect[i] = e
+                if e:
+                    seen_in_batch.add(key)
+            assert (ok == expect).all(), (
+                trial, batch_no, streams.tolist(), idxs.tolist(),
+                ok.tolist(), expect.tolist())
+            # commit accepted rows to both states
+            for i in range(bsz):
+                if expect[i]:
+                    s = int(streams[i])
+                    accepted[s].add(int(idxs[i]))
+                    model_max[s] = max(model_max[s], int(idxs[i]))
+            replay.update(max_index, mask, streams, idxs, ok)
+            for s in range(n_streams):
+                assert max_index[s] == model_max[s]
+            # SECURITY INVARIANT regardless of batching semantics: no
+            # (stream, index) pair is ever accepted twice
+            for i in range(bsz):
+                if ok[i]:
+                    key = (int(streams[i]), int(idxs[i]))
+                    assert key not in ever_accepted, key
+                    ever_accepted.add(key)
+
+
+def test_replay_window_exact_boundary():
+    """Index exactly WINDOW-1 behind max is acceptable; WINDOW is not."""
+    max_index = np.array([-1], dtype=np.int64)
+    mask = np.zeros(1, dtype=np.uint64)
+    s = np.array([0], dtype=np.int64)
+    hi = np.array([1000], dtype=np.int64)
+    ok = replay.check(max_index, mask, s, hi)
+    replay.update(max_index, mask, s, hi, ok)
+    edge_ok = replay.check(max_index, mask, s,
+                           np.array([1000 - replay.WINDOW + 1], np.int64))
+    edge_bad = replay.check(max_index, mask, s,
+                            np.array([1000 - replay.WINDOW], np.int64))
+    assert edge_ok[0] and not edge_bad[0]
