@@ -1,1 +1,4 @@
 from libjitsi_tpu.codecs.opus import OpusDecoder, OpusEncoder, opus_available  # noqa: F401
+from libjitsi_tpu.codecs.gsm import GsmCodec, gsm_available  # noqa: F401
+from libjitsi_tpu.codecs.speex import (SpeexDecoder, SpeexEncoder,  # noqa: F401
+                                       speex_available)

@@ -1,0 +1,91 @@
+"""GSM 06.10 (libgsm) and Speex (libspeex) codec bindings — the
+reference's telephony/legacy codecs (SURVEY §2.5), host-side like its
+JNI wrappers."""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.codecs.gsm import (FRAME_BYTES, FRAME_SAMPLES, GsmCodec,
+                                     gsm_available)
+from libjitsi_tpu.codecs.speex import (MODE_NB, MODE_WB, SpeexDecoder,
+                                       SpeexEncoder, speex_available)
+
+
+def _lagged_snr(ref: np.ndarray, out: np.ndarray, max_lag: int = 250,
+                lo: int = 400, hi: int = 1200) -> float:
+    """Best SNR over alignment lags (codecs have lookahead delay)."""
+    best = -99.0
+    a = ref[lo:hi].astype(float)
+    for lag in range(max_lag):
+        b = out[lo + lag:hi + lag].astype(float)
+        if len(b) < len(a):
+            break
+        err = a - b
+        snr = 10 * np.log10((a ** 2).mean() / max((err ** 2).mean(), 1e-9))
+        best = max(best, snr)
+    return best
+
+
+def _tone(n, rate, hz=300, amp=5000):
+    t = np.arange(n)
+    return (amp * np.sin(2 * np.pi * hz * t / rate)).astype(np.int16)
+
+
+@pytest.mark.skipif(not gsm_available(), reason="libgsm not present")
+def test_gsm_roundtrip_rate_and_quality():
+    c = GsmCodec()
+    pcm = _tone(10 * FRAME_SAMPLES, 8000)
+    enc = c.encode(pcm)
+    assert len(enc) == 10 * FRAME_BYTES          # 13 kbit/s exactly
+    dec = c.decode(enc)
+    assert dec.shape == pcm.shape
+    assert _lagged_snr(pcm, dec) > 8.0           # LPC codec on a tone
+    with pytest.raises(ValueError):
+        c.encode(pcm[:100])
+    with pytest.raises(ValueError):
+        c.decode(enc[:10])
+
+
+@pytest.mark.skipif(not speex_available(), reason="libspeex not present")
+@pytest.mark.parametrize("mode,rate", [(MODE_NB, 8000), (MODE_WB, 16000)])
+def test_speex_roundtrip(mode, rate):
+    enc, dec = SpeexEncoder(mode), SpeexDecoder(mode)
+    assert enc.frame_size == dec.frame_size
+    n = enc.frame_size
+    pcm = _tone(10 * n, rate)
+    outs = [dec.decode(enc.encode(pcm[k * n:(k + 1) * n]))
+            for k in range(10)]
+    out = np.concatenate(outs)
+    assert _lagged_snr(pcm, out) > 10.0
+    with pytest.raises(ValueError):
+        enc.encode(pcm[: n // 2])
+
+
+@pytest.mark.skipif(not speex_available(), reason="libspeex not present")
+def test_speex_packet_loss_concealment():
+    enc, dec = SpeexEncoder(MODE_NB), SpeexDecoder(MODE_NB)
+    n = enc.frame_size
+    pcm = _tone(4 * n, 8000)
+    for k in range(3):
+        dec.decode(enc.encode(pcm[k * n:(k + 1) * n]))
+    plc = dec.decode(None)                       # lost frame
+    assert plc.shape == (n,)
+    assert np.abs(plc.astype(np.int32)).max() > 0   # extrapolated, not mute
+
+
+@pytest.mark.skipif(not speex_available(), reason="libspeex not present")
+def test_speex_invalid_mode_and_input_safety():
+    with pytest.raises(ValueError):
+        SpeexEncoder(mode=3)
+    with pytest.raises(ValueError):
+        SpeexDecoder(mode=-1)
+    # encoder must not scribble over the caller's buffer
+    enc = SpeexEncoder(MODE_NB)
+    pcm = _tone(enc.frame_size, 8000)
+    keep = pcm.copy()
+    enc.encode(pcm)
+    assert np.array_equal(pcm, keep)
+    # read-only views are accepted
+    ro = pcm.copy()
+    ro.setflags(write=False)
+    enc.encode(ro)
