@@ -18,6 +18,17 @@ class MediaService:
         self.config = config
         self._registry = None
         self._mixer = None
+        self._encodings = None
+
+    @property
+    def encoding_configuration(self):
+        """The codec/encoding registry (reference:
+        MediaService.getCurrentEncodingConfiguration)."""
+        if self._encodings is None:
+            from libjitsi_tpu.service.encodings import EncodingConfiguration
+
+            self._encodings = EncodingConfiguration()
+        return self._encodings
 
     @property
     def registry(self):

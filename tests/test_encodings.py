@@ -1,0 +1,75 @@
+"""EncodingConfiguration registry (reference: EncodingConfigurationImpl
++ FMJPlugInConfiguration's role)."""
+
+import pytest
+
+import libjitsi_tpu
+from libjitsi_tpu.codecs import gsm_available, opus_available
+from libjitsi_tpu.service.encodings import Encoding, EncodingConfiguration
+
+needs_codecs = pytest.mark.skipif(
+    not (opus_available() and gsm_available()),
+    reason="libopus/libgsm not present")
+
+
+@needs_codecs
+def test_supported_order_and_disable():
+    ec = EncodingConfiguration()
+    names = [e.name for e in ec.supported("audio")]
+    assert names[0] == "opus"                   # highest default priority
+    assert "PCMU" in names and "GSM" in names
+    ec.set_priority("opus", 0)                  # disable
+    assert "opus" not in [e.name for e in ec.supported("audio")]
+    ec.set_priority("GSM", 5000)
+    assert [e.name for e in ec.supported("audio")][0] == "GSM"
+
+
+@needs_codecs
+def test_payload_type_assignment():
+    ec = EncodingConfiguration()
+    table = ec.assign_payload_types("audio")
+    # static PTs keep RFC 3551 numbers
+    assert table[0].name == "PCMU" and table[8].name == "PCMA"
+    assert table[3].name == "GSM"
+    # dynamic PTs start at 96, priority order
+    dyn = {pt: e.name for pt, e in table.items() if pt >= 96}
+    assert dyn[96] == "opus"
+    assert all(96 <= pt <= 127 for pt in dyn)
+
+
+@needs_codecs
+def test_apply_to_stream_and_service_accessor():
+    libjitsi_tpu.init()
+    svc = libjitsi_tpu.media_service()
+    ec = svc.encoding_configuration
+    s = svc.create_media_stream(media_type="audio")
+    table = ec.apply_to_stream(s, "audio")
+    pt_opus = next(pt for pt, e in table.items() if e.name == "opus")
+    assert s._formats[pt_opus] == ("opus", 48000)
+    # the PRIMARY encoding's clock rate is the one the jitter stat keeps
+    assert svc.registry.stats.clock_rate[s.sid] == 48000
+
+
+def test_custom_registration():
+    ec = EncodingConfiguration()
+    ec.register(Encoding("L16", "audio", 44100, 2, 11), priority=2000)
+    assert ec.assign_payload_types("audio")[11].name == "L16"
+
+
+def test_static_pt_in_dynamic_range_not_clobbered():
+    ec = EncodingConfiguration()
+    ec.register(Encoding("X", "audio", 8000, 1, 96), priority=9000)
+    table = ec.assign_payload_types("audio")
+    assert table[96].name == "X"            # static claim holds
+    assert "X" in {e.name for e in table.values()}
+    # dynamic encodings moved past the occupied PT
+    dyn_names = {pt: e.name for pt, e in table.items() if pt > 96}
+    assert len(dyn_names) >= 1
+
+
+def test_dynamic_exhaustion_keeps_statics():
+    ec = EncodingConfiguration()
+    for k in range(40):                     # flood the dynamic space
+        ec.register(Encoding(f"dyn{k}", "audio", 8000), priority=5000 + k)
+    table = ec.assign_payload_types("audio")
+    assert table[0].name == "PCMU" and table[8].name == "PCMA"
