@@ -24,6 +24,9 @@ from libjitsi_tpu.core.packet import PacketBatch  # noqa: F401
 
 _media_service = None
 _config_service = None
+_file_access_service = None
+_resources_service = None
+_audio_notifier_service = None
 _started = False
 
 
@@ -51,9 +54,13 @@ def init(config=None):
 
 def stop():
     """Stop the framework (reference: LibJitsi.stop())."""
-    global _started, _media_service, _config_service
+    global _started, _media_service, _config_service, \
+        _file_access_service, _resources_service, _audio_notifier_service
     _media_service = None
     _config_service = None
+    _file_access_service = None
+    _resources_service = None
+    _audio_notifier_service = None
     _started = False
 
 
@@ -75,3 +82,38 @@ def configuration_service():
     if not _started:
         init()
     return _config_service
+
+
+def file_access_service():
+    """Return the FileAccessService
+    (reference: LibJitsi.getFileAccessService())."""
+    global _file_access_service
+    if _file_access_service is None:
+        from libjitsi_tpu.service.aux_services import FileAccessService
+
+        _file_access_service = FileAccessService(configuration_service())
+    return _file_access_service
+
+
+def resource_management_service():
+    """Return the ResourceManagementService
+    (reference: LibJitsi.getResourceManagementService())."""
+    global _resources_service
+    if _resources_service is None:
+        from libjitsi_tpu.service.aux_services import \
+            ResourceManagementService
+
+        _resources_service = ResourceManagementService()
+    return _resources_service
+
+
+def audio_notifier_service():
+    """Return the AudioNotifierService
+    (reference: LibJitsi.getAudioNotifierService())."""
+    global _audio_notifier_service
+    if _audio_notifier_service is None:
+        from libjitsi_tpu.service.aux_services import AudioNotifierService
+
+        _audio_notifier_service = AudioNotifierService(
+            media_service().device_system.audio)
+    return _audio_notifier_service

@@ -139,5 +139,9 @@ class AudioMixer:
         """
         out, levels = _registry.call("mix_minus", jnp.asarray(self._frame),
                                      jnp.asarray(self.active))
+        # materialize BEFORE zeroing: on the CPU backend jnp.asarray can
+        # alias the host buffer and dispatch is async — zeroing first
+        # races the device read (seen as a rare wrong-mix flake)
+        out_np, levels_np = np.asarray(out), np.asarray(levels)
         self._frame[:] = 0
-        return np.asarray(out), np.asarray(levels)
+        return out_np, levels_np

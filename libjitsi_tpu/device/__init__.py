@@ -1,0 +1,23 @@
+"""Synthetic device framework (SURVEY §2.5 L3 obligation).
+
+Reference: `org.jitsi.impl.neomedia.device.*` + the offline fixture
+protocols (`audiosilence`, `rtpdumpfile`, `ivffile`).  See system.py.
+"""
+
+from libjitsi_tpu.device.sinks import (AudioSink, NullSink, PcmFileSink,
+                                       WavFileSink)
+from libjitsi_tpu.device.sources import (AudioSource, IvfReader, IvfWriter,
+                                         MixerCaptureSource, NoiseSource,
+                                         PcmFileSource, RtpdumpCaptureDevice,
+                                         SilenceSource, ToneSource)
+from libjitsi_tpu.device.system import (AudioMixerMediaDevice, AudioSystem,
+                                        DataFlow, DeviceSystem, MediaDevice)
+
+__all__ = [
+    "AudioSource", "SilenceSource", "ToneSource", "NoiseSource",
+    "PcmFileSource", "MixerCaptureSource", "RtpdumpCaptureDevice",
+    "IvfReader", "IvfWriter",
+    "AudioSink", "NullSink", "PcmFileSink", "WavFileSink",
+    "DataFlow", "MediaDevice", "AudioSystem", "DeviceSystem",
+    "AudioMixerMediaDevice",
+]

@@ -1,0 +1,6 @@
+"""Native C++ components (batched UDP engine); sources + built .so.
+
+Without this file setuptools' packages.find skips the directory and
+wheels ship without the engine sources/binary (io/udp.py loads
+libudp_engine.so from here via ctypes).
+"""

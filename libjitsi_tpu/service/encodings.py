@@ -49,6 +49,9 @@ _KNOWN: List[Encoding] = [
     Encoding("speex", "audio", 8000, 1, None, _speex_ok),
     Encoding("speex/16000", "audio", 16000, 1, None, _speex_ok),
     Encoding("GSM", "audio", 8000, 1, 3, _gsm_ok),
+    # G.722's RTP clock rate is 8000 by RFC 3551 §4.5.2 historical
+    # accident even though it samples at 16 kHz
+    Encoding("G722", "audio", 8000, 1, 9),
     Encoding("telephone-event", "audio", 8000, 1, None),   # RFC 4733
     Encoding("VP8", "video", 90000, 1, None),
     Encoding("VP9", "video", 90000, 1, None),
@@ -102,7 +105,9 @@ class EncodingConfiguration:
         table: Dict[int, Encoding] = {}
         supported = self.supported(media_type)
         for e in supported:
-            if e.static_pt is not None:
+            # supported() is descending priority: first claimant of a
+            # shared static PT (the higher-priority encoding) keeps it
+            if e.static_pt is not None and e.static_pt not in table:
                 table[e.static_pt] = e
         next_dyn = _DYNAMIC_PT_FIRST
         for e in supported:

@@ -19,6 +19,8 @@ class MediaService:
         self._registry = None
         self._mixer = None
         self._encodings = None
+        self._devices = None
+        self._mixer_device = None
 
     @property
     def encoding_configuration(self):
@@ -60,6 +62,36 @@ class MediaService:
 
             return VideoMediaStream(registry, **kwargs)
         return MediaStream(registry, **kwargs)
+
+    @property
+    def device_system(self):
+        """Synthetic device registry (reference:
+        DeviceSystem.initializeDeviceSystems from MediaServiceImpl's
+        ctor, SURVEY §3.1; devices here are file/PRNG/replay sources)."""
+        if self._devices is None:
+            from libjitsi_tpu.device import DeviceSystem
+
+            self._devices = DeviceSystem(self.config)
+        return self._devices
+
+    def audio_mixer_device(self, frame_samples: int = 960):
+        """The shared mixer wrapped as a capture device (reference:
+        MediaService.createMixer returning AudioMixerMediaDevice).
+
+        One wrapper per service — independent wrappers over one mixer
+        would steal each other's mix() output frames."""
+        mixer = self.audio_mixer(frame_samples)
+        if mixer.frame_samples != frame_samples:
+            # audio_mixer() returns the cached mixer whatever its size —
+            # surface the conflict instead of handing back wrong-size frames
+            raise ValueError(
+                f"shared mixer already created with frame_samples="
+                f"{mixer.frame_samples}, requested {frame_samples}")
+        if self._mixer_device is None:
+            from libjitsi_tpu.device import AudioMixerMediaDevice
+
+            self._mixer_device = AudioMixerMediaDevice(mixer)
+        return self._mixer_device
 
     def audio_mixer(self, frame_samples: int = 960):
         """Shared conference mixer device (reference:

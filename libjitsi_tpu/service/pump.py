@@ -1,0 +1,171 @@
+"""Media pumps — the Processor-graph role: device ⇄ codec ⇄ stream.
+
+The reference builds an FMJ Processor per stream that pulls capture
+`PushBufferStream`s through a codec chain into the RTP packetizer
+(send, SURVEY §3.2) and pulls the jitter buffer through the decoder to
+a renderer or the conference mixer (receive, SURVEY §3.3).  Here those
+graphs are two small host drivers over the batched framework pieces:
+
+- `SendPump`: AudioSource (device layer) -> frame codec -> encoded
+  payloads -> `MediaStream.send` (packetize + transform chain).
+- `ReceivePump`: `MediaStream.receive` -> jitter-buffer -> decode ->
+  AudioSink and/or mixer deposit.
+
+Codecs plug in as an (encode, decode, frame_samples, sample_rate)
+`FrameCodec` adapter; g711/g722/opus/gsm/speex adapters are provided.
+The tick cadence is the caller's (one `tick()` per ptime), so pumps
+compose with `MediaLoop`/`AudioMixerMediaDevice` tick-driven scheduling
+without threads — a server drives thousands of pumps from one loop.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class FrameCodec:
+    """One audio frame codec leg (encode: int16 [F] -> bytes)."""
+
+    name: str
+    pt: int
+    sample_rate: int          # media clock
+    frame_samples: int        # samples per ptime at sample_rate
+    ts_step: int              # RTP timestamp increment per packet
+    encode: Callable[[np.ndarray], bytes]
+    decode: Callable[[bytes], np.ndarray]
+
+
+def g711_codec(ulaw: bool = True, ptime_ms: int = 20) -> FrameCodec:
+    from libjitsi_tpu.kernels import g711
+
+    n = 8000 * ptime_ms // 1000
+
+    def enc(pcm):
+        x = np.asarray(pcm, dtype=np.int16)[None, :]
+        out = g711.ulaw_encode(x) if ulaw else g711.alaw_encode(x)
+        return np.asarray(out, dtype=np.uint8)[0].tobytes()
+
+    def dec(b):
+        x = np.frombuffer(b, dtype=np.uint8)[None, :]
+        out = g711.ulaw_decode(x) if ulaw else g711.alaw_decode(x)
+        return np.asarray(out, dtype=np.int16)[0]
+
+    return FrameCodec("PCMU" if ulaw else "PCMA", 0 if ulaw else 8,
+                      8000, n, n, enc, dec)
+
+
+def g722_codec(ptime_ms: int = 20) -> FrameCodec:
+    from libjitsi_tpu.codecs import g722
+
+    n = 16000 * ptime_ms // 1000
+    # RFC 3551 §4.5.2: G722's RTP clock is 8000 despite 16 kHz sampling
+    return FrameCodec("G722", 9, 16000, n, n // 2,
+                      lambda pcm: g722.encode(np.asarray(pcm, np.int16)),
+                      lambda b: g722.decode(b))
+
+
+def opus_codec(ptime_ms: int = 20, bitrate: int = 32000) -> FrameCodec:
+    from libjitsi_tpu.codecs.opus import OpusDecoder, OpusEncoder
+
+    n = 48000 * ptime_ms // 1000
+    enc = OpusEncoder(sample_rate=48000, channels=1, bitrate=bitrate)
+    dec = OpusDecoder(sample_rate=48000, channels=1)
+    return FrameCodec(
+        "opus", 111, 48000, n, n,
+        lambda pcm: enc.encode(np.asarray(pcm, np.int16)),
+        lambda b: dec.decode(b, frame_size=n))
+
+
+class SendPump:
+    """Capture -> encode -> packetize/protect (SURVEY §3.2 hot path).
+
+    One `tick()` = one ptime: read a frame from the source, encode,
+    hand to `MediaStream.send`, and return the wire datagrams (the
+    caller forwards them to its connector/UdpEngine)."""
+
+    def __init__(self, stream, source, codec: FrameCodec):
+        self.stream = stream
+        self.source = source
+        self.codec = codec
+        if getattr(source, "sample_rate", codec.sample_rate) \
+                != codec.sample_rate:
+            raise ValueError(
+                f"source rate {source.sample_rate} != codec rate "
+                f"{codec.sample_rate}; resample at the device layer "
+                "(kernels/resample.py)")
+
+    def tick(self) -> List[bytes]:
+        pcm = self.source.read(self.codec.frame_samples)
+        payload = self.codec.encode(pcm)
+        return self.stream.send([payload], pt=self.codec.pt,
+                                ts_step=self.codec.ts_step)
+
+
+class ReceivePump:
+    """Unprotect -> jitter buffer -> decode -> sink/mixer (SURVEY §3.3).
+
+    `push(datagrams)` feeds arrivals (any cadence); `tick()` pulls one
+    ptime's packet from the jitter buffer, decodes, writes the PCM to
+    the sink and/or deposits it into a mixer row.  Loss (buffer
+    underrun) plays silence — codecs with PLC can override that via
+    `codec.decode(b"")` handling."""
+
+    def __init__(self, stream, codec: FrameCodec,
+                 sink=None, mixer=None, mixer_sid: Optional[int] = None,
+                 ptime_ms: float = 20.0):
+        from libjitsi_tpu.rtp.jitter_buffer import JitterBuffer
+
+        self.stream = stream
+        self.codec = codec
+        self.sink = sink
+        self.mixer = mixer
+        self.mixer_sid = mixer_sid
+        # the jitter clock is the RTP media clock: ts_step per ptime
+        self.jb = JitterBuffer(
+            clock_rate=int(codec.ts_step * 1000 / ptime_ms),
+            frame_ms=ptime_ms)
+        self.decoded_frames = 0
+        self.lost_frames = 0
+
+    def push(self, datagrams: List[bytes],
+             now: Optional[float] = None) -> int:
+        """Receive-chain + jitter-buffer insert; returns accepted count."""
+        import time as _time
+
+        from libjitsi_tpu.rtp import header as rtp_header
+
+        if not datagrams:
+            return 0
+        now = _time.time() if now is None else now
+        batch, ok = self.stream.receive(datagrams, arrival=now)
+        hdr = rtp_header.parse(batch)
+        n = 0
+        for i in np.nonzero(ok)[0]:
+            payload = batch.to_bytes(int(i))[int(hdr.payload_off[i]):]
+            self.jb.insert(int(hdr.seq[i]), int(hdr.ts[i]), payload, now)
+            n += 1
+        return n
+
+    def tick(self, now: Optional[float] = None) -> np.ndarray:
+        """Pull + decode one ptime; returns the PCM frame (int16 [F])."""
+        import time as _time
+
+        now = _time.time() if now is None else now
+        payload = self.jb.pop(now)
+        if payload is None:
+            self.lost_frames += 1
+            pcm = np.zeros(self.codec.frame_samples, dtype=np.int16)
+        else:
+            pcm = np.asarray(self.codec.decode(payload), dtype=np.int16)
+            self.decoded_frames += 1
+        if len(pcm) < self.codec.frame_samples:   # short decode: pad
+            pcm = np.pad(pcm, (0, self.codec.frame_samples - len(pcm)))
+        if self.sink is not None:
+            self.sink.write(pcm)
+        if self.mixer is not None and self.mixer_sid is not None:
+            self.mixer.push(self.mixer_sid, pcm)
+        return pcm

@@ -1,0 +1,70 @@
+"""File access / resources / audio notifier substrate services."""
+
+import os
+
+import numpy as np
+import pytest
+
+import libjitsi_tpu
+from libjitsi_tpu.service.aux_services import (AudioNotifierService,
+                                               FileAccessService,
+                                               ResourceManagementService)
+
+
+def test_file_access_scoped(tmp_path):
+    from libjitsi_tpu.core.config import ConfigurationService
+
+    cfg = ConfigurationService({"libjitsi_tpu.data_dir": str(tmp_path)})
+    fas = FileAccessService(cfg)
+    assert fas.data_dir == str(tmp_path)
+    p = fas.get_private_file("logs/pkt.pcap")
+    assert p.startswith(str(tmp_path)) and os.path.isdir(os.path.dirname(p))
+    t = fas.create_temp_file(suffix=".webm")
+    assert os.path.exists(t) and t.startswith(str(tmp_path))
+    with pytest.raises(ValueError):
+        fas.get_private_file("../escape")
+
+
+def test_file_access_relative_data_dir(tmp_path, monkeypatch):
+    from libjitsi_tpu.core.config import ConfigurationService
+
+    monkeypatch.chdir(tmp_path)
+    fas = FileAccessService(ConfigurationService(
+        {"libjitsi_tpu.data_dir": "var/data"}))
+    p = fas.get_private_file("x.bin")          # must not false-positive
+    assert p == str(tmp_path / "var" / "data" / "x.bin")
+
+
+def test_default_data_dir_is_private(tmp_path):
+    fas = FileAccessService()
+    assert os.path.isdir(fas.data_dir)
+    assert (os.stat(fas.data_dir).st_mode & 0o077) == 0  # mkdtemp 0700
+
+
+def test_resources_lookup():
+    rms = ResourceManagementService({"srtp.window": 64})
+    assert rms.get_setting("srtp.window") == 64
+    assert rms.get_setting("absent", "d") == "d"
+    rms.register("greeting", 5)
+    assert rms.get_string("greeting") == "5"
+    assert rms.get_string("absent") is None
+
+
+def test_audio_notifier_renders_tone_and_mute():
+    n = AudioNotifierService()
+    pcm = n.play(880.0, duration_s=0.05, sample_rate=8000)
+    assert pcm.dtype == np.int16 and len(pcm) == 400 and pcm.any()
+    n.set_mute(True)
+    assert len(n.play()) == 0
+
+
+def test_libjitsi_service_accessors(tmp_path):
+    libjitsi_tpu.init({"libjitsi_tpu.data_dir": str(tmp_path)})
+    try:
+        assert libjitsi_tpu.file_access_service().data_dir == str(tmp_path)
+        assert libjitsi_tpu.resource_management_service() is \
+            libjitsi_tpu.resource_management_service()
+        pcm = libjitsi_tpu.audio_notifier_service().play(duration_s=0.01)
+        assert len(pcm) == 480
+    finally:
+        libjitsi_tpu.stop()

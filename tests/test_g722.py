@@ -1,0 +1,90 @@
+"""G.722 sub-band ADPCM: round-trip quality, batching, embedded modes."""
+
+import numpy as np
+
+from libjitsi_tpu.codecs import g722
+
+
+def _tone(n, freq=1000.0, amp=8000.0, sr=16000):
+    return np.round(
+        amp * np.sin(2 * np.pi * freq * np.arange(n) / sr)).astype(np.int16)
+
+
+def _best_snr_db(ref, got, max_lag=40):
+    """SNR at the best alignment.  The decoder output is *delayed* by the
+    QMF analysis+synthesis group delay (22 samples), so we advance `got`
+    and search a few lags to stay robust to off-by-one conventions."""
+    best = -np.inf
+    ref = ref.astype(np.float64)
+    got = got.astype(np.float64)
+    for lag in range(max_lag):
+        n = min(len(got) - lag, len(ref))
+        a, b = ref[:n], got[lag:lag + n]
+        a, b = a[800:], b[800:]           # skip adaptation transient
+        err = np.mean((a - b) ** 2)
+        sig = np.mean(a ** 2)
+        if err == 0:
+            return np.inf
+        best = max(best, 10 * np.log10(sig / err))
+    return best
+
+
+def test_roundtrip_tone_64k():
+    pcm = _tone(4000)
+    dec = g722.decode(g722.encode(pcm))
+    assert len(dec) == len(pcm)
+    assert _best_snr_db(pcm, dec) > 20.0
+
+
+def test_roundtrip_speechlike_modes():
+    # sum of low tones (speech band) — all three modes intelligible,
+    # quality ordered 64k >= 56k >= 48k
+    rng = np.random.default_rng(3)
+    t = np.arange(6000) / 16000.0
+    sig = sum(a * np.sin(2 * np.pi * f * t + p) for f, a, p in
+              [(350, 4000, 0.3), (800, 3000, 1.1), (1700, 1500, 2.0)])
+    pcm = np.round(sig + rng.normal(0, 30, len(t))).astype(np.int16)
+    code = g722.encode(pcm)
+    snrs = [_best_snr_db(pcm, g722.decode(code, bits_per_sample=b))
+            for b in (8, 7, 6)]
+    assert snrs[0] > 18.0 and snrs[1] > 14.0 and snrs[2] > 10.0
+    assert snrs[0] >= snrs[1] - 1.0 and snrs[1] >= snrs[2] - 1.0
+
+
+def test_silence_stays_quiet():
+    # ADPCM idle-channel noise is a few LSBs (the quantizer has no
+    # zero output level); assert it stays at that floor
+    dec = g722.decode(g722.encode(np.zeros(1600, dtype=np.int16)))
+    assert np.abs(dec.astype(np.int32)).max() <= 4
+
+
+def test_batched_matches_single():
+    rng = np.random.default_rng(11)
+    sigs = [(_tone(640, f)) for f in (440.0, 1000.0, 2500.0)]
+    sigs.append(rng.integers(-3000, 3000, 640).astype(np.int16))
+    batch = np.stack(sigs)
+    enc = g722.G722Encoder(batch=4).encode(batch)
+    for i, s in enumerate(sigs):
+        assert np.array_equal(enc[i], np.frombuffer(g722.encode(s),
+                                                    dtype=np.uint8))
+    dec = g722.G722Decoder(batch=4).decode(enc)
+    for i in range(4):
+        assert np.array_equal(dec[i], g722.decode(enc[i].tobytes()))
+
+
+def test_streaming_equals_oneshot():
+    pcm = _tone(1920, 700.0)
+    enc = g722.G722Encoder(1)
+    chunks = [enc.encode(pcm[None, i:i + 320]) for i in range(0, 1920, 320)]
+    assert np.array_equal(np.concatenate(chunks, axis=1)[0],
+                          np.frombuffer(g722.encode(pcm), dtype=np.uint8))
+    dec = g722.G722Decoder(1)
+    code = np.frombuffer(g722.encode(pcm), dtype=np.uint8).reshape(1, -1)
+    parts = [dec.decode(code[:, i:i + 80]) for i in range(0, 960, 80)]
+    assert np.array_equal(np.concatenate(parts, axis=1)[0],
+                          g722.decode(code[0].tobytes()))
+
+
+def test_rate_is_one_byte_per_two_samples():
+    pcm = _tone(320)
+    assert len(g722.encode(pcm)) == 160

@@ -1,0 +1,142 @@
+"""RFC 4571 TCP media connector: framing, loopback transport, SRTP leg."""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.io.tcp import TcpConnector, _FrameBuffer, frame
+
+
+def test_framing_roundtrip_incremental():
+    pkts = [b"\x80" + bytes(range(20)), b"x" * 1, b"y" * 1400]
+    blob = b"".join(frame(p) for p in pkts)
+    fb = _FrameBuffer()
+    got = []
+    # feed in adversarial chunk sizes (1, 3, 7, ...) across frame edges
+    i, step = 0, 1
+    while i < len(blob):
+        got += fb.feed(blob[i:i + step])
+        i += step
+        step = (step % 9) + 1
+    assert got == pkts
+
+
+def test_frame_rejects_oversize():
+    with pytest.raises(ValueError):
+        frame(b"z" * 65536)
+
+
+def test_loopback_batch_transport():
+    srv = TcpConnector(listen=True)
+    cli = TcpConnector()
+    dst = cli.connect("127.0.0.1", srv.port)
+    payloads = [bytes([0x80, 96, 0, i, 0, 0, 0, i, 0, 0, 0, 7]) + b"p" * i
+                for i in range(5)]
+    cli.send_batch(PacketBatch.from_payloads(payloads), dst)
+    got, addrs = srv.recv_batch(timeout_ms=2000)
+    assert got.to_payloads() == payloads
+    assert len(set(addrs)) == 1
+    # reverse direction over the accepted connection
+    peer = srv.peers()[0]
+    srv.send_batch(PacketBatch.from_payloads(payloads[:2]), peer)
+    back, _ = cli.recv_batch(timeout_ms=2000)
+    assert back.to_payloads() == payloads[:2]
+    cli.close()
+    srv.close()
+
+
+def test_peer_close_is_dropped():
+    srv = TcpConnector(listen=True)
+    cli = TcpConnector()
+    cli.connect("127.0.0.1", srv.port)
+    assert len(srv.peers()) == 1
+    cli.close()
+    srv.recv_batch(timeout_ms=50)
+    assert len(srv.peers()) == 0
+    srv.close()
+
+
+def test_oversize_frame_counted_not_silent():
+    srv = TcpConnector(listen=True, mtu=100)
+    cli = TcpConnector()
+    dst = cli.connect("127.0.0.1", srv.port)
+    big = b"\x80" + b"K" * 300          # legitimate RFC 4571, > row width
+    small = b"\x80" + b"s" * 20
+    cli.send_batch(PacketBatch.from_payloads([big, small], capacity=1500),
+                   dst)
+    got, _ = srv.recv_batch(timeout_ms=2000)
+    assert got.to_payloads() == [small]
+    assert srv.dropped_oversize == 1
+    cli.close(); srv.close()
+
+
+def test_stalled_peer_send_times_out_and_drops():
+    srv = TcpConnector(listen=True)
+    cli = TcpConnector(send_timeout_s=0.5)
+    dst = cli.connect("127.0.0.1", srv.port)
+    srv.peers()                          # accept, then never read
+    payload = [b"\x80" + b"z" * 1400] * 64
+    batch = PacketBatch.from_payloads(payload)
+    # shrink buffers so the zero-window stall happens fast
+    import socket as pysock
+    cli._conns[dst].setsockopt(pysock.SOL_SOCKET, pysock.SO_SNDBUF, 4096)
+    with pytest.raises(ConnectionError):
+        for _ in range(600):             # ~80 MB >> buffers
+            cli.send_batch(batch, dst)
+    assert dst not in cli._conns         # peer dropped
+    cli.close(); srv.close()
+
+
+def test_hotplug_preserves_app_devices():
+    from libjitsi_tpu.core.config import ConfigurationService
+    from libjitsi_tpu.device import (AudioSystem, DataFlow, MediaDevice,
+                                     SilenceSource)
+
+    sys_ = AudioSystem(ConfigurationService())
+    dev = MediaDevice("file:cap", "audio", "sendonly",
+                      source_factory=SilenceSource)
+    sys_.add_device(dev, DataFlow.CAPTURE)
+    sys_.set_selected_device(DataFlow.CAPTURE, "file:cap")
+    sys_.initialize()                    # hotplug rescan
+    assert any(d.name == "file:cap"
+               for d in sys_.devices(DataFlow.CAPTURE))
+    assert sys_.selected_device(DataFlow.CAPTURE).name == "file:cap"
+
+
+def test_static_pt_priority_not_clobbered():
+    from libjitsi_tpu.service.encodings import (Encoding,
+                                                EncodingConfiguration)
+
+    ec = EncodingConfiguration()
+    ec.register(Encoding("PCMU-wide", "audio", 16000, 1, static_pt=0),
+                priority=1)
+    table = ec.assign_payload_types("audio")
+    assert table[0].name == "PCMU"       # higher priority keeps PT 0
+
+
+def test_srtp_protected_media_over_tcp():
+    """Full leg: SDES-keyed SRTP protect -> RFC 4571 TCP -> unprotect."""
+    import libjitsi_tpu
+
+    libjitsi_tpu.init()
+    try:
+        svc = libjitsi_tpu.media_service()
+        a = svc.create_media_stream("audio")
+        b = svc.create_media_stream("audio")
+        answer = b.sdes.create_answer(a.sdes.create_offer())
+        a.sdes.accept_answer(answer)
+        a.set_remote_ssrc(b.local_ssrc)
+        b.set_remote_ssrc(a.local_ssrc)
+        a.start(); b.start()
+
+        srv = TcpConnector(listen=True)
+        cli = TcpConnector()
+        dst = cli.connect("127.0.0.1", srv.port)
+        wire = a.send([b"g722-frame-" + bytes(40)], pt=9)
+        cli.send_batch(PacketBatch.from_payloads(wire), dst)
+        got, _ = srv.recv_batch(timeout_ms=2000)
+        batch, ok = b.receive(got.to_payloads())
+        assert all(ok)
+        cli.close(); srv.close()
+    finally:
+        libjitsi_tpu.stop()
