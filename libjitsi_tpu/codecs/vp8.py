@@ -161,3 +161,109 @@ class SimulcastReceiver:
             if r <= target_bps and self.keyframe_seen[i]:
                 best = i
         return best
+
+
+def packetize(frame: bytes, picture_id: int = -1,
+              max_payload: int = 1200, tl0picidx: int = -1,
+              tid: int = -1) -> list:
+    """Split one VP8 frame into RTP payloads (descriptor + fragment).
+
+    Reference: `...codec.video.vp8.Packetizer` — S bit set on the first
+    fragment only; every fragment of a frame carries the same extension
+    fields; the RTP marker (set by the sender on the last fragment) ends
+    the frame.
+    """
+    if not frame:
+        raise ValueError("empty frame")
+    # descriptor length is the same for every fragment (the S bit does
+    # not change the size), so budget it out of max_payload up front —
+    # emitted payloads must not exceed the caller's MTU allowance
+    desc_len = len(build_descriptor(start=True, picture_id=picture_id,
+                                    tl0picidx=tl0picidx, tid=tid))
+    chunk = max_payload - desc_len
+    if chunk <= 0:
+        raise ValueError(f"max_payload {max_payload} cannot fit the "
+                         f"{desc_len}-byte descriptor")
+    out = []
+    for pos in range(0, len(frame), chunk):
+        desc = build_descriptor(start=(pos == 0), picture_id=picture_id,
+                                tl0picidx=tl0picidx, tid=tid)
+        out.append(desc + frame[pos:pos + chunk])
+    return out
+
+
+class FrameAssembler:
+    """Reassemble complete VP8 frames from depacketized RTP.
+
+    Reference: the DePacketizer's frame-reassembly half — fragments
+    share an RTP timestamp; the S-bit fragment starts the frame, the
+    marker-bit fragment ends it, and the frame is complete when every
+    sequence number in between has arrived (out-of-order tolerant).
+    `push_batch` ingests a decrypted PacketBatch; `pop_frames` yields
+    (rtp_ts, picture_id, is_keyframe, frame_bytes) in timestamp order.
+    """
+
+    def __init__(self, max_pending: int = 32):
+        self.max_pending = max_pending
+        # keys are UNWRAPPED timestamps (the 32-bit RTP ts starts at a
+        # random value and wraps within hours — minutes under loss —
+        # so min()/sorted() over raw values would misorder across the
+        # wrap and evict the wrong frames)
+        self._pending: dict = {}      # uts -> {seq: payload}
+        self._meta: dict = {}         # uts -> [start_seq, end_seq, pid, key]
+        self._ts_high: int = 0        # unwrap epoch (multiples of 2^32)
+        self._ts_last: int = -1       # last wire ts seen
+        self.dropped_incomplete = 0
+
+    def _unwrap_ts(self, ts: int) -> int:
+        if self._ts_last >= 0:
+            delta = (ts - self._ts_last) & 0xFFFFFFFF
+            if delta < 0x80000000:            # forward move
+                if ts < self._ts_last:        # wrapped past zero
+                    self._ts_high += 1 << 32
+            elif ts > self._ts_last:          # backward move across wrap
+                return self._ts_high - (1 << 32) + ts
+        self._ts_last = ts
+        return self._ts_high + ts
+
+    def push_batch(self, batch: PacketBatch) -> None:
+        hdr = rtp_header.parse(batch)
+        desc = parse_descriptors(batch)
+        for i in range(batch.batch_size):
+            if not desc.valid[i]:
+                continue
+            ts = self._unwrap_ts(int(hdr.ts[i]))
+            seq = int(hdr.seq[i])
+            frag = batch.to_bytes(i)[int(hdr.payload_off[i]
+                                         + desc.desc_len[i]):]
+            slot = self._pending.setdefault(ts, {})
+            meta = self._meta.setdefault(ts, [None, None, -1, False])
+            slot[seq] = frag
+            if desc.start_of_partition[i] == 1 and desc.partition_id[i] == 0:
+                meta[0] = seq
+                meta[2] = int(desc.picture_id[i])
+                meta[3] = bool(desc.is_keyframe[i])
+            if hdr.marker[i]:
+                meta[1] = seq
+        # bound memory: oldest incomplete frames give way
+        while len(self._pending) > self.max_pending:
+            oldest = min(self._pending)
+            del self._pending[oldest]
+            del self._meta[oldest]
+            self.dropped_incomplete += 1
+
+    def pop_frames(self) -> list:
+        done = []
+        for ts in sorted(self._pending):
+            start, end, pid, key = self._meta[ts]
+            if start is None or end is None:
+                continue
+            n = ((end - start) & 0xFFFF) + 1
+            seqs = [(start + k) & 0xFFFF for k in range(n)]
+            slot = self._pending[ts]
+            if all(s in slot for s in seqs):
+                done.append((ts, pid, key,
+                             b"".join(slot[s] for s in seqs)))
+                del self._pending[ts]
+                del self._meta[ts]
+        return done

@@ -76,6 +76,7 @@ class TcpConnector:
         self.dropped_oversize = 0
         self._conns: Dict[Tuple[str, int], socket.socket] = {}
         self._frames: Dict[Tuple[str, int], _FrameBuffer] = {}
+        self._overflow: List[Tuple[Tuple[str, int], bytes]] = []
         self._listener: Optional[socket.socket] = None
         if listen:
             s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -125,6 +126,13 @@ class TcpConnector:
         deadline = time.monotonic() + timeout_ms / 1e3
         payloads: List[bytes] = []
         addrs: List[Tuple[str, int]] = []
+        # packets deframed beyond max_batch on a previous call queue here
+        # so the max_batch contract holds even when one recv() chunk
+        # yields thousands of small frames
+        while self._overflow and len(payloads) < self.max_batch:
+            key, pkt = self._overflow.pop(0)
+            payloads.append(pkt)
+            addrs.append(key)
         while len(payloads) < self.max_batch:
             progressed = False
             for key, s in list(self._conns.items()):
@@ -139,15 +147,17 @@ class TcpConnector:
                     continue
                 progressed = True
                 for pkt in self._frames[key].feed(chunk):
-                    if len(pkt) <= self.mtu:
-                        payloads.append(pkt)
-                        addrs.append(key)
-                    else:
+                    if len(pkt) > self.mtu:
                         self.dropped_oversize += 1
                         _log.warning(
                             "dropping %d-byte framed packet from %s "
                             "(> row width %d; raise TcpConnector(mtu=...) "
                             "to accept)", len(pkt), key, self.mtu)
+                    elif len(payloads) < self.max_batch:
+                        payloads.append(pkt)
+                        addrs.append(key)
+                    else:
+                        self._overflow.append((key, pkt))
             if not progressed:
                 if payloads or time.monotonic() >= deadline:
                     break

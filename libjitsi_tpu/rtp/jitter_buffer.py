@@ -73,7 +73,10 @@ class JitterBuffer:
             return None
         e = self._buf.pop(self._next_seq, None)
         if e is not None:
-            if now - e.arrival < self.target_delay:
+            # 1 µs tolerance: float rounding in the transit-jitter EWMA
+            # yields epsilon (~1e-11 s) target delays that would hold a
+            # frame popped the same instant it arrived
+            if now - e.arrival < self.target_delay - 1e-6:
                 self._buf[e.seq] = e  # not due yet
                 return None
             self._next_seq = (self._next_seq + 1) & 0xFFFF

@@ -115,8 +115,7 @@ class ReceivePump:
     `codec.decode(b"")` handling."""
 
     def __init__(self, stream, codec: FrameCodec,
-                 sink=None, mixer=None, mixer_sid: Optional[int] = None,
-                 ptime_ms: float = 20.0):
+                 sink=None, mixer=None, mixer_sid: Optional[int] = None):
         from libjitsi_tpu.rtp.jitter_buffer import JitterBuffer
 
         self.stream = stream
@@ -124,9 +123,12 @@ class ReceivePump:
         self.sink = sink
         self.mixer = mixer
         self.mixer_sid = mixer_sid
-        # the jitter clock is the RTP media clock: ts_step per ptime
+        # ptime is fully determined by the codec (frame_samples at
+        # sample_rate); the jitter clock is the RTP media clock, i.e.
+        # ts_step RTP units per ptime
+        ptime_ms = codec.frame_samples * 1000.0 / codec.sample_rate
         self.jb = JitterBuffer(
-            clock_rate=int(codec.ts_step * 1000 / ptime_ms),
+            clock_rate=int(round(codec.ts_step * 1000 / ptime_ms)),
             frame_ms=ptime_ms)
         self.decoded_frames = 0
         self.lost_frames = 0
@@ -164,6 +166,10 @@ class ReceivePump:
             self.decoded_frames += 1
         if len(pcm) < self.codec.frame_samples:   # short decode: pad
             pcm = np.pad(pcm, (0, self.codec.frame_samples - len(pcm)))
+        elif len(pcm) > self.codec.frame_samples:
+            # remote-controlled payload length must not crash the loop
+            # (mixer.push enforces the frame shape): clamp to one ptime
+            pcm = pcm[: self.codec.frame_samples]
         if self.sink is not None:
             self.sink.write(pcm)
         if self.mixer is not None and self.mixer_sid is not None:

@@ -96,6 +96,18 @@ def test_hotplug_events_and_removal():
     assert len(ds.audio.devices(DataFlow.CAPTURE)) == 3
 
 
+def test_hotplug_preserves_app_devices():
+    sys_ = AudioSystem(ConfigurationService())
+    dev = MediaDevice("file:cap", "audio", "sendonly",
+                      source_factory=SilenceSource)
+    sys_.add_device(dev, DataFlow.CAPTURE)
+    sys_.set_selected_device(DataFlow.CAPTURE, "file:cap")
+    sys_.initialize()                    # hotplug rescan
+    assert any(d.name == "file:cap"
+               for d in sys_.devices(DataFlow.CAPTURE))
+    assert sys_.selected_device(DataFlow.CAPTURE).name == "file:cap"
+
+
 def test_rtpdump_capture_device_paced_and_looped(tmp_path):
     from libjitsi_tpu.io.pcap import RtpdumpWriter
 

@@ -73,3 +73,14 @@ def test_dynamic_exhaustion_keeps_statics():
         ec.register(Encoding(f"dyn{k}", "audio", 8000), priority=5000 + k)
     table = ec.assign_payload_types("audio")
     assert table[0].name == "PCMU" and table[8].name == "PCMA"
+
+
+def test_static_pt_priority_not_clobbered():
+    from libjitsi_tpu.service.encodings import (Encoding,
+                                                EncodingConfiguration)
+
+    ec = EncodingConfiguration()
+    ec.register(Encoding("PCMU-wide", "audio", 16000, 1, static_pt=0),
+                priority=1)
+    table = ec.assign_payload_types("audio")
+    assert table[0].name == "PCMU"       # higher priority keeps PT 0

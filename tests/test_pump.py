@@ -126,6 +126,23 @@ def test_conference_via_pumps_three_parties():
         libjitsi_tpu.stop()
 
 
+def test_receive_pump_clamps_oversize_payload():
+    """A remote peer sending over-long payloads must not crash the tick."""
+    libjitsi_tpu.init()
+    try:
+        svc = libjitsi_tpu.media_service()
+        a, b = _keyed_pair(svc)
+        mixdev = svc.audio_mixer_device(frame_samples=160)
+        mixdev.add_participant(0)
+        rx = ReceivePump(b, g711_codec(), mixer=mixdev, mixer_sid=0)
+        wire = a.send([b"\xff" * 200], pt=0)   # 200 > 160 samples
+        rx.push(wire, now=50.0)
+        pcm = rx.tick(now=51.0)
+        assert pcm.shape == (160,)
+    finally:
+        libjitsi_tpu.stop()
+
+
 def test_send_pump_rejects_rate_mismatch():
     libjitsi_tpu.init()
     try:

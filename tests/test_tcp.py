@@ -87,31 +87,21 @@ def test_stalled_peer_send_times_out_and_drops():
     cli.close(); srv.close()
 
 
-def test_hotplug_preserves_app_devices():
-    from libjitsi_tpu.core.config import ConfigurationService
-    from libjitsi_tpu.device import (AudioSystem, DataFlow, MediaDevice,
-                                     SilenceSource)
-
-    sys_ = AudioSystem(ConfigurationService())
-    dev = MediaDevice("file:cap", "audio", "sendonly",
-                      source_factory=SilenceSource)
-    sys_.add_device(dev, DataFlow.CAPTURE)
-    sys_.set_selected_device(DataFlow.CAPTURE, "file:cap")
-    sys_.initialize()                    # hotplug rescan
-    assert any(d.name == "file:cap"
-               for d in sys_.devices(DataFlow.CAPTURE))
-    assert sys_.selected_device(DataFlow.CAPTURE).name == "file:cap"
-
-
-def test_static_pt_priority_not_clobbered():
-    from libjitsi_tpu.service.encodings import (Encoding,
-                                                EncodingConfiguration)
-
-    ec = EncodingConfiguration()
-    ec.register(Encoding("PCMU-wide", "audio", 16000, 1, static_pt=0),
-                priority=1)
-    table = ec.assign_payload_types("audio")
-    assert table[0].name == "PCMU"       # higher priority keeps PT 0
+def test_recv_batch_respects_max_batch_with_overflow():
+    srv = TcpConnector(listen=True, max_batch=8)
+    cli = TcpConnector()
+    dst = cli.connect("127.0.0.1", srv.port)
+    pkts = [bytes([0x80, 96, 0, i]) + b"\x00" * 8 for i in range(30)]
+    cli.send_batch(PacketBatch.from_payloads(pkts), dst)
+    got = []
+    for _ in range(10):
+        b, _addrs = srv.recv_batch(timeout_ms=500)
+        assert b.batch_size <= 8         # the contract, even mid-flood
+        got += b.to_payloads()
+        if len(got) == 30:
+            break
+    assert got == pkts                   # nothing lost, order kept
+    cli.close(); srv.close()
 
 
 def test_srtp_protected_media_over_tcp():
