@@ -189,6 +189,22 @@ def mixer_mix_per_sec(n_participants: int = 256) -> float:
     return 1.0 / dt
 
 
+def bridge_mixes_per_sec(conferences: int = 64,
+                         participants: int = 64) -> float:
+    """Whole-bridge mixing: C conferences of N participants per launch
+    (a single conference launch is dispatch-bound; see MixerBridge)."""
+    import jax.numpy as jnp
+
+    from libjitsi_tpu.conference.mixer import _mix_many_jit
+
+    rng = np.random.default_rng(8)
+    pcm = jnp.asarray(rng.integers(
+        -8000, 8000, (conferences, participants, 960)).astype(np.int16))
+    active = jnp.ones((conferences, participants), dtype=bool)
+    dt = _time_fn(_mix_many_jit, (pcm, active))
+    return conferences / dt
+
+
 def fanout_rows_per_sec(packets: int = 64, receivers: int = 256) -> float:
     """BASELINE config #5 core: per-receiver re-encrypt of a fan-out
     matrix (rows = packets x receivers) in one launch."""
@@ -241,6 +257,8 @@ def main():
                   "cpu_openssl_pps": round(base, 1),
                   "gcm_pps": round(gcm_pps(), 1),
                   "mix_256p_per_sec": round(mixer_mix_per_sec(), 1),
+                  "bridge_64conf_64p_mixes_per_sec":
+                      round(bridge_mixes_per_sec(), 1),
                   "sfu_fanout_rows_per_sec":
                       round(fanout_rows_per_sec(), 1)},
     }))

@@ -213,7 +213,9 @@ class FrameAssembler:
         self._meta: dict = {}         # uts -> [start_seq, end_seq, pid, key]
         self._ts_high: int = 0        # unwrap epoch (multiples of 2^32)
         self._ts_last: int = -1       # last wire ts seen
+        self._delivered_ts: int = -1  # newest uts handed to the caller
         self.dropped_incomplete = 0
+        self.dropped_late = 0
 
     def _unwrap_ts(self, ts: int) -> int:
         if self._ts_last >= 0:
@@ -245,25 +247,50 @@ class FrameAssembler:
                 meta[3] = bool(desc.is_keyframe[i])
             if hdr.marker[i]:
                 meta[1] = seq
-        # bound memory: oldest incomplete frames give way
+        # bound memory two-tier: incomplete frames (waiting on loss)
+        # evict oldest-first at max_pending; COMPLETE frames — which a
+        # burst can accumulate faster than the caller pops — are only
+        # evicted at a 4x hard cap, so a backlog flush never silently
+        # loses frames whose packets all arrived
         while len(self._pending) > self.max_pending:
-            oldest = min(self._pending)
-            del self._pending[oldest]
-            del self._meta[oldest]
+            incomplete = [t for t in sorted(self._pending)
+                          if not self._is_complete(t)]
+            if incomplete:
+                t = incomplete[0]
+            elif len(self._pending) > 4 * self.max_pending:
+                t = min(self._pending)
+            else:
+                break
+            del self._pending[t]
+            del self._meta[t]
             self.dropped_incomplete += 1
+
+    def _is_complete(self, ts: int) -> bool:
+        start, end, _pid, _key = self._meta[ts]
+        if start is None or end is None:
+            return False
+        n = ((end - start) & 0xFFFF) + 1
+        slot = self._pending[ts]
+        return all(((start + k) & 0xFFFF) in slot for k in range(n))
 
     def pop_frames(self) -> list:
         done = []
         for ts in sorted(self._pending):
+            if not self._is_complete(ts):
+                continue
             start, end, pid, key = self._meta[ts]
-            if start is None or end is None:
+            slot = self._pending[ts]
+            del self._pending[ts]
+            del self._meta[ts]
+            if ts <= self._delivered_ts:
+                # completed only after a newer frame was already handed
+                # out — delivering it now would feed the decoder frames
+                # backwards; drop it (the decoder PLCs the gap)
+                self.dropped_late += 1
                 continue
             n = ((end - start) & 0xFFFF) + 1
-            seqs = [(start + k) & 0xFFFF for k in range(n)]
-            slot = self._pending[ts]
-            if all(s in slot for s in seqs):
-                done.append((ts, pid, key,
-                             b"".join(slot[s] for s in seqs)))
-                del self._pending[ts]
-                del self._meta[ts]
+            done.append((ts, pid, key,
+                         b"".join(slot[(start + k) & 0xFFFF]
+                                  for k in range(n))))
+            self._delivered_ts = ts
         return done

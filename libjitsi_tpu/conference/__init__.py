@@ -1,1 +1,2 @@
-from libjitsi_tpu.conference.mixer import AudioMixer, mix_minus  # noqa: F401
+from libjitsi_tpu.conference.mixer import (AudioMixer, MixerBridge,  # noqa: F401
+                                           mix_minus, mix_minus_many)

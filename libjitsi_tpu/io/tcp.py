@@ -20,6 +20,7 @@ import logging
 import socket
 import struct
 import time
+from collections import deque
 from typing import Dict, List, Optional, Tuple
 
 from libjitsi_tpu.core.packet import PacketBatch
@@ -76,7 +77,7 @@ class TcpConnector:
         self.dropped_oversize = 0
         self._conns: Dict[Tuple[str, int], socket.socket] = {}
         self._frames: Dict[Tuple[str, int], _FrameBuffer] = {}
-        self._overflow: List[Tuple[Tuple[str, int], bytes]] = []
+        self._overflow: deque = deque()   # O(1) popleft on flood drain
         self._listener: Optional[socket.socket] = None
         if listen:
             s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -130,7 +131,7 @@ class TcpConnector:
         # so the max_batch contract holds even when one recv() chunk
         # yields thousands of small frames
         while self._overflow and len(payloads) < self.max_batch:
-            key, pkt = self._overflow.pop(0)
+            key, pkt = self._overflow.popleft()
             payloads.append(pkt)
             addrs.append(key)
         while len(payloads) < self.max_batch:

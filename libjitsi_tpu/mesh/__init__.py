@@ -1,6 +1,7 @@
 from libjitsi_tpu.mesh.sharded import (  # noqa: F401
     make_media_mesh,
     make_multihost_mesh,
+    sharded_bridge_mix,
     sharded_mix_minus,
     sharded_mix_minus_2d,
     sharded_srtp_protect,

@@ -128,6 +128,26 @@ def sharded_mix_minus(mesh: Mesh):
     ))
 
 
+def sharded_bridge_mix(mesh: Mesh):
+    """Whole-bridge multi-conference mixing sharded over the mesh.
+
+    pcm int16 [C, N, F] / active bool [C, N] sharded on the CONFERENCE
+    axis: conferences are independent, so each chip mixes its shard with
+    zero collectives — the bridge scales linearly in chips the way
+    stream-data-parallel SRTP does.  (Contrast sharded_mix_minus, which
+    shards one conference's PARTICIPANTS and pays a psum; use that only
+    when a single conference outgrows a chip.)
+    """
+
+    from libjitsi_tpu.conference.mixer import mix_minus_many
+
+    return jax.jit(jax.shard_map(
+        lambda pcm, active: mix_minus_many(pcm, active),
+        mesh=mesh, in_specs=(P(AXIS, None, None), P(AXIS, None)),
+        out_specs=(P(AXIS, None, None), P(AXIS, None)), check_vma=False,
+    ))
+
+
 # ---------------------------------------------------------- full media step
 
 def sharded_media_step(mesh: Mesh, tag_len: int = 10):

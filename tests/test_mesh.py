@@ -95,3 +95,18 @@ def test_multihost_2d_mesh_mixer():
     want, want_lvl = mix_minus(pcm, active)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
     np.testing.assert_array_equal(np.asarray(lvl), np.asarray(want_lvl))
+
+
+def test_sharded_bridge_mix_matches_host(mesh):
+    from libjitsi_tpu.mesh import sharded_bridge_mix
+
+    rng = np.random.default_rng(12)
+    C, N, F = 16, 6, 96          # C divisible by the 8-device mesh
+    pcm = rng.integers(-9000, 9000, (C, N, F)).astype(np.int16)
+    active = rng.random((C, N)) < 0.8
+    out, lvl = sharded_bridge_mix(mesh)(pcm, active)
+    from libjitsi_tpu.conference import mix_minus_many
+
+    want, want_lvl = mix_minus_many(pcm, active)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
+    np.testing.assert_array_equal(np.asarray(lvl), np.asarray(want_lvl))

@@ -1,0 +1,77 @@
+"""Whole-bridge multi-conference mixing (one launch for C conferences)."""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.conference import AudioMixer, MixerBridge, mix_minus_many
+
+
+def test_mix_many_matches_per_conference_mix():
+    rng = np.random.default_rng(1)
+    C, N, F = 5, 8, 160
+    pcm = rng.integers(-20000, 20000, (C, N, F)).astype(np.int16)
+    active = rng.random((C, N)) < 0.7
+    out, levels = mix_minus_many(pcm, active)
+    for c in range(C):
+        mixer = AudioMixer(capacity=N, frame_samples=F)
+        for s in range(N):
+            if active[c, s]:
+                mixer.add_participant(s)
+                mixer.push(s, pcm[c, s])
+        # AudioMixer levels include inactive rows' pcm? mix() consumes
+        # only deposited frames; emulate by pushing zeros for inactive
+        want_out, want_lv = mixer.mix()
+        got_out = np.asarray(out[c])
+        # inactive rows in mix_many keep their (undeposited) pcm in the
+        # level calc; compare levels only on active rows
+        assert np.array_equal(got_out[active[c]], want_out[active[c]])
+        assert np.array_equal(np.asarray(levels[c])[active[c]],
+                              want_lv[active[c]])
+
+
+def test_bridge_lifecycle_and_mix_minus():
+    br = MixerBridge(conferences=4, capacity=6, frame_samples=80)
+    a = br.alloc_conference()
+    b = br.alloc_conference()
+    assert a != b
+    rng = np.random.default_rng(2)
+    fa = {s: rng.integers(-3000, 3000, 80).astype(np.int16) for s in (0, 1)}
+    fb = {s: rng.integers(-3000, 3000, 80).astype(np.int16)
+          for s in (2, 3, 4)}
+    for s, f in fa.items():
+        br.add_participant(a, s)
+        br.push(a, s, f)
+    for s, f in fb.items():
+        br.add_participant(b, s)
+        br.push(b, s, f)
+    out, levels = br.tick()
+    # conference a: each hears the other
+    assert np.array_equal(out[a, 0], fa[1])
+    assert np.array_equal(out[a, 1], fa[0])
+    # conference b: mix-minus of three
+    tot = sum(f.astype(np.int64) for f in fb.values())
+    for s, f in fb.items():
+        want = np.clip(tot - f, -32768, 32767).astype(np.int16)
+        assert np.array_equal(out[b, s], want)
+    # conferences are isolated: a's rows never see b's audio
+    assert not np.array_equal(out[a, 0], out[b, 2])
+    # frames consumed: next tick is silence
+    out2, _ = br.tick()
+    assert not out2[a].any() and not out2[b].any()
+
+
+def test_bridge_alloc_release_exhaustion():
+    br = MixerBridge(conferences=2, capacity=2, frame_samples=80)
+    c0, c1 = br.alloc_conference(), br.alloc_conference()
+    with pytest.raises(RuntimeError):
+        br.alloc_conference()
+    br.release_conference(c0)
+    assert br.alloc_conference() == c0
+
+
+def test_bridge_rejects_bad_frame_shape():
+    br = MixerBridge(conferences=1, capacity=2, frame_samples=80)
+    cid = br.alloc_conference()
+    br.add_participant(cid, 0)
+    with pytest.raises(ValueError):
+        br.push(cid, 0, np.zeros(81, np.int16))

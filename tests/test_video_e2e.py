@@ -77,6 +77,65 @@ def test_assembler_tolerates_reorder_and_gaps():
     assert [(t, d) for t, _, _, d in frames] == [(1000, f1)]  # f2 incomplete
 
 
+def test_assembler_burst_of_complete_frames_not_evicted():
+    """A backlog flush completing >max_pending frames in one push must
+    deliver every frame (only incomplete frames evict at the limit)."""
+    rng = np.random.default_rng(10)
+    fa = vp8.FrameAssembler(max_pending=8)
+    frames, seq = [], 0
+    pls_all, seqs, tss, mks = [], [], [], []
+    for i in range(20):
+        f = _fake_vp8_frame(rng, 600, key=(i == 0))
+        frames.append(f)
+        for j, p in enumerate(vp8.packetize(f, max_payload=700)):
+            pls_all.append(p); seqs.append(seq); tss.append(1000 + i * 90)
+            mks.append(1); seq += 1
+    fa.push_batch(rtp_header.build(pls_all, seqs, tss, [7] * len(pls_all),
+                                   [96] * len(pls_all), marker=mks))
+    got = fa.pop_frames()
+    assert [d for _, _, _, d in got] == frames
+    assert fa.dropped_incomplete == 0
+
+
+def test_assembler_drops_late_completion_keeps_order():
+    """A frame completing after a newer one was delivered is dropped,
+    never delivered out of order."""
+    rng = np.random.default_rng(11)
+    f1 = _fake_vp8_frame(rng, 1400, key=True)
+    f2 = _fake_vp8_frame(rng, 600, key=False)
+    p1 = vp8.packetize(f1, max_payload=800)        # 2 fragments
+    p2 = vp8.packetize(f2, max_payload=800)        # 1 fragment
+    fa = vp8.FrameAssembler()
+    # f1 fragment 0 arrives; f2 completes and is delivered
+    fa.push_batch(rtp_header.build([p1[0]], [10], [1000], [7], [96],
+                                   marker=[0]))
+    fa.push_batch(rtp_header.build(p2, [12], [2000], [7], [96], marker=[1]))
+    assert [t for t, _, _, _ in fa.pop_frames()] == [2000]
+    # the retransmitted tail of f1 completes it late -> dropped
+    fa.push_batch(rtp_header.build([p1[1]], [11], [1000], [7], [96],
+                                   marker=[1]))
+    assert fa.pop_frames() == []
+    assert fa.dropped_late == 1
+
+
+def test_bridge_rejects_stale_and_wrapping_ids():
+    import pytest
+
+    from libjitsi_tpu.conference import MixerBridge
+
+    br = MixerBridge(conferences=2, capacity=2, frame_samples=80)
+    cid = br.alloc_conference()
+    br.add_participant(cid, 0)
+    br.release_conference(cid)
+    with pytest.raises(KeyError):
+        br.push(cid, 0, np.zeros(80, np.int16))    # stale cid
+    cid2 = br.alloc_conference()
+    with pytest.raises(IndexError):
+        br.add_participant(cid2, -1)               # would wrap a row
+    with pytest.raises(KeyError):
+        br.push(-1, 0, np.zeros(80, np.int16))
+
+
 def test_assembler_survives_ts_wraparound():
     rng = np.random.default_rng(8)
     fs = [_fake_vp8_frame(rng, 1200, key=(i == 0)) for i in range(3)]
