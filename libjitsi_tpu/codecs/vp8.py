@@ -33,9 +33,14 @@ class Vp8Descriptors:
     valid: np.ndarray
 
 
-def parse_descriptors(batch: PacketBatch) -> Vp8Descriptors:
-    """Vectorized RFC 7741 §4.2 parse over the batch's RTP payloads."""
-    hdr = rtp_header.parse(batch)
+def parse_descriptors(batch: PacketBatch, hdr=None) -> Vp8Descriptors:
+    """Vectorized RFC 7741 §4.2 parse over the batch's RTP payloads.
+
+    Pass pre-parsed RTP headers via `hdr` to avoid re-parsing on paths
+    that already have them (the SFU forwarder parses once per batch).
+    """
+    if hdr is None:
+        hdr = rtp_header.parse(batch)
     d = batch.data
     n, cap = d.shape
     ln = np.asarray(batch.length, dtype=np.int64)
@@ -135,9 +140,12 @@ class SimulcastReceiver:
         self.keyframe_seen = np.zeros(n, dtype=bool)
         self.frames = np.zeros(n, dtype=np.int64)
 
-    def ingest(self, batch: PacketBatch) -> Vp8Descriptors:
-        hdr = rtp_header.parse(batch)
-        desc = parse_descriptors(batch)
+    def ingest(self, batch: PacketBatch, hdr=None,
+               desc: "Vp8Descriptors" = None) -> Vp8Descriptors:
+        if hdr is None:
+            hdr = rtp_header.parse(batch)
+        if desc is None:
+            desc = parse_descriptors(batch, hdr=hdr)
         for i in range(batch.batch_size):
             if not desc.valid[i]:
                 continue
@@ -214,8 +222,9 @@ class FrameAssembler:
         self._ts_high: int = 0        # unwrap epoch (multiples of 2^32)
         self._ts_last: int = -1       # last wire ts seen
         self._delivered_ts: int = -1  # newest uts handed to the caller
-        self.dropped_incomplete = 0
-        self.dropped_late = 0
+        self.dropped_incomplete = 0   # evicted waiting on lost packets
+        self.dropped_backlog = 0      # complete but never popped (4x cap)
+        self.dropped_late = 0         # completed after a newer delivery
 
     def _unwrap_ts(self, ts: int) -> int:
         if self._ts_last >= 0:
@@ -247,23 +256,26 @@ class FrameAssembler:
                 meta[3] = bool(desc.is_keyframe[i])
             if hdr.marker[i]:
                 meta[1] = seq
-        # bound memory two-tier: incomplete frames (waiting on loss)
-        # evict oldest-first at max_pending; COMPLETE frames — which a
-        # burst can accumulate faster than the caller pops — are only
-        # evicted at a 4x hard cap, so a backlog flush never silently
-        # loses frames whose packets all arrived
-        while len(self._pending) > self.max_pending:
-            incomplete = [t for t in sorted(self._pending)
-                          if not self._is_complete(t)]
-            if incomplete:
-                t = incomplete[0]
-            elif len(self._pending) > 4 * self.max_pending:
+        # bound memory two-tier: INCOMPLETE frames older than the newest
+        # entry (stalled gaps) evict oldest-first at max_pending — the
+        # newest frame is still arriving and is never a victim below the
+        # cap; COMPLETE frames, which a burst can accumulate faster than
+        # the caller pops, only give way at a 4x hard cap (counted
+        # separately: that is caller backlog, not packet loss).
+        if len(self._pending) > self.max_pending:
+            ordered = sorted(self._pending)
+            for t in ordered[:-1]:
+                if len(self._pending) <= self.max_pending:
+                    break
+                if not self._is_complete(t):
+                    del self._pending[t]
+                    del self._meta[t]
+                    self.dropped_incomplete += 1
+            while len(self._pending) > 4 * self.max_pending:
                 t = min(self._pending)
-            else:
-                break
-            del self._pending[t]
-            del self._meta[t]
-            self.dropped_incomplete += 1
+                del self._pending[t]
+                del self._meta[t]
+                self.dropped_backlog += 1
 
     def _is_complete(self, ts: int) -> bool:
         start, end, _pid, _key = self._meta[ts]

@@ -59,14 +59,12 @@ def mix_minus(pcm, active=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     the full mix (they contribute nothing, so total - 0 = total), matching
     the reference where a receive-only participant hears everyone.
     """
-    pcm = jnp.asarray(pcm, dtype=jnp.int32)
-    if active is None:
-        contrib = pcm
-    else:
-        contrib = jnp.where(active[:, None], pcm, 0)
-    total = jnp.sum(contrib, axis=0, keepdims=True)  # [1, F] int32
-    out = jnp.clip(total - contrib, I16_MIN, I16_MAX).astype(jnp.int16)
-    return out, audio_levels(pcm, active)
+    # the C=1 case of mix_minus_many — ONE source of truth for the mix
+    # math so the single-conference and whole-bridge paths cannot diverge
+    out, levels = mix_minus_many(
+        jnp.asarray(pcm)[None],
+        None if active is None else jnp.asarray(active)[None])
+    return out[0], levels[0]
 
 
 @jax.jit
@@ -150,6 +148,7 @@ class MixerBridge:
         return cid
 
     def release_conference(self, cid: int) -> None:
+        self._check(cid)     # stale/negative cid would clear another row
         self._in_use[cid] = False
         self.active[cid] = False
         self._frame[cid] = 0

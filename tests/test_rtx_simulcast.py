@@ -1,0 +1,168 @@
+"""RTX retransmission format (RFC 4588) and simulcast layer forwarding."""
+
+import numpy as np
+
+from libjitsi_tpu.codecs import vp8
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.sfu import (PacketCache, RtxReceiver, RtxSender,
+                              SimulcastForwarder, decapsulate_batch,
+                              encapsulate_batch)
+
+
+def _media_batch(seqs, ssrc=0x1111, pt=96, payloads=None):
+    payloads = payloads or [b"payload-%04d" % s for s in seqs]
+    return rtp_header.build(payloads, seqs, [s * 90 for s in seqs],
+                            [ssrc] * len(seqs), [pt] * len(seqs))
+
+
+def test_rtx_encapsulate_decapsulate_roundtrip():
+    seqs = [100, 101, 65535, 7]
+    batch = _media_batch(seqs)
+    originals = [batch.to_bytes(i) for i in range(4)]
+    rtx = encapsulate_batch(batch, rtx_ssrc=0x2222, rtx_pt=97,
+                            first_rtx_seq=500)
+    hdr = rtp_header.parse(rtx)
+    assert list(hdr.seq) == [500, 501, 502, 503]
+    assert all(s == 0x2222 for s in hdr.ssrc)
+    assert all(p == 97 for p in hdr.pt)
+    assert all(rtx.length[i] == len(originals[i]) + 2 for i in range(4))
+    # OSN is the first two payload bytes
+    assert rtx.to_bytes(0)[12:14] == bytes([100 >> 8, 100 & 0xFF])
+
+    back, osn = decapsulate_batch(rtx, orig_ssrc=0x1111, orig_pt=96)
+    assert list(osn) == seqs
+    for i in range(4):
+        assert back.to_bytes(i) == originals[i]
+
+
+def test_rtx_sender_receiver_over_cache():
+    cache = PacketCache()
+    sent = {}
+    for s in (10, 11, 12, 13):
+        b = _media_batch([s])
+        sent[s] = b.to_bytes(0)
+        cache.insert(0x1111, s, sent[s])
+    tx = RtxSender(cache, media_ssrc=0x1111, rtx_ssrc=0x2222, rtx_pt=97)
+    rtx_batch = tx.on_nack([11, 13, 99])     # 99 is a cache miss
+    assert rtx_batch.batch_size == 2 and tx.served == 2
+
+    rx = RtxReceiver()
+    rx.add_association(0x2222, 0x1111, 96)
+    restored = rx.restore(rtx_batch)
+    assert [(s, p) for s, p in restored] == [(11, sent[11]), (13, sent[13])]
+    # unknown rtx ssrc ignored
+    other = encapsulate_batch(_media_batch([5]), 0x9999, 98, 0)
+    assert rx.restore(other) == []
+    assert tx.on_nack([99]) is None
+
+
+def _layer_packet(ssrc, seq, ts, pid, key, fragment=b"x" * 40, start=True,
+                  marker=True):
+    body = (b"\x00" if key else b"\x01") + fragment
+    desc = vp8.build_descriptor(start=start, picture_id=pid | 0x4000)
+    # pid | 0x4000 forces 15-bit encoding so rewrite keeps field width
+    return rtp_header.build([desc + body], [seq], [ts], [ssrc], [100],
+                            marker=[1 if marker else 0])
+
+
+def test_simulcast_forward_single_layer_continuous():
+    fwd = SimulcastForwarder([0xA0, 0xA1, 0xA2], out_ssrc=0xBEEF,
+                             initial_layer=0)
+    outs = []
+    for i in range(4):
+        outs += fwd.forward(_layer_packet(0xA0, 100 + i, 3000 * i,
+                                          pid=50 + i, key=(i == 0)))
+        # other layers' packets are dropped
+        assert fwd.forward(_layer_packet(0xA1, 200 + i, 3000 * i,
+                                         pid=50 + i, key=(i == 0))) == []
+    got = PacketBatch.from_payloads(outs)
+    hdr = rtp_header.parse(got)
+    assert list(hdr.seq) == [0, 1, 2, 3]             # continuous out space
+    assert all(s == 0xBEEF for s in hdr.ssrc)
+    desc = vp8.parse_descriptors(got)
+    pids = list(desc.picture_id)
+    assert pids == [(pids[0] + k) & 0x7FFF for k in range(4)]
+
+
+def test_simulcast_switch_waits_for_keyframe():
+    fwd = SimulcastForwarder([0xA0, 0xA1, 0xA2], out_ssrc=0xBEEF)
+    fwd.forward(_layer_packet(0xA0, 100, 0, pid=10, key=True))
+    fwd.forward(_layer_packet(0xA0, 101, 3000, pid=11, key=False))
+    assert fwd.request_layer(2) is True              # needs upstream PLI
+    # delta frames on the target do NOT switch; current layer still flows
+    assert fwd.forward(_layer_packet(0xA2, 300, 6000, pid=7,
+                                     key=False)) == []
+    assert len(fwd.forward(_layer_packet(0xA0, 102, 6000, pid=12,
+                                         key=False))) == 1
+    assert fwd.awaiting_keyframe
+    # keyframe on the target completes the switch
+    out = fwd.forward(_layer_packet(0xA2, 301, 9000, pid=8, key=True))
+    assert len(out) == 1 and not fwd.awaiting_keyframe
+    assert fwd.current_layer == 2 and fwd.switches == 1
+    # old layer now dropped; output stays seq- and pid-continuous
+    assert fwd.forward(_layer_packet(0xA0, 103, 9000, pid=13,
+                                     key=False)) == []
+    out2 = fwd.forward(_layer_packet(0xA2, 302, 12000, pid=9, key=False))
+    both = PacketBatch.from_payloads(out + out2)
+    hdr = rtp_header.parse(both)
+    desc = vp8.parse_descriptors(both)
+    assert list(hdr.seq)[1] == (list(hdr.seq)[0] + 1) & 0xFFFF
+    assert int(desc.picture_id[1]) == (int(desc.picture_id[0]) + 1) & 0x7FFF
+
+
+def test_simulcast_ts_continuity_across_random_bases():
+    """Each layer has its own random RFC 3550 ts base; the output ts
+    must stay monotonic across a switch (no arbitrary jump)."""
+    fwd = SimulcastForwarder([0xA0, 0xA1], out_ssrc=0xBEEF,
+                             ts_switch_step=3000)
+    base0, base1 = 0xF0000000, 0x12345678       # wildly different bases
+    o1 = fwd.forward(_layer_packet(0xA0, 1, base0, pid=1, key=True))
+    o2 = fwd.forward(_layer_packet(0xA0, 2, base0 + 3000, pid=2, key=False))
+    fwd.request_layer(1)
+    o3 = fwd.forward(_layer_packet(0xA1, 50, base1, pid=9, key=True))
+    o4 = fwd.forward(_layer_packet(0xA1, 51, base1 + 3000, pid=10,
+                                   key=False))
+    got = PacketBatch.from_payloads(o1 + o2 + o3 + o4)
+    ts = list(rtp_header.parse(got).ts.astype(np.int64))
+    # in-layer spacing preserved exactly; switch gap = ts_switch_step
+    assert ts[1] - ts[0] == 3000
+    assert ts[2] - ts[1] == 3000
+    assert ts[3] - ts[2] == 3000
+
+
+def test_simulcast_seq_rewrite_preserves_relative_order():
+    """Upstream reordering/duplication must survive the rewrite (a
+    per-arrival counter would renumber dups as new packets)."""
+    fwd = SimulcastForwarder([0xA0], out_ssrc=0xBEEF)
+    pkts = {s: _layer_packet(0xA0, s, 0, pid=5, key=True, start=(s == 100),
+                             marker=(s == 102))
+            for s in (100, 101, 102)}
+    outs = []
+    for s in (100, 102, 101, 101):               # reorder + duplicate
+        outs += fwd.forward(pkts[s])
+    hdr = rtp_header.parse(PacketBatch.from_payloads(outs))
+    seqs = list(hdr.seq)
+    assert seqs[0] == 0 and seqs[1] == 2 and seqs[2] == 1 and seqs[3] == 1
+
+
+def test_simulcast_rejects_bad_layer():
+    import pytest
+
+    fwd = SimulcastForwarder([0xA0, 0xA1, 0xA2], out_ssrc=1)
+    with pytest.raises(IndexError):
+        fwd.request_layer(3)
+    with pytest.raises(IndexError):
+        SimulcastForwarder([0xA0], out_ssrc=1, initial_layer=5)
+
+
+def test_simulcast_rewrite_preserves_frame_content():
+    fwd = SimulcastForwarder([0xA0, 0xA1], out_ssrc=0x1234)
+    frag = bytes(range(60))
+    out = fwd.forward(_layer_packet(0xA0, 7, 0, pid=99, key=True,
+                                    fragment=frag))
+    got = PacketBatch.from_payloads(out)
+    desc = vp8.parse_descriptors(got)
+    hdr = rtp_header.parse(got)
+    payload = got.to_bytes(0)[int(hdr.payload_off[0] + desc.desc_len[0]):]
+    assert payload == b"\x00" + frag                 # content untouched

@@ -129,11 +129,46 @@ def test_bridge_rejects_stale_and_wrapping_ids():
     br.release_conference(cid)
     with pytest.raises(KeyError):
         br.push(cid, 0, np.zeros(80, np.int16))    # stale cid
+    with pytest.raises(KeyError):
+        br.release_conference(cid)                 # double release
+    with pytest.raises(KeyError):
+        br.release_conference(-1)                  # would wrap a row
     cid2 = br.alloc_conference()
     with pytest.raises(IndexError):
         br.add_participant(cid2, -1)               # would wrap a row
     with pytest.raises(KeyError):
         br.push(-1, 0, np.zeros(80, np.int16))
+
+
+def test_assembler_eviction_spares_newest_inflight_frame():
+    """A backlog of complete frames must not evict the newest frame
+    that is still arriving."""
+    rng = np.random.default_rng(12)
+    fa = vp8.FrameAssembler(max_pending=4)
+    frames, rowspec = [], []
+    for i in range(6):                          # 6 complete old frames
+        f = _fake_vp8_frame(rng, 400, key=(i == 0))
+        frames.append(f)
+        for p in vp8.packetize(f, max_payload=500):
+            rowspec.append((p, i, 100 + i * 90, 1))
+    newest = _fake_vp8_frame(rng, 900, key=False)
+    first_frag = vp8.packetize(newest, max_payload=500)[0]
+    rowspec.append((first_frag, 6, 100 + 6 * 90, 0))   # no marker yet
+    pls, seqs, tss, mks = zip(*rowspec)
+    fa.push_batch(rtp_header.build(list(pls), list(seqs), list(tss),
+                                   [7] * len(pls), [96] * len(pls),
+                                   marker=list(mks)))
+    # newest in-flight frame survived; complete backlog under the 4x
+    # hard cap survived too
+    assert fa.dropped_incomplete == 0 and fa.dropped_backlog == 0
+    assert [d for _, _, _, d in fa.pop_frames()] == frames
+    # its tail arrives -> the newest frame still completes
+    tail = vp8.packetize(newest, max_payload=500)[1:]
+    fa.push_batch(rtp_header.build(
+        tail, [7 + k for k in range(len(tail))], [100 + 6 * 90] * len(tail),
+        [7] * len(tail), [96] * len(tail),
+        marker=[0] * (len(tail) - 1) + [1]))
+    assert [d for _, _, _, d in fa.pop_frames()] == [newest]
 
 
 def test_assembler_survives_ts_wraparound():
