@@ -16,12 +16,14 @@ import time
 import numpy as np
 
 N_STREAMS = 10_240
-# Launch size: throughput scales with batch (per-launch overhead
-# dominates below ~8k rows: 2048 -> 39M pps, 16384 -> 388M pps
-# pipelined) while the sync round-trip latency stays flat (~0.05-0.28 ms
-# for 2048..16384 rows), so the largest batch still meets the 2 ms p99
-# budget with ~7x headroom — p99 is measured at THIS batch size.
-BATCH = 16384
+# Launch size: throughput scales with batch because the round trip is
+# dispatch-dominated, not compute-bound (recorded runs: 2048 -> 39M,
+# 16384 -> 345M, 65536 -> ~1.1B pps pipelined ~= 0.26 TB/s of packet
+# payload, ~2x that in HBM read+write traffic) while sync p99 latency
+# stays flat (~0.2-0.3 ms across 2048..65536), so the big launch still
+# meets the 2 ms p99 budget with >8x headroom — p99 is measured at THIS
+# batch size.  131072+ was rejected: compile time blows up.
+BATCH = 65536
 GCM_BATCH = 4096     # GCM carries a per-row 16 KiB GHASH table; bound HBM
 WIDTH = 192          # capacity; 20 ms Opus packet ≈ 12B header + 160B payload
 PKT_LEN = 172
@@ -205,7 +207,7 @@ def bridge_mixes_per_sec(conferences: int = 64,
     return conferences / dt
 
 
-def fanout_rows_per_sec(packets: int = 64, receivers: int = 256) -> float:
+def fanout_rows_per_sec(packets: int = 128, receivers: int = 512) -> float:
     """BASELINE config #5 core: per-receiver re-encrypt of a fan-out
     matrix (rows = packets x receivers) in one launch."""
     import functools
