@@ -2,3 +2,5 @@ from libjitsi_tpu.codecs.opus import OpusDecoder, OpusEncoder, opus_available  #
 from libjitsi_tpu.codecs.gsm import GsmCodec, gsm_available  # noqa: F401
 from libjitsi_tpu.codecs.speex import (SpeexDecoder, SpeexEncoder,  # noqa: F401
                                        speex_available)
+from libjitsi_tpu.codecs.vpx import (VpxDecoder, VpxEncoder,  # noqa: F401
+                                     vpx_available)

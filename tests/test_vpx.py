@@ -1,0 +1,136 @@
+"""libvpx binding + real-bitstream VP8 media path.
+
+The crown-jewel integration: REAL VP8 frames (encoded by libvpx)
+through the full secure SFU path — packetize, SRTP protect, fan out,
+per-receiver unprotect, reassemble, decode — and the picture survives.
+"""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.codecs import vpx
+from libjitsi_tpu.codecs import vp8 as vp8rtp
+
+pytestmark = pytest.mark.skipif(not vpx.vpx_available(),
+                                reason="libvpx not present")
+
+W, H = 64, 48
+
+
+def _frames(n, seed=0):
+    out = []
+    for i in range(n):
+        y = (np.add.outer(np.arange(H), np.arange(W)) * 2
+             + i * 9 + seed * 31).astype(np.uint8)
+        y[10:20, (8 + i * 4) % (W - 10):(18 + i * 4) % (W - 10) or 10] = 255
+        u = np.full((H // 2, W // 2), 90 + i, np.uint8)
+        v = np.full((H // 2, W // 2), 150 + i, np.uint8)
+        out.append((y, u, v))
+    return out
+
+
+def _psnr(a, b):
+    err = np.mean((a.astype(np.float64) - b.astype(np.float64)) ** 2)
+    return 10 * np.log10(255.0 ** 2 / max(err, 1e-9))
+
+
+def test_encode_decode_roundtrip_vp8():
+    enc = vpx.VpxEncoder(W, H, "vp8")
+    dec = vpx.VpxDecoder("vp8")
+    frames = _frames(6)
+    n_dec = 0
+    for i, (y, u, v) in enumerate(frames):
+        for pkt, key in enc.encode(y, u, v):
+            assert key == (i == 0)
+            for dy, du, dv in dec.decode(pkt):
+                assert dy.shape == (H, W)
+                assert _psnr(frames[n_dec][0], dy) > 30
+                n_dec += 1
+    assert n_dec == 6
+    enc.close(); dec.close()
+
+
+def test_encode_decode_roundtrip_vp9():
+    enc = vpx.VpxEncoder(W, H, "vp9")
+    dec = vpx.VpxDecoder("vp9")
+    frames = _frames(3)
+    pkts = []
+    for y, u, v in frames:
+        pkts += enc.encode(y, u, v)
+    pkts += enc.flush()          # VP9 defaults to multi-frame lookahead
+    n_dec = 0
+    for pkt, _key in pkts:
+        for dy, _du, _dv in dec.decode(pkt):
+            assert _psnr(frames[n_dec][0], dy) > 30
+            n_dec += 1
+    assert n_dec == 3
+    enc.close(); dec.close()
+
+
+def test_real_vp8_through_secure_sfu_path():
+    """Real bitstream -> RTP -> SRTP -> SFU fan-out -> decode -> PSNR."""
+    from libjitsi_tpu.core.packet import PacketBatch
+    from libjitsi_tpu.rtp import header as rtp_header
+    from libjitsi_tpu.sfu import RtpTranslator
+    from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+    enc = vpx.VpxEncoder(W, H, "vp8")
+    frames = _frames(5, seed=3)
+    tx = SrtpStreamTable(capacity=2); tx.add_stream(0, b"k" * 16, b"s" * 14)
+    sfu = SrtpStreamTable(capacity=2); sfu.add_stream(0, b"k" * 16, b"s" * 14)
+    tr = RtpTranslator(capacity=4)
+    tr.add_receiver(1, b"\x07" * 16, b"\x08" * 14)
+    tr.connect(0, [1])
+    leg = SrtpStreamTable(capacity=4)
+    leg.add_stream(2, b"\x07" * 16, b"\x08" * 14)
+    fa = vp8rtp.FrameAssembler()
+    dec = vpx.VpxDecoder("vp8")
+
+    seq, n_out = 50, 0
+    for i, (y, u, v) in enumerate(frames):
+        for pkt, _key in enc.encode(y, u, v):
+            pls = vp8rtp.packetize(pkt, picture_id=0x4000 | i,
+                                   max_payload=300)
+            n = len(pls)
+            batch = rtp_header.build(
+                pls, list(range(seq, seq + n)), [i * 3000] * n,
+                [0xCAFE] * n, [100] * n, marker=[0] * (n - 1) + [1],
+                stream=[0] * n)
+            seq += n
+            wire = tx.protect_rtp(batch)
+            decd, ok, idx = sfu.unprotect_rtp(wire, return_index=True)
+            assert ok.all()
+            out, recv = tr.translate(decd, idx)
+            sub = PacketBatch.from_payloads(
+                [out.to_bytes(j) for j in range(out.batch_size)],
+                stream=[2] * out.batch_size)
+            dec_r, ok_r = leg.unprotect_rtp(sub)
+            assert ok_r.all()
+            fa.push_batch(dec_r)
+        for _ts, _pid, key, data in fa.pop_frames():
+            for dy, _du, _dv in dec.decode(data):
+                assert _psnr(frames[n_out][0], dy) > 30
+                n_out += 1
+    assert n_out == 5
+    enc.close(); dec.close()
+
+
+def test_ivf_fixture_with_real_bitstream(tmp_path):
+    """Author an IVF with real VP8 frames, replay as a fake camera."""
+    from libjitsi_tpu.device import IvfReader, IvfWriter
+
+    enc = vpx.VpxEncoder(W, H, "vp8")
+    path = str(tmp_path / "real.ivf")
+    w = IvfWriter(path, W, H)
+    n_in = 0
+    for i, (y, u, v) in enumerate(_frames(4)):
+        for pkt, _key in enc.encode(y, u, v):
+            w.write(pkt, pts=i)
+            n_in += 1
+    w.close()
+    dec = vpx.VpxDecoder("vp8")
+    n_out = 0
+    for _pts, data in IvfReader(path):
+        n_out += len(dec.decode(data))
+    assert n_out == n_in == 4
+    enc.close(); dec.close()
