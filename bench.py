@@ -24,7 +24,11 @@ N_STREAMS = 10_240
 # meets the 2 ms p99 budget with >8x headroom — p99 is measured at THIS
 # batch size.  131072+ was rejected: compile time blows up.
 BATCH = 65536
-GCM_BATCH = 4096     # GCM carries a per-row 16 KiB GHASH table; bound HBM
+# GCM also scales with launch (observed 62-92M pps @4096 -> 140-270M
+# @16384 across tunnel conditions; matches BASELINE.md) but each row
+# carries a 16 KiB GHASH matrix, so 16384 rows = 268 MB of tables —
+# a deliberate HBM/throughput trade, not pushed to the CM batch size.
+GCM_BATCH = 16384
 WIDTH = 192          # capacity; 20 ms Opus packet ≈ 12B header + 160B payload
 PKT_LEN = 172
 TAG_LEN = 10

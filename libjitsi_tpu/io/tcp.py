@@ -23,7 +23,10 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+import numpy as np
+
 from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.io.udp import ip_to_u32, u32_to_ip
 
 _log = logging.getLogger(__name__)
 
@@ -211,3 +214,66 @@ class TcpConnector:
             self.close()
         except Exception:
             pass
+
+
+class TcpMediaEngine:
+    """UdpEngine-signature adapter: run a MediaLoop over TCP unchanged.
+
+    The reference swaps `RTPConnectorUDPImpl` for `RTPConnectorTCPImpl`
+    under the same `AbstractRTPConnector` surface; this is the same
+    move for our batch interface — `recv_batch` returns (batch, src_ip
+    u32 array, src_port array) and `send_batch(batch, ip, port)`
+    resolves the peer connection, so `MediaLoop` cannot tell the
+    transports apart (address latching and all).
+    """
+
+    def __init__(self, connector: TcpConnector):
+        self.connector = connector
+        self.send_failures = 0    # peers dropped mid-fan-out
+
+    @property
+    def port(self) -> int:
+        return self.connector.port
+
+    def recv_batch(self, timeout_ms: int = 1):
+        batch, addrs = self.connector.recv_batch(timeout_ms)
+        sip = np.array([ip_to_u32(ip) for ip, _ in addrs], dtype=np.uint32)
+        sport = np.array([p for _, p in addrs], dtype=np.uint16)
+        return batch, sip, sport
+
+    def send_batch(self, batch: PacketBatch, dst_ip, dst_port) -> int:
+        """dst_ip/dst_port may be scalars or per-row arrays (MediaLoop
+        sends with latched per-row addresses); rows are grouped per
+        peer connection.  One dead/stalled peer must not abort the
+        fan-out or crash the loop (UDP never raises per-peer, and the
+        adapter's contract is that MediaLoop can't tell transports
+        apart) — its failure is counted and the other peers still get
+        their rows."""
+        n = batch.batch_size
+        if n == 0:
+            return 0
+        if isinstance(dst_ip, str):
+            ips = np.full(n, ip_to_u32(dst_ip), dtype=np.uint64)
+        else:
+            ips = np.broadcast_to(
+                np.asarray(dst_ip, dtype=np.uint64), (n,))
+        ports = np.broadcast_to(np.asarray(dst_port, dtype=np.uint64),
+                                (n,))
+        keys = (ips << 16) | ports
+        sent = 0
+        for key in np.unique(keys):
+            rows = np.nonzero(keys == key)[0]
+            dst = (u32_to_ip(int(key >> 16)), int(key & 0xFFFF))
+            sub = PacketBatch(batch.data[rows],
+                              np.asarray(batch.length)[rows],
+                              np.asarray(batch.stream)[rows])
+            try:
+                sent += self.connector.send_batch(sub, dst)
+            except (ConnectionError, KeyError, OSError) as e:
+                self.send_failures += 1
+                _log.warning("dropping %d rows for TCP peer %s: %s",
+                             len(rows), dst, e)
+        return sent
+
+    def close(self) -> None:
+        self.connector.close()
