@@ -68,6 +68,32 @@ def g722_codec(ptime_ms: int = 20) -> FrameCodec:
                       lambda b: g722.decode(b))
 
 
+def gsm_codec() -> FrameCodec:
+    """GSM 06.10 full rate: fixed 20 ms / 160 samples / 33 bytes @8 kHz."""
+    from libjitsi_tpu.codecs.gsm import GsmCodec
+
+    c = GsmCodec()      # holds independent encoder+decoder states
+    return FrameCodec(
+        "GSM", 3, 8000, 160, 160,
+        lambda pcm: c.encode(np.asarray(pcm, np.int16)),
+        lambda b: c.decode(b))
+
+
+def speex_codec(mode: str = "nb") -> FrameCodec:
+    """Speex NB (8 kHz) / WB (16 kHz) / UWB (32 kHz); 20 ms frames."""
+    from libjitsi_tpu.codecs.speex import (MODE_NB, MODE_UWB, MODE_WB,
+                                           SpeexDecoder, SpeexEncoder)
+
+    m = {"nb": MODE_NB, "wb": MODE_WB, "uwb": MODE_UWB}[mode]
+    enc, dec = SpeexEncoder(mode=m), SpeexDecoder(mode=m)
+    n = enc.frame_size      # libspeex's own 20 ms frame size
+    return FrameCodec(
+        "speex" if mode == "nb" else f"speex/{enc.sample_rate}", 97,
+        enc.sample_rate, n, n,
+        lambda pcm: enc.encode(np.asarray(pcm, np.int16)),
+        lambda b: dec.decode(b))
+
+
 def opus_codec(ptime_ms: int = 20, bitrate: int = 32000) -> FrameCodec:
     from libjitsi_tpu.codecs.opus import OpusDecoder, OpusEncoder
 
@@ -132,6 +158,7 @@ class ReceivePump:
             frame_ms=ptime_ms)
         self.decoded_frames = 0
         self.lost_frames = 0
+        self.decode_errors = 0
 
     def push(self, datagrams: List[bytes],
              now: Optional[float] = None) -> int:
@@ -162,8 +189,15 @@ class ReceivePump:
             self.lost_frames += 1
             pcm = np.zeros(self.codec.frame_samples, dtype=np.int16)
         else:
-            pcm = np.asarray(self.codec.decode(payload), dtype=np.int16)
-            self.decoded_frames += 1
+            try:
+                pcm = np.asarray(self.codec.decode(payload),
+                                 dtype=np.int16)
+                self.decoded_frames += 1
+            except (ValueError, RuntimeError):
+                # a malformed (but authenticated) payload must not kill
+                # the loop driving thousands of pumps — play silence
+                self.decode_errors += 1
+                pcm = np.zeros(self.codec.frame_samples, dtype=np.int16)
         if len(pcm) < self.codec.frame_samples:   # short decode: pad
             pcm = np.pad(pcm, (0, self.codec.frame_samples - len(pcm)))
         elif len(pcm) > self.codec.frame_samples:
