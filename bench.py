@@ -11,9 +11,16 @@ Prints exactly one JSON line.
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
+
+
+from libjitsi_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 
 N_STREAMS = 10_240
 # Launch size: throughput scales with batch because the round trip is

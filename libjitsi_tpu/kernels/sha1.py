@@ -160,11 +160,39 @@ def hmac_precompute(key: bytes) -> np.ndarray:
     for pad in (0x36, 0x5C):
         blk = (k ^ pad).astype(np.uint32).reshape(16, 4)
         w16 = (blk[:, 0] << 24) | (blk[:, 1] << 16) | (blk[:, 2] << 8) | blk[:, 3]
-        h = np.asarray(
-            _compress_block(jnp.asarray(_H0), jnp.asarray(w16, dtype=jnp.uint32))
-        )
-        states.append(h)
+        # pure-host compress: a device call here costs one accelerator
+        # round trip PER KEY (x2 pads) — at 10k streams that is 20k RTTs
+        # of setup (hashlib can't help: it never exposes midstates)
+        states.append(_compress_block_np(_H0, w16))
     return np.stack(states).astype(np.uint32)
+
+
+def _compress_block_np(h: np.ndarray, w16: np.ndarray) -> np.ndarray:
+    """One SHA-1 compression on host (numpy scalar; cold path only)."""
+    mask = np.uint64(0xFFFFFFFF)
+
+    def rotl(x, n):
+        x = int(x) & 0xFFFFFFFF
+        return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+
+    w = [int(w16[t]) for t in range(16)]
+    for t in range(16, 80):
+        w.append(rotl(w[t - 3] ^ w[t - 8] ^ w[t - 14] ^ w[t - 16], 1))
+    a, b, c, d, e = (int(h[i]) for i in range(5))
+    K = (0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6)
+    for t in range(80):
+        if t < 20:
+            f = (b & c) | (~b & d & 0xFFFFFFFF)
+        elif t < 40:
+            f = b ^ c ^ d
+        elif t < 60:
+            f = (b & c) | (b & d) | (c & d)
+        else:
+            f = b ^ c ^ d
+        tmp = (rotl(a, 5) + f + e + K[t // 20] + w[t]) & 0xFFFFFFFF
+        a, b, c, d, e = tmp, a, rotl(b, 30), c, d
+    out = np.array([a, b, c, d, e], dtype=np.uint64)
+    return ((out + h.astype(np.uint64)) & mask).astype(np.uint32)
 
 
 @jax.jit

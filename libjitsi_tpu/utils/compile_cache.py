@@ -1,0 +1,26 @@
+"""Persistent XLA compilation cache setup (shared by bench + driver).
+
+The 65536-row SRTP programs take minutes to compile cold; caching them
+on disk makes fresh benchmark/entry processes start in seconds.  Always
+best-effort: the cache is an optimization, never a requirement.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_compile_cache(path: str = "") -> None:
+    try:
+        import jax
+
+        if not path:
+            path = os.path.join(
+                os.path.dirname(os.path.dirname(
+                    os.path.dirname(os.path.abspath(__file__)))),
+                ".jax_cache")
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
