@@ -81,7 +81,8 @@ def tpu_pps() -> tuple[float, float, float, dict]:
     #   pipelined        — enqueue 50 independent steps, block once at
     #                      the end: async dispatch overlaps transport
     #                      with execution the way a real deployment runs.
-    # The headline value is the max of the three lower bounds; p99 is
+    # The headline value is the pipelined estimator (the one sustained
+    # measurement; the others are printed for methodology); p99 is
     # reported for the best sync pass (chip tail) and pooled over every
     # sample (stalls included) so the filtering is visible, not hidden.
     best_sync, best_p99 = 0.0, float("inf")
@@ -114,7 +115,10 @@ def tpu_pps() -> tuple[float, float, float, dict]:
     estimators = {"sync_best_pass": best_sync,
                   "min_latency": BATCH / min_lat,
                   "pipelined": best_pipelined}
-    return max(estimators.values()), best_p99, pooled_p99, estimators
+    # Headline the pipelined estimator: it is a genuinely sustained
+    # measurement (50 launches in flight), where min_latency extrapolates
+    # one best-case round trip and sync pays a full drain per launch.
+    return estimators["pipelined"], best_p99, pooled_p99, estimators
 
 
 def cpu_pps() -> float:

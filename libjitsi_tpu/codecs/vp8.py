@@ -211,6 +211,13 @@ class FrameAssembler:
     (rtp_ts, picture_id, is_keyframe, frame_bytes) in timestamp order.
     """
 
+    # A 16 KiB-fragment frame at 512 fragments is ~8 MB — far beyond any
+    # real VP8 frame.  Larger start→end seq spans can only come from
+    # corrupt/hostile S-bit/marker packets; without the bound a forged
+    # span of up to 65536 makes every _is_complete call walk the span
+    # (quadratic across calls) before eviction engages.
+    MAX_FRAGMENTS = 512
+
     def __init__(self, max_pending: int = 32):
         self.max_pending = max_pending
         # keys are UNWRAPPED timestamps (the 32-bit RTP ts starts at a
@@ -225,6 +232,7 @@ class FrameAssembler:
         self.dropped_incomplete = 0   # evicted waiting on lost packets
         self.dropped_backlog = 0      # complete but never popped (4x cap)
         self.dropped_late = 0         # completed after a newer delivery
+        self.dropped_corrupt = 0      # start→end span > MAX_FRAGMENTS
 
     def _unwrap_ts(self, ts: int) -> int:
         if self._ts_last >= 0:
@@ -250,12 +258,27 @@ class FrameAssembler:
             slot = self._pending.setdefault(ts, {})
             meta = self._meta.setdefault(ts, [None, None, -1, False])
             slot[seq] = frag
+            if len(slot) > self.MAX_FRAGMENTS:
+                # fragment flood on one ts (unique seqs, no S/marker pair
+                # to trip the span check): a real frame never has this
+                # many fragments, so drop the whole entry
+                del self._pending[ts]
+                del self._meta[ts]
+                self.dropped_corrupt += 1
+                continue
             if desc.start_of_partition[i] == 1 and desc.partition_id[i] == 0:
                 meta[0] = seq
                 meta[2] = int(desc.picture_id[i])
                 meta[3] = bool(desc.is_keyframe[i])
             if hdr.marker[i]:
                 meta[1] = seq
+            if (meta[0] is not None and meta[1] is not None
+                    and ((meta[1] - meta[0]) & 0xFFFF) + 1
+                    > self.MAX_FRAGMENTS):
+                del self._pending[ts]
+                del self._meta[ts]
+                self.dropped_corrupt += 1
+                continue
         # bound memory two-tier: INCOMPLETE frames older than the newest
         # entry (stalled gaps) evict oldest-first at max_pending — the
         # newest frame is still arriving and is never a victim below the
@@ -282,6 +305,8 @@ class FrameAssembler:
         if start is None or end is None:
             return False
         n = ((end - start) & 0xFFFF) + 1
+        if n > self.MAX_FRAGMENTS:    # corrupt span; never completes
+            return False
         slot = self._pending[ts]
         return all(((start + k) & 0xFFFF) in slot for k in range(n))
 

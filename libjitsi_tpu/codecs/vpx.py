@@ -215,6 +215,20 @@ class VpxEncoder:
         if lib.vpx_codec_enc_config_default(self._iface, cfg, 0) \
                 != VPX_CODEC_OK:
             raise RuntimeError("vpx enc_config_default failed")
+        # The offsets below are patched blind, so validate the layout
+        # first: libvpx 1.x's defaults at those offsets are g_w=320,
+        # g_h=240, g_timebase=1/30.  A build whose cfg prefix differs
+        # must fail loudly here, not encode at silently wrong
+        # dimensions/timebase.
+        def _peek(off: int) -> int:
+            return ctypes.c_uint.from_buffer_copy(cfg, off).value
+        got = (_peek(_CFG_G_W), _peek(_CFG_G_H),
+               _peek(_CFG_G_TIMEBASE_NUM), _peek(_CFG_G_TIMEBASE_DEN))
+        if got != (320, 240, 1, 30):
+            raise RuntimeError(
+                f"vpx_codec_enc_cfg_t layout mismatch: defaults at "
+                f"g_w/g_h/g_timebase offsets read {got}, want "
+                "(320, 240, 1, 30); refusing to patch raw offsets")
         for off, val in ((_CFG_G_W, width), (_CFG_G_H, height),
                          (_CFG_G_TIMEBASE_NUM, 1),
                          (_CFG_G_TIMEBASE_DEN, fps)):

@@ -59,13 +59,25 @@ def g711_codec(ulaw: bool = True, ptime_ms: int = 20) -> FrameCodec:
 
 
 def g722_codec(ptime_ms: int = 20) -> FrameCodec:
-    from libjitsi_tpu.codecs import g722
+    from libjitsi_tpu.codecs.g722 import G722Decoder, G722Encoder
 
     n = 16000 * ptime_ms // 1000
+    # G.722 is stateful sub-band ADPCM: predictor/scale-factor state must
+    # persist across the stream's frames, so hold one encoder+decoder for
+    # the codec's lifetime (like gsm_codec) rather than the one-shot
+    # helpers, which reset state every 20 ms.
+    enc, dec = G722Encoder(1), G722Decoder(1)
+
+    def do_enc(pcm):
+        return enc.encode(
+            np.asarray(pcm, np.int16).reshape(1, -1))[0].tobytes()
+
+    def do_dec(b):
+        code = np.frombuffer(b, dtype=np.uint8).reshape(1, -1)
+        return dec.decode(code)[0]
+
     # RFC 3551 §4.5.2: G722's RTP clock is 8000 despite 16 kHz sampling
-    return FrameCodec("G722", 9, 16000, n, n // 2,
-                      lambda pcm: g722.encode(np.asarray(pcm, np.int16)),
-                      lambda b: g722.decode(b))
+    return FrameCodec("G722", 9, 16000, n, n // 2, do_enc, do_dec)
 
 
 def gsm_codec() -> FrameCodec:

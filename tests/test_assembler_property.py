@@ -85,3 +85,47 @@ def test_assembler_random_network(seed):
     # sanity: the harness isn't vacuous — most complete frames deliver
     if len(complete) >= 5:
         assert len(got_idx) >= len(complete) // 2
+
+
+def test_assembler_drops_corrupt_seq_span():
+    """A forged S-bit/marker pair spanning >MAX_FRAGMENTS seqs must be
+    dropped as corrupt, not walked fragment-by-fragment."""
+    rng = np.random.default_rng(0)
+    frame = _mk_frame(rng, 0)
+    pls = vp8.packetize(frame, picture_id=0x4001, max_payload=200)
+    assert len(pls) >= 2
+    fa = vp8.FrameAssembler()
+    # start fragment at seq 100, marker fragment at seq 100+5000: the
+    # implied span (5001) is unsatisfiable and hostile
+    fa.push_batch(rtp_header.build(
+        [pls[0], pls[-1]], [100, (100 + 5000) & 0xFFFF], [7000, 7000],
+        [5, 5], [96, 96], marker=[0, 1]))
+    assert fa.dropped_corrupt == 1
+    assert fa.pop_frames() == []
+    assert 7000 not in getattr(fa, "_pending")
+
+    # a sane frame right after still assembles
+    seqs = list(range(200, 200 + len(pls)))
+    mks = [0] * (len(pls) - 1) + [1]
+    fa.push_batch(rtp_header.build(
+        pls, seqs, [10000] * len(pls), [5] * len(pls), [96] * len(pls),
+        marker=mks))
+    got = fa.pop_frames()
+    assert len(got) == 1 and got[0][3] == frame
+
+
+def test_assembler_drops_single_ts_fragment_flood():
+    """Unique-seq fragments on one ts with no S/marker pair must be
+    bounded by MAX_FRAGMENTS, not accumulate 64k entries."""
+    rng = np.random.default_rng(1)
+    frame = _mk_frame(rng, 1)
+    pls = vp8.packetize(frame, picture_id=0x4002, max_payload=200)
+    mid = pls[1] if len(pls) > 2 else pls[0]   # no S-bit, no marker
+    fa = vp8.FrameAssembler()
+    cap = vp8.FrameAssembler.MAX_FRAGMENTS
+    n = cap + 8
+    fa.push_batch(rtp_header.build(
+        [mid] * n, list(range(n)), [5000] * n, [5] * n, [96] * n,
+        marker=[0] * n))
+    assert fa.dropped_corrupt >= 1
+    assert all(len(s) <= cap for s in fa._pending.values())

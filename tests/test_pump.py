@@ -204,3 +204,28 @@ def test_send_pump_rejects_rate_mismatch():
                      g711_codec())
     finally:
         libjitsi_tpu.stop()
+
+
+def test_g722_pump_codec_is_stateful_across_frames():
+    """G.722 is sub-band ADPCM: predictor state must persist per stream.
+
+    The pump codec's output over two consecutive frames must equal one
+    continuous stateful encode of the concatenated PCM (and differ from
+    what per-frame reset encoders would produce)."""
+    from libjitsi_tpu.codecs.g722 import G722Decoder, G722Encoder
+    from libjitsi_tpu.codecs.g722 import encode as oneshot_encode
+    from libjitsi_tpu.service.pump import g722_codec
+
+    rng = np.random.default_rng(3)
+    pcm = rng.integers(-8000, 8000, 640, dtype=np.int16)
+    c = g722_codec()
+    f1, f2 = c.encode(pcm[:320]), c.encode(pcm[320:])
+    ref = G722Encoder(1).encode(pcm.reshape(1, -1))[0].tobytes()
+    assert f1 + f2 == ref
+    assert f2 != oneshot_encode(pcm[320:])   # reset-per-frame is wrong
+
+    d = g722_codec()
+    out = np.concatenate([d.decode(f1), d.decode(f2)])
+    refd = G722Decoder(1).decode(
+        np.frombuffer(ref, np.uint8).reshape(1, -1))[0]
+    assert np.array_equal(out, refd)
