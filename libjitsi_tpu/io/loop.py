@@ -30,10 +30,9 @@ from libjitsi_tpu.utils.metrics import MetricsRegistry
 
 
 def _is_rtcp(data: np.ndarray, length: np.ndarray) -> np.ndarray:
-    """RFC 5761 demux: PT in [192, 223] marks RTCP on a muxed port."""
-    pt = data[:, 1] & 0x7F
-    m = data[:, 1] >= 192  # 200..207 have the marker-bit position set
-    return (length >= 8) & ((data[:, 1] >= 192) & (data[:, 1] <= 223))
+    """RFC 5761 demux: full second byte in [192, 223] marks RTCP on a
+    muxed port (RTCP PTs 200..207 occupy the M-bit+PT bit positions)."""
+    return (length >= 8) & (data[:, 1] >= 192) & (data[:, 1] <= 223)
 
 
 class MediaLoop:

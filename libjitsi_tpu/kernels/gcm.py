@@ -205,3 +205,57 @@ def gcm_unprotect(data, length, aad_len, round_keys, gmat, iv12,
     else:
         dec = ctr_crypt_offset(round_keys, ctr0, data, aad_len, ct_len)
     return dec, mlen, auth_ok
+
+
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def gcm_protect_fanout(data, length, round_keys, gmat, iv12,
+                       aad_const: int = 12):
+    """Full-mesh SFU seal: P packets x G receiver legs in one launch.
+
+    data [P, W] uint8 — the SAME decrypted packets go to every leg;
+    length [P] int32; round_keys [G, R, 16]; gmat [G, 128, 128] int8
+    (one GHASH matrix per LEG, read once per leg via `ghash_grouped`
+    instead of once per output row); iv12 [G, P, 12] (leg salt x sender
+    ssrc/index).  Returns (out [G, P, W], out_len [P] + 16).
+    """
+    from libjitsi_tpu.kernels.ghash import ghash_grouped
+
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    g = round_keys.shape[0]
+    p, w = data.shape
+    rows = g * p
+    data_gp = jnp.broadcast_to(data[None], (g, p, w)).reshape(rows, w)
+    rk_rows = jnp.repeat(jnp.asarray(round_keys), p, axis=0)
+    j0 = _j0(jnp.asarray(iv12).reshape(rows, 12))
+    ctr0 = _inc32(j0)
+    length_r = jnp.tile(length, g)
+    ct_len = length_r - aad_const
+    enc = ctr_crypt_uniform(rk_rows, ctr0, data_gp, aad_const, ct_len)
+    width = _ghash_width(w)
+    gin, nblk = _build_ghash_input_uniform(enc, aad_const, ct_len, width)
+    s = ghash_grouped(jnp.asarray(gmat), gin.reshape(g, p, width),
+                      nblk.reshape(g, p), width // 16)
+    ek_j0 = aes_encrypt(rk_rows, j0)
+    tag = jnp.bitwise_xor(s.reshape(rows, 16), ek_j0)
+    out = _scatter_tag(enc, length_r, tag)
+    return out.reshape(g, p, w), length + TAG_LEN
+
+
+def srtp_gcm_iv(salt12: np.ndarray, ssrc: np.ndarray,
+                index: np.ndarray) -> np.ndarray:
+    """RFC 7714 §8.1 SRTP IV: (00 00 || SSRC || ROC || SEQ) XOR salt.
+
+    Host-side, broadcast-capable: `salt12` [..., 12] uint8 is copied and
+    XORed with `ssrc` (4 bytes at offsets 2..5) and the 48-bit `index`
+    (offsets 6..11).  Single IV-construction source for the stream table
+    and the SFU translator — nonce layout must never diverge.
+    """
+    iv = np.array(salt12[..., :12], dtype=np.uint8, copy=True)
+    ssrc = np.asarray(ssrc, dtype=np.int64)
+    index = np.asarray(index, dtype=np.int64)
+    for k in range(4):
+        iv[..., 2 + k] ^= ((ssrc >> (8 * (3 - k))) & 0xFF).astype(np.uint8)
+    for k in range(6):
+        iv[..., 6 + k] ^= ((index >> (8 * (5 - k))) & 0xFF).astype(np.uint8)
+    return iv

@@ -52,6 +52,30 @@ def ghash_matrix(h_block: bytes) -> np.ndarray:
     return m
 
 
+def ghash_matrix_batch(h_blocks: np.ndarray) -> np.ndarray:
+    """Vectorized `ghash_matrix`: [S, 16] uint8 H blocks -> [S, 128, 128].
+
+    Column j of M_H is H * x^j in GF(2^128); successive columns follow by
+    one right-shift + conditional reduction, so the whole matrix builds in
+    128 vector steps across all S streams (vs the scalar version's
+    128x128 Python loop per stream — the GCM install-plane bottleneck).
+    """
+    hb = np.atleast_2d(np.asarray(h_blocks, dtype=np.uint8))
+    s = hb.shape[0]
+    # [S, 128] bit vectors, bit 0 = MSB of byte 0 (SP 800-38D order)
+    col = np.unpackbits(hb, axis=1)
+    rbits = np.unpackbits(
+        np.frombuffer(_R.to_bytes(16, "big"), dtype=np.uint8))
+    m = np.zeros((s, 128, 128), dtype=np.uint8)
+    for j in range(128):
+        m[:, :, j] = col
+        lsb = col[:, 127:128]                  # coefficient of x^127
+        col = np.concatenate(
+            [np.zeros((s, 1), dtype=np.uint8), col[:, :-1]], axis=1)
+        col = col ^ (lsb * rbits[None, :])
+    return m
+
+
 def ghash_ref(h_block: bytes, data: bytes) -> bytes:
     """Host reference GHASH over a whole (block-aligned) byte string."""
     if len(data) % 16:
@@ -106,3 +130,32 @@ def ghash(matrices, data, nblocks, nblk_max: int):
 
     y = jax.lax.fori_loop(0, nblk_max, body, y)
     return _bits_to_bytes(y)
+
+
+def ghash_grouped(matrices, data, nblocks, nblk_max: int):
+    """Grouped GHASH: G legs x P rows sharing one M_H per leg.
+
+    matrices: int8 [G, 128, 128]; data: uint8 [G, P, nblk_max*16];
+    nblocks: int32 [G, P].  Returns uint8 [G, P, 16].
+
+    The per-row form (`ghash`) gathers a 16 KiB matrix PER ROW — for an
+    SFU fan-out of P packets x G receivers that is P x G x 16 KiB of HBM
+    traffic for key material alone, and it capped the GCM launch size.
+    Here each leg's matrix is read once and applied to all its rows as
+    one [128,128] x [128, P] MXU matmul per Horner step.
+    """
+    g, p, _ = data.shape
+    y = jnp.zeros((g, p, 128), dtype=jnp.int8)
+
+    def body(i, y):
+        blk = jax.lax.dynamic_slice_in_dim(data, i * 16, 16, axis=2)
+        x = _bytes_to_bits(blk.reshape(g * p, 16)).reshape(g, p, 128)
+        t = jnp.bitwise_xor(y, x)
+        prod = jnp.einsum("gij,gpj->gpi", matrices, t,
+                          preferred_element_type=jnp.int32)
+        y2 = (prod & 1).astype(jnp.int8)
+        active = (i < nblocks)[..., None]
+        return jnp.where(active, y2, y)
+
+    y = jax.lax.fori_loop(0, nblk_max, body, y)
+    return _bits_to_bytes(y.reshape(g * p, 128)).reshape(g, p, 16)

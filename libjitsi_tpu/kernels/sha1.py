@@ -167,32 +167,63 @@ def hmac_precompute(key: bytes) -> np.ndarray:
     return np.stack(states).astype(np.uint32)
 
 
-def _compress_block_np(h: np.ndarray, w16: np.ndarray) -> np.ndarray:
-    """One SHA-1 compression on host (numpy scalar; cold path only)."""
+def hmac_precompute_batch(keys: np.ndarray) -> np.ndarray:
+    """Vectorized `hmac_precompute`: [S, kl<=64] uint8 -> [S, 2, 5] uint32.
+
+    The install plane's form (bulk conference joins, 10k-stream
+    bootstrap): both pad blocks of every key compress in one vectorized
+    pass instead of a per-key Python loop.
+    """
+    keys = np.atleast_2d(np.asarray(keys, dtype=np.uint8))
+    s, kl = keys.shape
+    if kl > BLOCK:
+        raise ValueError("batched HMAC keys must be <= one block (64B)")
+    k = np.zeros((s, BLOCK), dtype=np.uint8)
+    k[:, :kl] = keys
+    out = np.zeros((s, 2, 5), dtype=np.uint32)
+    for row, pad in enumerate((0x36, 0x5C)):
+        blk = (k ^ pad).astype(np.uint32).reshape(s, 16, 4)
+        w16 = ((blk[..., 0] << 24) | (blk[..., 1] << 16)
+               | (blk[..., 2] << 8) | blk[..., 3])
+        out[:, row] = _compress_blocks_np(_H0, w16)
+    return out
+
+
+def _compress_blocks_np(h: np.ndarray, w16: np.ndarray) -> np.ndarray:
+    """SHA-1 compression on host, vectorized over lanes (cold path only).
+
+    h: [5] or [S, 5] uint32 initial state; w16: [S, 16] uint32 words.
+    """
     mask = np.uint64(0xFFFFFFFF)
+    w16 = np.atleast_2d(w16)
+    s = w16.shape[0]
+    h = np.broadcast_to(np.asarray(h, dtype=np.uint32), (s, 5))
 
     def rotl(x, n):
-        x = int(x) & 0xFFFFFFFF
-        return ((x << n) | (x >> (32 - n))) & 0xFFFFFFFF
+        return ((x << np.uint64(n)) | (x >> np.uint64(32 - n))) & mask
 
-    w = [int(w16[t]) for t in range(16)]
+    w = [w16[:, t].astype(np.uint64) for t in range(16)]
     for t in range(16, 80):
         w.append(rotl(w[t - 3] ^ w[t - 8] ^ w[t - 14] ^ w[t - 16], 1))
-    a, b, c, d, e = (int(h[i]) for i in range(5))
-    K = (0x5A827999, 0x6ED9EBA1, 0x8F1BBCDC, 0xCA62C1D6)
+    a, b, c, d, e = (h[:, i].astype(np.uint64) for i in range(5))
+    K = (np.uint64(0x5A827999), np.uint64(0x6ED9EBA1),
+         np.uint64(0x8F1BBCDC), np.uint64(0xCA62C1D6))
     for t in range(80):
         if t < 20:
-            f = (b & c) | (~b & d & 0xFFFFFFFF)
-        elif t < 40:
+            f = (b & c) | (~b & d & mask)
+        elif t < 40 or t >= 60:
             f = b ^ c ^ d
-        elif t < 60:
-            f = (b & c) | (b & d) | (c & d)
         else:
-            f = b ^ c ^ d
-        tmp = (rotl(a, 5) + f + e + K[t // 20] + w[t]) & 0xFFFFFFFF
+            f = (b & c) | (b & d) | (c & d)
+        tmp = (rotl(a, 5) + f + e + K[t // 20] + w[t]) & mask
         a, b, c, d, e = tmp, a, rotl(b, 30), c, d
-    out = np.array([a, b, c, d, e], dtype=np.uint64)
+    out = np.stack([a, b, c, d, e], axis=1)
     return ((out + h.astype(np.uint64)) & mask).astype(np.uint32)
+
+
+def _compress_block_np(h: np.ndarray, w16: np.ndarray) -> np.ndarray:
+    """One SHA-1 compression on host (scalar shim over the batch form)."""
+    return _compress_blocks_np(h, np.asarray(w16)[None])[0]
 
 
 @jax.jit

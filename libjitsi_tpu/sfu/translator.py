@@ -27,7 +27,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from libjitsi_tpu.core.packet import PacketBatch
-from libjitsi_tpu.kernels.aes import expand_key
+from libjitsi_tpu.kernels import gcm as gcm_kernel
+from libjitsi_tpu.kernels.aes import aes_encrypt_np, expand_key
+from libjitsi_tpu.kernels.ghash import ghash_matrix
 from libjitsi_tpu.kernels.sha1 import hmac_precompute
 from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.transform.srtp import kernel
@@ -44,6 +46,14 @@ def _fanout_protect(tab_rk, tab_mid, recv, data, length, payload_off, iv,
         tag_len, encrypt)
 
 
+@functools.partial(jax.jit, static_argnames=("aad_const",), donate_argnums=(3,))
+def _fanout_protect_gcm(tab_rk, tab_gm, recv, data, length, aad_len, iv12,
+                        aad_const=None):
+    return gcm_kernel.gcm_protect(
+        data, length, aad_len, tab_rk[recv], tab_gm[recv], iv12,
+        aad_const=aad_const)
+
+
 class RtpTranslator:
     """Decrypt-once / re-encrypt-N fan-out over a receiver key table.
 
@@ -57,13 +67,17 @@ class RtpTranslator:
                  profile: SrtpProfile = SrtpProfile.AES_CM_128_HMAC_SHA1_80):
         self.profile = profile
         self.policy = profile.policy
-        if self.policy.cipher == Cipher.AES_GCM:
-            raise NotImplementedError("AEAD-GCM fan-out lands with GCM kernel")
+        self._gcm = self.policy.cipher == Cipher.AES_GCM
         rounds = {16: 11, 32: 15}[self.policy.enc_key_len]
         self.capacity = capacity
         self.active = np.zeros(capacity, dtype=bool)
         self._rk = np.zeros((capacity, rounds, 16), dtype=np.uint8)
         self._mid = np.zeros((capacity, 2, 5), dtype=np.uint32)
+        if self._gcm:
+            # per-LEG GHASH matrix (H = AES_K(0), RFC 7714) — a leg
+            # constant like the HMAC midstates, gathered or (full-mesh)
+            # applied group-wise by `ghash_grouped`
+            self._gm = np.zeros((capacity, 128, 128), dtype=np.int8)
         self._salt = np.zeros((capacity, 16), dtype=np.uint8)
         self._dev = None
         # routing: sender sid -> sorted receiver id array
@@ -77,7 +91,12 @@ class RtpTranslator:
             master_key, master_salt, enc_key_len=p.enc_key_len,
             auth_key_len=p.auth_key_len, salt_len=p.salt_len)
         self._rk[rid] = expand_key(ks.rtp_enc)
-        self._mid[rid] = hmac_precompute(ks.rtp_auth)
+        if self._gcm:
+            h = bytes(aes_encrypt_np(self._rk[rid],
+                                     np.zeros((1, 16), np.uint8))[0])
+            self._gm[rid] = ghash_matrix(h).astype(np.int8)
+        else:
+            self._mid[rid] = hmac_precompute(ks.rtp_auth)
         self._salt[rid, : p.salt_len] = np.frombuffer(ks.rtp_salt, np.uint8)
         self._salt[rid, p.salt_len:] = 0
         self.active[rid] = True
@@ -87,6 +106,8 @@ class RtpTranslator:
         self.active[rid] = False
         self._rk[rid] = 0
         self._mid[rid] = 0
+        if self._gcm:
+            self._gm[rid] = 0
         self._dev = None
         for s, rr in list(self._routes.items()):
             self._routes[s] = rr[rr != rid]
@@ -103,7 +124,8 @@ class RtpTranslator:
 
     def _device(self):
         if self._dev is None:
-            self._dev = (jnp.asarray(self._rk), jnp.asarray(self._mid))
+            aux = self._gm if self._gcm else self._mid
+            self._dev = (jnp.asarray(self._rk), jnp.asarray(aux))
         return self._dev
 
     # ------------------------------------------------------------ fan-out
@@ -150,21 +172,83 @@ class RtpTranslator:
                 batch.capacity:
             raise ValueError("fan-out rows need tag headroom in capacity")
 
-        # per-row IV from the receiver's salt + sender's ssrc/index
-        iv = self._salt[recv].copy()
-        for k in range(4):
-            iv[:, 4 + k] ^= ((ssrc >> (8 * (3 - k))) & 0xFF).astype(np.uint8)
-        for k in range(6):
-            iv[:, 8 + k] ^= ((idx >> (8 * (5 - k))) & 0xFF).astype(np.uint8)
+        if self._gcm:
+            out, out_len = self._translate_gcm(
+                batch, rows, recvs, src, recv, data, length,
+                hdr, payload_off, ssrc, idx)
+        else:
+            # per-row IV from the receiver's salt + sender's ssrc/index
+            iv = self._salt[recv].copy()
+            for k in range(4):
+                iv[:, 4 + k] ^= ((ssrc >> (8 * (3 - k))) & 0xFF
+                                 ).astype(np.uint8)
+            for k in range(6):
+                iv[:, 8 + k] ^= ((idx >> (8 * (5 - k))) & 0xFF
+                                 ).astype(np.uint8)
 
-        tab_rk, tab_mid = self._device()
-        out, out_len = _fanout_protect(
-            tab_rk, tab_mid, jnp.asarray(recv, dtype=jnp.int32),
-            jnp.asarray(data), jnp.asarray(length),
-            jnp.asarray(payload_off), jnp.asarray(iv),
-            jnp.asarray((idx >> 16) & 0xFFFFFFFF, dtype=jnp.uint32),
-            self.policy.auth_tag_len, self.policy.cipher != Cipher.NULL)
+            tab_rk, tab_mid = self._device()
+            out, out_len = _fanout_protect(
+                tab_rk, tab_mid, jnp.asarray(recv, dtype=jnp.int32),
+                jnp.asarray(data), jnp.asarray(length),
+                jnp.asarray(payload_off), jnp.asarray(iv),
+                jnp.asarray((idx >> 16) & 0xFFFFFFFF, dtype=jnp.uint32),
+                self.policy.auth_tag_len,
+                self.policy.cipher != Cipher.NULL)
         wire = PacketBatch(np.asarray(out),
                            np.asarray(out_len, dtype=np.int32),
                            recv.astype(np.int32))
         return wire, recv
+
+    def _translate_gcm(self, batch, rows, recvs, src, recv, data, length,
+                       hdr, payload_off, ssrc, idx):
+        """AEAD fan-out: per-leg H matrices replace HMAC midstates.
+
+        Full-mesh fast path: when every routed sender shares one
+        receiver list and headers are uniform, the (packets x legs)
+        matrix seals via `gcm_protect_fanout` — each leg's 16 KiB GHASH
+        matrix is read once per leg, not once per output row.
+        Reference: RTPTranslatorImpl's cipher-agnostic per-leg
+        transform (SURVEY §3.4).
+        """
+        tab_rk, tab_gm = self._device()
+        off0 = np.asarray(hdr.payload_off)[rows]
+        # the offset bound mirrors _uniform_off: a forged ext_words field
+        # can claim a header larger than the packet; such batches take
+        # the general path, which clamps per row (the packets then die
+        # at the receiving legs, not in our trace)
+        uniform = (len(recvs) > 1 and
+                   all(len(r) == len(recvs[0]) and np.array_equal(
+                       r, recvs[0]) for r in recvs[1:])
+                   and off0.size and np.all(off0 == off0[0])
+                   and 0 <= int(off0[0]) < batch.capacity)
+        if uniform:
+            rr = recvs[0]
+            p_rows = np.asarray(rows, dtype=np.int64)
+            pdata = batch.data[p_rows]
+            plen = np.asarray(batch.length, dtype=np.int32)[p_rows]
+            pssrc = hdr.ssrc[p_rows]
+            pidx = np.asarray(idx).reshape(len(rows), len(rr))[:, 0] \
+                if len(rr) else np.zeros(0, np.int64)
+            # iv [G, P, 12]: leg salt x sender ssrc/index
+            iv = gcm_kernel.srtp_gcm_iv(
+                np.broadcast_to(self._salt[rr][:, None, :12],
+                                (len(rr), len(p_rows), 12)),
+                pssrc[None, :], pidx[None, :])
+            out_gp, out_len_p = gcm_kernel.gcm_protect_fanout(
+                jnp.asarray(pdata), jnp.asarray(plen),
+                tab_rk[jnp.asarray(rr)], tab_gm[jnp.asarray(rr)],
+                jnp.asarray(iv), aad_const=int(off0[0]))
+            # grouped output is leg-major [G, P, W]; the contract is
+            # packet-major rows (p0r0, p0r1, ...) matching `src`/`recv`
+            out = jnp.transpose(out_gp, (1, 0, 2)).reshape(
+                len(p_rows) * len(rr), batch.capacity)
+            out_len = jnp.tile(out_len_p[:, None],
+                               (1, len(rr))).reshape(-1)
+            return out, out_len
+        iv = gcm_kernel.srtp_gcm_iv(self._salt[recv], ssrc, idx)
+        from libjitsi_tpu.transform.srtp.context import _uniform_off
+        return _fanout_protect_gcm(
+            tab_rk, tab_gm, jnp.asarray(recv, dtype=jnp.int32),
+            jnp.asarray(data), jnp.asarray(length),
+            jnp.asarray(payload_off), jnp.asarray(iv),
+            aad_const=_uniform_off(payload_off, batch.capacity))

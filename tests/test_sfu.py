@@ -11,6 +11,7 @@ from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.rtp import rtcp
 from libjitsi_tpu.sfu import PacketCache, RtpTranslator
 from libjitsi_tpu.transform.srtp import SrtpStreamTable
+import pytest
 
 MK_A = bytes(range(16))            # sender A's master key
 MS_A = bytes(range(50, 64))
@@ -24,6 +25,7 @@ def _sender_batch(n=4, ssrc=0xAAA, sid=0):
         [ssrc] * n, [96] * n, stream=[sid] * n)
 
 
+@pytest.mark.slow
 def test_fanout_reencrypts_per_receiver():
     # sender -> SFU leg
     tx = SrtpStreamTable(capacity=4)
@@ -60,6 +62,7 @@ def test_fanout_reencrypts_per_receiver():
     assert c1 != c2
 
 
+@pytest.mark.slow
 def test_fanout_respects_routes_and_removal():
     tr = RtpTranslator(capacity=8)
     for r, (mk, ms) in RECV_KEYS.items():
@@ -173,3 +176,118 @@ def test_rtcp_termination_aggregates_and_throttles():
     # a leaving bottleneck receiver releases the REMB cap
     t.forget_receiver(1)
     assert t.min_remb(media) == 2_000_000
+
+
+# --------------------------------------------------------- GCM fan-out ---
+
+GCM_RECV_KEYS = {r: (bytes([r] * 16), bytes([r + 100] * 12))
+                 for r in (1, 2, 3)}
+
+
+def _gcm_fanout_roundtrip(routes):
+    """Protect with a GCM sender, fan out, decrypt each leg, compare."""
+    from libjitsi_tpu.transform.srtp import SrtpProfile
+
+    prof = SrtpProfile.AEAD_AES_128_GCM
+    mk_a, ms_a = bytes(range(16)), bytes(range(50, 62))
+    tx = SrtpStreamTable(capacity=4, profile=prof)
+    tx.add_stream(0, mk_a, ms_a)
+    rx = SrtpStreamTable(capacity=4, profile=prof)
+    rx.add_stream(0, mk_a, ms_a)
+    wire_in = tx.protect_rtp(_sender_batch())
+    dec, ok, idx = rx.unprotect_rtp(wire_in, return_index=True)
+    assert ok.all()
+
+    tr = RtpTranslator(capacity=8, profile=prof)
+    for r, (mk, ms) in GCM_RECV_KEYS.items():
+        tr.add_receiver(r, mk, ms)
+    for sid, rr in routes.items():
+        tr.connect(sid, rr)
+    out, recv = tr.translate(dec, idx)
+    n_legs = len(routes[0])
+    assert out.batch_size == 4 * n_legs
+
+    for r in routes[0]:
+        mk, ms = GCM_RECV_KEYS[r]
+        leg = SrtpStreamTable(capacity=8, profile=prof)
+        leg.add_stream(5, mk, ms)
+        rows = np.nonzero(recv == r)[0]
+        sub = PacketBatch.from_payloads(
+            [out.to_bytes(i) for i in rows], stream=[5] * len(rows))
+        dec_r, ok_r = leg.unprotect_rtp(sub)
+        assert ok_r.all(), f"receiver {r} failed GCM auth"
+        for j in range(len(rows)):
+            assert dec_r.to_bytes(j) == dec.to_bytes(j)
+    c1 = out.to_bytes(int(np.nonzero(recv == routes[0][0])[0][0]))
+    c2 = out.to_bytes(int(np.nonzero(recv == routes[0][1])[0][0]))
+    assert c1 != c2
+    return tr
+
+
+@pytest.mark.slow
+def test_gcm_fanout_full_mesh_grouped_path():
+    """Uniform routes take the grouped (per-leg H matrix) kernel; every
+    leg must still open the AEAD against its own session keys."""
+    _gcm_fanout_roundtrip({0: [1, 2, 3]})
+
+
+@pytest.mark.slow
+def test_gcm_fanout_general_path_matches_grouped():
+    """Non-uniform routes fall back to the per-row gather path; the
+    ciphertext for a shared (packet, receiver) pair must be identical
+    to the grouped path's (same keys, same IVs => same AEAD output)."""
+    from libjitsi_tpu.transform.srtp import SrtpProfile
+
+    prof = SrtpProfile.AEAD_AES_128_GCM
+    mk_a, ms_a = bytes(range(16)), bytes(range(50, 62))
+    rx = SrtpStreamTable(capacity=4, profile=prof)
+    rx.add_stream(0, mk_a, ms_a)
+    tx = SrtpStreamTable(capacity=4, profile=prof)
+    tx.add_stream(0, mk_a, ms_a)
+    wire_in = tx.protect_rtp(_sender_batch())
+    dec, ok, idx = rx.unprotect_rtp(wire_in, return_index=True)
+
+    tr = RtpTranslator(capacity=8, profile=prof)
+    for r, (mk, ms) in GCM_RECV_KEYS.items():
+        tr.add_receiver(r, mk, ms)
+    tr.connect(0, [1, 2, 3])
+    out_grouped, recv_g = tr.translate(dec, idx)
+
+    # force the general path: batch with two senders, different routes
+    tr2 = RtpTranslator(capacity=8, profile=prof)
+    for r, (mk, ms) in GCM_RECV_KEYS.items():
+        tr2.add_receiver(r, mk, ms)
+    tr2.connect(0, [1, 2])
+    tr2.connect(9, [3])
+    two = rtp_header.build(
+        [dec.to_bytes(0)[12:], b"other-sender"],
+        [1000, 7], [0, 0], [0xAAA, 0xBBB], [96, 96], stream=[0, 9])
+    out_mixed, recv_m = tr2.translate(two, np.array([int(idx[0]), 7]))
+    assert sorted(np.unique(recv_m)) == [1, 2, 3]
+    # packet 0 to receiver 1: identical bytes via either path
+    g_row = int(np.nonzero(recv_g == 1)[0][0])
+    m_row = int(np.nonzero(recv_m == 1)[0][0])
+    assert out_grouped.to_bytes(g_row) == out_mixed.to_bytes(m_row)
+
+
+def test_gcm_fanout_forged_ext_header_does_not_crash():
+    """A (validly authenticated) packet whose X/ext_words claims a header
+    bigger than the packet must not crash translate(): the grouped fast
+    path's static-offset gate rejects it and the general path clamps."""
+    from libjitsi_tpu.transform.srtp import SrtpProfile
+
+    prof = SrtpProfile.AEAD_AES_128_GCM
+    tr = RtpTranslator(capacity=8, profile=prof)
+    for r, (mk, ms) in GCM_RECV_KEYS.items():
+        tr.add_receiver(r, mk, ms)
+    tr.connect(0, [1, 2, 3])
+    b = _sender_batch(n=2)
+    # forge X=1 + huge ext_words on both rows (same offset -> would take
+    # the uniform path if the bound didn't gate it)
+    for i in range(2):
+        b.data[i, 0] |= 0x10                    # X bit
+        b.data[i, 12:14] = (0xBE, 0xDE)         # ext profile
+        b.data[i, 14] = 0x03                    # ext_words hi
+        b.data[i, 15] = 0xE8                    # 0x3E8 = 1000 words
+    out, recv = tr.translate(b, np.array([1000, 1001]))
+    assert out.batch_size == 2 * 3              # processed, not crashed

@@ -132,6 +132,7 @@ def test_protect_differential_vs_oracle(profile, tag_len):
         assert out.to_bytes(i) == expected, f"packet {i} mismatch"
 
 
+@pytest.mark.slow
 def test_roundtrip_and_auth_failure():
     t_tx = make_table()
     t_rx = make_table()
@@ -188,6 +189,7 @@ def test_replay_in_batch_duplicate():
     assert ok.sum() == 1 and ok[0]
 
 
+@pytest.mark.slow
 def test_replay_window_reorder_and_too_old():
     t_tx, t_rx = make_table(), make_table()
     pkts = {s: rtp_pkt(s) for s in range(0, 200)}
@@ -262,6 +264,7 @@ def test_forged_packet_does_not_poison_established_stream():
     assert not ok[0] and ok[1]
 
 
+@pytest.mark.slow
 def test_protect_near_capacity_grows_not_truncates():
     """A packet whose tag would overflow the input capacity gets a
     grown output buffer (size-class headroom), never silent truncation
@@ -289,6 +292,7 @@ def rtcp_sr(ssrc=0x5678, n_extra=40):
     return bytes(body[: 28 + n_extra])
 
 
+@pytest.mark.slow
 def test_rtcp_differential_and_roundtrip():
     t_tx, t_rx = make_table(), make_table()
     pkts = [rtcp_sr(0x5678, 40), rtcp_sr(0x5678, 40), rtcp_sr(0x9999, 12)]
@@ -353,3 +357,61 @@ def test_protect_rejects_unmapped_stream():
     with pytest.raises(KeyError):
         t.protect_rtp(PacketBatch.from_payloads([p], stream=[99]))  # range
     np.testing.assert_array_equal(t.tx_ext, before)
+
+
+# ----------------------------------------------------- batch install ---
+
+def test_add_streams_matches_scalar_install_all_profiles():
+    """The vectorized install plane (bulk joins / restore / bootstrap)
+    must produce bit-identical tables and state to per-stream
+    add_stream, for CM, GCM and F8 profiles, incl. kdr streams."""
+    rng = np.random.default_rng(11)
+    for prof in (SrtpProfile.AES_CM_128_HMAC_SHA1_80,
+                 SrtpProfile.AES_256_CM_HMAC_SHA1_80,
+                 SrtpProfile.AEAD_AES_128_GCM,
+                 SrtpProfile.F8_128_HMAC_SHA1_80):
+        n = 6
+        mks = rng.integers(0, 256, (n, prof.policy.enc_key_len),
+                           dtype=np.uint8)
+        mss = rng.integers(0, 256, (n, prof.policy.salt_len),
+                           dtype=np.uint8)
+        kdrs = np.array([0, 0, 16, 0, 256, 0])
+        t1 = SrtpStreamTable(capacity=n, profile=prof)
+        for i in range(n):
+            t1.add_stream(i, mks[i].tobytes(), mss[i].tobytes(),
+                          kdr=int(kdrs[i]))
+        t2 = SrtpStreamTable(capacity=n, profile=prof)
+        t2.add_streams(np.arange(n), mks, mss, kdr=kdrs)
+        for attr in ('_rk_rtp', '_rk_rtcp', '_mid_rtp', '_mid_rtcp',
+                     '_salt_rtp', '_salt_rtcp', 'tx_ext', 'rx_max',
+                     'rx_mask', 'kdr', 'active'):
+            assert np.array_equal(getattr(t1, attr), getattr(t2, attr)), \
+                (prof, attr)
+        if t1._gcm:
+            assert np.array_equal(t1._gm_rtp, t2._gm_rtp)
+            assert np.array_equal(t1._gm_rtcp, t2._gm_rtcp)
+        if t1._f8:
+            assert np.array_equal(t1._rk_f8_rtp, t2._rk_f8_rtp)
+            assert np.array_equal(t1._rk_f8_rtcp, t2._rk_f8_rtcp)
+        assert t1._masters == t2._masters
+
+
+def test_kdf_batch_matches_scalar_with_epochs():
+    from libjitsi_tpu.transform.srtp.kdf import derive_session_keys_batch
+
+    rng = np.random.default_rng(12)
+    for ekl in (16, 32):
+        mks = rng.integers(0, 256, (5, ekl), dtype=np.uint8)
+        mss = rng.integers(0, 256, (5, 14), dtype=np.uint8)
+        r = np.array([0, 1, 5, 1000, 2**40], dtype=np.int64)
+        rc = np.array([0, 2, 9, 0, 77], dtype=np.int64)
+        ksb = derive_session_keys_batch(mks, mss, enc_key_len=ekl,
+                                        r=r, rc=rc)
+        for i in range(5):
+            want = derive_session_keys(
+                mks[i].tobytes(), mss[i].tobytes(), enc_key_len=ekl,
+                kdr=1, index=int(r[i]), srtcp_index=int(rc[i]))
+            got = ksb.row(i)
+            for f in ('rtp_enc', 'rtp_auth', 'rtp_salt', 'rtcp_enc',
+                      'rtcp_auth', 'rtcp_salt'):
+                assert getattr(got, f) == getattr(want, f), (ekl, i, f)
