@@ -1,0 +1,132 @@
+"""TPU smoke test: run the north-star kernels on the REAL chip.
+
+The pytest suite deliberately forces a virtual CPU mesh (tests/conftest.py),
+so Mosaic/layout regressions that only bite on actual TPU hardware slip
+past it.  This script is the hardware gate: a differential SRTP protect
+(device vs a scalar OpenSSL oracle, byte-identical) and a mixer frame
+(device vs NumPy), both on whatever real accelerator `jax.devices()`
+offers.  Exit 0 = pass.
+
+Run:  python scripts/tpu_smoke.py
+Keep it small: one tiny batch per kernel so cold compiles stay short.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from libjitsi_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()
+
+
+# -- scalar RFC 3711 oracle (OpenSSL via `cryptography`; no shared code
+#    with the device path) --------------------------------------------------
+
+def _aes_ctr(key: bytes, iv16: bytes, data: bytes) -> bytes:
+    from cryptography.hazmat.primitives.ciphers import (Cipher, algorithms,
+                                                        modes)
+
+    enc = Cipher(algorithms.AES(key), modes.CTR(iv16)).encryptor()
+    return enc.update(data) + enc.finalize()
+
+
+def _kdf(mk: bytes, ms: bytes, label: int, n: int) -> bytes:
+    x = int.from_bytes(ms, "big") ^ (label << 48)
+    return _aes_ctr(mk, (x << 16).to_bytes(16, "big"), b"\x00" * n)
+
+
+def _protect_oracle(mk: bytes, ms: bytes, pkt: bytes, index: int,
+                    tag_len: int) -> bytes:
+    ke = _kdf(mk, ms, 0, len(mk))
+    ka = _kdf(mk, ms, 1, 20)
+    ksalt = int.from_bytes(_kdf(mk, ms, 2, 14), "big")
+    cc = pkt[0] & 0x0F
+    off = 12 + 4 * cc
+    ssrc = int.from_bytes(pkt[8:12], "big")
+    iv = ((ksalt << 16) ^ (ssrc << 64) ^ (index << 16)).to_bytes(16, "big")
+    ct = pkt[:off] + _aes_ctr(ke, iv, pkt[off:])
+    roc = index >> 16
+    tag = hmac_mod.new(ka, ct + roc.to_bytes(4, "big"),
+                       hashlib.sha1).digest()
+    return ct + tag[:tag_len]
+
+
+def smoke_srtp(platform: str) -> None:
+    from libjitsi_tpu.core.packet import PacketBatch
+    from libjitsi_tpu.rtp import header as rtp_header
+    from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+    mk, ms = bytes(range(16)), bytes(range(100, 114))
+    table = SrtpStreamTable(capacity=4)
+    for i in range(4):
+        table.add_stream(i, mk, ms)
+
+    rng = np.random.default_rng(42)
+    pkts, sids = [], []
+    for i in range(16):
+        payload = bytes(rng.integers(0, 256, 40 + i, dtype=np.uint8))
+        b = rtp_header.build([payload], [100 + i // 4], [3000],
+                             [0x1000 + i % 4], [96])
+        pkts.append(b.to_bytes(0))
+        sids.append(i % 4)
+    batch = PacketBatch.from_payloads(pkts, stream=sids)
+    out = table.protect_rtp(batch)
+
+    per_seq = {}
+    for i in range(16):
+        sid = sids[i]
+        seq = 100 + i // 4
+        want = _protect_oracle(mk, ms, pkts[i], seq, 10)
+        got = out.to_bytes(i)
+        assert got == want, (
+            f"device SRTP != oracle on {platform} (row {i}): "
+            f"{got.hex()[:40]} vs {want.hex()[:40]}")
+        per_seq[sid] = seq
+    print(f"[smoke] SRTP protect: 16 packets byte-identical to OpenSSL "
+          f"oracle on {platform}")
+
+
+def smoke_mixer(platform: str) -> None:
+    import jax
+
+    from libjitsi_tpu.conference.mixer import mix_minus
+
+    rng = np.random.default_rng(7)
+    pcm = rng.integers(-20000, 20000, (8, 160)).astype(np.int16)
+    active = np.ones(8, dtype=bool)
+    mixed, levels = mix_minus(pcm, active)
+    jax.block_until_ready(mixed)
+    total = pcm.astype(np.int64).sum(axis=0)
+    want = np.clip(total[None, :] - pcm.astype(np.int64), -32768, 32767)
+    assert np.array_equal(np.asarray(mixed, np.int64), want), \
+        f"mixer mix-minus != host reference on {platform}"
+    assert np.asarray(levels).shape == (8,)
+    print(f"[smoke] mixer mix-minus frame matches host reference on "
+          f"{platform}")
+
+
+def main() -> int:
+    import jax
+
+    dev = jax.devices()[0]
+    platform = dev.platform
+    print(f"[smoke] device: {dev} (platform={platform})")
+    if platform == "cpu":
+        print("[smoke] WARNING: no accelerator visible; this run only "
+              "exercises the CPU backend")
+    smoke_srtp(platform)
+    smoke_mixer(platform)
+    print("[smoke] PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,3 +22,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# Persistent XLA compile cache (same one bench.py/__graft_entry__ use):
+# the suite is dominated by CPU XLA compiles; caching them on disk makes
+# re-runs start warm.
+from libjitsi_tpu.utils.compile_cache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()

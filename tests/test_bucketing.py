@@ -9,6 +9,7 @@ from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.transform.engine import TransformEngineChain
 from libjitsi_tpu.transform.header_ext import TransportCCEngine
 from libjitsi_tpu.transform.srtp import SrtpStreamTable
+import pytest
 
 KEY, SALT = bytes(16), bytes(14)
 
@@ -35,6 +36,7 @@ def test_bucket_shapes_and_reassembly_identity():
         assert out.to_bytes(i) == batch.to_bytes(i)
 
 
+@pytest.mark.slow
 def test_unbucket_grows_capacity_for_near_mtu_rows():
     # a 1500B packet + 10B tag must not be truncated on reassembly
     batch = rtp_header.build([b"x" * 1488], [1], [0], [7], [96], stream=[0])
@@ -49,6 +51,7 @@ def test_unbucket_grows_capacity_for_near_mtu_rows():
     assert ok.all() and dec.to_bytes(0) == batch.to_bytes(0)
 
 
+@pytest.mark.slow
 def test_bucketed_srtp_roundtrip_mixed_sizes():
     tx = SrtpStreamTable(capacity=2)
     rx = SrtpStreamTable(capacity=2)
@@ -65,6 +68,7 @@ def test_bucketed_srtp_roundtrip_mixed_sizes():
         assert dec.to_bytes(i) == batch.to_bytes(i)
 
 
+@pytest.mark.slow
 def test_bucketed_equals_wide_single_class():
     """Same keys, same packets: a mixed batch's small row must produce
     the exact bytes a homogeneous small batch produces."""
@@ -80,6 +84,7 @@ def test_bucketed_equals_wide_single_class():
     assert both.to_bytes(0) == lone.to_bytes(0)
 
 
+@pytest.mark.slow
 def test_padding_rows_do_not_advance_state():
     """Row counts that force padding (5 real rows -> 16) must leave
     tx/rx state exactly as an unpadded equivalent run."""
@@ -99,6 +104,7 @@ def test_padding_rows_do_not_advance_state():
     assert bin(int(rx.rx_mask[0])).count("1") == 5
 
 
+@pytest.mark.slow
 def test_sfu_translator_index_passthrough_bucketed():
     """unprotect_rtp(return_index=True) merges per-bucket indices."""
     tx = SrtpStreamTable(capacity=1)
@@ -134,6 +140,7 @@ def test_empty_batch_protect_unprotect():
     assert dec.batch_size == 0 and len(ok) == 0
 
 
+@pytest.mark.slow
 def test_class_exact_row_count_near_mtu():
     """Exactly ROW_CLASSES[0] near-MTU rows must still get headroom (the
     old direct-path shortcut bypassed the padded sub-batch and raised)."""

@@ -18,6 +18,8 @@ from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.transform.srtp import SrtpStreamTable
 
+pytestmark = pytest.mark.slow   # cold-compile-heavy e2e tier
+
 N = 4
 FRAME = 960  # 20 ms @ 48 kHz
 

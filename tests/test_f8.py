@@ -16,6 +16,7 @@ from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.transform.srtp import SrtpStreamTable
 from libjitsi_tpu.transform.srtp.kdf import derive_session_keys
 from libjitsi_tpu.transform.srtp.policy import SrtpProfile
+import pytest
 
 KEY = bytes(range(16))
 SALT = bytes(range(100, 114))
@@ -56,6 +57,7 @@ def _scalar_f8_protect(mk: bytes, ms: bytes, pkt: bytes, roc: int) -> bytes:
     return ct + tag
 
 
+@pytest.mark.slow
 def test_f8_protect_matches_scalar_oracle():
     tx = SrtpStreamTable(capacity=1, profile=SrtpProfile.F8_128_HMAC_SHA1_80)
     tx.add_stream(0, KEY, SALT)
@@ -66,6 +68,7 @@ def test_f8_protect_matches_scalar_oracle():
     assert prot.to_bytes(0) == want
 
 
+@pytest.mark.slow
 def test_f8_rtp_roundtrip_and_tamper():
     tx = SrtpStreamTable(capacity=2, profile=SrtpProfile.F8_128_HMAC_SHA1_80)
     rx = SrtpStreamTable(capacity=2, profile=SrtpProfile.F8_128_HMAC_SHA1_80)
@@ -89,6 +92,7 @@ def test_f8_rtp_roundtrip_and_tamper():
     assert not ok2[2] and ok2[[0, 1, 3, 4, 5]].sum() == 0  # replayed too
 
 
+@pytest.mark.slow
 def test_f8_rtcp_roundtrip():
     tx = SrtpStreamTable(capacity=1, profile=SrtpProfile.F8_128_HMAC_SHA1_80)
     rx = SrtpStreamTable(capacity=1, profile=SrtpProfile.F8_128_HMAC_SHA1_80)

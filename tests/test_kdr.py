@@ -8,6 +8,7 @@ from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.transform.srtp import SrtpStreamTable
 from libjitsi_tpu.transform.srtp.kdf import derive_session_keys
+import pytest
 
 MK = bytes(range(16))
 MS = bytes(range(50, 64))
@@ -108,6 +109,7 @@ def test_kdr_snapshot_restore():
     assert t2.protect_rtp(p40).to_bytes(0) == _oracle_kdr(_pkt(40), 40)
 
 
+@pytest.mark.slow
 def test_kdr_one_every_packet_epoch_no_recursion():
     """kdr=1 (re-key EVERY packet, RFC-legal) over a large batch: the
     wave loop must handle one epoch per row without recursion blowup."""

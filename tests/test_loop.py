@@ -17,6 +17,7 @@ from libjitsi_tpu.rtp import rtcp
 from libjitsi_tpu.service.media_stream import StreamRegistry
 from libjitsi_tpu.transform import SrtpTransformEngine, TransformEngineChain
 from libjitsi_tpu.transform.srtp import SrtpStreamTable
+import pytest
 
 MK, MS = bytes(range(16)), bytes(range(30, 44))
 MK2, MS2 = bytes(range(60, 76)), bytes(range(80, 94))
@@ -28,6 +29,7 @@ def _registry():
     return StreamRegistry(libjitsi_tpu.configuration_service(), capacity=16)
 
 
+@pytest.mark.slow
 def test_bridge_echo_over_udp():
     reg = _registry()
     # bridge rx context (client->bridge key), tx context (bridge->client)

@@ -59,6 +59,7 @@ def test_sdes_answer_selects_common_suite():
     assert a.remote.master_key == b.local.master_key
 
 
+@pytest.mark.slow
 def test_e2e_media_roundtrip(svc):
     a, b = make_pair(svc)
     payloads = [b"opus-frame-%02d" % i for i in range(8)]

@@ -42,6 +42,7 @@ def mesh():
     return make_media_mesh(jax.devices()[:8])
 
 
+@pytest.mark.slow
 def test_sharded_protect_matches_single(mesh):
     rng = np.random.default_rng(5)
     args = _protect_args(32, 128, rng)

@@ -42,6 +42,7 @@ def test_audio_stream_dtmf_roundtrip(svc):
     assert events and events[0].event == 7
 
 
+@pytest.mark.slow
 def test_audio_stream_levels(svc):
     a, b = make_audio_pair(svc)
     levels = np.full(1024, 127, np.uint8)

@@ -8,6 +8,7 @@ from libjitsi_tpu.transform import TransformEngineChain
 from libjitsi_tpu.transform.srtp import SrtpStreamTable
 from libjitsi_tpu.transform.srtp.engine import SrtpTransformEngine
 from libjitsi_tpu.utils import FaultInjectionEngine, MetricsRegistry
+import pytest
 
 
 def test_metrics_render_arrays_and_scalars():
@@ -36,6 +37,7 @@ def test_timing_ring_percentiles():
     assert 'quantile="p99"' in m.render()
 
 
+@pytest.mark.slow
 def test_fault_injection_loss_and_corrupt_against_srtp():
     MK, MS = bytes(16), bytes(14)
     tx = SrtpStreamTable(capacity=2)
@@ -62,6 +64,7 @@ def test_fault_injection_loss_and_corrupt_against_srtp():
         assert raw[int(hdr.payload_off[i]):].startswith(b"m")
 
 
+@pytest.mark.slow
 def test_fault_injection_duplicates_rejected_by_replay():
     MK, MS = bytes(16), bytes(14)
     tx = SrtpStreamTable(capacity=2)

@@ -15,6 +15,10 @@ from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.sfu import RtpTranslator
 from libjitsi_tpu.transform.srtp import SrtpStreamTable
 
+import pytest
+
+pytestmark = pytest.mark.slow   # cold-compile-heavy e2e tier
+
 MK = bytes(range(16))
 MS = bytes(range(30, 44))
 RECV_KEYS = {1: (b"\x01" * 16, b"\x65" * 14), 2: (b"\x02" * 16, b"\x66" * 14)}

@@ -67,6 +67,7 @@ def test_encode_decode_roundtrip_vp9():
     enc.close(); dec.close()
 
 
+@pytest.mark.slow
 def test_real_vp8_through_secure_sfu_path():
     """Real bitstream -> RTP -> SRTP -> SFU fan-out -> decode -> PSNR."""
     from libjitsi_tpu.core.packet import PacketBatch
