@@ -380,6 +380,37 @@ def table_pps(n_streams: int = N_STREAMS, batch: int = 4096,
             install_rate, host_plane_pps, transfer_probe_ms)
 
 
+def dense_receive_tick_ms(n_streams: int = 10_240) -> float:
+    """Host cost of one decode-path tick at 10k streams: dense jitter
+    insert+pop plus the batched GCC feed — the plane that used to be
+    per-stream Python objects.  Pure host time (no device)."""
+    from libjitsi_tpu.bwe.batched import BatchedRemoteBitrateEstimator
+    from libjitsi_tpu.rtp.dense_jitter import DenseJitterBank
+
+    jb = DenseJitterBank(capacity=n_streams, depth=16, payload_cap=64)
+    bwe = BatchedRemoteBitrateEstimator(capacity=64)
+    rng = np.random.default_rng(13)
+    sids = np.arange(n_streams)
+    tids = sids % 64
+    pay = rng.integers(0, 256, (n_streams, 64), dtype=np.uint8)
+    best = float("inf")
+    for k in range(6):
+        now = 5.0 + 0.02 * k
+        t0 = time.perf_counter()
+        jb.insert_batch(sids, np.full(n_streams, 100 + k),
+                        np.full(n_streams, 160 * k), pay,
+                        np.full(n_streams, 64), now)
+        jb.pop_all(now + 0.001)
+        bwe.incoming_batch(tids, np.full(n_streams, now * 1000),
+                           np.full(n_streams,
+                                   (int(now * (1 << 18)) & 0xFFFFFF)),
+                           np.full(n_streams, 172))
+        if k >= 2:
+            best = min(best, time.perf_counter() - t0)
+    bwe.update_estimate(6.0 * 1000)
+    return best * 1e3
+
+
 def loop_rtt(n_pkts: int = 256, cycles: int = 24):
     """End-to-end MediaLoop tick over REAL loopback UDP: client protect →
     send → bridge recv_batch → SSRC demux → unprotect → echo →
@@ -487,6 +518,8 @@ def main():
                   "table_unprotect_p99_batch_ms": round(untab_p99, 3),
                   "install_streams_per_sec": round(install_rate, 1),
                   "table_host_plane_pps": round(host_plane_pps, 1),
+                  "dense_receive_tick_ms_10k":
+                      round(dense_receive_tick_ms(), 3),
                   "h2d_transfer_probe_ms": round(transfer_probe_ms, 3),
                   "loop_udp_echo_pps": round(lp_pps, 1),
                   "loop_udp_cycle_p99_ms": round(lp_p99, 3),

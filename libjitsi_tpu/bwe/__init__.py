@@ -1,3 +1,4 @@
 from libjitsi_tpu.bwe.rate_stats import RateStatistics  # noqa: F401
 from libjitsi_tpu.bwe.remote_estimator import RemoteBitrateEstimator  # noqa: F401
 from libjitsi_tpu.bwe.send_side import SendSideBandwidthEstimation  # noqa: F401
+from libjitsi_tpu.bwe.batched import BatchedRemoteBitrateEstimator  # noqa: F401

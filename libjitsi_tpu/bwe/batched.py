@@ -1,0 +1,360 @@
+"""Batched receive-side bandwidth estimation: T transports as arrays.
+
+The scalar classes (`InterArrival`, `OveruseEstimator`, `OveruseDetector`,
+`AimdRateControl`, `RateStatistics` — ports of the reference's
+`...remotebitrateestimator.*`, themselves WebRTC GCC ports) are one
+Python state machine per transport, driven per packet.  A bridge with
+thousands of transports pays a Python-loop toll per packet; this bank
+keeps every transport's state in `[T]` NumPy arrays and applies the same
+update laws vectorized — the dense-state doctrine of the rest of the
+framework (SURVEY §2.3's re-design note).
+
+Equivalence: updates use the identical formulas in the identical order,
+so results match the scalar classes to float rounding; the differential
+test tests/test_dense_receive.py::test_batched_bwe_matches_scalar pins
+it.  In-batch multi-packet
+transports decompose into waves by per-transport rank, preserving
+per-packet sequencing.
+
+States are int codes here (vector-friendly): signal 0/1/2 =
+normal/overusing/underusing; rate state 0/1/2 = hold/increase/decrease;
+region 0/1 = multiplicative/additive.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from libjitsi_tpu.core.rtp_math import segment_ranks
+
+SIG_NORMAL, SIG_OVERUSING, SIG_UNDERUSING = 0, 1, 2
+ST_HOLD, ST_INCREASE, ST_DECREASE = 0, 1, 2
+RG_MULTIPLICATIVE, RG_ADDITIVE = 0, 1
+
+_BURST_SPAN_MS = 5.0
+_BETA = 0.85
+
+
+class BatchedRemoteBitrateEstimator:
+    """T independent GCC estimators in dense arrays."""
+
+    def __init__(self, capacity: int, min_bitrate_bps: float = 30_000,
+                 start_bitrate_bps: float = 300_000,
+                 max_bitrate_bps: float = 30e6,
+                 window_ms: int = 1000):
+        t = capacity
+        self.capacity = t
+        # ---- abs-send-time unwrap
+        self._last_send = np.zeros(t, dtype=np.float64)
+        self._send_unwrapped = np.zeros(t, dtype=np.float64)
+        self._has_send = np.zeros(t, dtype=bool)
+        # ---- InterArrival groups
+        self._g_has = np.zeros(t, dtype=bool)
+        self._g_first_send = np.zeros(t, dtype=np.float64)
+        self._g_send = np.zeros(t, dtype=np.float64)
+        self._g_arrival = np.zeros(t, dtype=np.float64)
+        self._g_size = np.zeros(t, dtype=np.int64)
+        self._p_has = np.zeros(t, dtype=bool)
+        self._p_send = np.zeros(t, dtype=np.float64)
+        self._p_arrival = np.zeros(t, dtype=np.float64)
+        self._p_size = np.zeros(t, dtype=np.int64)
+        # ---- Kalman (OveruseEstimator)
+        self.offset = np.zeros(t, dtype=np.float64)
+        self._slope = np.full(t, 8.0 / 512.0, dtype=np.float64)
+        self._e00 = np.full(t, 100.0, dtype=np.float64)
+        self._e01 = np.zeros(t, dtype=np.float64)
+        self._e10 = np.zeros(t, dtype=np.float64)
+        self._e11 = np.full(t, 1e-1, dtype=np.float64)
+        self._avg_noise = np.zeros(t, dtype=np.float64)
+        self._var_noise = np.full(t, 50.0, dtype=np.float64)
+        self.num_deltas = np.zeros(t, dtype=np.int64)
+        # ---- detector
+        self.threshold = np.full(t, 12.5, dtype=np.float64)
+        self._last_update_ms = np.full(t, -1.0, dtype=np.float64)
+        self._time_over_using = np.full(t, -1.0, dtype=np.float64)
+        self._overuse_counter = np.zeros(t, dtype=np.int64)
+        self.signal = np.zeros(t, dtype=np.int8)
+        self._overuse_time_th = 10.0
+        # ---- AIMD
+        self.min_bitrate = float(min_bitrate_bps)
+        self.max_bitrate = float(max_bitrate_bps)
+        self.bitrate = np.full(t, float(start_bitrate_bps),
+                               dtype=np.float64)
+        self.rate_state = np.zeros(t, dtype=np.int8)
+        self.region = np.zeros(t, dtype=np.int8)
+        self.rtt_ms = np.full(t, 200.0, dtype=np.float64)
+        self._avg_max_kbps = np.full(t, -1.0, dtype=np.float64)
+        self._var_max_kbps = np.full(t, 0.4, dtype=np.float64)
+        self._last_change_ms = np.full(t, -1.0, dtype=np.float64)
+        # ---- incoming rate window (timestamped buckets)
+        self.window_ms = window_ms
+        self._buckets = np.zeros((t, window_ms), dtype=np.int64)
+        self._bucket_ms = np.full((t, window_ms), -1, dtype=np.int64)
+        self._oldest_ms = np.full(t, -1, dtype=np.int64)
+
+    def set_rtt(self, tids, rtt_ms) -> None:
+        self.rtt_ms[np.asarray(tids, dtype=np.int64)] = rtt_ms
+
+    # ------------------------------------------------------------- feeding
+    def incoming_batch(self, tids, arrival_ms, ast24, sizes) -> None:
+        """Feed a packet batch: tids [B] transport rows, arrival_ms [B]
+        host arrival, ast24 [B] 24-bit abs-send-time, sizes [B] bytes."""
+        tids = np.asarray(tids, dtype=np.int64)
+        b = len(tids)
+        if b == 0:
+            return
+        arrival_ms = np.asarray(arrival_ms, dtype=np.float64)
+        send_ms = (np.asarray(ast24, dtype=np.float64)
+                   / float(1 << 18)) * 1000.0
+        sizes = np.asarray(sizes, dtype=np.int64)
+        ranks = segment_ranks(tids)
+        for r in range(int(ranks.max(initial=0)) + 1):
+            rows = np.nonzero(ranks == r)[0]
+            if len(rows) == 0:
+                break
+            self._packet_wave(tids[rows], arrival_ms[rows],
+                              send_ms[rows], sizes[rows])
+
+    def _packet_wave(self, t, arrival, send, size) -> None:
+        """One packet per transport."""
+        self._rate_update(t, size, arrival.astype(np.int64))
+
+        # unwrap 64 s abs-send-time circle against the last value
+        fresh = ~self._has_send[t]
+        d = send - self._last_send[t]
+        d = np.where(d < -32000, d + 64000,
+                     np.where(d > 32000, d - 64000, d))
+        unwrapped = np.where(fresh, send, self._send_unwrapped[t] + d)
+        self._send_unwrapped[t] = unwrapped
+        self._last_send[t] = send
+        self._has_send[t] = True
+        send = unwrapped
+
+        # ---- InterArrival group bookkeeping
+        no_group = ~self._g_has[t]
+        n = t[no_group]
+        self._g_has[n] = True
+        self._g_first_send[n] = send[no_group]
+        self._g_send[n] = send[no_group]
+        self._g_arrival[n] = arrival[no_group]
+        self._g_size[n] = size[no_group]
+
+        g = ~no_group
+        tg, sg, ag, zg = t[g], send[g], arrival[g], size[g]
+        ooo = sg < self._g_first_send[tg]            # out-of-order: ignore
+        in_group = ~ooo & (sg - self._g_first_send[tg] <= _BURST_SPAN_MS)
+        ti = tg[in_group]
+        self._g_send[ti] = np.maximum(self._g_send[ti], sg[in_group])
+        self._g_arrival[ti] = ag[in_group]
+        self._g_size[ti] += zg[in_group]
+
+        closes = ~ooo & ~in_group
+        tc = tg[closes]
+        if len(tc):
+            have_prev = self._p_has[tc]
+            send_delta = self._g_send[tc] - self._p_send[tc]
+            arr_delta = self._g_arrival[tc] - self._p_arrival[tc]
+            size_delta = self._g_size[tc] - self._p_size[tc]
+            filt = tc[have_prev & (send_delta >= 0)]
+            fm = have_prev & (send_delta >= 0)
+            # previous <- current, current <- new packet
+            self._p_has[tc] = True
+            self._p_send[tc] = self._g_send[tc]
+            self._p_arrival[tc] = self._g_arrival[tc]
+            self._p_size[tc] = self._g_size[tc]
+            self._g_first_send[tc] = sg[closes]
+            self._g_send[tc] = sg[closes]
+            self._g_arrival[tc] = ag[closes]
+            self._g_size[tc] = zg[closes]
+            if len(filt):
+                self._kalman_update(filt, arr_delta[fm], send_delta[fm],
+                                    size_delta[fm].astype(np.float64))
+                self._detect(filt, send_delta[fm], ag[closes][fm])
+
+    # --------------------------------------------------------------- kalman
+    def _kalman_update(self, t, t_delta, ts_delta, fs_delta) -> None:
+        """OveruseEstimator.update, vectorized over the closing rows."""
+        self.num_deltas[t] = np.minimum(self.num_deltas[t] + 1, 60)
+        t_ts_delta = t_delta - ts_delta
+
+        e00, e01 = self._e00[t], self._e01[t]
+        e10, e11 = self._e10[t], self._e11[t]
+        e00 = e00 + 1e-13
+        e11 = e11 + 1e-3
+        sig = self.signal[t]
+        off = self.offset[t]
+        unstable = ((sig == SIG_OVERUSING) & (off < 0)) | \
+                   ((sig == SIG_UNDERUSING) & (off > 0))
+        e11 = e11 + np.where(unstable, 10 * 1e-3, 0.0)
+
+        h0, h1 = fs_delta, 1.0
+        eh0 = e00 * h0 + e01 * h1
+        eh1 = e10 * h0 + e11 * h1
+        residual = t_ts_delta - self._slope[t] * h0 - off
+
+        max_residual = 3.0 * np.sqrt(self._var_noise[t])
+        in_stable = np.abs(residual) < max_residual
+        shaped = np.where(in_stable, residual,
+                          np.copysign(max_residual, residual))
+        self._update_noise(t, ts_delta, shaped)
+
+        denom = self._var_noise[t] + (h0 * eh0 + h1 * eh1)
+        k0, k1 = eh0 / denom, eh1 / denom
+        ikh00 = 1.0 - k0 * h0
+        ikh01 = -k0 * h1
+        ikh10 = -k1 * h0
+        ikh11 = 1.0 - k1 * h1
+        n00 = e00 * ikh00 + e10 * ikh01
+        n01 = e01 * ikh00 + e11 * ikh01
+        n10 = e00 * ikh10 + e10 * ikh11
+        n11 = e01 * ikh10 + e11 * ikh11
+        self._e00[t], self._e01[t] = n00, n01
+        self._e10[t], self._e11[t] = n10, n11
+        self._slope[t] += k0 * residual
+        self.offset[t] = off + k1 * residual
+
+    def _update_noise(self, t, ts_delta, residual) -> None:
+        norm = self.signal[t] == SIG_NORMAL
+        alpha = np.where(ts_delta > 0,
+                         np.power(0.01, np.maximum(ts_delta, 0) / 30.0),
+                         0.0)
+        alpha = np.clip(alpha, 0.0, 1.0)
+        avg = alpha * self._avg_noise[t] + (1 - alpha) * residual
+        var = alpha * self._var_noise[t] + (1 - alpha) * (
+            residual - avg) ** 2
+        var = np.maximum(var, 1.0)
+        self._avg_noise[t] = np.where(norm, avg, self._avg_noise[t])
+        self._var_noise[t] = np.where(norm, var, self._var_noise[t])
+
+    # -------------------------------------------------------------- detect
+    def _detect(self, t, ts_delta, now_ms) -> None:
+        nd = self.num_deltas[t]
+        enough = nd >= 2
+        tt = np.minimum(nd, 60) * self.offset[t]
+        over = tt > self.threshold[t]
+        under = tt < -self.threshold[t]
+
+        tou = self._time_over_using[t]
+        tou = np.where(over, np.where(tou == -1, ts_delta / 2,
+                                      tou + ts_delta), -1.0)
+        cnt = np.where(over, self._overuse_counter[t] + 1, 0)
+        trip = over & (tou > self._overuse_time_th) & (cnt > 1)
+        sig = self.signal[t]
+        new_sig = np.where(trip, SIG_OVERUSING,
+                           np.where(under, SIG_UNDERUSING,
+                                    np.where(over, sig, SIG_NORMAL)))
+        self._time_over_using[t] = np.where(enough, tou,
+                                            self._time_over_using[t])
+        self._overuse_counter[t] = np.where(enough, cnt,
+                                            self._overuse_counter[t])
+        self.signal[t] = np.where(enough, new_sig, sig).astype(np.int8)
+
+        # adaptive threshold
+        lu_orig = self._last_update_ms[t]
+        lu = np.where(lu_orig < 0, now_ms, lu_orig)
+        far = np.abs(tt) > self.threshold[t] + 15.0
+        k = np.where(np.abs(tt) < self.threshold[t], 0.039, 0.0087)
+        dt = np.clip(now_ms - lu, 0.0, 100.0)
+        new_th = self.threshold[t] + k * (np.abs(tt)
+                                          - self.threshold[t]) * dt
+        new_th = np.clip(new_th, 6.0, 600.0)
+        self.threshold[t] = np.where(enough & ~far, new_th,
+                                     self.threshold[t])
+        self._last_update_ms[t] = np.where(enough, now_ms, lu_orig)
+
+    # ------------------------------------------------------------ rate win
+    def _rate_update(self, t, nbytes, now_ms) -> None:
+        first = self._oldest_ms[t] < 0
+        self._oldest_ms[t] = np.where(first, now_ms, self._oldest_ms[t])
+        self._oldest_ms[t] = np.maximum(self._oldest_ms[t],
+                                        now_ms - self.window_ms + 1)
+        now_eff = np.maximum(now_ms, self._oldest_ms[t])
+        idx = now_eff % self.window_ms
+        stale = self._bucket_ms[t, idx] != now_eff
+        self._buckets[t[stale], idx[stale]] = 0
+        self._bucket_ms[t, idx] = now_eff
+        self._buckets[t, idx] += nbytes
+
+    def incoming_rate(self, now_ms: int) -> np.ndarray:
+        """Windowed receive rate, bits/sec, all T transports.
+
+        The window anchors to the NEWEST update each transport has seen
+        (the scalar RateStatistics advances `oldest` on update, and a
+        rate() query older than that is a no-op erase), so live buckets
+        are those at/after the maintained per-transport oldest — not
+        `query_now - window`.
+        """
+        now_ms = int(now_ms)
+        seen = self._oldest_ms >= 0
+        self._oldest_ms = np.where(
+            seen, np.maximum(self._oldest_ms,
+                             now_ms - self.window_ms + 1),
+            self._oldest_ms)
+        live = self._bucket_ms >= np.maximum(self._oldest_ms, 0)[:, None]
+        total = np.where(live, self._buckets, 0).sum(axis=1)
+        active = np.where(seen,
+                          np.clip(now_ms - self._oldest_ms + 1, 1,
+                                  self.window_ms),
+                          1)
+        return total * 8000.0 / active
+
+    # ---------------------------------------------------------------- aimd
+    def update_estimate(self, now_ms: float) -> np.ndarray:
+        """Periodic GCC tick for every transport -> REMB bitrates [T]."""
+        sig = self.signal
+        st = self.rate_state.copy()
+        st = np.where((sig == SIG_NORMAL) & (st == ST_HOLD),
+                      ST_INCREASE, st)
+        st = np.where(sig == SIG_OVERUSING, ST_DECREASE, st)
+        st = np.where(sig == SIG_UNDERUSING, ST_HOLD, st)
+
+        lc = self._last_change_ms
+        lc = np.where(lc < 0, now_ms, lc)
+        dt = now_ms - lc
+        self._last_change_ms[:] = now_ms
+
+        incoming = self.incoming_rate(int(now_ms))
+        rate = self.bitrate.copy()
+
+        inc = st == ST_INCREASE
+        mul = inc & (self.region == RG_MULTIPLICATIVE)
+        factor = np.minimum(np.power(1.08, np.minimum(dt / 1000.0, 1.0)),
+                            1.5)
+        rate = np.where(mul, rate * factor, rate)
+        add = inc & (self.region == RG_ADDITIVE)
+        response_ms = 100.0 + self.rtt_ms
+        alpha = 0.5 * np.minimum(dt / response_ms, 1.0)
+        rate = np.where(add, rate + np.maximum(1000.0, alpha * 8 * 1200),
+                        rate)
+
+        dec = st == ST_DECREASE
+        rate = np.where(dec, _BETA * incoming, rate)
+        # max-estimate EWMA on decrease
+        sample = incoming / 1000.0
+        d = self._avg_max_kbps < 0
+        avg = np.where(d, sample,
+                       0.95 * self._avg_max_kbps + 0.05 * sample)
+        norm = np.maximum(avg, 1.0)
+        dev = (sample - avg) ** 2 / norm
+        var = np.clip(0.95 * self._var_max_kbps + 0.05 * dev, 0.4, 2.5)
+        self._avg_max_kbps = np.where(dec, avg, self._avg_max_kbps)
+        self._var_max_kbps = np.where(dec, var, self._var_max_kbps)
+        self.region = np.where(dec, RG_ADDITIVE, self.region
+                               ).astype(np.int8)
+        st = np.where(dec, ST_HOLD, st)
+
+        # back to multiplicative far above the max estimate
+        has_max = self._avg_max_kbps >= 0
+        sigma = np.sqrt(np.maximum(self._var_max_kbps
+                                   * self._avg_max_kbps, 0.0))
+        above = has_max & (rate / 1000.0
+                           > self._avg_max_kbps + 3 * sigma)
+        self.region = np.where(above, RG_MULTIPLICATIVE, self.region
+                               ).astype(np.int8)
+        self._avg_max_kbps = np.where(above, -1.0, self._avg_max_kbps)
+
+        self.bitrate = np.clip(rate, self.min_bitrate, self.max_bitrate)
+        self.rate_state = st.astype(np.int8)
+        return self.bitrate

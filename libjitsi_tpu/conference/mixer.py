@@ -232,6 +232,16 @@ class AudioMixer:
                 f"frame must be [{self.frame_samples}] int16, got {f.shape}")
         self._frame[sid] = f
 
+    def push_batch(self, sids: np.ndarray, frames: np.ndarray) -> None:
+        """Deposit many participants' frames at once (int16 [K, F]) —
+        the dense receive plane's deposit path (one array write)."""
+        frames = np.asarray(frames, dtype=np.int16)
+        if frames.ndim != 2 or frames.shape[1] != self.frame_samples:
+            raise ValueError(
+                f"frames must be [K, {self.frame_samples}] int16, "
+                f"got {frames.shape}")
+        self._frame[np.asarray(sids, dtype=np.int64)] = frames
+
     def mix(self) -> Tuple[np.ndarray, np.ndarray]:
         """Run one frame tick: returns (out int16 [N, F], levels uint8 [N]).
 

@@ -1,0 +1,1 @@
+from libjitsi_tpu.rtp.dense_jitter import DenseJitterBank  # noqa: F401

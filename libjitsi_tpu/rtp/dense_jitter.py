@@ -1,0 +1,214 @@
+"""Dense jitter-buffer bank: S streams as struct-of-arrays, zero
+per-stream Python objects.
+
+The scalar `rtp.jitter_buffer.JitterBuffer` (one dict + dataclass per
+packet per stream — FMJ's JitterBuffer family re-imagined) is fine for
+tens of streams but is a Python-loop bottleneck at 10k streams x 50 pps.
+This bank holds every stream's ring in `[S, depth]` arrays and processes
+whole packet batches with NumPy, the same dense-state doctrine as
+`SrtpStreamTable` (SURVEY §2.3's re-design obligation).
+
+Semantics match the scalar buffer (same adaptive target-delay law,
+late-drop rule, gap-skip law, RFC 3550 transit-jitter EWMA), with one
+bounded-memory deviation: each stream holds at most `depth` outstanding
+packets (a ring slot per seq mod depth); a slot collision evicts the
+older packet and counts it in `overwritten`.  The scalar buffer's dict
+is unbounded — at bridge scale, bounded rings are the point.
+
+In-batch ordering: multiple packets of one stream in one `insert_batch`
+are applied in batch order (wave decomposition by per-stream rank), so
+results are identical to feeding the scalar buffer one packet at a time.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import numpy as np
+
+from libjitsi_tpu.core.rtp_math import segment_ranks, seq_delta
+
+
+class DenseJitterBank:
+    """S adaptive jitter buffers in dense arrays.
+
+    payload_cap bounds the stored payload bytes per packet (audio
+    payloads; oversize inserts are truncated — callers with jumbo video
+    frames use the SFU path, which does not buffer).
+    """
+
+    def __init__(self, capacity: int, depth: int = 16,
+                 payload_cap: int = 256, clock_rate: int = 48000,
+                 frame_ms: float = 20.0, min_delay_ms: float = 0.0,
+                 max_delay_ms: float = 200.0,
+                 jitter_multiplier: float = 2.0):
+        if depth & (depth - 1):
+            raise ValueError("depth must be a power of two")
+        s = capacity
+        self.capacity = s
+        self.depth = depth
+        self.payload_cap = payload_cap
+        self.clock_rate = np.full(s, clock_rate, dtype=np.float64)
+        self.frame_s = np.full(s, frame_ms / 1000.0, dtype=np.float64)
+        self.min_delay = np.full(s, min_delay_ms / 1000.0, dtype=np.float64)
+        self.max_delay = np.full(s, max_delay_ms / 1000.0, dtype=np.float64)
+        self.mult = np.full(s, jitter_multiplier, dtype=np.float64)
+
+        self.next_seq = np.full(s, -1, dtype=np.int32)     # -1 = unset
+        self.released = np.zeros(s, dtype=bool)
+        self.jitter_s = np.zeros(s, dtype=np.float64)
+        self._last_transit = np.zeros(s, dtype=np.float64)
+        self._has_transit = np.zeros(s, dtype=bool)
+        self.lost = np.zeros(s, dtype=np.int64)
+        self.late_dropped = np.zeros(s, dtype=np.int64)
+        self.overwritten = np.zeros(s, dtype=np.int64)
+
+        self._occ = np.zeros((s, depth), dtype=bool)
+        self._slot_seq = np.zeros((s, depth), dtype=np.int32)
+        self._arrival = np.zeros((s, depth), dtype=np.float64)
+        self._plen = np.zeros((s, depth), dtype=np.int32)
+        self._pay = np.zeros((s, depth, payload_cap), dtype=np.uint8)
+
+    def configure_streams(self, sids, clock_rate=None, frame_ms=None
+                          ) -> None:
+        """Per-stream media clocks (codecs differ across a bridge)."""
+        sids = np.asarray(sids, dtype=np.int64)
+        if clock_rate is not None:
+            self.clock_rate[sids] = clock_rate
+        if frame_ms is not None:
+            self.frame_s[sids] = np.asarray(frame_ms, np.float64) / 1000.0
+
+    @property
+    def target_delay(self) -> np.ndarray:
+        return np.minimum(np.maximum(self.mult * self.jitter_s,
+                                     self.min_delay), self.max_delay)
+
+    # ---------------------------------------------------------------- insert
+    def insert_batch(self, sids, seq, rtp_ts, payload: np.ndarray,
+                     plen, now) -> None:
+        """Insert a decrypted batch: sids/seq/rtp_ts/plen [B], payload
+        [B, <=payload_cap], now scalar or [B] arrival times."""
+        sids = np.asarray(sids, dtype=np.int64)
+        b = len(sids)
+        if b == 0:
+            return
+        seq = np.asarray(seq, dtype=np.int64) & 0xFFFF
+        rtp_ts = np.asarray(rtp_ts, dtype=np.int64)
+        plen = np.minimum(np.asarray(plen, dtype=np.int64),
+                          self.payload_cap).astype(np.int32)
+        payload = np.asarray(payload, dtype=np.uint8)[:, :self.payload_cap]
+        now = np.broadcast_to(np.asarray(now, dtype=np.float64), (b,))
+
+        ranks = segment_ranks(sids)
+        for r in range(int(ranks.max(initial=0)) + 1):
+            rows = np.nonzero(ranks == r)[0]
+            if len(rows) == 0:
+                break
+            self._insert_wave(sids[rows], seq[rows], rtp_ts[rows],
+                              payload[rows], plen[rows], now[rows])
+
+    def _insert_wave(self, s, q, ts, pay, pl, now) -> None:
+        """One packet per stream (callers guarantee uniqueness)."""
+        unset = self.next_seq[s] < 0
+        delta = seq_delta(q, np.where(unset, q, self.next_seq[s]))
+        late = ~unset & (delta < 0) & self.released[s]
+        np.add.at(self.late_dropped, s[late], 1)
+        keep = ~late
+        s, q, ts = s[keep], q[keep], ts[keep]
+        pay, pl, now = pay[keep], pl[keep], now[keep]
+        if len(s) == 0:
+            return
+        unset = self.next_seq[s] < 0
+        moveback = ~unset & (seq_delta(q, np.where(
+            unset, q, self.next_seq[s])) < 0)
+        self.next_seq[s[moveback]] = q[moveback]
+
+        transit = now - ts / self.clock_rate[s]
+        has = self._has_transit[s]
+        d = np.abs(transit - self._last_transit[s])
+        self.jitter_s[s[has]] += (d[has] - self.jitter_s[s[has]]) / 16.0
+        self._last_transit[s] = transit
+        self._has_transit[s] = True
+
+        slot = (q & (self.depth - 1)).astype(np.int64)
+        occ_other = self._occ[s, slot] & (self._slot_seq[s, slot] != q)
+        np.add.at(self.overwritten, s[occ_other], 1)
+        self._occ[s, slot] = True
+        self._slot_seq[s, slot] = q
+        self._arrival[s, slot] = now
+        self._plen[s, slot] = pl
+        self._pay[s, slot, :pay.shape[1]] = pay
+        if pay.shape[1] < self.payload_cap:
+            self._pay[s, slot, pay.shape[1]:] = 0
+        self.next_seq[s[self.next_seq[s] < 0]] = q[self.next_seq[s] < 0]
+
+    # ------------------------------------------------------------------ pop
+    def pop_all(self, now: float
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """One decode tick for every stream: release the next in-order
+        frame where due (same laws as the scalar pop, applied to all S
+        at once).  Returns (ready [S] bool, payload [S, cap], plen [S]);
+        streams with nothing due have ready=False.
+        """
+        s_all = np.arange(self.capacity)
+        ready = np.zeros(self.capacity, dtype=bool)
+        out_pay = np.zeros((self.capacity, self.payload_cap), np.uint8)
+        out_len = np.zeros(self.capacity, np.int32)
+        target = self.target_delay
+        active = self.next_seq >= 0
+        # bounded gap-skip loop: each iteration either releases or skips
+        # one seq per stream; depth+1 rounds covers a full ring
+        for _ in range(self.depth + 1):
+            cand = active & ~ready
+            if not cand.any():
+                break
+            s = s_all[cand]
+            nq = self.next_seq[s].astype(np.int64)
+            slot = (nq & (self.depth - 1))
+            hit = self._occ[s, slot] & (self._slot_seq[s, slot] == nq)
+            due = hit & (now - self._arrival[s, slot]
+                         >= target[s] - 1e-6)
+            rel = s[due]
+            rslot = slot[due]
+            ready[rel] = True
+            out_pay[rel] = self._pay[rel, rslot]
+            out_len[rel] = self._plen[rel, rslot]
+            self._occ[rel, rslot] = False
+            self.next_seq[rel] = (self.next_seq[rel] + 1) & 0xFFFF
+            self.released[rel] = True
+
+            # gap skip: buffer non-empty and its oldest waited out
+            # target + one frame
+            miss = s[~hit]
+            if len(miss):
+                occ = self._occ[miss]
+                any_buf = occ.any(axis=1)
+                oldest = np.where(occ, self._arrival[miss],
+                                  np.inf).min(axis=1)
+                skip = any_buf & (now - oldest
+                                  > target[miss] + self.frame_s[miss])
+                sk = miss[skip]
+                self.lost[sk] += 1
+                self.next_seq[sk] = (self.next_seq[sk] + 1) & 0xFFFF
+                if not skip.any() and not due.any():
+                    break
+            elif not due.any():
+                break
+        return ready, out_pay, out_len
+
+    def depth_used(self) -> np.ndarray:
+        return self._occ.sum(axis=1)
+
+    def reset_streams(self, sids) -> None:
+        """Clear per-stream state for (re)used rows — a new participant
+        on a recycled sid must not inherit the previous occupant's
+        sequence window, jitter estimate or counters."""
+        s = np.asarray(sids, dtype=np.int64)
+        self.next_seq[s] = -1
+        self.released[s] = False
+        self.jitter_s[s] = 0.0
+        self._has_transit[s] = False
+        self.lost[s] = 0
+        self.late_dropped[s] = 0
+        self.overwritten[s] = 0
+        self._occ[s] = False
