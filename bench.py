@@ -193,6 +193,47 @@ def gcm_pps() -> float:
     return b / dt
 
 
+def aes_core_blocks_per_sec(b: int = 65536) -> dict:
+    """Provider sweep for the AES core (SURVEY §7 'hard parts'): the
+    table/S-box-gather core vs the gather-free bitsliced Boolean circuit
+    (kernels/aes_bitsliced.py), plus the Pallas lowering attempt.
+    Standalone block-encrypt rate, pipelined.  The bitsliced circuit
+    measures ~1.3x the table core standalone; inside the fused SRTP
+    kernel (where HMAC dominates) the two are within noise, so 'table'
+    stays the default (set LIBJITSI_TPU_AES_CORE=bitsliced to swap)."""
+    import jax
+    import jax.numpy as jnp
+
+    from libjitsi_tpu.kernels.aes import aes_encrypt_table, \
+        expand_keys_batch
+    from libjitsi_tpu.kernels.aes_bitsliced import (
+        aes_encrypt_bitsliced, aes_encrypt_pallas_bitsliced)
+
+    rng = np.random.default_rng(21)
+    rks = expand_keys_batch(rng.integers(0, 256, (b, 16), dtype=np.uint8))
+    blocks = rng.integers(0, 256, (b, 16), dtype=np.uint8)
+    rksd, blkd = jnp.asarray(rks), jnp.asarray(blocks)
+    out = {}
+    table = jax.jit(aes_encrypt_table)
+    for name, fn in (("xla_table", table),
+                     ("xla_bitsliced", aes_encrypt_bitsliced),
+                     ("pallas_bitsliced", aes_encrypt_pallas_bitsliced)):
+        try:
+            o = fn(rksd, blkd)
+            jax.block_until_ready(o)
+            best = 0.0
+            for _ in range(3):
+                t0 = time.perf_counter()
+                for _ in range(30):
+                    o = fn(rksd, blkd)
+                jax.block_until_ready(o)
+                best = max(best, b * 30 / (time.perf_counter() - t0))
+            out[name] = round(best, 1)
+        except Exception as e:   # Mosaic lowering refusal, recorded
+            out[name] = f"error: {type(e).__name__}"
+    return out
+
+
 def gcm_fanout_rows_per_sec(packets: int = 128, receivers: int = 256
                             ) -> float:
     """AEAD leg of BASELINE config #5: full-mesh GCM fan-out via the
@@ -527,6 +568,7 @@ def main():
                   "gcm_pps": round(gcm_pps(), 1),
                   "gcm_fanout_rows_per_sec":
                       round(gcm_fanout_rows_per_sec(), 1),
+                  "aes_core_blocks_per_sec": aes_core_blocks_per_sec(),
                   "mix_256p_per_sec": round(mixer_mix_per_sec(), 1),
                   "bridge_64conf_64p_mixes_per_sec":
                       round(bridge_mixes_per_sec(), 1),

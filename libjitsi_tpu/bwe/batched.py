@@ -88,10 +88,12 @@ class BatchedRemoteBitrateEstimator:
         self._avg_max_kbps = np.full(t, -1.0, dtype=np.float64)
         self._var_max_kbps = np.full(t, 0.4, dtype=np.float64)
         self._last_change_ms = np.full(t, -1.0, dtype=np.float64)
-        # ---- incoming rate window (timestamped buckets)
+        # ---- incoming rate window (erase-on-advance, running totals —
+        # the scalar RateStatistics' incremental design, vectorized;
+        # no full-window scan on the tick path)
         self.window_ms = window_ms
         self._buckets = np.zeros((t, window_ms), dtype=np.int64)
-        self._bucket_ms = np.full((t, window_ms), -1, dtype=np.int64)
+        self._win_total = np.zeros(t, dtype=np.int64)
         self._oldest_ms = np.full(t, -1, dtype=np.int64)
 
     def set_rtt(self, tids, rtt_ms) -> None:
@@ -265,40 +267,58 @@ class BatchedRemoteBitrateEstimator:
         self._last_update_ms[t] = np.where(enough, now_ms, lu_orig)
 
     # ------------------------------------------------------------ rate win
+    def _erase_old(self, t, now_ms) -> None:
+        """Advance each transport's window edge to now-window+1,
+        subtracting the outgoing buckets (vectorized form of the scalar
+        _erase_old; the partial-erase loop is bounded by the largest
+        advance, typically the tick interval in ms)."""
+        seen = self._oldest_ms[t] >= 0
+        new_oldest = np.asarray(now_ms) - self.window_ms + 1
+        adv = np.where(seen,
+                       np.clip(new_oldest - self._oldest_ms[t], 0, None),
+                       0)
+        full = adv >= self.window_ms
+        ft = t[full]
+        if len(ft):
+            self._buckets[ft] = 0
+            self._win_total[ft] = 0
+        part = np.nonzero(~full & (adv > 0))[0]
+        if len(part):
+            tp = t[part]
+            start = self._oldest_ms[tp]
+            n = adv[part]
+            for i in range(int(n.max())):
+                sel = n > i
+                tt = tp[sel]
+                idx = (start[sel] + i) % self.window_ms
+                self._win_total[tt] -= self._buckets[tt, idx]
+                self._buckets[tt, idx] = 0
+        upd = adv > 0
+        self._oldest_ms[t] = np.where(
+            upd, np.broadcast_to(new_oldest, adv.shape),
+            self._oldest_ms[t])
+
     def _rate_update(self, t, nbytes, now_ms) -> None:
+        self._erase_old(t, now_ms)
         first = self._oldest_ms[t] < 0
         self._oldest_ms[t] = np.where(first, now_ms, self._oldest_ms[t])
-        self._oldest_ms[t] = np.maximum(self._oldest_ms[t],
-                                        now_ms - self.window_ms + 1)
+        # late packet: fold into the oldest live bucket (scalar rule)
         now_eff = np.maximum(now_ms, self._oldest_ms[t])
         idx = now_eff % self.window_ms
-        stale = self._bucket_ms[t, idx] != now_eff
-        self._buckets[t[stale], idx[stale]] = 0
-        self._bucket_ms[t, idx] = now_eff
         self._buckets[t, idx] += nbytes
+        self._win_total[t] += nbytes
 
     def incoming_rate(self, now_ms: int) -> np.ndarray:
-        """Windowed receive rate, bits/sec, all T transports.
-
-        The window anchors to the NEWEST update each transport has seen
-        (the scalar RateStatistics advances `oldest` on update, and a
-        rate() query older than that is a no-op erase), so live buckets
-        are those at/after the maintained per-transport oldest — not
-        `query_now - window`.
-        """
+        """Windowed receive rate, bits/sec, all T transports (O(T) via
+        the running totals; the erase keeps them window-exact)."""
         now_ms = int(now_ms)
+        self._erase_old(np.arange(self.capacity), now_ms)
         seen = self._oldest_ms >= 0
-        self._oldest_ms = np.where(
-            seen, np.maximum(self._oldest_ms,
-                             now_ms - self.window_ms + 1),
-            self._oldest_ms)
-        live = self._bucket_ms >= np.maximum(self._oldest_ms, 0)[:, None]
-        total = np.where(live, self._buckets, 0).sum(axis=1)
         active = np.where(seen,
                           np.clip(now_ms - self._oldest_ms + 1, 1,
                                   self.window_ms),
                           1)
-        return total * 8000.0 / active
+        return self._win_total * 8000.0 / active
 
     # ---------------------------------------------------------------- aimd
     def update_estimate(self, now_ms: float) -> np.ndarray:

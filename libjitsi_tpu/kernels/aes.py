@@ -205,12 +205,12 @@ def _mix_columns(st):
     return jnp.stack([r0, r1, r2, r3], axis=-1).reshape(st.shape)
 
 
-def aes_encrypt(round_keys, blocks):
-    """Batched AES block encrypt.
+def aes_encrypt_table(round_keys, blocks):
+    """Batched AES block encrypt (table/S-box-gather core).
 
-    round_keys: [B, R, 16] uint8 (R = 11 for AES-128, 15 for AES-256);
-    blocks: [B, 16] uint8.  -> [B, 16] uint8.  Round count is taken from the
-    static shape, so this traces once per key size.
+    round_keys: [..., R, 16] uint8 (R = 11 for AES-128, 15 for AES-256);
+    blocks: [..., 16] uint8.  -> [..., 16] uint8.  Round count is taken
+    from the static shape, so this traces once per key size.
     """
     rk = jnp.asarray(round_keys, dtype=jnp.uint8)
     st = jnp.asarray(blocks, dtype=jnp.uint8) ^ rk[..., 0, :]
@@ -218,6 +218,47 @@ def aes_encrypt(round_keys, blocks):
     for r in range(1, nr):
         st = _mix_columns(_shift_rows(_sub_bytes(st))) ^ rk[..., r, :]
     return _shift_rows(_sub_bytes(st)) ^ rk[..., nr, :]
+
+
+# Selectable encrypt core (the reference's `.srtp.crypto.Aes`
+# benchmark-and-pick idea at the kernel level): "table" (S-box gather,
+# the long-time default) or "bitsliced" (gather-free Boolean circuit,
+# kernels/aes_bitsliced.py — measured ~1.3x the table core's sustained
+# block rate on v5e).  The choice is read at TRACE time, so switch it
+# before the first jit of the consuming kernels (env
+# LIBJITSI_TPU_AES_CORE or set_core(); set_core clears jax caches so
+# later compiles pick the new core).
+import os as _os
+
+_CORE_NAME = _os.environ.get("LIBJITSI_TPU_AES_CORE", "table")
+if _CORE_NAME not in ("table", "bitsliced"):
+    raise ValueError(
+        f"LIBJITSI_TPU_AES_CORE={_CORE_NAME!r}: must be 'table' or "
+        "'bitsliced' (a typo would otherwise silently run the default)")
+
+
+def set_core(name: str) -> None:
+    global _CORE_NAME
+    if name not in ("table", "bitsliced"):
+        raise ValueError("aes core must be 'table' or 'bitsliced'")
+    if name != _CORE_NAME:
+        _CORE_NAME = name
+        jax.clear_caches()
+
+
+def get_core() -> str:
+    return _CORE_NAME
+
+
+def aes_encrypt(round_keys, blocks):
+    """Batched AES block encrypt via the selected core ([..., R, 16]
+    keys, [..., 16] blocks; see `set_core`)."""
+    if _CORE_NAME == "bitsliced":
+        from libjitsi_tpu.kernels.aes_bitsliced import \
+            aes_encrypt_bitsliced_nd
+
+        return aes_encrypt_bitsliced_nd(round_keys, blocks)
+    return aes_encrypt_table(round_keys, blocks)
 
 
 def _iv_to_limbs(iv):

@@ -1,0 +1,286 @@
+"""Bitsliced, gather-free AES — the SURVEY §7 "hard parts" candidate.
+
+The production AES path (`kernels.aes.aes_encrypt`) uses a 256-entry
+S-box `jnp.take`, which XLA lowers well but Mosaic (Pallas TPU) refuses
+to lower at all.  This module builds AES-128/256 encryption as a pure
+Boolean circuit — XOR/AND/slice/concat only, no gathers — so the same
+body runs as an XLA program *and* as a Pallas kernel, and the provider
+registry (`kernels.registry`, the reference's `.srtp.crypto.Aes`
+benchmark-and-pick pattern) can measure all three and keep the winner.
+
+Circuit construction is derived, not transcribed: the S-box is computed
+as ``affine(x^254)`` over GF(2^8), with the squaring/power linear maps
+and the polynomial-reduction matrix generated from field arithmetic at
+import time and the complete 256-entry truth table asserted against an
+independently generated S-box.  Inversion uses the addition chain
+x -> x^2 -> x^3 -> x^12 -> x^15 -> x^240 -> x^252 -> x^254
+(4 variable GF multiplications; squarings are linear).
+
+State layout: 8 bit-planes, each ``[B, 4, 4]`` (byte i = row + 4*col),
+LSB-first bit order.  ShiftRows is slice+concat per row; MixColumns is
+xtime/XOR over row variables — nothing here indexes by data.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# ------------------------------------------------------------ host derivation
+
+_POLY = 0x11B
+
+
+def _gf_mul(a: int, b: int) -> int:
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        a <<= 1
+        if a & 0x100:
+            a ^= _POLY
+        b >>= 1
+    return r
+
+
+def _gf_pow(a: int, n: int) -> int:
+    r = 1
+    while n:
+        if n & 1:
+            r = _gf_mul(r, a)
+        a = _gf_mul(a, a)
+        n >>= 1
+    return r
+
+
+def _linear_matrix(fn) -> np.ndarray:
+    """8x8 GF(2) matrix of a linear byte map, via basis probing
+    (bit i = (byte >> i) & 1)."""
+    m = np.zeros((8, 8), dtype=np.uint8)
+    for j in range(8):
+        y = fn(1 << j)
+        for i in range(8):
+            m[i, j] = (y >> i) & 1
+    return m
+
+
+_M_SQ = _linear_matrix(lambda x: _gf_pow(x, 2))
+_M_P4 = _linear_matrix(lambda x: _gf_pow(x, 4))
+_M_P16 = _linear_matrix(lambda x: _gf_pow(x, 16))
+# AES S-box affine layer: s = A*x + 0x63 (applied AFTER inversion)
+_M_AFF = _linear_matrix(
+    lambda x: (x ^ ((x << 1) | (x >> 7)) ^ ((x << 2) | (x >> 6))
+               ^ ((x << 3) | (x >> 5)) ^ ((x << 4) | (x >> 4))) & 0xFF)
+_AFF_C = 0x63
+# x^k mod poly for the 15 product coefficients of an 8x8-bit multiply
+_REDC = [_gf_pow(2, k) for k in range(15)]
+
+
+# ----------------------------------------------------------- circuit builders
+
+def _linear(bits, mat: np.ndarray, const: int = 0):
+    out = []
+    for i in range(8):
+        acc = None
+        for j in range(8):
+            if mat[i, j]:
+                acc = bits[j] if acc is None else acc ^ bits[j]
+        if acc is None:
+            acc = bits[0] ^ bits[0]
+        if (const >> i) & 1:
+            acc = acc ^ 1
+        out.append(acc)
+    return out
+
+
+def _gf_mult_bits(a, b):
+    """Bitsliced GF(2^8) multiply of two byte variables."""
+    c = []
+    for k in range(15):
+        acc = None
+        for i in range(max(0, k - 7), min(8, k + 1)):
+            t = a[i] & b[k - i]
+            acc = t if acc is None else acc ^ t
+        c.append(acc)
+    out = []
+    for i in range(8):
+        acc = None
+        for k in range(15):
+            if (_REDC[k] >> i) & 1:
+                acc = c[k] if acc is None else acc ^ c[k]
+        out.append(acc)
+    return out
+
+
+def _sbox_bits(x):
+    """S(x) = affine(x^254): 4 GF multiplies + linear maps, no tables."""
+    a2 = _linear(x, _M_SQ)
+    a3 = _gf_mult_bits(a2, x)
+    a12 = _linear(a3, _M_P4)
+    a15 = _gf_mult_bits(a12, a3)
+    a240 = _linear(a15, _M_P16)
+    a252 = _gf_mult_bits(a240, a12)
+    a254 = _gf_mult_bits(a252, a2)
+    return _linear(a254, _M_AFF, _AFF_C)
+
+
+def _self_check() -> None:
+    """Assert the derived circuit reproduces the full S-box table."""
+    xs = np.arange(256, dtype=np.uint8)
+    bits = [((xs >> p) & 1).astype(np.uint8) for p in range(8)]
+    out = _sbox_bits(bits)
+    got = np.zeros(256, dtype=np.uint16)
+    for p in range(8):
+        got |= out[p].astype(np.uint16) << p
+    from libjitsi_tpu.kernels.aes import _SBOX
+
+    if not np.array_equal(got.astype(np.uint8), _SBOX):
+        raise AssertionError("bitsliced S-box circuit != S-box table")
+
+
+_self_check()
+
+
+def _vxor(a, b):
+    return [x ^ y for x, y in zip(a, b)]
+
+
+def _xtime_bits(v):
+    """GF doubling: out = v << 1 reduced by 0x11B (LSB-first planes)."""
+    return [v[7], v[0] ^ v[7], v[1], v[2] ^ v[7], v[3] ^ v[7],
+            v[4], v[5], v[6]]
+
+
+def _shift_rows_bits(bits, cat):
+    out = []
+    for p in bits:
+        rows = []
+        for r in range(4):
+            row = p[:, r:r + 1, :]
+            rows.append(cat([row[..., r:], row[..., :r]], -1)
+                        if r else row)
+        out.append(cat(rows, 1))
+    return out
+
+
+def _mix_columns_bits(bits, stack):
+    rows = [[p[:, r, :] for p in bits] for r in range(4)]
+    new_rows = []
+    for r in range(4):
+        a, b = rows[r], rows[(r + 1) % 4]
+        c, d = rows[(r + 2) % 4], rows[(r + 3) % 4]
+        new_rows.append(_vxor(_vxor(_xtime_bits(a), _vxor(_xtime_bits(b),
+                                                          b)),
+                              _vxor(c, d)))
+    return [stack([new_rows[r][p] for r in range(4)], 1)
+            for p in range(8)]
+
+
+def _rounds(bits, rk_bits, nr: int, cat, stack):
+    """The shared round schedule over bit-plane state."""
+    bits = _vxor(bits, rk_bits[0])
+    for r in range(1, nr):
+        bits = _sbox_bits(bits)
+        bits = _shift_rows_bits(bits, cat)
+        bits = _mix_columns_bits(bits, stack)
+        bits = _vxor(bits, rk_bits[r])
+    bits = _sbox_bits(bits)
+    bits = _shift_rows_bits(bits, cat)
+    return _vxor(bits, rk_bits[nr])
+
+
+# --------------------------------------------------------------- XLA provider
+
+def _to_planes(blocks):
+    """[B, 16] uint8 -> 8 planes [B, 4, 4] (byte i = row + 4*col)."""
+    x = blocks.reshape(-1, 4, 4).transpose(0, 2, 1)   # [B, r, c]
+    return [((x >> p) & 1).astype(jnp.uint8) for p in range(8)]
+
+
+def _from_planes(bits):
+    acc = bits[0]
+    for p in range(1, 8):
+        acc = acc | (bits[p] << p)
+    return acc.transpose(0, 2, 1).reshape(-1, 16).astype(jnp.uint8)
+
+
+@jax.jit
+def aes_encrypt_bitsliced(round_keys, blocks):
+    """Drop-in twin of `kernels.aes.aes_encrypt_table`, gather-free.
+
+    round_keys [B, R, 16] uint8; blocks [B, 16] uint8 -> [B, 16].
+    """
+    rk = jnp.asarray(round_keys, dtype=jnp.uint8)
+    nr = rk.shape[-2] - 1
+    bits = _to_planes(jnp.asarray(blocks, dtype=jnp.uint8))
+    rk_bits = [_to_planes(rk[:, r, :]) for r in range(nr + 1)]
+    out = _rounds(bits, rk_bits, nr, jnp.concatenate, jnp.stack)
+    return _from_planes(out)
+
+
+def aes_encrypt_bitsliced_nd(round_keys, blocks):
+    """Leading-dim-agnostic wrapper matching `aes_encrypt`'s contract
+    ([..., R, 16] keys, [..., 16] blocks) — the CTR/GCM paths call with
+    broadcast key tensors, which flatten away under jit."""
+    rk = jnp.asarray(round_keys, dtype=jnp.uint8)
+    blk = jnp.asarray(blocks, dtype=jnp.uint8)
+    lead = blk.shape[:-1]
+    out = aes_encrypt_bitsliced(rk.reshape((-1,) + rk.shape[-2:]),
+                                blk.reshape(-1, 16))
+    return out.reshape(lead + (16,))
+
+
+# ------------------------------------------------------------ Pallas provider
+
+def _pallas_kernel(blocks_ref, rk_ref, out_ref, *, nr: int):
+    """Whole-tile bitsliced AES in VMEM; no gathers anywhere."""
+    blocks = blocks_ref[:]
+    rk = rk_ref[:]
+    x = blocks.reshape(-1, 4, 4).transpose(0, 2, 1)
+    bits = [((x >> p) & 1).astype(jnp.uint8) for p in range(8)]
+    rk_bits = []
+    for r in range(nr + 1):
+        k = rk[:, r, :].reshape(-1, 4, 4).transpose(0, 2, 1)
+        rk_bits.append([((k >> p) & 1).astype(jnp.uint8)
+                        for p in range(8)])
+    out = _rounds(bits, rk_bits, nr, jnp.concatenate, jnp.stack)
+    acc = out[0]
+    for p in range(1, 8):
+        acc = acc | (out[p] << p)
+    out_ref[:] = acc.transpose(0, 2, 1).reshape(-1, 16).astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def aes_encrypt_pallas_bitsliced(round_keys, blocks,
+                                 interpret: bool = False):
+    """Pallas twin; may fail to lower on some Mosaic toolchains — the
+    registry records the error and keeps a working provider."""
+    from jax.experimental import pallas as pl
+
+    rk = jnp.asarray(round_keys, dtype=jnp.uint8)
+    blocks = jnp.asarray(blocks, dtype=jnp.uint8)
+    nr = rk.shape[-2] - 1
+    return pl.pallas_call(
+        functools.partial(_pallas_kernel, nr=nr),
+        out_shape=jax.ShapeDtypeStruct(blocks.shape, jnp.uint8),
+        interpret=interpret,
+    )(blocks, rk)
+
+
+# ------------------------------------------------------------------ registry
+
+def register_providers() -> None:
+    from libjitsi_tpu.kernels import aes as aes_mod
+    from libjitsi_tpu.kernels import registry
+
+    registry.register("aes_encrypt", "xla_table", aes_mod.aes_encrypt)
+    registry.register("aes_encrypt", "xla_bitsliced",
+                      aes_encrypt_bitsliced)
+    registry.register("aes_encrypt", "pallas_bitsliced",
+                      aes_encrypt_pallas_bitsliced)
+
+
+register_providers()

@@ -178,7 +178,12 @@ class DenseJitterBank:
             self.released[rel] = True
 
             # gap skip: buffer non-empty and its oldest waited out
-            # target + one frame
+            # target + one frame.  The scalar pop's recursion skips seq
+            # by seq until it lands on a buffered one; since the oldest-
+            # arrival condition stays true throughout, that is a jump
+            # straight to the nearest buffered seq with the whole gap
+            # counted lost — done here in one vector step so a large
+            # sender jump doesn't stall for depth-bounded ticks.
             miss = s[~hit]
             if len(miss):
                 occ = self._occ[miss]
@@ -188,8 +193,17 @@ class DenseJitterBank:
                 skip = any_buf & (now - oldest
                                   > target[miss] + self.frame_s[miss])
                 sk = miss[skip]
-                self.lost[sk] += 1
-                self.next_seq[sk] = (self.next_seq[sk] + 1) & 0xFFFF
+                if len(sk):
+                    d = seq_delta(self._slot_seq[sk],
+                                  self.next_seq[sk][:, None])
+                    d = np.where(self._occ[sk] & (d > 0), d,
+                                 np.int32(1 << 16))
+                    jump = d.min(axis=1).astype(np.int64)
+                    ok_j = jump < (1 << 16)   # a buffered target exists
+                    sk, jump = sk[ok_j], jump[ok_j]
+                    self.lost[sk] += jump
+                    self.next_seq[sk] = (self.next_seq[sk]
+                                         + jump) & 0xFFFF
                 if not skip.any() and not due.any():
                     break
             elif not due.any():

@@ -68,28 +68,29 @@ class JitterBuffer:
     def pop(self, now: float) -> Optional[bytes]:
         """Release the next in-order frame if due; skips a missing seq
         (counting it lost) once its successor has waited out the target
-        delay plus one frame."""
-        if self._next_seq is None:
-            return None
-        e = self._buf.pop(self._next_seq, None)
-        if e is not None:
-            # 1 µs tolerance: float rounding in the transit-jitter EWMA
-            # yields epsilon (~1e-11 s) target delays that would hold a
-            # frame popped the same instant it arrived
-            if now - e.arrival < self.target_delay - 1e-6:
-                self._buf[e.seq] = e  # not due yet
-                return None
-            self._next_seq = (self._next_seq + 1) & 0xFFFF
-            self._released = True
-            return e.payload
-        # gap: wait for reordering up to target + one frame, then skip
-        if self._buf:
-            oldest = min(self._buf.values(), key=lambda x: x.arrival)
-            if now - oldest.arrival > self.target_delay + \
-                    self.frame_ms / 1000.0:
-                self.lost += 1
+        delay plus one frame.  Iterative (a recursion here blows the
+        interpreter stack on a large sender seq jump — seen at ~1000)."""
+        while self._next_seq is not None:
+            e = self._buf.pop(self._next_seq, None)
+            if e is not None:
+                # 1 µs tolerance: float rounding in the transit-jitter
+                # EWMA yields epsilon (~1e-11 s) target delays that would
+                # hold a frame popped the same instant it arrived
+                if now - e.arrival < self.target_delay - 1e-6:
+                    self._buf[e.seq] = e  # not due yet
+                    return None
                 self._next_seq = (self._next_seq + 1) & 0xFFFF
-                return self.pop(now)
+                self._released = True
+                return e.payload
+            # gap: wait for reordering up to target + one frame, then skip
+            if not self._buf:
+                return None
+            oldest = min(self._buf.values(), key=lambda x: x.arrival)
+            if now - oldest.arrival <= self.target_delay + \
+                    self.frame_ms / 1000.0:
+                return None
+            self.lost += 1
+            self._next_seq = (self._next_seq + 1) & 0xFFFF
         return None
 
     def __len__(self) -> int:

@@ -254,6 +254,10 @@ class ReceiveBank:
         self.decoded_frames = np.zeros(capacity, dtype=np.int64)
         self.lost_frames = np.zeros(capacity, dtype=np.int64)
         self.decode_errors = np.zeros(capacity, dtype=np.int64)
+        # frames larger than payload_cap are DROPPED (not truncated —
+        # feeding a truncated frame to a stateful decoder corrupts its
+        # state); size payload_cap for the codec/bitrate in use
+        self.oversize_dropped = np.zeros(capacity, dtype=np.int64)
 
     def add_stream(self, sid: int, codec: FrameCodec) -> None:
         if self.mixer is not None and \
@@ -300,18 +304,20 @@ class ReceiveBank:
         sids = np.asarray(batch.stream, dtype=np.int64)
         hdr = rtp_header.parse(batch)
         lens_all = np.asarray(batch.length) - hdr.payload_off
-        rows = np.nonzero(np.asarray(ok)
-                          & np.asarray(hdr.valid)
-                          & (lens_all > 0)     # lying ext len -> negative
-                          & (sids >= 0) & (sids < self.capacity)
-                          & (self._kind[np.clip(sids, 0,
-                                                self.capacity - 1)] >= 0)
-                          )[0]
+        cap = self.jb.payload_cap
+        base = (np.asarray(ok) & np.asarray(hdr.valid)
+                & (lens_all > 0)               # lying ext len -> negative
+                & (sids >= 0) & (sids < self.capacity)
+                & (self._kind[np.clip(sids, 0,
+                                      self.capacity - 1)] >= 0))
+        over = base & (lens_all > cap)
+        if over.any():
+            np.add.at(self.oversize_dropped, sids[over], 1)
+        rows = np.nonzero(base & ~over)[0]
         if len(rows) == 0:
             return 0
         off = hdr.payload_off[rows]
         lens = lens_all[rows]
-        cap = self.jb.payload_cap
         # vectorized ragged gather: no per-row Python loop on the intake
         col = np.arange(cap, dtype=np.int64)[None, :]
         src = np.clip(off[:, None] + col, 0, batch.capacity - 1)
@@ -338,6 +344,7 @@ class ReceiveBank:
         self.lost_frames[installed & ~ready] += 1
         out_sids: List[int] = []
         out_pcm: List[np.ndarray] = []
+        mix_deposits: List[Tuple[np.ndarray, np.ndarray]] = []
 
         for kind, fn in ((self.G711_ULAW, g711.ulaw_decode),
                          (self.G711_ALAW, g711.alaw_decode)):
@@ -347,10 +354,14 @@ class ReceiveBank:
                 rows = krows[self.frame_samples[krows] == n]
                 pcm = np.asarray(fn(pays[rows, :int(n)]), dtype=np.int16)
                 self.decoded_frames[rows] += 1
-                for k, sid in enumerate(rows):
-                    out_sids.append(int(sid))
-                    out_pcm.append(pcm[k])
+                # block-level bookkeeping: no per-row loop on the
+                # vectorized path (10k ready streams = 10k rows here)
+                out_sids.extend(rows.tolist())
+                out_pcm.extend(pcm)
+                mix_deposits.append((rows, pcm))
         srows = np.nonzero(ready & (self._kind == self.STATEFUL))[0]
+        s_sids: List[int] = []
+        s_pcm: List[np.ndarray] = []
         for sid in srows:
             sid = int(sid)
             try:
@@ -363,12 +374,18 @@ class ReceiveBank:
                 elif len(pcm) > f:
                     pcm = pcm[:f]
                 self.decoded_frames[sid] += 1
-                out_sids.append(sid)
-                out_pcm.append(pcm)
+                s_sids.append(sid)
+                s_pcm.append(pcm)
             except (ValueError, RuntimeError):
                 self.decode_errors[sid] += 1
-        if self.mixer is not None and out_sids:
-            # frame sizes verified against the mixer at add_stream time
-            self.mixer.push_batch(np.asarray(out_sids),
-                                  np.stack(out_pcm))
+        out_sids.extend(s_sids)
+        out_pcm.extend(s_pcm)
+        if self.mixer is not None:
+            # frame sizes verified against the mixer at add_stream time;
+            # vectorized groups deposit as whole blocks
+            for rows, pcm in mix_deposits:
+                self.mixer.push_batch(rows, pcm)
+            if s_sids:
+                self.mixer.push_batch(np.asarray(s_sids),
+                                      np.stack(s_pcm))
         return out_sids, out_pcm

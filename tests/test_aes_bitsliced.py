@@ -1,0 +1,75 @@
+"""Gather-free bitsliced AES vs the table core.
+
+The circuit is derived from GF(2^8) algebra at import (and the module
+asserts its full S-box truth table then); these tests pin the batched
+device paths: XLA bitsliced, Pallas interpret mode, the nd wrapper the
+CTR/GCM call sites use, and the `set_core` seam end-to-end through
+`srtp_protect`.
+"""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.kernels import aes
+from libjitsi_tpu.kernels.aes import aes_encrypt_table, expand_keys_batch
+from libjitsi_tpu.kernels.aes_bitsliced import (
+    aes_encrypt_bitsliced, aes_encrypt_bitsliced_nd,
+    aes_encrypt_pallas_bitsliced)
+
+
+@pytest.mark.parametrize("key_len", [16, 32])
+def test_bitsliced_matches_table(key_len):
+    rng = np.random.default_rng(1)
+    rks = expand_keys_batch(
+        rng.integers(0, 256, (24, key_len), dtype=np.uint8))
+    blocks = rng.integers(0, 256, (24, 16), dtype=np.uint8)
+    want = np.asarray(aes_encrypt_table(rks, blocks))
+    assert np.array_equal(np.asarray(aes_encrypt_bitsliced(rks, blocks)),
+                          want)
+    got_p = np.asarray(aes_encrypt_pallas_bitsliced(rks, blocks,
+                                                    interpret=True))
+    assert np.array_equal(got_p, want)
+
+
+def test_bitsliced_nd_wrapper_broadcast_keys():
+    """The CTR path calls with [B, n, R, 16] broadcast keys."""
+    rng = np.random.default_rng(2)
+    rks = expand_keys_batch(rng.integers(0, 256, (6, 16), dtype=np.uint8))
+    rk4 = np.broadcast_to(rks[:, None], (6, 3, 11, 16))
+    blocks = rng.integers(0, 256, (6, 3, 16), dtype=np.uint8)
+    want = np.asarray(aes_encrypt_table(rk4, blocks))
+    got = np.asarray(aes_encrypt_bitsliced_nd(rk4, blocks))
+    assert np.array_equal(got, want)
+
+
+@pytest.mark.slow          # set_core clears jax caches -> recompiles
+def test_set_core_switches_srtp_protect_bit_identically():
+    from libjitsi_tpu.core.packet import PacketBatch
+    from libjitsi_tpu.rtp import header as rtp_header
+    from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+    rng = np.random.default_rng(3)
+    mk, ms = bytes(range(16)), bytes(range(40, 54))
+
+    def protect():
+        t = SrtpStreamTable(capacity=2)
+        t.add_stream(0, mk, ms)
+        b = rtp_header.build([b"core-check-%d" % i for i in range(4)],
+                             [50 + i for i in range(4)], [0] * 4,
+                             [0xC0DE] * 4, [96] * 4, stream=[0] * 4)
+        return [t.protect_rtp(b).to_bytes(i) for i in range(4)]
+
+    assert aes.get_core() == "table"
+    want = protect()
+    try:
+        aes.set_core("bitsliced")
+        assert protect() == want
+    finally:
+        aes.set_core("table")
+
+
+def test_registry_lists_aes_providers():
+    from libjitsi_tpu.kernels import registry
+
+    assert set(registry.providers("aes_encrypt")) >= {
+        "xla_table", "xla_bitsliced", "pallas_bitsliced"}

@@ -274,3 +274,37 @@ def test_receive_bank_review_hardening():
     sids2, _ = bank.tick(now=51.05)
     assert 0 in sids2
     assert bank.jb.late_dropped[0] == 0
+
+
+def test_dense_jitter_large_seq_jump_catches_up_in_one_tick():
+    """A sender restart that jumps seq by ~1000 must not stall for
+    depth-bounded ticks: the gap skips in one pop (like the scalar
+    recursion), counting the whole gap lost."""
+    bank = DenseJitterBank(capacity=2, depth=16, payload_cap=32,
+                           clock_rate=8000, frame_ms=20.0)
+    sc = JitterBuffer(clock_rate=8000, frame_ms=20.0)
+    pay = np.zeros((1, 8), np.uint8)
+    bank.insert_batch([0], [100], [0], pay, [8], 5.0)
+    sc.insert(100, 0, bytes(8), 5.0)
+    assert bank.pop_all(5.0)[0][0] and sc.pop(5.0) is not None
+    # jump: next packet at seq 1100
+    bank.insert_batch([0], [1100], [160], pay, [8], 5.02)
+    sc.insert(1100, 160, bytes(8), 5.02)
+    # after the wait law expires, one tick releases the new packet
+    ready, _, _ = bank.pop_all(5.5)
+    want = sc.pop(5.5)
+    assert ready[0] and want is not None
+    assert bank.lost[0] == sc.lost == 999
+
+
+def test_receive_bank_drops_oversize_frames_not_truncates():
+    from libjitsi_tpu.rtp import header as rtp_header
+    from libjitsi_tpu.service.pump import ReceiveBank, gsm_codec
+
+    bank = ReceiveBank(capacity=2, payload_cap=64)
+    bank.add_stream(0, gsm_codec())
+    big = bytes(100)                          # > payload_cap
+    b = rtp_header.build([big], [5], [0], [1], [3], stream=[0])
+    assert bank.push_decrypted(b, np.ones(1, bool), now=50.0) == 0
+    assert bank.oversize_dropped[0] == 1
+    assert bank.decode_errors[0] == 0
