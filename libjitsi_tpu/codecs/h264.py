@@ -1,12 +1,12 @@
 """H.264 RTP packetization/depacketization (RFC 6184).
 
 Rebuilds the header logic of the reference's
-`org.jitsi.impl.neomedia.codec.video.h264.{Packetizer,DePacketizer}`
-(the JNI encoder/decoder around ffmpeg/openh264 stays out of scope —
-like VP8, the bitstream codec is a host library concern; the RTP-layer
-byte logic is what the SFU/stream paths need): single NAL unit mode,
-STAP-A aggregation, and FU-A fragmentation, plus keyframe (IDR/SPS)
-detection for layer switching.
+`org.jitsi.impl.neomedia.codec.video.h264.{Packetizer,DePacketizer}`:
+single NAL unit mode, STAP-A aggregation, and FU-A fragmentation, plus
+keyframe (IDR/SPS) detection for layer switching.  The bitstream codec
+half (the reference's JNIEncoder/JNIDecoder over ffmpeg) is
+`codecs.avcodec` (libavcodec via ctypes); `split_annexb` bridges its
+Annex-B access units to the NAL lists this module packetizes.
 """
 
 from __future__ import annotations
@@ -19,6 +19,31 @@ NAL_FU_A = 28
 NAL_IDR = 5
 NAL_SPS = 7
 NAL_PPS = 8
+
+
+def split_annexb(au: bytes) -> List[bytes]:
+    """Split an Annex-B access unit (00 00 [00] 01 start codes) into
+    bare NAL units (the packetizer's input format)."""
+    nals: List[bytes] = []
+    i = 0
+    n = len(au)
+    start = -1
+    while i + 2 < n:
+        if au[i] == 0 and au[i + 1] == 0 and \
+                (au[i + 2] == 1
+                 or (i + 3 < n and au[i + 2] == 0 and au[i + 3] == 1)):
+            sc = 3 if au[i + 2] == 1 else 4
+            if start >= 0:
+                nal = au[start:i]
+                if nal:
+                    nals.append(nal)
+            i += sc
+            start = i
+        else:
+            i += 1
+    if start >= 0 and start < n:
+        nals.append(au[start:])
+    return nals
 
 
 def packetize(nals: List[bytes], mtu: int = 1200) -> List[bytes]:
