@@ -48,14 +48,55 @@ class Synchronizer:
         return unix + d / rate
 
 
+class WavWriter:
+    """Streaming PCM16 WAV sink over the stdlib `wave` module (mono by
+    default; the mixer's interchange format).
+
+    Reference: RecorderImpl's mixed-audio file output — the conference
+    mix (`AudioMixer.mix()` rows or the total sum) lands in a standard
+    RIFF/WAVE file, header sizes patched on close.
+    """
+
+    def __init__(self, path: str, sample_rate: int = 48000,
+                 channels: int = 1):
+        import wave
+
+        self.path = path
+        self.channels = channels
+        self._w = wave.open(path, "wb")
+        self._w.setnchannels(channels)
+        self._w.setsampwidth(2)
+        self._w.setframerate(sample_rate)
+
+    def write(self, pcm) -> None:
+        """Append int16 samples ([N] mono or [N, channels])."""
+        import numpy as _np
+
+        arr = _np.asarray(pcm)
+        if arr.dtype != _np.int16:
+            raise TypeError(f"WAV sink wants int16 PCM, got {arr.dtype}")
+        if self.channels > 1 and (arr.ndim != 2
+                                  or arr.shape[1] != self.channels):
+            raise ValueError(
+                f"want [N, {self.channels}] samples, got {arr.shape}")
+        self._w.writeframesraw(arr.astype("<i2").tobytes())
+
+    def close(self) -> str:
+        self._w.close()
+        return self.path
+
+
 class Recorder:
-    """Record per-SSRC RTP to rtpdump + JSON event timeline."""
+    """Record per-SSRC RTP to rtpdump + JSON event timeline, plus an
+    optional mixed-audio WAV (reference: RecorderImpl records the
+    conference audio to files, not just packets)."""
 
     def __init__(self, directory: str):
         self.directory = directory
         os.makedirs(directory, exist_ok=True)
         self.sync = Synchronizer()
         self._writers: Dict[int, RtpdumpWriter] = {}
+        self._wav: Optional[WavWriter] = None
         self._events: List[dict] = []
         self._started = time.time()
         self._event("RECORDING_STARTED")
@@ -90,9 +131,27 @@ class Recorder:
         can follow the dominant speaker."""
         self._event("SPEAKER_CHANGED", ssrc=ssrc)
 
+    # -------------------------------------------------------- mixed audio
+    def enable_audio(self, sample_rate: int = 48000,
+                     filename: str = "conference.wav") -> None:
+        """Open the mixed-audio WAV sink (one mono track: the
+        conference sum — feed `write_mixed_audio` once per mix tick)."""
+        if self._wav is None:
+            path = os.path.join(self.directory, filename)
+            self._wav = WavWriter(path, sample_rate=sample_rate)
+            self._event("AUDIO_RECORDING_STARTED", filename=path)
+
+    def write_mixed_audio(self, pcm) -> None:
+        """Append one mixed PCM frame (int16 [F]); no-op until
+        `enable_audio`."""
+        if self._wav is not None:
+            self._wav.write(pcm)
+
     def close(self) -> str:
         for w in self._writers.values():
             w.close()
+        if self._wav is not None:
+            self._wav.close()
         self._event("RECORDING_ENDED")
         path = os.path.join(self.directory, "metadata.json")
         with open(path, "w") as f:

@@ -28,6 +28,7 @@ from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.rtp import rtcp
 from libjitsi_tpu.rtp.stats import StreamStatsTable
+from libjitsi_tpu.rtp.stats2 import StatsPoller
 from libjitsi_tpu.control.sdes import SdesControl
 from libjitsi_tpu.transform.engine import TransformEngineChain, TransformEngine
 from libjitsi_tpu.transform.header_ext import (
@@ -69,6 +70,9 @@ class StreamRegistry:
         self.config = config
         self.capacity = capacity
         self.stats = StreamStatsTable(capacity)
+        # MediaStreamStats2-shaped pull API (rates for all rows close in
+        # one vectorized poll; streams read per-track views from it)
+        self.stats2 = StatsPoller(self.stats)
         # per-profile crypto tables, created on first use (tx, rx)
         self._srtp: Dict[SrtpProfile, Tuple[SrtpStreamTable, SrtpStreamTable]] = {}
         self._free = list(range(capacity - 1, -1, -1))
@@ -90,6 +94,7 @@ class StreamRegistry:
             if rx.active[sid]:
                 rx.remove_stream(sid)
         self.stats.reset(sid)  # a recycled row must not inherit counters
+        self.stats2.reset(sid)
         self._free.append(sid)
 
     def srtp_tables(self, profile: SrtpProfile
@@ -163,6 +168,7 @@ class MediaStream:
         self._extra = list(extra_engines)
         self._chain: Optional[TransformEngineChain] = None
         self._started = False
+        self._rtcp_listeners: list = []
 
     # ------------------------------------------------------------ control
     def add_dynamic_rtp_payload_type(self, pt: int, encoding: str,
@@ -282,7 +288,9 @@ class MediaStream:
 
     def handle_rtcp(self, blob: bytes, now: Optional[float] = None) -> list:
         """Feed an incoming (already-unprotected) compound RTCP packet to
-        stats; returns the parsed packets for upper layers (BWE etc.)."""
+        stats; returns the parsed packets for upper layers (BWE etc.).
+        Registered RTCP listeners (reference: RTCPPacketListener on
+        MediaStreamStats2) see every parsed packet."""
         pkts = rtcp.parse_compound(blob)
         st = self.registry.stats
         for p in pkts:
@@ -295,12 +303,23 @@ class MediaStream:
                 for rb in p.reports:
                     if rb.ssrc == self.local_ssrc:
                         st.on_rr_received(self.sid, rb, now=now)
+        for fn in self._rtcp_listeners:
+            for p in pkts:
+                fn(self, p)
         return pkts
+
+    def add_rtcp_listener(self, fn) -> None:
+        """fn(stream, parsed_rtcp_packet) per incoming RTCP packet."""
+        self._rtcp_listeners.append(fn)
+
+    def remove_rtcp_listener(self, fn) -> None:
+        self._rtcp_listeners.remove(fn)
 
     # -------------------------------------------------------------- stats
     @property
     def stats(self) -> dict:
-        """Snapshot for this stream (reference: MediaStreamStats2)."""
+        """Flat snapshot for this stream (see `send_stats` /
+        `receive_stats` for the typed MediaStreamStats2 views)."""
         st = self.registry.stats
         i = self.sid
         return {
@@ -312,6 +331,18 @@ class MediaStream:
             "jitter_rtp_units": float(st.jitter[i]),
             "rtt_seconds": float(st.rtt[i]),
         }
+
+    def send_stats(self):
+        """Typed per-track send stats (reference: `stats.SendTrackStats`
+        via MediaStreamStats2.getSendStats).  Rates reflect the
+        registry poller's last closed interval — call
+        `registry.stats2.poll()` periodically."""
+        return self.registry.stats2.send_stats(self.sid)
+
+    def receive_stats(self):
+        """Typed per-track receive stats (reference:
+        `stats.ReceiveTrackStats` via getReceiveStats)."""
+        return self.registry.stats2.receive_stats(self.sid)
 
 
 def create_media_stream(config: ConfigurationService,

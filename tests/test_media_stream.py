@@ -142,3 +142,55 @@ def test_registry_demux_and_release(svc):
     assert sid not in reg.streams
     c = svc.create_media_stream()
     assert c.sid == sid  # row recycled
+
+
+@pytest.mark.slow
+def test_stats2_pull_api_and_rtcp_listener(svc):
+    """MediaStreamStats2 shape: typed Send/ReceiveTrackStats with
+    windowed rates from the registry poller, plus RTCP listeners."""
+    a, b = make_pair(svc)
+    reg = a.registry
+    reg.stats2.poll(now=100.0)
+    payloads = [bytes([i]) * 120 for i in range(20)]
+    wire = a.send(payloads, pt=96)
+    b.receive(wire, arrival=100.5)
+    reg.stats2.poll(now=101.0)            # close a 1 s interval
+
+    s = a.send_stats()
+    assert s.packets == 20 and s.bytes > 20 * 120
+    assert s.packet_rate_pps == pytest.approx(20.0, rel=0.01)
+    assert s.bitrate_bps == pytest.approx(s.bytes * 8.0, rel=0.01)
+    assert s.rtt_ms == -1.0               # no RR echoed yet
+
+    r = b.receive_stats()
+    assert r.packets == 20
+    assert r.packet_rate_pps == pytest.approx(20.0, rel=0.01)
+    assert r.cumulative_lost == 0 and r.fraction_lost == 0.0
+    assert r.highest_seq >= 0
+
+    # RTCP listener sees parsed packets
+    seen = []
+    b.add_rtcp_listener(lambda stream, p: seen.append(type(p).__name__))
+    blob = a.make_rtcp_report(now=101.0)
+    b.handle_rtcp(blob, now=101.1)
+    assert "SenderReport" in seen or "ReceiverReport" in seen
+    assert "SdesPacket" in "".join(seen) or len(seen) >= 2
+
+
+def test_stats2_poller_resets_on_row_recycle(svc):
+    """A recycled stream row must not difference rates against the dead
+    stream's totals (would show huge negative pps)."""
+    reg = libjitsi_tpu.media_service()._registry \
+        if hasattr(libjitsi_tpu.media_service(), "_registry") else None
+    a, b = make_pair(svc)
+    reg = a.registry
+    reg.stats2.poll(now=10.0)
+    a.send([b"x" * 100] * 50, pt=96)
+    reg.stats2.poll(now=11.0)
+    sid = a.sid
+    a.close() if hasattr(a, "close") else reg.release(sid)
+    c = svc.create_media_stream(local_ssrc=0xC)
+    assert c.sid == sid                      # row recycled
+    reg.stats2.poll(now=12.0)
+    assert c.send_stats().packet_rate_pps == 0.0
+    assert c.send_stats().packets == 0

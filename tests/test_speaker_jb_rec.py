@@ -118,3 +118,29 @@ def test_recorder_writes_rtpdump_and_events(tmp_path):
     kinds = [e["type"] for e in events]
     assert kinds == ["RECORDING_STARTED", "STREAM_STARTED",
                      "SPEAKER_CHANGED", "RECORDING_ENDED"]
+
+
+def test_recorder_mixed_audio_wav(tmp_path):
+    """RecorderImpl parity: the conference mix lands in a playable WAV."""
+    import wave
+
+    from libjitsi_tpu.recording.recorder import Recorder
+
+    rec = Recorder(str(tmp_path / "conf"))
+    rec.enable_audio(sample_rate=8000)
+    tone = (3000 * np.sin(2 * np.pi * 440 / 8000
+                          * np.arange(8000))).astype(np.int16)
+    for k in range(0, 8000, 160):
+        rec.write_mixed_audio(tone[k:k + 160])
+    meta = rec.close()
+    path = tmp_path / "conf" / "conference.wav"
+    with wave.open(str(path), "rb") as w:
+        assert w.getnchannels() == 1
+        assert w.getframerate() == 8000
+        assert w.getsampwidth() == 2
+        assert w.getnframes() == 8000
+        got = np.frombuffer(w.readframes(8000), dtype="<i2")
+    assert np.array_equal(got, tone)
+    import json as _json
+    events = _json.load(open(meta))["events"]
+    assert any(e["type"] == "AUDIO_RECORDING_STARTED" for e in events)
