@@ -15,17 +15,30 @@ Run:  PYTHONPATH=. python examples/conference_bridge.py
 prints per-participant stats.)
 """
 
+import os
 import time
 
 import jax
 import numpy as np
 
-try:  # environments that export JAX_PLATFORMS for an unavailable
-    jax.devices()       # accelerator plugin fall back to CPU (same
-except RuntimeError:    # guard tests/conftest.py applies)
+# Demo platform policy: default to the CPU backend (tests/conftest.py's
+# recipe — config-update BEFORE any backend init; env vars alone are
+# clobbered where sitecustomize pins an accelerator plugin).  A tunneled
+# accelerator "works" here but compiles the demo over the wire; set
+# LIBJITSI_TPU_DEMO_DEVICE=accel to opt in to the real device.
+if os.environ.get("LIBJITSI_TPU_DEMO_DEVICE", "cpu") != "accel":
     jax.config.update("jax_platforms", "cpu")
+else:
+    try:
+        jax.devices()
+    except RuntimeError:    # accelerator plugin unavailable after all
+        jax.config.update("jax_platforms", "cpu")
 
 import libjitsi_tpu
+from libjitsi_tpu.utils.compile_cache import enable_compile_cache
+
+enable_compile_cache()      # re-runs start warm
+
 from libjitsi_tpu.conference import AudioMixer
 from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.device import ToneSource

@@ -75,8 +75,11 @@ class WavWriter:
         arr = _np.asarray(pcm)
         if arr.dtype != _np.int16:
             raise TypeError(f"WAV sink wants int16 PCM, got {arr.dtype}")
-        if self.channels > 1 and (arr.ndim != 2
-                                  or arr.shape[1] != self.channels):
+        if self.channels == 1:
+            if arr.ndim != 1:       # a [S, F] mix matrix would silently
+                raise ValueError(   # interleave into garbage audio
+                    f"mono WAV sink wants [N] samples, got {arr.shape}")
+        elif arr.ndim != 2 or arr.shape[1] != self.channels:
             raise ValueError(
                 f"want [N, {self.channels}] samples, got {arr.shape}")
         self._w.writeframesraw(arr.astype("<i2").tobytes())

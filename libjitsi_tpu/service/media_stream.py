@@ -303,8 +303,8 @@ class MediaStream:
                 for rb in p.reports:
                     if rb.ssrc == self.local_ssrc:
                         st.on_rr_received(self.sid, rb, now=now)
-        for fn in self._rtcp_listeners:
-            for p in pkts:
+        for fn in list(self._rtcp_listeners):   # listeners may remove
+            for p in pkts:                      # themselves mid-callback
                 fn(self, p)
         return pkts
 

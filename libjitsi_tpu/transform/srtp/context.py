@@ -169,6 +169,32 @@ class SrtpStreamTable:
         self._epoch_rtcp = np.zeros(s, dtype=np.int64)
         self._masters: Dict[int, Tuple[bytes, bytes]] = {}
 
+    def _cow_tables(self) -> None:
+        """Copy-on-write before any key-table mutation.
+
+        On the CPU backend `jnp.asarray` can zero-copy ALIAS the host
+        numpy buffers (see the project's asarray-alias note), so writing
+        keys in place while async/pipelined work is in flight would feed
+        mutated keys to already-dispatched kernels.  Re-pointing the
+        numpy attributes at fresh copies leaves any aliased device
+        arrays reading the old, still-consistent buffers; `_dev = None`
+        makes the next launch re-upload the new ones.  Cold path (key
+        installs/removals), so the ~MB copy is irrelevant.
+        """
+        self._rk_rtp = self._rk_rtp.copy()
+        self._rk_rtcp = self._rk_rtcp.copy()
+        self._mid_rtp = self._mid_rtp.copy()
+        self._mid_rtcp = self._mid_rtcp.copy()
+        if self._gcm:
+            self._gm_rtp = self._gm_rtp.copy()
+            self._gm_rtcp = self._gm_rtcp.copy()
+        if self._f8:
+            self._rk_f8_rtp = self._rk_f8_rtp.copy()
+            self._rk_f8_rtcp = self._rk_f8_rtcp.copy()
+        self._salt_rtp = self._salt_rtp.copy()
+        self._salt_rtcp = self._salt_rtcp.copy()
+        self._dev = None
+
     # ------------------------------------------------------------------ keys
     def add_stream(self, sid: int, master_key: bytes, master_salt: bytes,
                    kdr: int = 0) -> None:
@@ -230,6 +256,7 @@ class SrtpStreamTable:
             mks, mss, enc_key_len=p.enc_key_len,
             auth_key_len=p.auth_key_len, salt_len=p.salt_len)
 
+        self._cow_tables()
         self._rk_rtp[sids] = expand_keys_batch(ksb.rtp_enc)
         self._rk_rtcp[sids] = expand_keys_batch(ksb.rtcp_enc)
         if self._gcm:
@@ -282,6 +309,7 @@ class SrtpStreamTable:
         """Pack one stream's derived session keys into the device tables
         (shared by add_stream and kdr epoch re-derivation)."""
         p = self.policy
+        self._cow_tables()
         self._rk_rtp[sid] = expand_key(ks.rtp_enc)
         self._rk_rtcp[sid] = expand_key(ks.rtcp_enc)
         if self._gcm:
@@ -427,6 +455,7 @@ class SrtpStreamTable:
 
     def remove_stream(self, sid: int) -> None:
         self.active[sid] = False
+        self._cow_tables()
         self._rk_rtp[sid] = 0
         self._rk_rtcp[sid] = 0
         self._mid_rtp[sid] = 0
@@ -562,7 +591,38 @@ class SrtpStreamTable:
         out, _ = unbucket(done, batch.batch_size, batch.capacity)
         return out
 
+    def protect_rtp_async(self, batch: PacketBatch) -> "PendingProtect":
+        """Dispatch-only protect: device work is enqueued and host TX
+        state is fully updated, but results are NOT materialized —
+        `.result()` does that.  This is the double-buffering seam
+        (SURVEY §7 step 4's latency budget): dispatch batch N+1 while
+        batch N's bytes are still in flight; protect's host state
+        (chain index + tx max) depends only on inputs, so pipelining is
+        state-safe at any depth, and key-table mutations while parts are
+        pending are safe because every mutator goes through
+        `_cow_tables` (in-flight kernels keep reading the old buffers).
+        kdr re-keying batches fall back to the sync path (epoch waves
+        are inherently sequential).
+        """
+        if batch.batch_size == 0:
+            return PendingProtect([], 0, batch.capacity, done=batch)
+        stream0 = np.asarray(batch.stream, dtype=np.int64)
+        if self._kdr_active(stream0):
+            return PendingProtect([], 0, batch.capacity,
+                                  done=self.protect_rtp(batch))
+        parts = bucket_by_size(batch)
+        pend = [(rows, self._protect_rtp_dispatch(part), n)
+                for rows, part, n in parts]
+        return PendingProtect(pend, batch.batch_size, batch.capacity)
+
     def _protect_rtp_direct(self, batch: PacketBatch) -> PacketBatch:
+        data, length, stream = self._protect_rtp_dispatch(batch)
+        return PacketBatch(np.asarray(data),
+                           np.asarray(length, dtype=np.int32), stream)
+
+    def _protect_rtp_dispatch(self, batch: PacketBatch):
+        """Device dispatch + host state update; returns device arrays
+        (data, length) plus the stream ids, WITHOUT materializing."""
         hdr = rtp_header.parse(batch)
         stream = np.asarray(batch.stream, dtype=np.int64)
         self._require_active(stream)
@@ -602,8 +662,7 @@ class SrtpStreamTable:
                 self.policy.auth_tag_len, self.policy.cipher != Cipher.NULL,
                 off_const=_uniform_off(hdr.payload_off, batch.capacity))
         np.maximum.at(self.tx_ext, stream, idx)
-        return PacketBatch(np.asarray(data), np.asarray(length, dtype=np.int32),
-                           batch.stream)
+        return data, length, batch.stream
 
     def unprotect_rtp(self, batch: PacketBatch, return_index: bool = False):
         """Auth-check, replay-check and decrypt incoming RTP.
@@ -977,3 +1036,30 @@ class SrtpStreamTable:
             t._masters = dict(snap["masters"])
         t._dev = None
         return t
+
+
+class PendingProtect:
+    """An in-flight `protect_rtp_async` call.
+
+    Host state is already committed; the device results materialize on
+    `result()` (one blocking transfer per size-class part).  The object
+    is single-shot: result() caches and re-returns.
+    """
+
+    def __init__(self, parts, batch_size: int, capacity: int,
+                 done: "PacketBatch | None" = None):
+        self._parts = parts
+        self._batch_size = batch_size
+        self._capacity = capacity
+        self._done = done
+
+    def result(self) -> PacketBatch:
+        if self._done is None:
+            done = [(rows, PacketBatch(np.asarray(data),
+                                       np.asarray(length, dtype=np.int32),
+                                       stream), n)
+                    for rows, (data, length, stream), n in self._parts]
+            out, _ = unbucket(done, self._batch_size, self._capacity)
+            self._done = out
+            self._parts = []
+        return self._done

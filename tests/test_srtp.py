@@ -415,3 +415,52 @@ def test_kdf_batch_matches_scalar_with_epochs():
             for f in ('rtp_enc', 'rtp_auth', 'rtp_salt', 'rtcp_enc',
                       'rtcp_auth', 'rtcp_salt'):
                 assert getattr(got, f) == getattr(want, f), (ekl, i, f)
+
+
+def test_protect_rtp_async_matches_sync():
+    """Double-buffered dispatch: N in-flight protects materialize to
+    exactly what the sync path produces, with identical TX state."""
+    rng = np.random.default_rng(21)
+    t_sync = make_table(n=4)
+    t_async = make_table(n=4)
+    pendings = []
+    batches = []
+    for k in range(3):                      # three batches in flight
+        pkts, sids = [], []
+        for i in range(12):
+            payload = bytes(rng.integers(0, 256, 30 + 40 * (i % 3),
+                                         dtype=np.uint8))
+            pkts.append(rtp_pkt(200 + 3 * k + i // 4,
+                                ssrc=0x2000 + i % 4, payload=payload))
+            sids.append(i % 4)
+        b = PacketBatch.from_payloads(pkts, stream=sids)
+        batches.append(b)
+        pendings.append(t_async.protect_rtp_async(b))
+    for k, (b, p) in enumerate(zip(batches, pendings)):
+        want = t_sync.protect_rtp(b)
+        got = p.result()
+        for i in range(b.batch_size):
+            assert got.to_bytes(i) == want.to_bytes(i), (k, i)
+        assert p.result() is got            # single-shot cache
+    assert np.array_equal(t_sync.tx_ext, t_async.tx_ext)
+
+
+def test_key_mutation_while_protect_pending_is_safe():
+    """CPU-backend jnp.asarray can alias host buffers: installing or
+    removing keys while async protects are in flight must not corrupt
+    the dispatched batches (copy-on-write in the mutators)."""
+    rng = np.random.default_rng(30)
+    t = make_table(n=4)
+    ref = make_table(n=4)
+    pkts = [rtp_pkt(700 + i, ssrc=0x3000 + i % 4,
+                    payload=bytes(rng.integers(0, 256, 60, dtype=np.uint8)))
+            for i in range(8)]
+    b = PacketBatch.from_payloads(pkts, stream=[i % 4 for i in range(8)])
+    want = ref.protect_rtp(b)
+    pend = t.protect_rtp_async(b)
+    # mutate the tables while the batch is (potentially) in flight
+    t.add_stream(2, bytes(range(50, 66)), bytes(range(70, 84)))
+    t.remove_stream(3)
+    got = pend.result()
+    for i in range(8):
+        assert got.to_bytes(i) == want.to_bytes(i), i
