@@ -352,20 +352,25 @@ def table_pps(n_streams: int = N_STREAMS, batch: int = 4096,
     rx = SrtpStreamTable(capacity=n_streams)
     rx.add_streams(np.arange(n_streams), mks, mss)
 
-    # n_batches distinct batches (distinct seqs: replay must accept all),
-    # mixed sizes hitting all three width classes: 60% small voice, 30%
-    # mid video, 10% near-MTU
+    # distinct batches (distinct seqs: replay must accept all), mixed
+    # sizes hitting all three width classes: 60% small voice, 30% mid
+    # video, 10% near-MTU
     sizes = np.array([100, 400, 950])
-    batches = []
-    for k in range(n_batches):
-        streams = rng.permutation(n_streams)[:batch]
-        ln = sizes[rng.choice(3, batch, p=[0.6, 0.3, 0.1])]
-        payloads = [rng.integers(0, 256, n, dtype=np.uint8).tobytes()
-                    for n in ln]
-        b = rtp_header.build(payloads, [100 + k] * batch, [k * 960] * batch,
-                             (0x10000 + streams).tolist(), [96] * batch,
-                             stream=streams.tolist())
-        batches.append(b)
+
+    def make_batches(count: int, seq_base: int):
+        out = []
+        for k in range(count):
+            streams = rng.permutation(n_streams)[:batch]
+            ln = sizes[rng.choice(3, batch, p=[0.6, 0.3, 0.1])]
+            payloads = [rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+                        for n in ln]
+            out.append(rtp_header.build(
+                payloads, [seq_base + k] * batch, [k * 960] * batch,
+                (0x10000 + streams).tolist(), [96] * batch,
+                stream=streams.tolist()))
+        return out
+
+    batches = make_batches(n_batches, 100)
 
     warm = n_batches // 3                     # first passes pay compiles
     lat_p, lat_u = [], []
@@ -396,16 +401,7 @@ def table_pps(n_streams: int = N_STREAMS, batch: int = 4096,
     # materialize later), overlapping H2D/compute/D2H across batches —
     # the naive path above drains every batch before the next dispatch
     depth = 3
-    more = []
-    for k in range(n_batches):
-        streams = rng.permutation(n_streams)[:batch]
-        ln = sizes[rng.choice(3, batch, p=[0.6, 0.3, 0.1])]
-        payloads = [rng.integers(0, 256, n, dtype=np.uint8).tobytes()
-                    for n in ln]
-        more.append(rtp_header.build(
-            payloads, [200 + k] * batch, [k * 960] * batch,
-            (0x10000 + streams).tolist(), [96] * batch,
-            stream=streams.tolist()))
+    more = make_batches(n_batches, 200)
     t1 = time.perf_counter()
     inflight = []
     for b in more:

@@ -26,7 +26,10 @@ from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.io.pcap import PcapWriter
 from libjitsi_tpu.io.udp import UdpEngine
 from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.utils.logging import get_logger
 from libjitsi_tpu.utils.metrics import MetricsRegistry
+
+_log = get_logger("io.loop")
 
 
 def _is_rtcp(data: np.ndarray, length: np.ndarray) -> np.ndarray:
@@ -120,6 +123,10 @@ class MediaLoop:
             sids[rtcp_sel] = self.registry.demux_rtcp(rtcp_sub)
         sub.stream[:] = sids
         known = sids >= 0
+        if not known.all():
+            # rate-limited: an unknown-SSRC flood must not flood the log
+            _log.warn("unknown_ssrc_drop", count=int((~known).sum()),
+                      tick=self.ticks)
         self.addr_ip[sids[known]] = sip[known]
         self.addr_port[sids[known]] = sport[known]
 
@@ -134,6 +141,10 @@ class MediaLoop:
                 if self.chain is not None:
                     rtp, ok = self.chain.rtp_transformer.reverse_transform(
                         rtp)
+                    if not ok.all():
+                        _log.warn("reverse_chain_drop",
+                                  count=int((~ok).sum()),
+                                  tick=self.ticks)
                 else:
                     ok = np.ones(rtp.batch_size, bool)
                 if self.on_media is not None:

@@ -4,6 +4,7 @@ from libjitsi_tpu.mesh.sharded import (  # noqa: F401
     sharded_bridge_mix,
     sharded_mix_minus,
     sharded_mix_minus_2d,
+    sharded_gcm_fanout,
     sharded_srtp_protect,
     sharded_media_step,
 )

@@ -188,3 +188,29 @@ def sharded_media_step(mesh: Mesh, tag_len: int = 10):
         _step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
     ))
+
+
+def sharded_gcm_fanout(mesh: Mesh, aad_const: int = 12):
+    """Full-mesh AEAD SFU fan-out with receiver LEGS sharded over chips.
+
+    The decrypt-once/re-encrypt-N load is embarrassingly parallel over
+    the receiver axis: each chip holds a shard of the per-leg key
+    schedules + GHASH matrices and seals the SAME P packets for its
+    legs — zero collectives, the packets broadcast once over ICI.
+    data [P, W]; length [P]; round_keys [G, R, 16]; gmat [G, 128, 128];
+    iv12 [G, P, 12] -> (out [G, P, W], out_len [P]).
+    Reference: RTPTranslatorImpl's per-receiver send chains (SURVEY
+    §3.4), re-designed as a sharded batch.
+    """
+    from libjitsi_tpu.kernels.gcm import gcm_protect_fanout
+
+    def _fan(data, length, rks, gms, iv):
+        out, out_len = gcm_protect_fanout(data, length, rks, gms, iv,
+                                          aad_const=aad_const)
+        return out, out_len
+
+    return jax.jit(jax.shard_map(
+        _fan, mesh=mesh,
+        in_specs=(P(None, None), P(None), P(AXIS, None, None),
+                  P(AXIS, None, None), P(AXIS, None, None)),
+        out_specs=(P(AXIS, None, None), P(None)), check_vma=False))

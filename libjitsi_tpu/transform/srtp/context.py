@@ -150,6 +150,7 @@ class SrtpStreamTable:
             self._rk_f8_rtp = np.zeros((s, rounds, 16), dtype=np.uint8)
             self._rk_f8_rtcp = np.zeros((s, rounds, 16), dtype=np.uint8)
         self._dev = None  # cached jnp copies
+        self._aliased = False  # device copies may alias host buffers
         # host-side IV salts (16B, low 2 bytes zero)
         self._salt_rtp = np.zeros((s, 16), dtype=np.uint8)
         self._salt_rtcp = np.zeros((s, 16), dtype=np.uint8)
@@ -178,9 +179,17 @@ class SrtpStreamTable:
         mutated keys to already-dispatched kernels.  Re-pointing the
         numpy attributes at fresh copies leaves any aliased device
         arrays reading the old, still-consistent buffers; `_dev = None`
-        makes the next launch re-upload the new ones.  Cold path (key
-        installs/removals), so the ~MB copy is irrelevant.
+        makes the next launch re-upload the new ones.
+
+        Copies happen at most once per dispatch episode (`_aliased` is
+        set by `_device()` and cleared here), so a loop of installs —
+        or a kdr epoch re-keying many streams — pays ONE table copy,
+        not one per stream (a 10k GCM table is ~340 MB of matrices).
         """
+        if not self._aliased:
+            self._dev = None
+            return
+        self._aliased = False
         self._rk_rtp = self._rk_rtp.copy()
         self._rk_rtcp = self._rk_rtcp.copy()
         self._mid_rtp = self._mid_rtp.copy()
@@ -481,6 +490,7 @@ class SrtpStreamTable:
             if self._f8:
                 self._dev_f8 = (jnp.asarray(self._rk_f8_rtp),
                                 jnp.asarray(self._rk_f8_rtcp))
+            self._aliased = True
         return self._dev
 
     def _require_active(self, stream: np.ndarray) -> None:

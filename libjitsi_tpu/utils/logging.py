@@ -1,0 +1,126 @@
+"""Structured, level-guarded, rate-limited logging for the media plane.
+
+Parity target: the reference's `org.jitsi.util.Logger` discipline —
+thin wrapper over the platform logger with cheap level guards so hot
+paths pay nothing when a level is off (SURVEY §2.1's "Logging" row).
+A media engine adds two twists the plain stdlib idiom misses:
+
+- **per-stream context without per-stream loggers**: one logger per
+  subsystem, with the stream/batch identifiers carried as structured
+  key-value fields (rendered `k=v`, machine-greppable), never baked
+  into per-stream logger objects (10k streams must not mean 10k
+  logger instances);
+- **token-bucket rate limiting per call site**: a flood of malformed
+  packets must not turn the log into the DoS amplifier — each
+  (logger, key) site emits at most `burst` records then `rate_hz`
+  thereafter, with a suppressed-count carried on the next emit.
+
+`MediaLogger.debug_enabled` is a plain bool read (the level guard), so
+`if log.debug_enabled: log.debug(...)` costs one attribute load on the
+fast path — the reference's `logger.isDebugEnabled()` pattern.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Dict, Optional
+
+_ROOT = "libjitsi_tpu"
+
+
+class _Site:
+    __slots__ = ("tokens", "last", "suppressed")
+
+    def __init__(self, burst: float):
+        self.tokens = burst
+        self.last = 0.0
+        self.suppressed = 0
+
+
+class MediaLogger:
+    """One per subsystem (module); stream ids travel as fields.
+
+    >>> log = get_logger("srtp")
+    >>> log.warn("auth_fail", sid=7, seq=1234, reason="bad tag")
+    """
+
+    def __init__(self, name: str, rate_hz: float = 10.0,
+                 burst: int = 20):
+        self._log = logging.getLogger(f"{_ROOT}.{name}")
+        self.rate_hz = rate_hz
+        self.burst = float(burst)
+        self._sites: Dict[str, _Site] = {}
+
+    # ------------------------------------------------------- level guards
+    @property
+    def debug_enabled(self) -> bool:
+        return self._log.isEnabledFor(logging.DEBUG)
+
+    @property
+    def info_enabled(self) -> bool:
+        return self._log.isEnabledFor(logging.INFO)
+
+    # ------------------------------------------------------------ emitters
+    def debug(self, event: str, **fields) -> None:
+        if self._log.isEnabledFor(logging.DEBUG):
+            self._emit(logging.DEBUG, event, fields)
+
+    def info(self, event: str, **fields) -> None:
+        if self._log.isEnabledFor(logging.INFO):
+            self._emit(logging.INFO, event, fields)
+
+    def warn(self, event: str, **fields) -> None:
+        if self._log.isEnabledFor(logging.WARNING):
+            self._emit(logging.WARNING, event, fields)
+
+    def error(self, event: str, **fields) -> None:
+        if self._log.isEnabledFor(logging.ERROR):
+            self._emit(logging.ERROR, event, fields)
+
+    def _emit(self, level: int, event: str, fields: dict,
+              now: Optional[float] = None) -> None:
+        site = self._sites.get(event)
+        if site is None:
+            site = self._sites[event] = _Site(self.burst)
+        now = time.monotonic() if now is None else now
+        if site.last:
+            site.tokens = min(self.burst,
+                              site.tokens + (now - site.last)
+                              * self.rate_hz)
+        site.last = now
+        if site.tokens < 1.0:
+            site.suppressed += 1
+            return
+        site.tokens -= 1.0
+        if site.suppressed:
+            fields = dict(fields, suppressed=site.suppressed)
+            site.suppressed = 0
+        kv = " ".join(f"{k}={v}" for k, v in fields.items())
+        self._log.log(level, "%s %s", event, kv)
+
+
+_loggers: Dict[str, MediaLogger] = {}
+
+
+def get_logger(subsystem: str, rate_hz: float = 10.0,
+               burst: int = 20) -> MediaLogger:
+    """Shared MediaLogger per subsystem name."""
+    lg = _loggers.get(subsystem)
+    if lg is None:
+        lg = _loggers[subsystem] = MediaLogger(subsystem, rate_hz, burst)
+    return lg
+
+
+def configure(level: int = logging.INFO,
+              stream=None) -> None:
+    """Opt-in root config for the framework's logger tree (library
+    code never calls basicConfig; applications call this or wire their
+    own handlers onto the 'libjitsi_tpu' logger)."""
+    root = logging.getLogger(_ROOT)
+    root.setLevel(level)
+    if not root.handlers:
+        h = logging.StreamHandler(stream)
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s %(name)s %(message)s"))
+        root.addHandler(h)

@@ -1,0 +1,56 @@
+"""Structured logging: level guards, k=v fields, token-bucket limiting."""
+
+import logging
+
+import pytest
+
+from libjitsi_tpu.utils.logging import MediaLogger, configure, get_logger
+
+
+def _capture(name):
+    records = []
+
+    class H(logging.Handler):
+        def emit(self, r):
+            records.append(r.getMessage())
+
+    lg = logging.getLogger(f"libjitsi_tpu.{name}")
+    lg.setLevel(logging.DEBUG)
+    lg.addHandler(H())
+    return records
+
+
+def test_structured_fields_and_levels():
+    log = MediaLogger("t1")
+    records = _capture("t1")
+    log.warn("auth_fail", sid=7, seq=1234, reason="bad tag")
+    assert records == ["auth_fail sid=7 seq=1234 reason=bad tag"]
+    assert log.debug_enabled          # handler set DEBUG
+    log.debug("x", a=1)
+    assert records[-1] == "x a=1"
+
+
+def test_rate_limit_suppresses_floods_and_reports():
+    log = MediaLogger("t2", rate_hz=1000.0, burst=5)
+    records = _capture("t2")
+    t = 100.0
+    for i in range(50):
+        log._emit(logging.WARNING, "flood", {"i": i}, now=t)
+    assert len(records) == 5          # burst only; 45 suppressed
+    t += 0.01                          # 10 ms at 1000 Hz -> 10 tokens
+    log._emit(logging.WARNING, "flood", {"i": 99}, now=t)
+    assert records[-1] == "flood i=99 suppressed=45"
+    # independent sites do not share buckets
+    log._emit(logging.WARNING, "other", {}, now=t)
+    assert records[-1].startswith("other")
+
+
+def test_level_guard_skips_rate_accounting():
+    log = MediaLogger("t3")
+    logging.getLogger("libjitsi_tpu.t3").setLevel(logging.ERROR)
+    log.warn("nope", a=1)             # below level: no site created
+    assert "nope" not in log._sites
+
+
+def test_get_logger_shared():
+    assert get_logger("shared") is get_logger("shared")

@@ -111,3 +111,29 @@ def test_sharded_bridge_mix_matches_host(mesh):
     want, want_lvl = mix_minus_many(pcm, active)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(want))
     np.testing.assert_array_equal(np.asarray(lvl), np.asarray(want_lvl))
+
+
+def test_sharded_gcm_fanout_matches_single_device():
+    """Receiver legs sharded over the mesh seal identically to the
+    single-device grouped kernel (zero collectives — leg-parallel)."""
+    import jax
+
+    from libjitsi_tpu.kernels.gcm import gcm_protect_fanout
+    from libjitsi_tpu.mesh import make_media_mesh, sharded_gcm_fanout
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the virtual 8-device mesh")
+    rng = np.random.default_rng(17)
+    G, Pk, W = 16, 4, 128                # 2 legs per device
+    rks = rng.integers(0, 256, (G, 11, 16), dtype=np.uint8)
+    gms = rng.integers(0, 2, (G, 128, 128), dtype=np.int8)
+    data = rng.integers(0, 256, (Pk, W), dtype=np.uint8)
+    length = np.full(Pk, 100, np.int32)
+    iv = rng.integers(0, 256, (G, Pk, 12), dtype=np.uint8)
+
+    mesh = make_media_mesh(jax.devices()[:8])
+    out_s, len_s = sharded_gcm_fanout(mesh)(data, length, rks, gms, iv)
+    out_1, len_1 = gcm_protect_fanout(data, length, rks, gms, iv,
+                                      aad_const=12)
+    assert np.array_equal(np.asarray(out_s), np.asarray(out_1))
+    assert np.array_equal(np.asarray(len_s), np.asarray(len_1))
