@@ -105,10 +105,18 @@ _loggers: Dict[str, MediaLogger] = {}
 
 def get_logger(subsystem: str, rate_hz: float = 10.0,
                burst: int = 20) -> MediaLogger:
-    """Shared MediaLogger per subsystem name."""
+    """Shared MediaLogger per subsystem name.
+
+    The instance is shared; a later caller passing different limits
+    re-tunes the shared logger (last caller wins) rather than silently
+    receiving the first caller's configuration.
+    """
     lg = _loggers.get(subsystem)
     if lg is None:
         lg = _loggers[subsystem] = MediaLogger(subsystem, rate_hz, burst)
+    elif (rate_hz, float(burst)) != (lg.rate_hz, lg.burst):
+        lg.rate_hz = rate_hz
+        lg.burst = float(burst)
     return lg
 
 
@@ -124,3 +132,6 @@ def configure(level: int = logging.INFO,
         h.setFormatter(logging.Formatter(
             "%(asctime)s %(levelname).1s %(name)s %(message)s"))
         root.addHandler(h)
+    # our handler owns rendering: without this, an application root
+    # handler (e.g. basicConfig) would print every record twice
+    root.propagate = False
