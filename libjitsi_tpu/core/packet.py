@@ -123,7 +123,12 @@ def _round_rows(n: int) -> int:
     for r in ROW_CLASSES:
         if n <= r:
             return r
-    return n
+    # beyond the table: round up to a multiple of the largest class so
+    # big batches still land on a bounded set of compiled shapes (a raw
+    # row count here would jit-compile fresh for EVERY distinct batch
+    # size — cache churn that melts a production tick)
+    top = ROW_CLASSES[-1]
+    return (n + top - 1) // top * top
 
 
 def bucket_by_size(batch: "PacketBatch",

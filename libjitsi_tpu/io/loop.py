@@ -60,6 +60,13 @@ class MediaLoop:
         self.engine = engine
         self.registry = registry
         self.chain = chain
+        # kernel arrival stamps ride along when the engine has them;
+        # after each tick, `last_rtp_arrival_ns` aligns row-for-row with
+        # the batch handed to on_media (BWE wants skb-receive times,
+        # not userspace-scheduler-jittered ones)
+        self.use_kernel_ts = bool(getattr(engine, "kernel_timestamps",
+                                          False))
+        self.last_rtp_arrival_ns: Optional[np.ndarray] = None
         self.on_media = on_media
         self.on_rtcp = on_rtcp
         self.on_dtls = on_dtls
@@ -76,7 +83,15 @@ class MediaLoop:
     # -------------------------------------------------------------- tick
     def tick(self) -> int:
         """One batching window; returns packets processed."""
-        batch, sip, sport = self.engine.recv_batch(self.recv_window_ms)
+        # re-established below only when this tick carries RTP rows; a
+        # stale previous-tick value must never masquerade as fresh
+        self.last_rtp_arrival_ns = None
+        if self.use_kernel_ts:
+            batch, sip, sport, ats = self.engine.recv_batch_ts(
+                self.recv_window_ms)
+        else:
+            batch, sip, sport = self.engine.recv_batch(self.recv_window_ms)
+            ats = None
         n = batch.batch_size
         self.ticks += 1
         if n == 0:
@@ -104,6 +119,8 @@ class MediaLoop:
                           np.asarray(batch.length)[media_rows],
                           batch.stream[media_rows])
         sip, sport = sip[media_rows], sport[media_rows]
+        if ats is not None:
+            ats = ats[media_rows]
 
         # 2. RTCP vs RTP split (rtcp-mux), then ssrc -> stream row
         # (the SSRC field sits at different offsets in the two formats)
@@ -138,6 +155,8 @@ class MediaLoop:
                 rtp = PacketBatch(sub.data[rtp_rows],
                                   np.asarray(sub.length)[rtp_rows],
                                   sub.stream[rtp_rows])
+                self.last_rtp_arrival_ns = (
+                    ats[rtp_rows] if ats is not None else None)
                 if self.chain is not None:
                     rtp, ok = self.chain.rtp_transformer.reverse_transform(
                         rtp)

@@ -52,6 +52,14 @@ def _load():
     lib.udp_send_batch.argtypes = [
         ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+    if hasattr(lib, "udp_enable_timestamps"):  # older sanitized builds
+        lib.udp_enable_timestamps.restype = ctypes.c_int
+        lib.udp_enable_timestamps.argtypes = [ctypes.c_int]
+        lib.udp_recv_batch_ts.restype = ctypes.c_int
+        lib.udp_recv_batch_ts.argtypes = [
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int]
     _lib = lib
     return lib
 
@@ -74,7 +82,8 @@ class UdpEngine:
 
     def __init__(self, port: int = 0, bind_ip: str = "0.0.0.0",
                  reuseport: bool = False, capacity: int = DEFAULT_CAPACITY,
-                 max_batch: int = 1024, rcvbuf: int = 4 << 20):
+                 max_batch: int = 1024, rcvbuf: int = 4 << 20,
+                 kernel_timestamps: bool = False):
         lib = _load()
         self.capacity = capacity
         self.max_batch = max_batch
@@ -83,11 +92,23 @@ class UdpEngine:
             raise OSError(-fd, os.strerror(-fd))
         self._fd = fd
         self.port = lib.udp_local_port(fd)
+        self.kernel_timestamps = False
+        if kernel_timestamps:
+            if hasattr(lib, "udp_enable_timestamps"):
+                self.kernel_timestamps = lib.udp_enable_timestamps(fd) == 0
+            if not self.kernel_timestamps:
+                from libjitsi_tpu.utils.logging import get_logger
+
+                # the feature was explicitly requested: degrading to
+                # userspace stamps must not be silent
+                get_logger("io.udp").warn(
+                    "kernel_timestamps_unavailable", port=self.port)
         # persistent receive arena (the PacketBatch SoA itself)
         self._buf = np.zeros((max_batch, capacity), dtype=np.uint8)
         self._len = np.zeros(max_batch, dtype=np.int32)
         self._sip = np.zeros(max_batch, dtype=np.uint32)
         self._sport = np.zeros(max_batch, dtype=np.uint16)
+        self._ats = np.zeros(max_batch, dtype=np.int64)
 
     def recv_batch(self, timeout_ms: int = 1
                    ) -> Tuple[PacketBatch, np.ndarray, np.ndarray]:
@@ -106,6 +127,25 @@ class UdpEngine:
         batch = PacketBatch(self._buf[:n].copy(), self._len[:n].copy(),
                             np.full(n, -1, dtype=np.int32))
         return batch, self._sip[:n].copy(), self._sport[:n].copy()
+
+    def recv_batch_ts(self, timeout_ms: int = 1
+                      ) -> Tuple[PacketBatch, np.ndarray, np.ndarray,
+                                 np.ndarray]:
+        """`recv_batch` plus per-packet KERNEL arrival times (ns,
+        CLOCK_REALTIME; skb-receive stamps when `kernel_timestamps` is
+        enabled, else a per-batch syscall-time fallback).  Feed these to
+        the GCC inter-arrival filters — userspace arrival times carry
+        scheduler jitter the kernel stamp does not."""
+        n = _load().udp_recv_batch_ts(
+            self._fd, self._buf.ctypes.data, self.capacity, self.max_batch,
+            self._len.ctypes.data, self._sip.ctypes.data,
+            self._sport.ctypes.data, self._ats.ctypes.data, timeout_ms)
+        if n < 0:
+            raise OSError(-n, os.strerror(-n))
+        batch = PacketBatch(self._buf[:n].copy(), self._len[:n].copy(),
+                            np.full(n, -1, dtype=np.int32))
+        return (batch, self._sip[:n].copy(), self._sport[:n].copy(),
+                self._ats[:n].copy())
 
     def send_batch(self, batch: PacketBatch, dst_ip, dst_port) -> int:
         """Send all rows; dst_ip (u32 or dotted str) / dst_port broadcast."""

@@ -15,6 +15,7 @@
 #include <cerrno>
 #include <cstdint>
 #include <cstring>
+#include <ctime>
 #include <netinet/in.h>
 #include <poll.h>
 #include <sys/socket.h>
@@ -50,6 +51,17 @@ int udp_create(const char *bind_ip, uint16_t port, int reuseport,
 
 int udp_close(int fd) { return close(fd); }
 
+// Enable kernel receive timestamps (SO_TIMESTAMPNS).  The BWE
+// inter-arrival filters (GCC) react to sub-millisecond queueing-delay
+// gradients; userspace arrival times include scheduler jitter that the
+// kernel stamp (taken at skb receive) does not.  Returns 0 or -errno.
+int udp_enable_timestamps(int fd) {
+  int one = 1;
+  if (setsockopt(fd, SOL_SOCKET, SO_TIMESTAMPNS, &one, sizeof(one)) < 0)
+    return -errno;
+  return 0;
+}
+
 // Get the locally bound port (for port-0 ephemeral binds in tests).
 int udp_local_port(int fd) {
   sockaddr_in addr{};
@@ -58,6 +70,13 @@ int udp_local_port(int fd) {
     return -errno;
   return ntohs(addr.sin_port);
 }
+
+// (see udp_recv_batch_ts below; this entry point keeps the original
+// ABI and simply skips the timestamp plumbing)
+int udp_recv_batch_ts(int fd, uint8_t *buf, int capacity, int max_pkts,
+                      int32_t *lengths, uint32_t *src_ip,
+                      uint16_t *src_port, int64_t *arrival_ns,
+                      int timeout_ms);
 
 // Batched receive via recvmmsg into the caller's [max_pkts, capacity]
 // row-major buffer; writes per-packet lengths, source ip4 (host order)
@@ -68,6 +87,19 @@ int udp_local_port(int fd) {
 int udp_recv_batch(int fd, uint8_t *buf, int capacity, int max_pkts,
                    int32_t *lengths, uint32_t *src_ip, uint16_t *src_port,
                    int timeout_ms) {
+  return udp_recv_batch_ts(fd, buf, capacity, max_pkts, lengths, src_ip,
+                           src_port, nullptr, timeout_ms);
+}
+
+// Timestamped batched receive: like udp_recv_batch, and when
+// arrival_ns != nullptr also writes per-packet kernel arrival times
+// (CLOCK_REALTIME nanoseconds).  Packets without a kernel stamp
+// (SO_TIMESTAMPNS not enabled / not delivered) fall back to a
+// syscall-time clock_gettime taken once per batch.
+int udp_recv_batch_ts(int fd, uint8_t *buf, int capacity, int max_pkts,
+                      int32_t *lengths, uint32_t *src_ip,
+                      uint16_t *src_port, int64_t *arrival_ns,
+                      int timeout_ms) {
   if (timeout_ms > 0) {
     pollfd p{fd, POLLIN, 0};
     int pr = poll(&p, 1, timeout_ms);
@@ -77,6 +109,9 @@ int udp_recv_batch(int fd, uint8_t *buf, int capacity, int max_pkts,
   std::vector<mmsghdr> hdrs(max_pkts);
   std::vector<iovec> iovs(max_pkts);
   std::vector<sockaddr_in> addrs(max_pkts);
+  constexpr size_t kCtrl = 64;  // room for one timestampns cmsg
+  std::vector<uint8_t> ctrl;
+  if (arrival_ns) ctrl.resize(static_cast<size_t>(max_pkts) * kCtrl);
   for (int i = 0; i < max_pkts; i++) {
     iovs[i].iov_base = buf + static_cast<size_t>(i) * capacity;
     iovs[i].iov_len = capacity;
@@ -85,13 +120,36 @@ int udp_recv_batch(int fd, uint8_t *buf, int capacity, int max_pkts,
     hdrs[i].msg_hdr.msg_iovlen = 1;
     hdrs[i].msg_hdr.msg_name = &addrs[i];
     hdrs[i].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+    if (arrival_ns) {
+      hdrs[i].msg_hdr.msg_control =
+          ctrl.data() + static_cast<size_t>(i) * kCtrl;
+      hdrs[i].msg_hdr.msg_controllen = kCtrl;
+    }
   }
   int n = recvmmsg(fd, hdrs.data(), max_pkts, MSG_DONTWAIT, nullptr);
   if (n < 0) return (errno == EAGAIN || errno == EWOULDBLOCK) ? 0 : -errno;
+  int64_t fallback = 0;
+  if (arrival_ns) {
+    timespec now{};
+    clock_gettime(CLOCK_REALTIME, &now);
+    fallback = static_cast<int64_t>(now.tv_sec) * 1000000000LL + now.tv_nsec;
+  }
   for (int i = 0; i < n; i++) {
     lengths[i] = static_cast<int32_t>(hdrs[i].msg_len);
     src_ip[i] = ntohl(addrs[i].sin_addr.s_addr);
     src_port[i] = ntohs(addrs[i].sin_port);
+    if (!arrival_ns) continue;
+    arrival_ns[i] = fallback;
+    for (cmsghdr *c = CMSG_FIRSTHDR(&hdrs[i].msg_hdr); c;
+         c = CMSG_NXTHDR(&hdrs[i].msg_hdr, c)) {
+      if (c->cmsg_level == SOL_SOCKET && c->cmsg_type == SO_TIMESTAMPNS) {
+        timespec ts{};
+        std::memcpy(&ts, CMSG_DATA(c), sizeof(ts));
+        arrival_ns[i] =
+            static_cast<int64_t>(ts.tv_sec) * 1000000000LL + ts.tv_nsec;
+        break;
+      }
+    }
   }
   return n;
 }

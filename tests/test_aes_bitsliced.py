@@ -17,7 +17,8 @@ from libjitsi_tpu.kernels.aes_bitsliced import (
     aes_encrypt_pallas_bitsliced)
 
 
-@pytest.mark.parametrize("key_len", [16, 32])
+@pytest.mark.slow      # the Boolean-circuit HLO is big; cold CPU
+@pytest.mark.parametrize("key_len", [16, 32])   # compiles take minutes
 def test_bitsliced_matches_table(key_len):
     rng = np.random.default_rng(1)
     rks = expand_keys_batch(

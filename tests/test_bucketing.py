@@ -158,3 +158,15 @@ def test_class_exact_row_count_near_mtu():
     assert ok.all()
     for i in range(16):
         assert dec.to_bytes(i) == batch.to_bytes(i)
+
+
+def test_row_class_bounded_beyond_table():
+    """Row counts beyond the largest class round to multiples of it —
+    distinct big batches must share compiled shapes."""
+    from libjitsi_tpu.core.packet import ROW_CLASSES, _round_rows
+
+    top = ROW_CLASSES[-1]
+    assert _round_rows(top) == top
+    assert _round_rows(top + 1) == 2 * top
+    assert _round_rows(2 * top + 7) == 3 * top
+    assert _round_rows(5 * top) == 5 * top

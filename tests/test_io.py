@@ -98,3 +98,28 @@ def test_pcap_tap_for_batch(tmp_path):
     w.close()
     got = [x[1] for x in PcapReader(p)]
     assert got == [b"aaa", b"bbbb"]
+
+
+def test_kernel_timestamps_recv():
+    """SO_TIMESTAMPNS path: arrival stamps are sane CLOCK_REALTIME ns,
+    monotonic-ish, and close to the send time."""
+    import time
+
+    from libjitsi_tpu.io import UdpEngine
+
+    rx = UdpEngine(port=0, max_batch=16, kernel_timestamps=True)
+    tx = UdpEngine(port=0, max_batch=16)
+    from libjitsi_tpu.core.packet import PacketBatch
+
+    t0 = time.time()
+    b = PacketBatch.from_payloads([b"stamp-%d" % i for i in range(5)])
+    tx.send_batch(b, "127.0.0.1", rx.port)
+    got, _, _, ats = rx.recv_batch_ts(timeout_ms=500)
+    t1 = time.time()
+    assert got.batch_size == 5
+    assert ats.dtype == np.int64
+    secs = ats / 1e9
+    assert np.all(secs >= t0 - 1.0) and np.all(secs <= t1 + 1.0)
+    assert np.all(np.diff(ats) >= 0)     # recvmmsg preserves order
+    # with SO_TIMESTAMPNS active the stamps should be kernel-made
+    assert rx.kernel_timestamps

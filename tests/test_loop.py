@@ -122,3 +122,43 @@ def test_loop_splits_dtls_and_rtcp():
     assert "reverse_chain_seconds" in bridge.metrics.render()
     client.close()
     bridge.engine.close()
+
+
+def test_loop_kernel_arrival_ns_aligned_with_media_rows():
+    """MediaLoop with a timestamped engine exposes per-row kernel
+    arrival times aligned with the batch handed to on_media."""
+    reg = _registry()
+    rx_tab = SrtpStreamTable(capacity=16)
+    rx_tab.add_stream(3, MK, MS)
+    tx_tab = SrtpStreamTable(capacity=16)
+    tx_tab.add_stream(3, MK2, MS2)
+    chain = TransformEngineChain([SrtpTransformEngine(tx_tab, rx_tab)])
+    seen = {}
+
+    def on_media(batch, ok):
+        seen["n"] = batch.batch_size
+        seen["ats"] = bridge.last_rtp_arrival_ns
+        return None
+
+    bridge = MediaLoop(
+        UdpEngine(port=0, max_batch=64, kernel_timestamps=True), reg,
+        on_media=on_media, chain=chain)
+    assert bridge.use_kernel_ts
+    reg.map_ssrc(0xC11E27, 3)
+    c_tx = SrtpStreamTable(capacity=1)
+    c_tx.add_stream(0, MK, MS)
+    b = rtp_header.build([b"k-%d" % i for i in range(4)],
+                         list(range(4)), [0] * 4, [0xC11E27] * 4,
+                         [96] * 4, stream=[0] * 4)
+    client = UdpEngine(port=0, max_batch=64)
+    client.send_batch(c_tx.protect_rtp(b), "127.0.0.1",
+                      bridge.engine.port)
+    import time as _t
+    t0 = _t.time()
+    for _ in range(50):
+        if bridge.tick():
+            break
+    assert seen["n"] == 4
+    ats = seen["ats"]
+    assert ats is not None and len(ats) == 4
+    assert np.all(np.abs(ats / 1e9 - t0) < 5.0)
