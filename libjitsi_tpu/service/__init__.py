@@ -1,0 +1,1 @@
+from libjitsi_tpu.service.bridge import ConferenceBridge  # noqa: F401

@@ -1,0 +1,210 @@
+"""ConferenceBridge — the whole audio-bridge tick as one object.
+
+The reference assembles a conference from many moving parts: an
+`AudioMixerMediaDevice` capture device, one `MediaStream` +
+FMJ Processor per participant, connector threads, and the SRTP
+transformers each stream installs (SURVEY §3.3's receive path feeding
+§2.4's mixer, then §3.2's send path per participant).  This class is
+that assembly in the dense design: ONE MediaLoop (batched UDP +
+reverse chain), ONE ReceiveBank (dense jitter + decode), ONE AudioMixer
+row range, and a batched encode→packetize→protect→send tail — a whole
+conference tick is a handful of array programs regardless of
+participant count.
+
+Tick flow (one ptime, default 20 ms):
+
+    loop.tick()            drain socket -> demux -> batched unprotect
+       -> bank.push_decrypted (dense jitter insert)
+    bank.tick()            pop due frames -> decode -> mixer deposit
+    mixer.mix()            mix-minus + RFC 6465 levels (device)
+    encode rows            per-codec (G.711 vectorized; stateful via C)
+    loop.send_media()      packetize + batched protect -> sendmmsg
+
+Keying is SDES-style static master keys per participant (rx = what the
+participant sends with, tx = what we send to them with); DTLS/ZRTP
+controls can feed the same install calls.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from libjitsi_tpu.conference.mixer import AudioMixer
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.io.loop import MediaLoop
+from libjitsi_tpu.io.udp import UdpEngine
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.service.media_stream import StreamRegistry
+from libjitsi_tpu.service.pump import FrameCodec, ReceiveBank, g711_codec
+from libjitsi_tpu.transform import (SrtpTransformEngine,
+                                    TransformEngineChain)
+from libjitsi_tpu.transform.srtp import SrtpProfile, SrtpStreamTable
+from libjitsi_tpu.utils.logging import get_logger
+
+_log = get_logger("service.bridge")
+
+
+class ConferenceBridge:
+    """A secure N-party audio bridge on one UDP port."""
+
+    def __init__(self, config, port: int = 0, capacity: int = 256,
+                 profile: SrtpProfile =
+                 SrtpProfile.AES_CM_128_HMAC_SHA1_80,
+                 ptime_ms: int = 20, kernel_timestamps: bool = False,
+                 recv_window_ms: int = 1):
+        self.capacity = capacity
+        self.profile = profile
+        self.ptime_ms = ptime_ms
+        self.registry = StreamRegistry(config, capacity=capacity)
+        self.rx_table = SrtpStreamTable(capacity, profile)
+        self.tx_table = SrtpStreamTable(capacity, profile)
+        self.chain = TransformEngineChain(
+            [SrtpTransformEngine(self.tx_table, self.rx_table)])
+        self.loop = MediaLoop(
+            UdpEngine(port=port, max_batch=4 * capacity,
+                      kernel_timestamps=kernel_timestamps),
+            self.registry, on_media=self._on_media, chain=self.chain,
+            recv_window_ms=recv_window_ms)
+        self.port = self.loop.engine.port
+        # one mixer frame size per bridge; codecs must match it
+        self._frame_samples: Optional[int] = None
+        self.mixer: Optional[AudioMixer] = None
+        self.bank: Optional[ReceiveBank] = None
+        self._codec: Dict[int, FrameCodec] = {}
+        self._ssrc_of: Dict[int, int] = {}      # sid -> mapped rx ssrc
+        self._tx_seq = np.zeros(capacity, dtype=np.int64)
+        self._tx_ts = np.zeros(capacity, dtype=np.int64)
+        self._tx_ssrc = np.zeros(capacity, dtype=np.int64)
+        self.ticks = 0
+
+    # ------------------------------------------------------- participants
+    def add_participant(self, ssrc: int, rx_key: Tuple[bytes, bytes],
+                        tx_key: Tuple[bytes, bytes],
+                        codec: Optional[FrameCodec] = None) -> int:
+        """Join: install keys + codec, map the SSRC, return the row id.
+
+        `rx_key` protects what the participant sends us; `tx_key`
+        protects what we send them (SDES-style separate directions).
+        """
+        codec = codec or g711_codec(ptime_ms=self.ptime_ms)
+        if self._frame_samples is None:
+            self._frame_samples = codec.frame_samples
+            self.mixer = AudioMixer(capacity=self.capacity,
+                                    frame_samples=codec.frame_samples)
+            self.bank = ReceiveBank(self.capacity, mixer=self.mixer,
+                                    payload_cap=max(256,
+                                                    codec.frame_samples))
+        elif codec.frame_samples != self._frame_samples:
+            raise ValueError(
+                f"codec frame {codec.frame_samples} != bridge frame "
+                f"{self._frame_samples}; resample at the device layer")
+        if ssrc in [s for s in self._ssrc_of.values()]:
+            # silently remapping would mute the existing participant
+            raise ValueError(f"ssrc {ssrc:#x} already joined")
+        sid = self.registry.alloc(self)
+        self.rx_table.add_stream(sid, *rx_key)
+        self.tx_table.add_stream(sid, *tx_key)
+        self.registry.map_ssrc(ssrc, sid)
+        self.bank.add_stream(sid, codec)
+        self.mixer.add_participant(sid)
+        self._codec[sid] = codec
+        self._ssrc_of[sid] = ssrc & 0xFFFFFFFF
+        self._tx_seq[sid] = int.from_bytes(np.random.bytes(2), "big")
+        self._tx_ts[sid] = int.from_bytes(np.random.bytes(4), "big")
+        self._tx_ssrc[sid] = (0x42000000 + sid) & 0xFFFFFFFF
+        _log.info("participant_join", sid=sid, ssrc=ssrc)
+        return sid
+
+    def remove_participant(self, sid: int) -> None:
+        """Leave: every per-row residue must go — a recycled sid must
+        not demux the old SSRC, keep old keys, or inherit the old
+        latched address (late packets would otherwise redirect the NEW
+        occupant's media to the OLD participant's socket)."""
+        ssrc = self._ssrc_of.pop(sid, None)
+        if ssrc is not None:
+            self.registry.unmap_ssrc(ssrc)
+        self.rx_table.remove_stream(sid)
+        self.tx_table.remove_stream(sid)
+        self.loop.addr_ip[sid] = 0
+        self.loop.addr_port[sid] = 0
+        self.bank.remove_stream(sid)
+        self.mixer.remove_participant(sid)
+        self._codec.pop(sid, None)
+        self.registry.release(sid)
+        _log.info("participant_leave", sid=sid)
+
+    # --------------------------------------------------------------- tick
+    def _on_media(self, batch: PacketBatch, ok: np.ndarray):
+        self.bank.push_decrypted(batch, ok, now=self._now)
+        return None
+
+    def tick(self, now: Optional[float] = None) -> dict:
+        """One ptime: returns counters for observability."""
+        self._now = time.time() if now is None else now
+        rx = self.loop.tick()
+        if self.bank is None:         # no participants yet
+            return {"rx": rx, "mixed": 0, "tx": 0,
+                    "levels": np.zeros(0, dtype=np.uint8)}
+        sids, _frames = self.bank.tick(now=self._now)
+        out, levels = self.mixer.mix()
+        tx = self._send_mixes(out)
+        self.ticks += 1
+        return {"rx": rx, "mixed": len(sids), "tx": tx,
+                "levels": levels}
+
+    def _send_mixes(self, out: np.ndarray) -> int:
+        """Encode each active participant's mix-minus row and send it
+        through the forward chain to their latched address.  G.711 rows
+        encode as ONE vectorized kernel call (like ReceiveBank's decode
+        grouping); only stateful codecs pay a per-row C call."""
+        from libjitsi_tpu.kernels import g711
+
+        active = [sid for sid in self._codec
+                  if self.loop.addr_port[sid] != 0]
+        if not active:
+            return 0
+        payloads: Dict[int, bytes] = {}
+        by_kind: Dict[str, List[int]] = {}
+        for sid in active:
+            by_kind.setdefault(self._codec[sid].name.upper(),
+                               []).append(sid)
+        for kind, rows in by_kind.items():
+            if kind in ("PCMU", "PCMA"):
+                fn = g711.ulaw_encode if kind == "PCMU" \
+                    else g711.alaw_encode
+                enc = np.asarray(fn(out[np.asarray(rows)]),
+                                 dtype=np.uint8)
+                for k, sid in enumerate(rows):
+                    payloads[sid] = enc[k].tobytes()
+            else:
+                for sid in rows:     # stateful: per-row C call
+                    payloads[sid] = self._codec[sid].encode(out[sid])
+        sids = np.asarray(active, dtype=np.int64)
+        steps = np.asarray([self._codec[s].ts_step for s in active],
+                           dtype=np.int64)
+        batch = rtp_header.build(
+            [payloads[s] for s in active], self._tx_seq[sids].tolist(),
+            self._tx_ts[sids].tolist(), self._tx_ssrc[sids].tolist(),
+            [self._codec[s].pt for s in active],
+            stream=sids.tolist())
+        self._tx_seq[sids] = (self._tx_seq[sids] + 1) & 0xFFFF
+        self._tx_ts[sids] = (self._tx_ts[sids] + steps) & 0xFFFFFFFF
+        return self.loop.send_media(batch)
+
+    def run(self, duration_s: float) -> None:
+        """Drive real-time ticks for a bounded interval."""
+        end = time.time() + duration_s
+        period = self.ptime_ms / 1000.0
+        nxt = time.time()
+        while time.time() < end:
+            self.tick()
+            nxt += period
+            delay = nxt - time.time()
+            if delay > 0:
+                time.sleep(delay)
+
+    def close(self) -> None:
+        self.loop.engine.close()

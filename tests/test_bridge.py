@@ -1,0 +1,148 @@
+"""ConferenceBridge: the whole-conference tick as one object, e2e.
+
+Three SRTP clients over real loopback UDP; each must hear the
+mix-minus of the OTHERS (their own tone absent), all through the
+batched unprotect -> dense bank -> mixer -> encode -> protect tail.
+"""
+
+import numpy as np
+import pytest
+
+import libjitsi_tpu
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.io import UdpEngine
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.service.bridge import ConferenceBridge
+from libjitsi_tpu.service.pump import g711_codec
+from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+
+class _Client:
+    def __init__(self, ssrc, freq, bridge_port):
+        self.ssrc = ssrc
+        self.freq = freq
+        self.codec = g711_codec()
+        self.rx_key = (bytes([ssrc]) * 16, bytes([ssrc + 1]) * 14)
+        self.tx_key = (bytes([ssrc + 2]) * 16, bytes([ssrc + 3]) * 14)
+        self.protect = SrtpStreamTable(capacity=1)
+        self.protect.add_stream(0, *self.rx_key)
+        self.unprotect = SrtpStreamTable(capacity=1)
+        self.unprotect.add_stream(0, *self.tx_key)
+        self.engine = UdpEngine(port=0, max_batch=32)
+        self.bridge_port = bridge_port
+        self.seq = 100
+        self.t = 0
+        self.heard = []
+
+    def send_frame(self):
+        n = np.arange(160)
+        pcm = (8000 * np.sin(2 * np.pi * self.freq *
+                             (self.t + n) / 8000)).astype(np.int16)
+        self.t += 160
+        b = rtp_header.build([self.codec.encode(pcm)], [self.seq],
+                             [self.t], [self.ssrc], [0], stream=[0])
+        self.seq += 1
+        self.engine.send_batch(self.protect.protect_rtp(b),
+                               "127.0.0.1", self.bridge_port)
+
+    def drain(self):
+        back, _, _ = self.engine.recv_batch(timeout_ms=1)
+        if back.batch_size:
+            back.stream[:] = 0
+            dec, ok = self.unprotect.unprotect_rtp(back)
+            hdr = rtp_header.parse(dec)
+            for i in np.nonzero(ok)[0]:
+                pay = dec.to_bytes(int(i))[int(hdr.payload_off[i]):]
+                self.heard.append(self.codec.decode(pay))
+
+
+@pytest.mark.slow
+def test_bridge_three_party_mix_minus():
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    bridge = ConferenceBridge(libjitsi_tpu.configuration_service(),
+                              port=0, capacity=16, recv_window_ms=0)
+    clients = [_Client(10, 400.0, bridge.port),
+               _Client(20, 900.0, bridge.port),
+               _Client(30, 1600.0, bridge.port)]
+    for c in clients:
+        bridge.add_participant(c.ssrc, c.rx_key, c.tx_key)
+
+    now = 100.0
+    for tick in range(30):
+        for c in clients:
+            c.send_frame()
+        for _ in range(10):       # let the datagrams land
+            stats = bridge.tick(now=now)
+            if stats["rx"]:
+                break
+        bridge.tick(now=now + 0.001)   # decode tick (frames due)
+        for c in clients:
+            c.drain()
+        now += 0.020
+
+    for c in clients:
+        assert len(c.heard) >= 10, f"ssrc {c.ssrc} heard too little"
+        pcm = np.concatenate(c.heard[5:]).astype(np.float64)
+        spec = np.abs(np.fft.rfft(pcm * np.hanning(len(pcm))))
+        freqs = np.fft.rfftfreq(len(pcm), 1 / 8000.0)
+
+        def power_at(f):
+            return spec[np.argmin(np.abs(freqs - f))]
+
+        own = power_at(c.freq)
+        others = [power_at(o.freq) for o in clients if o is not c]
+        # mix-minus: both other tones clearly present, own tone absent
+        assert min(others) > 10 * own, \
+            (c.ssrc, own, others)
+
+    # stats2 / counters sanity through the bridge registry
+    assert bridge.bank.decoded_frames[:3].sum() > 30
+    bridge.close()
+
+
+def test_bridge_rejects_mismatched_codec_frame():
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    bridge = ConferenceBridge(libjitsi_tpu.configuration_service(),
+                              port=0, capacity=4)
+    bridge.add_participant(1, (b"\x01" * 16, b"\x02" * 14),
+                           (b"\x03" * 16, b"\x04" * 14))
+    with pytest.raises(ValueError):
+        bridge.add_participant(
+            2, (b"\x05" * 16, b"\x06" * 14),
+            (b"\x07" * 16, b"\x08" * 14),
+            codec=g711_codec(ptime_ms=30))
+    bridge.close()
+
+
+def test_bridge_participant_churn_clears_row_residue():
+    """A leave must clear ssrc demux, SRTP rows, and the latched
+    address — the recycled sid must not redirect the new occupant's
+    media to the old participant's socket."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    bridge = ConferenceBridge(libjitsi_tpu.configuration_service(),
+                              port=0, capacity=4, recv_window_ms=0)
+    sid = bridge.add_participant(0x10, (b"\x01" * 16, b"\x02" * 14),
+                                 (b"\x03" * 16, b"\x04" * 14))
+    # simulate a latched address from a received packet
+    bridge.loop.addr_ip[sid] = 0x7F000001
+    bridge.loop.addr_port[sid] = 55555
+    bridge.remove_participant(sid)
+    assert bridge.loop.addr_port[sid] == 0
+    assert not bridge.rx_table.active[sid]
+    assert not bridge.tx_table.active[sid]
+    # same ssrc can rejoin; duplicate join is rejected while mapped
+    sid2 = bridge.add_participant(0x10, (b"\x05" * 16, b"\x06" * 14),
+                                  (b"\x07" * 16, b"\x08" * 14))
+    assert sid2 == sid                    # LIFO row recycle
+    with pytest.raises(ValueError):
+        bridge.add_participant(0x10, (b"\x09" * 16, b"\x0a" * 14),
+                               (b"\x0b" * 16, b"\x0c" * 14))
+    # empty-tick return shape is stable (levels key always present)
+    bridge2 = ConferenceBridge(libjitsi_tpu.configuration_service(),
+                               port=0, capacity=4, recv_window_ms=0)
+    assert "levels" in bridge2.tick(now=1.0)
+    bridge.close()
+    bridge2.close()
