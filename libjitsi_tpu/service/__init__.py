@@ -1,1 +1,2 @@
 from libjitsi_tpu.service.bridge import ConferenceBridge  # noqa: F401
+from libjitsi_tpu.service.sfu_bridge import SfuBridge  # noqa: F401

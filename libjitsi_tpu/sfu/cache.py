@@ -25,8 +25,12 @@ class PacketCache:
 
     def insert(self, ssrc: int, seq: int, packet: bytes,
                now: Optional[float] = None) -> None:
+        """`ssrc` is the cache namespace: a plain 32-bit SSRC for the
+        single-stream RTX case, or any wider composite key (e.g. the
+        SFU's (leg_sid << 32) | sender_ssrc) — it is NOT masked, so
+        composite namespaces never collide."""
         now = time.time() if now is None else now
-        key = (ssrc & 0xFFFFFFFF, seq & 0xFFFF)
+        key = (int(ssrc), seq & 0xFFFF)
         old = self._store.pop(key, None)
         if old is not None:
             self._bytes -= len(old[1])
@@ -41,7 +45,7 @@ class PacketCache:
             self.insert(int(ssrc), int(seq), pkt, now)
 
     def get(self, ssrc: int, seq: int) -> Optional[bytes]:
-        e = self._store.get((ssrc & 0xFFFFFFFF, seq & 0xFFFF))
+        e = self._store.get((int(ssrc), seq & 0xFFFF))
         return e[1] if e is not None else None
 
     def lookup_nack(self, ssrc: int, lost_seqs: Sequence[int]) -> List[bytes]:
