@@ -28,6 +28,7 @@ from typing import Tuple
 import numpy as np
 
 from libjitsi_tpu.core.rtp_math import segment_ranks
+from libjitsi_tpu.utils.checkpoint import ArraySnapshotMixin
 
 SIG_NORMAL, SIG_OVERUSING, SIG_UNDERUSING = 0, 1, 2
 ST_HOLD, ST_INCREASE, ST_DECREASE = 0, 1, 2
@@ -37,7 +38,7 @@ _BURST_SPAN_MS = 5.0
 _BETA = 0.85
 
 
-class BatchedRemoteBitrateEstimator:
+class BatchedRemoteBitrateEstimator(ArraySnapshotMixin):
     """T independent GCC estimators in dense arrays."""
 
     def __init__(self, capacity: int, min_bitrate_bps: float = 30_000,
@@ -378,3 +379,29 @@ class BatchedRemoteBitrateEstimator:
         self.bitrate = np.clip(rate, self.min_bitrate, self.max_bitrate)
         self.rate_state = st.astype(np.int8)
         return self.bitrate
+
+    # --------------------------------------------------------- checkpoint
+    # (snapshot()/restore() from ArraySnapshotMixin; SURVEY §5: a
+    # restarted worker must not re-probe bandwidth from the start
+    # bitrate and overload already-congested links)
+    _SNAP_FIELDS = (
+        "_last_send", "_send_unwrapped", "_has_send", "_g_has",
+        "_g_first_send", "_g_send", "_g_arrival", "_g_size", "_p_has",
+        "_p_send", "_p_arrival", "_p_size", "offset", "_slope", "_e00",
+        "_e01", "_e10", "_e11", "_avg_noise", "_var_noise", "num_deltas",
+        "threshold", "_last_update_ms", "_time_over_using",
+        "_overuse_counter", "signal", "bitrate", "rate_state", "region",
+        "rtt_ms", "_avg_max_kbps", "_var_max_kbps", "_last_change_ms",
+        "_buckets", "_win_total", "_oldest_ms")
+
+    def _snap_scalars(self) -> dict:
+        return {"window_ms": self.window_ms,
+                "min_bitrate": self.min_bitrate,
+                "max_bitrate": self.max_bitrate}
+
+    @classmethod
+    def _restore_kwargs(cls, snap: dict) -> dict:
+        return {"capacity": len(snap["offset"]),
+                "min_bitrate_bps": snap["min_bitrate"],
+                "max_bitrate_bps": snap["max_bitrate"],
+                "window_ms": snap["window_ms"]}

@@ -27,9 +27,10 @@ from typing import Optional, Tuple
 import numpy as np
 
 from libjitsi_tpu.core.rtp_math import segment_ranks, seq_delta
+from libjitsi_tpu.utils.checkpoint import ArraySnapshotMixin
 
 
-class DenseJitterBank:
+class DenseJitterBank(ArraySnapshotMixin):
     """S adaptive jitter buffers in dense arrays.
 
     payload_cap bounds the stored payload bytes per packet (audio
@@ -226,3 +227,22 @@ class DenseJitterBank:
         self.late_dropped[s] = 0
         self.overwritten[s] = 0
         self._occ[s] = False
+
+    # --------------------------------------------------------- checkpoint
+    # (snapshot()/restore() from ArraySnapshotMixin; SURVEY §5: a
+    # restarted worker resumes the playout sequence windows, or streams
+    # glitch)
+    _SNAP_FIELDS = ("clock_rate", "frame_s", "min_delay", "max_delay",
+                    "mult", "next_seq", "released", "jitter_s",
+                    "_last_transit", "_has_transit", "lost",
+                    "late_dropped", "overwritten", "_occ", "_slot_seq",
+                    "_arrival", "_plen", "_pay")
+
+    def _snap_scalars(self) -> dict:
+        return {"depth": self.depth, "payload_cap": self.payload_cap}
+
+    @classmethod
+    def _restore_kwargs(cls, snap: dict) -> dict:
+        return {"capacity": len(snap["next_seq"]),
+                "depth": snap["depth"],
+                "payload_cap": snap["payload_cap"]}

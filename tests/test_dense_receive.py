@@ -308,3 +308,51 @@ def test_receive_bank_drops_oversize_frames_not_truncates():
     assert bank.push_decrypted(b, np.ones(1, bool), now=50.0) == 0
     assert bank.oversize_dropped[0] == 1
     assert bank.decode_errors[0] == 0
+
+
+def test_dense_jitter_snapshot_resume_equals_uninterrupted():
+    """Checkpoint mid-stream: the restored bank must behave exactly
+    like the uninterrupted one for the rest of the trace."""
+    a = DenseJitterBank(capacity=3, depth=16, payload_cap=32,
+                        clock_rate=8000, frame_ms=20.0)
+    pay = np.zeros((3, 16), np.uint8)
+    for k in range(6):
+        a.insert_batch([0, 1, 2], [50 + k] * 3, [160 * k] * 3,
+                       pay + k, [16] * 3, 5.0 + 0.02 * k)
+        a.pop_all(5.0 + 0.02 * k + 0.001)
+    b = DenseJitterBank.restore(a.snapshot())
+    for k in range(6, 12):
+        now = 5.0 + 0.02 * k
+        for bank in (a, b):
+            bank.insert_batch([0, 1, 2], [50 + k] * 3, [160 * k] * 3,
+                              pay + k, [16] * 3, now)
+        ra, pa, la = a.pop_all(now + 0.001)
+        rb, pb, lb = b.pop_all(now + 0.001)
+        assert np.array_equal(ra, rb)
+        assert np.array_equal(pa, pb) and np.array_equal(la, lb)
+    assert np.array_equal(a.lost, b.lost)
+    assert np.array_equal(a.jitter_s, b.jitter_s)
+
+
+def test_batched_bwe_snapshot_resume_equals_uninterrupted():
+    a = BatchedRemoteBitrateEstimator(capacity=3)
+
+    def feed(est, step, now):
+        ast = int((now / 1000.0 + step * 0.006) * (1 << 18)) & 0xFFFFFF
+        est.incoming_batch([0, 1, 2], [now + step] * 3, [ast] * 3,
+                           [900] * 3)
+
+    now = 1000.0
+    for step in range(50):
+        feed(a, step, now)
+        now += 20.0
+    b = BatchedRemoteBitrateEstimator.restore(a.snapshot())
+    for step in range(50, 100):
+        feed(a, step, now)
+        feed(b, step, now)
+        ra = a.update_estimate(now)
+        rb = b.update_estimate(now)
+        assert np.array_equal(ra, rb), step
+        now += 20.0
+    assert np.array_equal(a.offset, b.offset)
+    assert np.array_equal(a.threshold, b.threshold)
