@@ -23,7 +23,7 @@ region 0/1 = multiplicative/additive.
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -81,6 +81,7 @@ class BatchedRemoteBitrateEstimator(ArraySnapshotMixin):
         # ---- AIMD
         self.min_bitrate = float(min_bitrate_bps)
         self.max_bitrate = float(max_bitrate_bps)
+        self.start_bitrate = float(start_bitrate_bps)
         self.bitrate = np.full(t, float(start_bitrate_bps),
                                dtype=np.float64)
         self.rate_state = np.zeros(t, dtype=np.int8)
@@ -100,10 +101,58 @@ class BatchedRemoteBitrateEstimator(ArraySnapshotMixin):
     def set_rtt(self, tids, rtt_ms) -> None:
         self.rtt_ms[np.asarray(tids, dtype=np.int64)] = rtt_ms
 
+    def reset_rows(self, tids,
+                   start_bitrate_bps: Optional[float] = None) -> None:
+        """Return rows to their fresh state — a departing transport's
+        Kalman/AIMD state must not leak into the next occupant of a
+        recycled row."""
+        if start_bitrate_bps is None:
+            start_bitrate_bps = self.start_bitrate
+        t = np.asarray(tids, dtype=np.int64)
+        self._last_send[t] = 0.0
+        self._send_unwrapped[t] = 0.0
+        self._has_send[t] = False
+        self._g_has[t] = False
+        self._p_has[t] = False
+        self.offset[t] = 0.0
+        self._slope[t] = 8.0 / 512.0
+        self._e00[t] = 100.0
+        self._e01[t] = 0.0
+        self._e10[t] = 0.0
+        self._e11[t] = 1e-1
+        self._avg_noise[t] = 0.0
+        self._var_noise[t] = 50.0
+        self.num_deltas[t] = 0
+        self.threshold[t] = 12.5
+        self._last_update_ms[t] = -1.0
+        self._time_over_using[t] = -1.0
+        self._overuse_counter[t] = 0
+        self.signal[t] = SIG_NORMAL
+        self.bitrate[t] = float(start_bitrate_bps)
+        self.rate_state[t] = ST_HOLD
+        self.region[t] = RG_MULTIPLICATIVE
+        self.rtt_ms[t] = 200.0
+        self._avg_max_kbps[t] = -1.0
+        self._var_max_kbps[t] = 0.4
+        self._last_change_ms[t] = -1.0
+        self._buckets[t] = 0
+        self._win_total[t] = 0
+        self._oldest_ms[t] = -1
+
     # ------------------------------------------------------------- feeding
     def incoming_batch(self, tids, arrival_ms, ast24, sizes) -> None:
         """Feed a packet batch: tids [B] transport rows, arrival_ms [B]
-        host arrival, ast24 [B] 24-bit abs-send-time, sizes [B] bytes."""
+        host arrival, ast24 [B] 24-bit abs-send-time, sizes [B] bytes.
+
+        Fast path: a tick's batch carries many packets per transport,
+        but the GCC arrival filter only *updates* on burst-group
+        closures (5 ms send-time spans) — so within-group packets fold
+        in one vectorized pass and the Python loop runs per group
+        closure (1-2 per transport per tick), not per packet.  A batch
+        whose arrivals span >= the rate window could alias its own
+        bucket writes; that pathological shape routes through the exact
+        per-packet wave path instead.
+        """
         tids = np.asarray(tids, dtype=np.int64)
         b = len(tids)
         if b == 0:
@@ -112,6 +161,29 @@ class BatchedRemoteBitrateEstimator(ArraySnapshotMixin):
         send_ms = (np.asarray(ast24, dtype=np.float64)
                    / float(1 << 18)) * 1000.0
         sizes = np.asarray(sizes, dtype=np.int64)
+        if (b > 1 and float(arrival_ms.max()) - float(arrival_ms.min())
+                >= self.window_ms - 1):
+            self._incoming_waves(tids, arrival_ms, send_ms, sizes)
+            return
+
+        order = np.argsort(tids, kind="stable")
+        t_s = tids[order]
+        a_s = arrival_ms[order]
+        s_s = send_ms[order]
+        z_s = sizes[order]
+        first = np.ones(b, dtype=bool)
+        first[1:] = t_s[1:] != t_s[:-1]
+        seg_start = np.nonzero(first)[0]
+        seg_end = np.append(seg_start[1:], b)
+        ut = t_s[seg_start]
+        seg_id = np.repeat(np.arange(len(ut)), seg_end - seg_start)
+
+        self._rate_update_batch(ut, seg_id, seg_start, seg_end, a_s, z_s)
+        u = self._unwrap_batch(ut, seg_id, seg_start, seg_end, s_s)
+        self._group_rounds(ut, seg_start, seg_end, u, a_s, z_s)
+
+    def _incoming_waves(self, tids, arrival_ms, send_ms, sizes) -> None:
+        """Exact per-packet order via rank waves (slow fallback)."""
         ranks = segment_ranks(tids)
         for r in range(int(ranks.max(initial=0)) + 1):
             rows = np.nonzero(ranks == r)[0]
@@ -119,6 +191,153 @@ class BatchedRemoteBitrateEstimator(ArraySnapshotMixin):
                 break
             self._packet_wave(tids[rows], arrival_ms[rows],
                               send_ms[rows], sizes[rows])
+
+    def _rate_update_batch(self, ut, seg_id, seg_start, seg_end,
+                           a_s, z_s) -> None:
+        """Whole-batch form of per-packet `_rate_update`, bit-exact for
+        batches spanning < window_ms (guarded by the caller).
+
+        Per packet the scalar does: erase to now-W+1, init oldest on
+        first sight, fold late packets into the oldest live bucket, add
+        bytes.  With the span bound, the only in-batch interaction is a
+        later packet's erase zeroing an earlier packet's bucket — which
+        is exactly the set of packets whose effective time falls before
+        the *final* window edge, so those are masked out instead of
+        written and erased.
+        """
+        w = self.window_ms
+        a_i = a_s.astype(np.int64)
+        lo = int(a_i.min())
+        # segmented running max of arrivals via a seg-keyed cummax (the
+        # key makes later segments always dominate earlier ones)
+        span1 = int(a_i.max()) - lo + 1
+        enc = seg_id * np.int64(span1) + (a_i - lo)
+        pref = (np.maximum.accumulate(enc)
+                - seg_id * np.int64(span1)) + lo
+        oldest_before = self._oldest_ms[ut]
+        oldest_start = np.where(oldest_before >= 0, oldest_before,
+                                a_i[seg_start])
+        oldest_i = np.maximum(oldest_start[seg_id], pref - w + 1)
+        now_eff = np.maximum(a_i, oldest_i)
+        final_oldest = oldest_i[seg_end - 1]
+        # pre-batch buckets: erase up to the final edge, then pin oldest
+        # to the per-packet-equivalent end state (covers fresh rows the
+        # erase can't see)
+        self._erase_old(ut, pref[seg_end - 1])
+        self._oldest_ms[ut] = final_oldest
+        survive = now_eff >= final_oldest[seg_id]
+        flat = ut[seg_id] * np.int64(w) + now_eff % w
+        np.add.at(self._buckets.reshape(-1), flat[survive], z_s[survive])
+        tot = np.bincount(seg_id[survive],
+                          weights=z_s[survive].astype(np.float64),
+                          minlength=len(ut))
+        self._win_total[ut] += tot.astype(np.int64)
+
+    def _unwrap_batch(self, ut, seg_id, seg_start, seg_end, s_s
+                      ) -> np.ndarray:
+        """Per-packet 64 s abs-send-time unwrap as a segmented prefix
+        sum of wrapped deltas; returns unwrapped send [B]."""
+        b = len(s_s)
+        prev = np.empty(b, dtype=np.float64)
+        prev[1:] = s_s[:-1]
+        prev[seg_start] = self._last_send[ut]
+        d = s_s - prev
+        d = np.where(d < -32000, d + 64000,
+                     np.where(d > 32000, d - 64000, d))
+        fresh = ~self._has_send[ut]
+        start = np.where(fresh, s_s[seg_start],
+                         self._send_unwrapped[ut] + d[seg_start])
+        d[seg_start] = 0.0
+        c = np.cumsum(d)
+        u = start[seg_id] + (c - c[seg_start][seg_id])
+        self._send_unwrapped[ut] = u[seg_end - 1]
+        self._last_send[ut] = s_s[seg_end - 1]
+        self._has_send[ut] = True
+        return u
+
+    def _group_rounds(self, ut, seg_start, seg_end, u, a_s, z_s
+                      ) -> None:
+        """InterArrival group bookkeeping, one Python round per group
+        *closure* instead of per packet: each round folds every
+        transport's maximal run of in-group/out-of-order packets in one
+        vector pass, then performs the (Kalman + detector) closure for
+        transports whose next packet opens a new group."""
+        big = np.int64(1) << 60
+        h = seg_start.copy()
+        act = np.nonzero(h < seg_end)[0]
+        while len(act):
+            t_a = ut[act]
+            nog = ~self._g_has[t_a]
+            if nog.any():
+                rows = h[act[nog]]
+                tn = t_a[nog]
+                self._g_has[tn] = True
+                self._g_first_send[tn] = u[rows]
+                self._g_send[tn] = u[rows]
+                self._g_arrival[tn] = a_s[rows]
+                self._g_size[tn] = z_s[rows]
+                h[act[nog]] += 1
+                act = act[h[act] < seg_end[act]]
+                if len(act) == 0:
+                    break
+                t_a = ut[act]
+            lens = seg_end[act] - h[act]
+            offs = np.zeros(len(act), dtype=np.int64)
+            np.cumsum(lens[:-1], out=offs[1:])
+            ar = (np.arange(int(lens.sum()), dtype=np.int64)
+                  - np.repeat(offs, lens))
+            idx = np.repeat(h[act], lens) + ar
+            sid = np.repeat(np.arange(len(act)), lens)
+            su = u[idx]
+            gf = self._g_first_send[t_a][sid]
+            ooo = su < gf                      # out-of-order: ignored
+            close = ~ooo & (su - gf > _BURST_SPAN_MS)
+            firstclose = np.minimum.reduceat(
+                np.where(close, ar, big), offs)
+            consumed = ar < firstclose[sid]
+            ing = consumed & ~ooo
+            if ing.any():
+                gmax = np.maximum.reduceat(
+                    np.where(ing, su, -np.inf), offs)
+                lpos = np.maximum.reduceat(
+                    np.where(ing, ar, np.int64(-1)), offs)
+                zsum = np.add.reduceat(np.where(ing, z_s[idx], 0), offs)
+                hasin = lpos >= 0
+                tf = t_a[hasin]
+                self._g_send[tf] = np.maximum(self._g_send[tf],
+                                              gmax[hasin])
+                self._g_arrival[tf] = a_s[h[act[hasin]] + lpos[hasin]]
+                self._g_size[tf] += zsum[hasin]
+            closing = firstclose < lens
+            newh = h[act] + np.minimum(firstclose, lens)
+            if closing.any():
+                ci = act[closing]
+                rows = h[ci] + firstclose[closing]
+                tc = ut[ci]
+                sg, ag, zg = u[rows], a_s[rows], z_s[rows]
+                have_prev = self._p_has[tc]
+                send_delta = self._g_send[tc] - self._p_send[tc]
+                arr_delta = self._g_arrival[tc] - self._p_arrival[tc]
+                size_delta = self._g_size[tc] - self._p_size[tc]
+                fm = have_prev & (send_delta >= 0)
+                self._p_has[tc] = True
+                self._p_send[tc] = self._g_send[tc]
+                self._p_arrival[tc] = self._g_arrival[tc]
+                self._p_size[tc] = self._g_size[tc]
+                self._g_first_send[tc] = sg
+                self._g_send[tc] = sg
+                self._g_arrival[tc] = ag
+                self._g_size[tc] = zg
+                if fm.any():
+                    filt = tc[fm]
+                    self._kalman_update(filt, arr_delta[fm],
+                                        send_delta[fm],
+                                        size_delta[fm].astype(
+                                            np.float64))
+                    self._detect(filt, send_delta[fm], ag[fm])
+                newh[closing] += 1
+            h[act] = newh
+            act = np.nonzero(h < seg_end)[0]
 
     def _packet_wave(self, t, arrival, send, size) -> None:
         """One packet per transport."""
@@ -285,15 +504,21 @@ class BatchedRemoteBitrateEstimator(ArraySnapshotMixin):
             self._win_total[ft] = 0
         part = np.nonzero(~full & (adv > 0))[0]
         if len(part):
+            # ragged zeroing: all outgoing buckets of all rows at once
+            # (advance < window, so each row's range hits distinct slots)
             tp = t[part]
             start = self._oldest_ms[tp]
-            n = adv[part]
-            for i in range(int(n.max())):
-                sel = n > i
-                tt = tp[sel]
-                idx = (start[sel] + i) % self.window_ms
-                self._win_total[tt] -= self._buckets[tt, idx]
-                self._buckets[tt, idx] = 0
+            n = np.asarray(adv[part], dtype=np.int64)
+            offs = np.zeros(len(part), dtype=np.int64)
+            np.cumsum(n[:-1], out=offs[1:])
+            ar = (np.arange(int(n.sum()), dtype=np.int64)
+                  - np.repeat(offs, n))
+            flat = (np.repeat(tp, n) * np.int64(self.window_ms)
+                    + (np.repeat(start, n) + ar) % self.window_ms)
+            bf = self._buckets.reshape(-1)
+            gone = bf[flat]
+            self._win_total[tp] -= np.add.reduceat(gone, offs)
+            bf[flat] = 0
         upd = adv > 0
         self._oldest_ms[t] = np.where(
             upd, np.broadcast_to(new_oldest, adv.shape),
@@ -397,11 +622,13 @@ class BatchedRemoteBitrateEstimator(ArraySnapshotMixin):
     def _snap_scalars(self) -> dict:
         return {"window_ms": self.window_ms,
                 "min_bitrate": self.min_bitrate,
-                "max_bitrate": self.max_bitrate}
+                "max_bitrate": self.max_bitrate,
+                "start_bitrate": self.start_bitrate}
 
     @classmethod
     def _restore_kwargs(cls, snap: dict) -> dict:
         return {"capacity": len(snap["offset"]),
                 "min_bitrate_bps": snap["min_bitrate"],
                 "max_bitrate_bps": snap["max_bitrate"],
+                "start_bitrate_bps": snap.get("start_bitrate", 300_000),
                 "window_ms": snap["window_ms"]}
