@@ -30,7 +30,12 @@ def _ceil16(x):
 
 
 def _ghash_width(capacity: int) -> int:
-    return 2 * _ceil16(capacity) + 16
+    """Tight bound on the GHASH input row: padded-AAD + padded-CT +
+    length block.  ceil16(a) + ceil16(c) <= ceil16(a + c) + 16 for any
+    split, and a + c <= capacity, so ceil16(cap) + 16 covers the data
+    and +16 the length block.  (The old 2*cap+16 bound nearly doubled
+    the Horner matmul rounds every GCM path pays.)"""
+    return _ceil16(capacity) + 32
 
 
 def _length_block(cols, ap, cp, abits, cbits):
@@ -197,6 +202,80 @@ def gcm_unprotect(data, length, aad_len, round_keys, gmat, iv12,
     width = _ghash_width(data.shape[1])
     want = _tag(round_keys, gmat, data, aad_len, ct_len, j0, width,
                 aad_const)
+    stored = _gather_span(data, mlen, TAG_LEN)
+    auth_ok = jnp.all(stored == want, axis=1)
+    ctr0 = _inc32(j0)
+    if aad_const is not None:
+        dec = ctr_crypt_uniform(round_keys, ctr0, data, aad_const, ct_len)
+    else:
+        dec = ctr_crypt_offset(round_keys, ctr0, data, aad_len, ct_len)
+    return dec, mlen, auth_ok
+
+
+def _grouped_tag(round_keys, gmat_g, enc, aad_len, ct_len, j0,
+                 grid_rows, inv_pos, width: int, aad_const):
+    """Per-stream-grouped tag for a mixed-stream batch.
+
+    The per-row `_tag` gathers a 16 KiB GHASH matrix per packet — at
+    batch 65536 that is 1 GiB of HBM traffic for key material, which
+    capped the GCM launch size (BENCH_r02).  Here the host pre-groups
+    rows by stream into a [G, P] grid (`grid_rows`: row index or -1
+    padding) so each stream's matrix is read ONCE and applied to all its
+    rows as one MXU matmul per Horner step (`ghash_grouped`), then the
+    digests scatter back to batch order via `inv_pos`.
+    """
+    from libjitsi_tpu.kernels.ghash import ghash_grouped
+
+    if aad_const is not None:
+        gin, nblk = _build_ghash_input_uniform(enc, aad_const, ct_len,
+                                               width)
+    else:
+        gin, nblk = _build_ghash_input(enc, aad_len, ct_len, width)
+    g, p = grid_rows.shape
+    safe = jnp.clip(grid_rows.reshape(-1), 0, enc.shape[0] - 1)
+    gin_g = gin[safe].reshape(g, p, width)
+    nblk_g = jnp.where(grid_rows >= 0, nblk[safe].reshape(g, p), 0)
+    s = ghash_grouped(gmat_g, gin_g, nblk_g, width // 16)
+    s_rows = s.reshape(g * p, 16)[inv_pos]
+    ek_j0 = aes_encrypt(round_keys, j0)
+    return jnp.bitwise_xor(s_rows, ek_j0)
+
+
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def gcm_protect_grouped(data, length, aad_len, round_keys, gmat_g, iv12,
+                        grid_rows, inv_pos, aad_const=None):
+    """`gcm_protect` with stream-grouped GHASH: round_keys [B, R, 16]
+    stay per-row (cheap), gmat_g [G, 128, 128] is per GROUP."""
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    aad_len = jnp.asarray(aad_len, dtype=jnp.int32)
+    j0 = _j0(jnp.asarray(iv12))
+    ctr0 = _inc32(j0)
+    ct_len = length - aad_len
+    if aad_const is not None:
+        enc = ctr_crypt_uniform(round_keys, ctr0, data, aad_const, ct_len)
+    else:
+        enc = ctr_crypt_offset(round_keys, ctr0, data, aad_len, ct_len)
+    width = _ghash_width(data.shape[1])
+    tag = _grouped_tag(round_keys, gmat_g, enc, aad_len, ct_len, j0,
+                       grid_rows, inv_pos, width, aad_const)
+    out = _scatter_tag(enc, length, tag)
+    return out, length + TAG_LEN
+
+
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def gcm_unprotect_grouped(data, length, aad_len, round_keys, gmat_g,
+                          iv12, grid_rows, inv_pos, aad_const=None):
+    """`gcm_unprotect` with stream-grouped GHASH."""
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    aad_len = jnp.asarray(aad_len, dtype=jnp.int32)
+    mlen = length - TAG_LEN
+    ct_len = mlen - aad_len
+    j0 = _j0(jnp.asarray(iv12))
+    width = _ghash_width(data.shape[1])
+    want = _grouped_tag(round_keys, gmat_g, data, aad_len, ct_len, j0,
+                        grid_rows, inv_pos, width, aad_const)
     stored = _gather_span(data, mlen, TAG_LEN)
     auth_ok = jnp.all(stored == want, axis=1)
     ctr0 = _inc32(j0)

@@ -32,6 +32,7 @@ import numpy as np
 from libjitsi_tpu.core.packet import (PacketBatch,
                                       bucket_by_size, unbucket)
 from libjitsi_tpu.core.rtp_math import (
+    _segments,
     chain_packet_indices,
     estimate_packet_index,
     segment_ranks,
@@ -118,6 +119,56 @@ def _unprotect_gcm_dev(tab_rk, tab_gm, stream, data, length, aad_len, iv12,
     return gcm_kernel.gcm_unprotect(
         data, length, aad_len, tab_rk[stream], tab_gm[stream], iv12,
         aad_const=aad_const)
+
+
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def _protect_gcm_grouped_dev(tab_rk, tab_gm, stream, data, length,
+                             aad_len, iv12, grid_rows, ustream, inv_pos,
+                             aad_const=None):
+    return gcm_kernel.gcm_protect_grouped(
+        data, length, aad_len, tab_rk[stream], tab_gm[ustream], iv12,
+        grid_rows, inv_pos, aad_const=aad_const)
+
+
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def _unprotect_gcm_grouped_dev(tab_rk, tab_gm, stream, data, length,
+                               aad_len, iv12, grid_rows, ustream,
+                               inv_pos, aad_const=None):
+    return gcm_kernel.gcm_unprotect_grouped(
+        data, length, aad_len, tab_rk[stream], tab_gm[ustream], iv12,
+        grid_rows, inv_pos, aad_const=aad_const)
+
+
+_GCM_GROUP_MIN_BATCH = 256
+
+
+def _gcm_grid(stream: np.ndarray):
+    """Group batch rows by stream for the grouped-GHASH path.
+
+    Returns (grid_rows [G, P] int32 row-index-or-minus-one, ustream [G]
+    int64, inv_pos [B] int32), with G and P rounded up to powers of two
+    so jit shapes stay cacheable — or None when the per-row path should
+    run instead (tiny batches, or stream skew so heavy the padded grid
+    would more than double the GHASH work).
+    """
+    n = len(stream)
+    if n < _GCM_GROUP_MIN_BATCH:
+        return None
+    order, s_o, first, grp, fpos = _segments(stream)
+    g = int(grp[-1]) + 1
+    rank = np.arange(n, dtype=np.int64) - fpos[grp]
+    p = int(rank.max()) + 1
+    gp = 1 << max(g - 1, 0).bit_length()
+    pp = 1 << max(p - 1, 0).bit_length()
+    if gp * pp > 2 * n:
+        return None
+    grid = np.full((gp, pp), -1, dtype=np.int32)
+    grid[grp, rank] = order
+    ustream = np.zeros(gp, dtype=np.int64)
+    ustream[:g] = s_o[fpos]
+    inv = np.empty(n, dtype=np.int32)
+    inv[order] = (grp * pp + rank).astype(np.int32)
+    return grid, ustream, inv
 
 
 class SrtpStreamTable:
@@ -647,11 +698,24 @@ class SrtpStreamTable:
         tab_rk, tab_aux, _, _ = self._device()
         if self._gcm:
             iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
-            data, length = _protect_gcm_dev(
-                tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
-                jnp.asarray(batch.data), jnp.asarray(batch.length),
-                jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
-                aad_const=_uniform_off(hdr.payload_off, batch.capacity))
+            grid = _gcm_grid(stream)
+            if grid is not None:
+                gr, us, inv = grid
+                data, length = _protect_gcm_grouped_dev(
+                    tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+                    jnp.asarray(batch.data), jnp.asarray(batch.length),
+                    jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
+                    jnp.asarray(gr), jnp.asarray(us, dtype=jnp.int32),
+                    jnp.asarray(inv),
+                    aad_const=_uniform_off(hdr.payload_off,
+                                           batch.capacity))
+            else:
+                data, length = _protect_gcm_dev(
+                    tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+                    jnp.asarray(batch.data), jnp.asarray(batch.length),
+                    jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
+                    aad_const=_uniform_off(hdr.payload_off,
+                                           batch.capacity))
         elif self._f8:
             iv = self._f8_rtp_iv(hdr, v)
             data, length = _protect_rtp_dev(
@@ -752,11 +816,24 @@ class SrtpStreamTable:
         tab_rk, tab_aux, _, _ = self._device()
         if self._gcm:
             iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
-            data, mlen, auth_ok = _unprotect_gcm_dev(
-                tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
-                jnp.asarray(batch.data), jnp.asarray(length),
-                jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
-                aad_const=_uniform_off(hdr.payload_off, batch.capacity))
+            grid = _gcm_grid(stream)
+            if grid is not None:
+                gr, us, inv = grid
+                data, mlen, auth_ok = _unprotect_gcm_grouped_dev(
+                    tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+                    jnp.asarray(batch.data), jnp.asarray(length),
+                    jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
+                    jnp.asarray(gr), jnp.asarray(us, dtype=jnp.int32),
+                    jnp.asarray(inv),
+                    aad_const=_uniform_off(hdr.payload_off,
+                                           batch.capacity))
+            else:
+                data, mlen, auth_ok = _unprotect_gcm_dev(
+                    tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+                    jnp.asarray(batch.data), jnp.asarray(length),
+                    jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
+                    aad_const=_uniform_off(hdr.payload_off,
+                                           batch.capacity))
         elif self._f8:
             iv = self._f8_rtp_iv(hdr, v)
             data, mlen, auth_ok = _unprotect_rtp_dev(
