@@ -26,6 +26,9 @@ from cryptography.x509.oid import NameOID
 import datetime
 
 from libjitsi_tpu.transform.srtp.policy import SrtpProfile
+from libjitsi_tpu.utils.logging import get_logger
+
+_dtls_log = get_logger("control.dtls")
 
 _b = Binding()
 _lib, _ffi = _b.lib, _b.ffi
@@ -97,7 +100,8 @@ class DtlsSrtpEndpoint:
                  cert_der: Optional[bytes] = None,
                  key_der: Optional[bytes] = None,
                  remote_fingerprint: Optional[str] = None,
-                 mtu: int = 1200):
+                 mtu: int = 1200,
+                 cookie_exchange: bool = False):
         if role not in ("client", "server"):
             raise ValueError("role must be client or server")
         self.role = role
@@ -149,15 +153,49 @@ class DtlsSrtpEndpoint:
                 if role == "server" else 0),
             self._verify_cb)
 
+        # optional RFC 6347 §4.2.1 cookie exchange (HelloVerifyRequest):
+        # a spoofed-source ClientHello costs the server no association
+        # state until the cookie round-trips.  Cookie = HMAC-free random
+        # per-endpoint secret (no peer address exists on a memory BIO;
+        # the bridge's one-socket model ties the exchange to the 5-tuple
+        # at the io layer).  Reference behavior: BouncyCastle's
+        # DTLSVerifier under DtlsPacketTransformer.
+        self._cookie_cbs = None
+        if role == "server" and cookie_exchange:
+            secret = os.urandom(16)
+
+            @_ffi.callback("int(SSL *, unsigned char *, unsigned int *)")
+            def _gen(ssl_p, cookie, clen):
+                _ffi.buffer(cookie, 16)[:] = secret
+                clen[0] = 16
+                return 1
+
+            @_ffi.callback(
+                "int(SSL *, const unsigned char *, unsigned int)")
+            def _ver(ssl_p, cookie, clen):
+                return 1 if _ffi.buffer(cookie, clen)[:] == secret else 0
+
+            self._cookie_cbs = (_gen, _ver)      # keep cffi handles alive
+            _lib.SSL_CTX_set_cookie_generate_cb(self._ctx, _gen)
+            _lib.SSL_CTX_set_cookie_verify_cb(self._ctx, _ver)
+
         ssl = _lib.SSL_new(self._ctx)
         self._ssl = _ffi.gc(ssl, _lib.SSL_free)
         self._rbio = _lib.BIO_new(_lib.BIO_s_mem())
         self._wbio = _lib.BIO_new(_lib.BIO_s_mem())
         _lib.SSL_set_bio(self._ssl, self._rbio, self._wbio)  # SSL owns BIOs
+        if role == "server" and cookie_exchange:
+            _lib.SSL_set_options(self._ssl, 0x00002000)  # OP_COOKIE_EXCHANGE
         if role == "client":
             _lib.SSL_set_connect_state(self._ssl)
         else:
             _lib.SSL_set_accept_state(self._ssl)
+        self.retransmits = 0
+        # flips once the peer has demonstrably advanced the handshake
+        # past the stateless phase (see feed); used by the association
+        # table to decide whether an address binding may be superseded
+        self.progressed = False
+        self._out_bytes = 0
 
     # ------------------------------------------------------------- pumps
     def _drain_out(self) -> List[bytes]:
@@ -187,6 +225,30 @@ class DtlsSrtpEndpoint:
         _lib.BIO_write(self._rbio, buf, len(datagram))
         if not self.complete:
             self._pump()
+        out = self._drain_out()
+        # a HelloVerifyRequest is one tiny record; the ServerHello
+        # flight (certificate etc.) is far larger.  Crossing that line
+        # means the peer round-tripped the cookie (or no cookies are in
+        # use) and actually holds its source address.
+        self._out_bytes += sum(len(d) for d in out)
+        if self.complete or self._out_bytes > 300:
+            self.progressed = True
+        return out
+
+    def tick(self) -> List[bytes]:
+        """Drive the RFC 6347 retransmission timer; call periodically
+        (e.g. from the media loop tick).  OpenSSL tracks the flight
+        timer internally (1 s initial, doubling); when it has expired
+        this retransmits the last flight and returns the datagrams —
+        without it, one lost handshake datagram deadlocks the
+        association.  Reference: BouncyCastle's DTLSReliableHandshake
+        under DtlsPacketTransformer.
+        """
+        if self.complete:
+            return []
+        rc = _lib.DTLSv1_handle_timeout(self._ssl)
+        if rc > 0:
+            self.retransmits += 1
         return self._drain_out()
 
     # ---------------------------------------------------------- completion
@@ -239,3 +301,121 @@ class DtlsSrtpEndpoint:
         if self.role == "client":
             return profile, ck, cs, sk, ss
         return profile, sk, ss, ck, cs
+
+
+class DtlsAssociationTable:
+    """Pending DTLS-SRTP associations for a bridge's media loop.
+
+    Owns the sid <-> peer-address binding, datagram routing, flight
+    retransmission ticking and the early-media hold window; the owning
+    bridge supplies `install(sid, endpoint)` to put exported keys into
+    its own tables.  Shared by ConferenceBridge and SfuBridge so the
+    association logic exists exactly once.  Reference:
+    DtlsPacketTransformer + DtlsControlImpl (SURVEY §3.5).
+    """
+
+    def __init__(self, loop, profile: SrtpProfile, install):
+        self.loop = loop
+        self.profile = profile
+        self.install = install
+        self.pending = {}              # sid -> DtlsSrtpEndpoint
+        self.addr_of = {}              # (ip, port) -> sid
+        self.sid_addr = {}             # sid -> (ip, port)  (companion)
+        self.rejected = 0              # fingerprint-mismatch teardowns
+
+    def join(self, sid: int, role: str = "server",
+             remote_fingerprint: Optional[str] = None,
+             cookie_exchange: bool = False,
+             remote_addr: Optional[Tuple[int, int]] = None
+             ) -> "DtlsSrtpEndpoint":
+        ep = DtlsSrtpEndpoint(role, profiles=[self.profile],
+                              remote_fingerprint=remote_fingerprint,
+                              cookie_exchange=cookie_exchange)
+        self.pending[sid] = ep
+        if remote_addr is not None:
+            # signaling-known peer address: bind now, no guessing later
+            self._bind(sid, tuple(remote_addr))
+            ep.progressed = True       # binding is authoritative
+        self.loop.hold_stream(sid)
+        return ep
+
+    def _bind(self, sid: int, addr) -> None:
+        old = self.sid_addr.get(sid)
+        if old is not None:
+            self.addr_of.pop(old, None)
+        self.addr_of[addr] = sid
+        self.sid_addr[sid] = addr
+
+    def _claim(self, addr):
+        """Pick the sid a first-seen address may drive.  Unclaimed
+        pending rows win; otherwise a bound-but-unprogressed row may be
+        superseded (with cookie_exchange, a spoofed-source ClientHello
+        can bind an address but can never round-trip the cookie, so it
+        never progresses and the real peer reclaims the row)."""
+        unclaimed = [s for s in self.pending if s not in self.sid_addr]
+        if len(unclaimed) == 1:
+            return unclaimed[0]
+        if not unclaimed:
+            stale = [s for s, ep in self.pending.items()
+                     if not ep.progressed
+                     and self.sid_addr.get(s) is not None]
+            if len(stale) == 1:
+                return stale[0]
+        # ambiguous: guessing could land keys on the wrong row; the
+        # peer's flight timer retransmits, signaling-bound joins route
+        return None
+
+    def on_dtls(self, datagram: bytes, addr) -> list:
+        addr = tuple(addr)
+        sid = self.addr_of.get(addr)
+        if sid is None:
+            sid = self._claim(addr)
+            if sid is None:
+                return []
+            self._bind(sid, addr)
+        ep = self.pending.get(sid)
+        if ep is None:
+            return []
+        try:
+            out = ep.feed(datagram)
+        except RuntimeError as e:
+            # fingerprint mismatch (wrong peer / MITM): drop the
+            # association, not the bridge tick
+            self.forget(sid)
+            self.rejected += 1
+            _dtls_log.warn("dtls_association_rejected", sid=sid,
+                           error=str(e))
+            return []
+        if ep.complete:
+            # media return address comes from the AUTHENTICATED
+            # handshake's bound 5-tuple, never from the first datagram
+            self.loop.addr_ip[sid] = addr[0]
+            self.loop.addr_port[sid] = addr[1]
+            # un-pend BEFORE install: install hooks (e.g. SFU route
+            # rebuild) must see this row as keyed
+            self.pending.pop(sid, None)
+            self.install(sid, ep)
+            self.loop.release_stream(sid)
+        return out
+
+    def tick(self) -> None:
+        """Drive retransmission timers; resend expired flights."""
+        from libjitsi_tpu.core.packet import PacketBatch
+
+        for sid, ep in list(self.pending.items()):
+            out = ep.tick()
+            if not out:
+                continue
+            addr = self.sid_addr.get(sid)
+            if addr is None:
+                continue
+            for d in out:
+                self.loop.engine.send_batch(
+                    PacketBatch.from_payloads([d]), addr[0], addr[1])
+
+    def forget(self, sid: int) -> None:
+        self.pending.pop(sid, None)
+        addr = self.sid_addr.pop(sid, None)
+        if addr is not None:
+            self.addr_of.pop(addr, None)
+        self.loop.discard_stream(sid)

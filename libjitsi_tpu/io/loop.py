@@ -76,9 +76,47 @@ class MediaLoop:
         # learned (ip, port) per stream row (latched from last packet)
         self.addr_ip = np.zeros(registry.capacity, dtype=np.uint32)
         self.addr_port = np.zeros(registry.capacity, dtype=np.uint16)
+        # streams on hold (keys not yet installed): their RTP is queued
+        # raw, bounded, and replayed through the chain on release —
+        # media racing the DTLS Finished flight must not be dropped.
+        # Reference: DtlsPacketTransformer's pre-handshake queue.
+        self._hold_mask = np.zeros(registry.capacity, dtype=bool)
+        self._hold_q: Dict[int, "deque"] = {}
         self.ticks = 0
         self.rx_packets = 0
         self.tx_packets = 0
+
+    # ------------------------------------------------------------- holds
+    def hold_stream(self, sid: int, max_packets: int = 64) -> None:
+        from collections import deque
+
+        self._hold_mask[sid] = True
+        self._hold_q[sid] = deque(maxlen=max_packets)
+
+    def discard_stream(self, sid: int) -> None:
+        """Drop a held stream's queue without replay (endpoint left)."""
+        self._hold_mask[sid] = False
+        self._hold_q.pop(sid, None)
+
+    def release_stream(self, sid: int) -> int:
+        """Replay a held stream's queued packets through the normal
+        receive path (chain + on_media); returns the packet count."""
+        self._hold_mask[sid] = False
+        q = self._hold_q.pop(sid, None)
+        if not q:
+            return 0
+        self.last_rtp_arrival_ns = None      # no kernel stamps for these
+        batch = PacketBatch.from_payloads(list(q), stream=[sid] * len(q))
+        if self.chain is not None:
+            batch, ok = self.chain.rtp_transformer.reverse_transform(
+                batch)
+        else:
+            ok = np.ones(batch.batch_size, bool)
+        if self.on_media is not None:
+            reply = self.on_media(batch, ok)
+            if reply is not None:
+                self.send_media(reply)
+        return len(q)
 
     # -------------------------------------------------------------- tick
     def tick(self) -> int:
@@ -149,6 +187,18 @@ class MediaLoop:
 
         rtp_rows = np.nonzero(~rtcp_mask & known)[0]
         rtcp_rows = np.nonzero(rtcp_mask & known)[0]
+
+        # held streams (pre-handshake): queue raw RTP, drop their RTCP
+        if len(rtp_rows) and self._hold_q:
+            held = self._hold_mask[sids[rtp_rows]]
+            if held.any():
+                lens = np.asarray(sub.length)
+                for i in rtp_rows[held]:
+                    self._hold_q[int(sids[i])].append(
+                        sub.data[i, :lens[i]].tobytes())
+                rtp_rows = rtp_rows[~held]
+        if len(rtcp_rows) and self._hold_q:
+            rtcp_rows = rtcp_rows[~self._hold_mask[sids[rtcp_rows]]]
 
         with self.metrics.timing("reverse_chain"):
             if len(rtp_rows):

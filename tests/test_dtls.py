@@ -103,3 +103,93 @@ def test_exported_keys_drive_srtp_tables():
     dec, ok = rx.unprotect_rtp(tx.protect_rtp(b))
     assert ok.all()
     assert dec.to_bytes(0) == b.to_bytes(0)
+
+
+@pytest.mark.slow
+def test_lossy_handshake_completes_via_retransmission():
+    """VERDICT r2 #5: 30% datagram loss each way; the RFC 6347 flight
+    timers (DtlsSrtpEndpoint.tick) must still complete the handshake.
+    Real-time test: OpenSSL's initial flight timer is 1 s."""
+    import time as _t
+
+    rng = np.random.default_rng(7)
+    c = DtlsSrtpEndpoint("client")
+    s = DtlsSrtpEndpoint("server", cookie_exchange=True)
+
+    def deliver(dst, datagrams):
+        out = []
+        for d in datagrams:
+            if rng.random() < 0.30:
+                continue                      # lost
+            out.extend(dst.feed(d))
+        return out
+
+    pend_to_s = c.handshake_packets()
+    t0 = _t.time()
+    while not (c.complete and s.complete):
+        assert _t.time() - t0 < 25, "handshake deadlocked under loss"
+        pend_to_c = deliver(s, pend_to_s)
+        pend_to_s = deliver(c, pend_to_c)
+        pend_to_s += c.tick()
+        for d in s.tick():
+            pend_to_s.extend(c.feed(d))
+        _t.sleep(0.05)
+    assert c.retransmits + s.retransmits > 0, \
+        "loss seeded but no flight was ever retransmitted"
+    pc, ps = c.srtp_keys(), s.srtp_keys()
+    assert pc[0] == ps[0]
+    assert (pc[1], pc[2]) == (ps[3], ps[4])
+    assert (pc[3], pc[4]) == (ps[1], ps[2])
+
+
+def test_media_loop_hold_queues_and_releases():
+    """Early media (racing the DTLS Finished flight) queues raw and
+    replays through the chain once keys install."""
+    import libjitsi_tpu
+    from libjitsi_tpu.core.packet import PacketBatch
+    from libjitsi_tpu.io.loop import MediaLoop
+    from libjitsi_tpu.service.media_stream import StreamRegistry
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+
+    class _FakeEngine:
+        port = 0
+
+        def recv_batch(self, timeout_ms):
+            b = self._next
+            self._next = (PacketBatch.from_payloads([]),
+                          np.zeros(0, np.uint32), np.zeros(0, np.uint16))
+            return b
+
+        def send_batch(self, batch, ip, port):
+            return batch.batch_size
+
+    reg = StreamRegistry(libjitsi_tpu.configuration_service(),
+                         capacity=4)
+    seen = []
+    eng = _FakeEngine()
+    loop = MediaLoop(eng, reg,
+                     on_media=lambda b, ok: seen.append(
+                         (b.batch_size, ok.sum())) or None)
+    reg.map_ssrc(0xABC, 2)
+    loop.hold_stream(2)
+    wire = rtp_header.build([b"early-%d" % i for i in range(3)],
+                            [10, 11, 12], [0] * 3, [0xABC] * 3,
+                            [96] * 3, stream=[0] * 3)
+    pkts = [wire.to_bytes(i) for i in range(3)]
+    eng._next = (PacketBatch.from_payloads(pkts),
+                 np.full(3, 0x7F000001, np.uint32),
+                 np.full(3, 5555, np.uint16))
+    loop.tick()
+    assert seen == [], "held media leaked through"
+    n = loop.release_stream(2)
+    assert n == 3
+    assert seen == [(3, 3)]
+    # bounded: queue holds max_packets, oldest evicted
+    loop.hold_stream(2, max_packets=2)
+    eng._next = (PacketBatch.from_payloads(pkts),
+                 np.full(3, 0x7F000001, np.uint32),
+                 np.full(3, 5555, np.uint16))
+    loop.tick()
+    assert loop.release_stream(2) == 2
