@@ -169,6 +169,15 @@ class MediaStream:
         self._chain: Optional[TransformEngineChain] = None
         self._started = False
         self._rtcp_listeners: list = []
+        # send-side BWE (reference: BandwidthEstimatorImpl on the
+        # stream): fed by handle_rtcp from RR loss, REMB caps and TCC
+        # feedback (the latter via a TransportCCEngine when one is in
+        # the chain's extra engines)
+        from libjitsi_tpu.bwe.send_side import SendSideBandwidthEstimation
+        self.bwe = SendSideBandwidthEstimation()
+        self._tcc_engine: Optional[TransportCCEngine] = next(
+            (e for e in self._extra
+             if isinstance(e, TransportCCEngine)), None)
 
     # ------------------------------------------------------------ control
     def add_dynamic_rtp_payload_type(self, pt: int, encoding: str,
@@ -293,16 +302,31 @@ class MediaStream:
         MediaStreamStats2) see every parsed packet."""
         pkts = rtcp.parse_compound(blob)
         st = self.registry.stats
+        now_ms = (time.time() if now is None else now) * 1000.0
         for p in pkts:
             if isinstance(p, rtcp.SenderReport):
                 st.on_sr_received(self.sid, p, arrival=now)
                 for rb in p.reports:
                     if rb.ssrc == self.local_ssrc:
                         st.on_rr_received(self.sid, rb, now=now)
+                        self.bwe.on_receiver_report(rb.fraction_lost,
+                                                    now_ms)
             elif isinstance(p, rtcp.ReceiverReport):
                 for rb in p.reports:
                     if rb.ssrc == self.local_ssrc:
                         st.on_rr_received(self.sid, rb, now=now)
+                        self.bwe.on_receiver_report(rb.fraction_lost,
+                                                    now_ms)
+            elif isinstance(p, rtcp.Remb):
+                self.bwe.on_remb(p.bitrate_bps)
+            elif isinstance(p, rtcp.TccFeedback) and \
+                    self._tcc_engine is not None:
+                sts = [self._tcc_engine.lookup_send_time(
+                           (p.base_seq + i) & 0xFFFF)
+                       for i in range(len(p.received))]
+                self.bwe.on_tcc_feedback(
+                    p, [None if t is None else t * 1000.0 for t in sts],
+                    now_ms)
         for fn in list(self._rtcp_listeners):   # listeners may remove
             for p in pkts:                      # themselves mid-callback
                 fn(self, p)

@@ -23,13 +23,17 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from libjitsi_tpu.bwe.batched import BatchedRemoteBitrateEstimator
 from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.io.loop import MediaLoop
 from libjitsi_tpu.io.udp import UdpEngine
+from libjitsi_tpu.rtp import ext as rtp_ext
+from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.rtp import rtcp
 from libjitsi_tpu.service.media_stream import StreamRegistry
 from libjitsi_tpu.sfu import PacketCache, RtpTranslator
 from libjitsi_tpu.sfu.rtcp_termination import RtcpTermination
+from libjitsi_tpu.transform.header_ext import AbsSendTimeEngine
 from libjitsi_tpu.transform.srtp import SrtpProfile, SrtpStreamTable
 from libjitsi_tpu.utils.logging import get_logger
 
@@ -43,9 +47,11 @@ class SfuBridge:
                  profile: SrtpProfile =
                  SrtpProfile.AES_CM_128_HMAC_SHA1_80,
                  recv_window_ms: int = 1,
-                 kernel_timestamps: bool = False):
+                 kernel_timestamps: bool = False,
+                 abs_send_time_ext_id: int = 3):
         self.capacity = capacity
         self.profile = profile
+        self.ast_ext_id = abs_send_time_ext_id
         self.registry = StreamRegistry(config, capacity=capacity)
         # rx_table: what endpoints SEND us (media + their SRTCP);
         # tx_table: what we send THEM (our SRTCP feedback; media forward
@@ -60,12 +66,31 @@ class SfuBridge:
             UdpEngine(port=port, max_batch=4 * capacity,
                       kernel_timestamps=kernel_timestamps),
             self.registry, on_media=self._on_media,
-            on_rtcp=self._on_rtcp, chain=None,
+            on_rtcp=self._on_rtcp,
+            on_dtls=lambda d, a: self._dtls.on_dtls(d, a), chain=None,
             recv_window_ms=recv_window_ms)
         self.port = self.loop.engine.port
         self._ssrc_of: Dict[int, int] = {}     # sid -> sender ssrc
         self.forwarded = 0
         self.retransmitted = 0
+        # receive-side GCC over each sender->bridge leg: fed per tick
+        # from the abs-send-time ext + (kernel, when enabled) arrival
+        # stamps; one transport row per sender sid.  Reference:
+        # RemoteBitrateEstimatorAbsSendTime driven from the translator's
+        # receive path (SURVEY §2.3).
+        self.bwe = BatchedRemoteBitrateEstimator(capacity=capacity)
+        self._bwe_fed = np.zeros(capacity, dtype=bool)
+        # egress abs-send-time stamping so every receiver can run its
+        # own receive-side estimate on the bridge->receiver leg
+        # (reference: AbsSendTimeEngine on the SFU's send chain)
+        self._ast = AbsSendTimeEngine(abs_send_time_ext_id,
+                                      clock=lambda: self._now)
+        self._now = time.time()
+        # pending DTLS-SRTP associations (shared table: routing,
+        # retransmit timers, early-media hold)
+        from libjitsi_tpu.control.dtls import DtlsAssociationTable
+        self._dtls = DtlsAssociationTable(self.loop, profile,
+                                          self._install_dtls)
 
     # ---------------------------------------------------------- endpoints
     def add_endpoint(self, ssrc: int, rx_key: Tuple[bytes, bytes],
@@ -82,6 +107,38 @@ class SfuBridge:
         _log.info("endpoint_join", sid=sid, ssrc=ssrc)
         return sid
 
+    def add_endpoint_dtls(self, ssrc: int, role: str = "server",
+                          remote_fingerprint: Optional[str] = None,
+                          cookie_exchange: bool = False,
+                          remote_addr=None):
+        """Join keyed by DTLS-SRTP instead of direct keys: allocates the
+        row and starts an association; media arriving before the
+        handshake finishes is queued (MediaLoop.hold_stream) and
+        replayed once keys install.  Returns (sid, endpoint) — publish
+        `endpoint.local_fingerprint` via signaling, and pass
+        `remote_addr` when signaling knows the peer's 5-tuple (with
+        several concurrent unbound joins, unknown-address handshakes
+        are dropped rather than guessed onto the wrong row).
+        Reference: DtlsControlImpl started by MediaStream.start
+        (SURVEY §3.5)."""
+        if ssrc in self._ssrc_of.values():
+            raise ValueError(f"ssrc {ssrc:#x} already joined")
+        sid = self.registry.alloc(self)
+        self.registry.map_ssrc(ssrc, sid)
+        self._ssrc_of[sid] = ssrc & 0xFFFFFFFF
+        ep = self._dtls.join(sid, role, remote_fingerprint,
+                             cookie_exchange, remote_addr)
+        _log.info("endpoint_join_dtls", sid=sid, ssrc=ssrc, role=role)
+        return sid, ep
+
+    def _install_dtls(self, sid: int, ep) -> None:
+        profile, tk, tsalt, rk, rsalt = ep.srtp_keys()
+        self.rx_table.add_stream(sid, rk, rsalt)
+        self.tx_table.add_stream(sid, tk, tsalt)
+        self.translator.add_receiver(sid, tk, tsalt)
+        self._rebuild_routes()
+        _log.info("dtls_keys_installed", sid=sid, profile=profile.name)
+
     def remove_endpoint(self, sid: int) -> None:
         ssrc = self._ssrc_of.pop(sid, None)
         if ssrc is not None:
@@ -91,6 +148,9 @@ class SfuBridge:
         self.translator.disconnect(sid)
         self.translator.remove_receiver(sid)
         self.rtcp_term.forget_receiver(sid)
+        self.bwe.reset_rows([sid])
+        self._bwe_fed[sid] = False
+        self._dtls.forget(sid)
         self.loop.addr_ip[sid] = 0
         self.loop.addr_port[sid] = 0
         self.registry.release(sid)
@@ -98,8 +158,11 @@ class SfuBridge:
         _log.info("endpoint_leave", sid=sid)
 
     def _rebuild_routes(self) -> None:
-        """Full mesh: every sender forwards to every OTHER endpoint."""
-        sids = sorted(self._ssrc_of)
+        """Full mesh: every sender forwards to every OTHER endpoint.
+        DTLS-pending rows have no leg keys yet and stay out of the mesh
+        until their install completes."""
+        sids = [s for s in sorted(self._ssrc_of)
+                if s not in self._dtls.pending]
         for s in sids:
             self.translator.connect(s, [r for r in sids if r != s])
 
@@ -114,6 +177,10 @@ class SfuBridge:
         sub = PacketBatch(dec.data[rows],
                           np.asarray(dec.length)[rows],
                           dec.stream[rows])
+        self._feed_bwe(sub, rows)
+        # stamp the bridge's own abs-send-time before the fan-out so
+        # every receiver leg can run receive-side GCC on its downlink
+        sub, _ = self._ast.rtp_transformer.transform(sub)
         wire, recv = self.translator.translate(sub, idx[rows])
         if wire.batch_size == 0:
             return None
@@ -131,8 +198,6 @@ class SfuBridge:
         # (leg sid, SENDER ssrc) + original seq — seq survives the
         # fan-out, and two senders' seq ranges must never collide in
         # one leg's cache
-        from libjitsi_tpu.rtp import header as rtp_header
-
         hdr = rtp_header.parse(wire)
         self.cache.insert_batch(
             (recv.astype(np.int64) << 32) | hdr.ssrc.astype(np.int64),
@@ -143,6 +208,39 @@ class SfuBridge:
             wire, self.loop.addr_ip[recv], self.loop.addr_port[recv])
         self.forwarded += sent
         return None
+
+    def _feed_bwe(self, sub: PacketBatch, rows: np.ndarray) -> None:
+        """Drive the bridge's receive-side GCC from the senders'
+        abs-send-time stamps.  Arrival times prefer the engine's kernel
+        rx stamps (row-aligned via MediaLoop.last_rtp_arrival_ns);
+        without them, the tick's host clock."""
+        hdr = rtp_header.parse(sub)
+        off, dlen, found = rtp_ext.find_one_byte_ext(sub, hdr,
+                                                     self.ast_ext_id)
+        f = np.nonzero(found & (dlen == 3))[0]
+        if len(f) == 0:
+            return
+        d = sub.data
+        o = off[f]
+        ast24 = ((d[f, o].astype(np.int64) << 16)
+                 | (d[f, o + 1].astype(np.int64) << 8)
+                 | d[f, o + 2].astype(np.int64))
+        ats = self.loop.last_rtp_arrival_ns
+        if ats is not None:
+            arrival_ms = ats[rows][f].astype(np.float64) / 1e6
+        else:
+            arrival_ms = np.full(len(f), self._now * 1000.0)
+        sids = sub.stream[f].astype(np.int64)
+        self.bwe.incoming_batch(sids, arrival_ms, ast24,
+                                np.asarray(sub.length)[f])
+        self._bwe_fed[sids] = True
+
+    def own_estimate_bps(self, sid: int) -> Optional[float]:
+        """The bridge's current receive-side estimate for a sender leg
+        (None until that sender's abs-send-time stamps have fed it)."""
+        if not self._bwe_fed[sid]:
+            return None
+        return float(self.bwe.bitrate[sid])
 
     def _on_rtcp(self, batch: PacketBatch, _ok) -> None:
         """SRTCP-authenticate, then: NACK -> retransmit from the
@@ -182,12 +280,19 @@ class SfuBridge:
         long-lived conference does not grow state unboundedly."""
         now = time.time() if now is None else now
         sent = 0
+        # periodic GCC tick: every fed sender leg's estimate advances
+        # (AIMD increase in normal state, beta-cut on overuse)
+        if self._bwe_fed.any():
+            self.bwe.update_estimate(now * 1000.0)
         for sid, ssrc in list(self._ssrc_of.items()):
+            own = self.own_estimate_bps(sid)
             if self.loop.addr_port[sid] == 0:
                 # no address: still drain to bound memory
-                self.rtcp_term.make_sender_feedback(ssrc, now=now)
+                self.rtcp_term.make_sender_feedback(ssrc, now=now,
+                                                    own_bps=own)
                 continue
-            blobs = self.rtcp_term.make_sender_feedback(ssrc, now=now)
+            blobs = self.rtcp_term.make_sender_feedback(ssrc, now=now,
+                                                        own_bps=own)
             if not blobs:
                 continue
             b = PacketBatch.from_payloads(
@@ -200,6 +305,8 @@ class SfuBridge:
     def tick(self, now: Optional[float] = None) -> dict:
         self._now = time.time() if now is None else now
         rx = self.loop.tick()
+        if self._dtls.pending:
+            self._dtls.tick()
         return {"rx": rx, "forwarded": self.forwarded,
                 "retransmitted": self.retransmitted}
 

@@ -54,8 +54,17 @@ class RtcpTermination:
 
     # ------------------------------------------------------------- output
     def make_sender_feedback(self, media_ssrc: int,
-                             now: Optional[float] = None) -> List[bytes]:
-        """Drain aggregated feedback to send toward the media sender."""
+                             now: Optional[float] = None,
+                             own_bps: Optional[float] = None
+                             ) -> List[bytes]:
+        """Drain aggregated feedback to send toward the media sender.
+
+        own_bps: the bridge's OWN receive-side estimate for this sender
+        (abs-send-time GCC over the sender->bridge leg).  The advertised
+        REMB is the min of it and every receiver's REMB — whichever hop
+        is the bottleneck governs, as the reference's
+        RemoteBitrateEstimatorAbsSendTime + REMB merge does.
+        """
         now = time.time() if now is None else now
         out: List[bytes] = []
 
@@ -72,9 +81,12 @@ class RtcpTermination:
                 rtcp.ReceiverReport(self.bridge_ssrc, [agg])))
 
         rembs = self._remb.get(media_ssrc)
-        if rembs:
+        caps = list(rembs.values()) if rembs else []
+        if own_bps is not None:
+            caps.append(float(own_bps))
+        if caps:
             out.append(rtcp.build_remb(rtcp.Remb(
-                self.bridge_ssrc, int(min(rembs.values())), [media_ssrc])))
+                self.bridge_ssrc, int(min(caps)), [media_ssrc])))
 
         lost = self._nacks.pop(media_ssrc, None)
         if lost:

@@ -9,6 +9,7 @@ from libjitsi_tpu.io import UdpEngine
 from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.rtp import rtcp
 from libjitsi_tpu.service.sfu_bridge import SfuBridge
+from libjitsi_tpu.transform.header_ext import AbsSendTimeEngine
 from libjitsi_tpu.transform.srtp import SrtpStreamTable
 
 
@@ -124,3 +125,156 @@ def test_sfu_fanout_and_nack_over_udp():
     # feedback drain: aggregated NACK/RR toward senders, SRTCP-protected
     sfu.emit_feedback(now=50.6)
     sfu.close()
+
+
+class _BweSender(_Endpoint):
+    """Endpoint whose media carries abs-send-time stamps from a
+    controllable clock (lets the test shape queue delay: arrival is the
+    bridge tick's `now`, send time is `ast_now`)."""
+
+    def __init__(self, ssrc, bridge_port, ext_id=3):
+        super().__init__(ssrc, bridge_port)
+        self.ast_now = 0.0
+        self._ast = AbsSendTimeEngine(ext_id, clock=lambda: self.ast_now)
+
+    def send_media(self, n=4):
+        pls = [b"m-%08x-%d" % (self.ssrc, self.seq + i)
+               for i in range(n)]
+        b = rtp_header.build(pls, [self.seq + i for i in range(n)],
+                             [0] * n, [self.ssrc] * n, [96] * n,
+                             stream=[0] * n)
+        self.seq += n
+        b, _ = self._ast.rtp_transformer.transform(b)
+        self.engine.send_batch(self.protect.protect_rtp(b),
+                               "127.0.0.1", self.bridge_port)
+
+    def drain_rembs(self):
+        """Unprotect bridge SRTCP feedback; return REMB bitrates."""
+        out = []
+        back, _, _ = self.engine.recv_batch(timeout_ms=2)
+        for i in range(back.batch_size):
+            back.stream[i] = 0
+        if back.batch_size:
+            dec, ok = self.srtcp_rx.unprotect_rtcp(back)
+            for i in np.nonzero(np.asarray(ok))[0]:
+                for p in rtcp.parse_compound(dec.to_bytes(int(i))):
+                    if isinstance(p, rtcp.Remb):
+                        out.append(p.bitrate_bps)
+        return out
+
+
+@pytest.mark.slow
+def test_sfu_bwe_congestion_drives_remb_down_and_back_up():
+    """VERDICT r2 #2: the bridge's OWN receive-side estimate (abs-send-
+    time GCC over the sender->bridge leg) governs the REMB it advertises:
+    a growing-queue trace cuts it, recovery raises it again."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    sfu = SfuBridge(libjitsi_tpu.configuration_service(), port=0,
+                    capacity=8, recv_window_ms=0)
+    sender = _BweSender(0x700, sfu.port)
+    recv = _Endpoint(0x701, sfu.port)
+    sid_s = sfu.add_endpoint(sender.ssrc, sender.rx_key, sender.tx_key)
+    sfu.add_endpoint(recv.ssrc, recv.rx_key, recv.tx_key)
+    recv.expect_sender(sender.ssrc)
+    # receiver must latch an address on the bridge (any packet does)
+    recv.send_media(1)
+    # sender-side SRTCP context for the bridge's feedback (protected
+    # with the sender leg's tx key)
+    sender.srtcp_rx = SrtpStreamTable(capacity=1)
+    sender.srtcp_rx.add_stream(0, *sender.tx_key)
+
+    rembs = []
+
+    def run_phase(rounds, queue_of):
+        for r in range(rounds):
+            t = run_phase.t0 + r * 0.02
+            sender.ast_now = t - queue_of(r)
+            sender.send_media(4)
+            for _ in range(10):
+                sfu.tick(now=t)
+            sfu.emit_feedback(now=t)
+            got = sender.drain_rembs()
+            if got:
+                rembs.append(got[-1])
+            recv.drain()
+        run_phase.t0 += rounds * 0.02
+
+    run_phase.t0 = 50.0
+    run_phase(10, lambda r: 0.0)                  # clean network
+    assert rembs, "no REMB reached the sender"
+    baseline = rembs[-1]
+    assert sfu.own_estimate_bps(sid_s) is not None
+    run_phase(30, lambda r: r * 0.003)            # queue grows 3 ms/tick
+    congested = rembs[-1]
+    assert congested < baseline * 0.7, \
+        f"REMB did not drop under congestion: {baseline} -> {congested}"
+    run_phase(60, lambda r: 0.090)                # constant queue: drained
+    recovered = rembs[-1]
+    assert recovered > congested * 1.1, \
+        f"REMB did not recover: {congested} -> {recovered}"
+    sfu.close()
+
+
+@pytest.mark.slow
+def test_sfu_dtls_keyed_endpoint_e2e():
+    """VERDICT r2 #5: a sender joins the SfuBridge keyed by DTLS-SRTP
+    over the real UDP port (loop first-byte demux -> on_dtls), media
+    sent the instant the client completes flows to a static-keyed
+    receiver — any packets racing the install are queued and replayed."""
+    from libjitsi_tpu.control.dtls import DtlsSrtpEndpoint
+    from libjitsi_tpu.core.packet import PacketBatch
+    from libjitsi_tpu.transform.srtp import SrtpProfile
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    sfu = SfuBridge(libjitsi_tpu.configuration_service(), port=0,
+                    capacity=8, recv_window_ms=0)
+    recv = _Endpoint(0x901, sfu.port)
+    sfu.add_endpoint(recv.ssrc, recv.rx_key, recv.tx_key)
+    recv.send_media(1)                     # latch receiver address
+
+    ssrc = 0x900
+    sid, bridge_ep = sfu.add_endpoint_dtls(ssrc, role="server")
+    cli = DtlsSrtpEndpoint(
+        "client", remote_fingerprint=bridge_ep.local_fingerprint)
+    eng = UdpEngine(port=0, max_batch=16)
+
+    def pump_client(datagrams):
+        if datagrams:
+            eng.send_batch(PacketBatch.from_payloads(list(datagrams)),
+                           "127.0.0.1", sfu.port)
+        sfu.tick(now=80.0)
+        back, _, _ = eng.recv_batch(timeout_ms=5)
+        return [back.to_bytes(i) for i in range(back.batch_size)]
+
+    out = cli.handshake_packets()
+    for _ in range(40):
+        if cli.complete:
+            break
+        replies = pump_client(out)
+        out = []
+        for r in replies:
+            out.extend(cli.feed(r))
+    assert cli.complete, "client handshake did not complete"
+
+    profile, tk, tsalt, rk, rsalt = cli.srtp_keys()
+    assert profile == SrtpProfile.AES_CM_128_HMAC_SHA1_80
+    tx = SrtpStreamTable(capacity=1, profile=profile)
+    tx.add_stream(0, tk, tsalt)
+    # receiver must open the DTLS sender's legs with the BRIDGE leg key
+    # it was added with (fan-out re-encrypts per leg as usual)
+    recv.expect_sender(ssrc)
+
+    b = rtp_header.build([b"dtls-media-%d" % i for i in range(4)],
+                         [700 + i for i in range(4)], [0] * 4,
+                         [ssrc] * 4, [96] * 4, stream=[0] * 4)
+    eng.send_batch(tx.protect_rtp(b), "127.0.0.1", sfu.port)
+    for _ in range(20):
+        sfu.tick(now=80.1)
+    for _ in range(4):
+        recv.drain()
+    got = b"".join(recv.got.values())
+    assert b"dtls-media-0" in got and b"dtls-media-3" in got
+    sfu.close()
+    eng.close()
