@@ -70,3 +70,22 @@ def resample(pcm, rate_in: int, rate_out: int,
     if l > 480:
         raise ValueError(f"unreasonable ratio {rate_out}/{rate_in}")
     return _resample_jit(jnp.asarray(pcm), l, m, taps_per_phase)
+
+
+def resample_to_frame(pcm, rate_in: int, rate_out: int,
+                      frame: int) -> "np.ndarray":
+    """`resample` pinned to an exact output frame width.
+
+    The conference paths (mixer deposit up-conversion and egress
+    down-conversion) both need rows of exactly the target clock's frame
+    size; L/M rounding can leave the resampler a sample short/long, so
+    trim or zero-pad to `frame`.  Shared so the two paths can never
+    drift apart.
+    """
+    import numpy as np
+
+    out = np.asarray(resample(pcm, rate_in, rate_out), dtype=np.int16)
+    if out.shape[1] != frame:
+        out = (out[:, :frame] if out.shape[1] > frame
+               else np.pad(out, ((0, 0), (0, frame - out.shape[1]))))
+    return out

@@ -33,6 +33,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from libjitsi_tpu.conference.mixer import AudioMixer
+from libjitsi_tpu.conference.speaker import DominantSpeakerIdentification
 from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.io.loop import MediaLoop
 from libjitsi_tpu.io.udp import UdpEngine
@@ -41,6 +42,7 @@ from libjitsi_tpu.service.media_stream import StreamRegistry
 from libjitsi_tpu.service.pump import FrameCodec, ReceiveBank, g711_codec
 from libjitsi_tpu.transform import (SrtpTransformEngine,
                                     TransformEngineChain)
+from libjitsi_tpu.transform.header_ext import CsrcAudioLevelEngine
 from libjitsi_tpu.transform.srtp import SrtpProfile, SrtpStreamTable
 from libjitsi_tpu.utils.logging import get_logger
 
@@ -54,23 +56,49 @@ class ConferenceBridge:
                  profile: SrtpProfile =
                  SrtpProfile.AES_CM_128_HMAC_SHA1_80,
                  ptime_ms: int = 20, kernel_timestamps: bool = False,
-                 recv_window_ms: int = 1):
+                 recv_window_ms: int = 1,
+                 audio_level_ext_id: int = 1,
+                 on_speaker_change=None,
+                 recorder=None):
         self.capacity = capacity
         self.profile = profile
         self.ptime_ms = ptime_ms
         self.registry = StreamRegistry(config, capacity=capacity)
         self.rx_table = SrtpStreamTable(capacity, profile)
         self.tx_table = SrtpStreamTable(capacity, profile)
+        # egress audio-level stamping (RFC 6465 mixer-to-client, the
+        # engine's one-byte element = the loudest contributor heard in
+        # that receiver's mix-minus) sits BEFORE SRTP in the forward
+        # chain; the reverse chain extracts participants' RFC 6464
+        # levels for free.  Reference: .csrc.CsrcTransformEngine.
+        self._egress_levels = np.full(capacity, 127, dtype=np.uint8)
+        self.levels_engine = CsrcAudioLevelEngine(
+            audio_level_ext_id, capacity,
+            level_of=lambda sids: self._egress_levels[sids])
         self.chain = TransformEngineChain(
-            [SrtpTransformEngine(self.tx_table, self.rx_table)])
+            [self.levels_engine,
+             SrtpTransformEngine(self.tx_table, self.rx_table)])
+        # dominant-speaker detection fed by the mixer's per-tick levels
+        # (reference: ActiveSpeakerDetectorImpl on the mixer device)
+        self.on_speaker_change = on_speaker_change
+        self.recorder = recorder
+        self.speaker = DominantSpeakerIdentification(
+            capacity, on_change=self._speaker_changed)
+        self.speaker_events: List[Tuple[int, int]] = []  # (tick, sid)
         self.loop = MediaLoop(
             UdpEngine(port=port, max_batch=4 * capacity,
                       kernel_timestamps=kernel_timestamps),
             self.registry, on_media=self._on_media, chain=self.chain,
+            on_dtls=lambda d, a: self._dtls.on_dtls(d, a),
             recv_window_ms=recv_window_ms)
+        from libjitsi_tpu.control.dtls import DtlsAssociationTable
+        self._dtls = DtlsAssociationTable(self.loop, profile,
+                                          self._install_dtls)
         self.port = self.loop.engine.port
-        # one mixer frame size per bridge; codecs must match it
+        # one mixer frame clock per bridge (first codec sets it);
+        # other-rate codecs resample to it on both paths
         self._frame_samples: Optional[int] = None
+        self._rate: Optional[int] = None
         self.mixer: Optional[AudioMixer] = None
         self.bank: Optional[ReceiveBank] = None
         self._codec: Dict[int, FrameCodec] = {}
@@ -89,34 +117,72 @@ class ConferenceBridge:
         `rx_key` protects what the participant sends us; `tx_key`
         protects what we send them (SDES-style separate directions).
         """
+        sid = self._register_media(ssrc, codec)
+        self.rx_table.add_stream(sid, *rx_key)
+        self.tx_table.add_stream(sid, *tx_key)
+        _log.info("participant_join", sid=sid, ssrc=ssrc)
+        return sid
+
+    def _register_media(self, ssrc: int,
+                        codec: Optional[FrameCodec]) -> int:
+        """Crypto-independent join half: row, demux, bank/mixer/speaker."""
         codec = codec or g711_codec(ptime_ms=self.ptime_ms)
+        if (codec.frame_samples * 1000
+                != codec.sample_rate * self.ptime_ms):
+            raise ValueError(
+                f"codec ptime {codec.frame_samples * 1000.0 / codec.sample_rate:.1f} ms "
+                f"!= bridge ptime {self.ptime_ms} ms")
         if self._frame_samples is None:
+            # the first participant's codec sets the bridge clock; later
+            # joins at other rates resample to it (reference: AudioMixer
+            # normalizing via the Speex resampler, SURVEY §2.4/§2.5)
             self._frame_samples = codec.frame_samples
+            self._rate = codec.sample_rate
             self.mixer = AudioMixer(capacity=self.capacity,
                                     frame_samples=codec.frame_samples)
             self.bank = ReceiveBank(self.capacity, mixer=self.mixer,
                                     payload_cap=max(256,
-                                                    codec.frame_samples))
-        elif codec.frame_samples != self._frame_samples:
-            raise ValueError(
-                f"codec frame {codec.frame_samples} != bridge frame "
-                f"{self._frame_samples}; resample at the device layer")
+                                                    codec.frame_samples),
+                                    mixer_rate=codec.sample_rate)
         if ssrc in [s for s in self._ssrc_of.values()]:
             # silently remapping would mute the existing participant
             raise ValueError(f"ssrc {ssrc:#x} already joined")
         sid = self.registry.alloc(self)
-        self.rx_table.add_stream(sid, *rx_key)
-        self.tx_table.add_stream(sid, *tx_key)
         self.registry.map_ssrc(ssrc, sid)
         self.bank.add_stream(sid, codec)
         self.mixer.add_participant(sid)
+        self.speaker.add_participant(sid)
         self._codec[sid] = codec
         self._ssrc_of[sid] = ssrc & 0xFFFFFFFF
         self._tx_seq[sid] = int.from_bytes(np.random.bytes(2), "big")
         self._tx_ts[sid] = int.from_bytes(np.random.bytes(4), "big")
         self._tx_ssrc[sid] = (0x42000000 + sid) & 0xFFFFFFFF
-        _log.info("participant_join", sid=sid, ssrc=ssrc)
         return sid
+
+    def add_participant_dtls(self, ssrc: int,
+                             codec: Optional[FrameCodec] = None,
+                             role: str = "server",
+                             remote_fingerprint: Optional[str] = None,
+                             cookie_exchange: bool = False,
+                             remote_addr=None):
+        """Join keyed by DTLS-SRTP: media registration happens now,
+        SRTP keys install when the handshake completes; early media is
+        queued and replayed (MediaLoop.hold_stream).  Returns
+        (sid, endpoint); pass `remote_addr` when signaling knows the
+        peer's 5-tuple.  Reference: DtlsControlImpl under
+        MediaStream.start (SURVEY §3.5)."""
+        sid = self._register_media(ssrc, codec)
+        ep = self._dtls.join(sid, role, remote_fingerprint,
+                             cookie_exchange, remote_addr)
+        _log.info("participant_join_dtls", sid=sid, ssrc=ssrc,
+                  role=role)
+        return sid, ep
+
+    def _install_dtls(self, sid: int, ep) -> None:
+        profile, tk, tsalt, rk, rsalt = ep.srtp_keys()
+        self.rx_table.add_stream(sid, rk, rsalt)
+        self.tx_table.add_stream(sid, tk, tsalt)
+        _log.info("dtls_keys_installed", sid=sid, profile=profile.name)
 
     def remove_participant(self, sid: int) -> None:
         """Leave: every per-row residue must go — a recycled sid must
@@ -128,10 +194,13 @@ class ConferenceBridge:
             self.registry.unmap_ssrc(ssrc)
         self.rx_table.remove_stream(sid)
         self.tx_table.remove_stream(sid)
+        self._dtls.forget(sid)
         self.loop.addr_ip[sid] = 0
         self.loop.addr_port[sid] = 0
         self.bank.remove_stream(sid)
         self.mixer.remove_participant(sid)
+        self.speaker.remove_participant(sid)
+        self._egress_levels[sid] = 127
         self._codec.pop(sid, None)
         self.registry.release(sid)
         _log.info("participant_leave", sid=sid)
@@ -145,15 +214,42 @@ class ConferenceBridge:
         """One ptime: returns counters for observability."""
         self._now = time.time() if now is None else now
         rx = self.loop.tick()
+        if self._dtls.pending:
+            self._dtls.tick()
         if self.bank is None:         # no participants yet
             return {"rx": rx, "mixed": 0, "tx": 0,
-                    "levels": np.zeros(0, dtype=np.uint8)}
+                    "levels": np.zeros(0, dtype=np.uint8),
+                    "dominant": -1}
         sids, _frames = self.bank.tick(now=self._now)
         out, levels = self.mixer.mix()
+        self.speaker.levels(levels)
+        self._update_egress_levels(levels)
         tx = self._send_mixes(out)
         self.ticks += 1
         return {"rx": rx, "mixed": len(sids), "tx": tx,
-                "levels": levels}
+                "levels": levels, "dominant": self.speaker.dominant}
+
+    def _speaker_changed(self, sid: int) -> None:
+        self.speaker_events.append((self.ticks, sid))
+        ssrc = self._ssrc_of.get(sid)
+        _log.info("speaker_change", sid=sid, ssrc=ssrc)
+        if self.recorder is not None and ssrc is not None:
+            self.recorder.on_speaker_change(ssrc)
+        if self.on_speaker_change is not None:
+            self.on_speaker_change(sid, ssrc)
+
+    def _update_egress_levels(self, levels: np.ndarray) -> None:
+        """Each receiver's egress level = loudest OTHER contributor
+        (min dBov excluding self), i.e. the level of the mix it hears:
+        overall min + second-min, one vector pass."""
+        act = self.mixer.active
+        lv = np.where(act, levels[:len(act)].astype(np.int64), 128)
+        order = np.argsort(lv)
+        m1, m1_row = int(lv[order[0]]), int(order[0])
+        m2 = int(lv[order[1]]) if len(order) > 1 else 128
+        outl = np.full(self.capacity, m1, dtype=np.int64)
+        outl[m1_row] = m2
+        self._egress_levels[:] = np.minimum(outl, 127).astype(np.uint8)
 
     def _send_mixes(self, out: np.ndarray) -> int:
         """Encode each active participant's mix-minus row and send it
@@ -162,8 +258,12 @@ class ConferenceBridge:
         grouping); only stateful codecs pay a per-row C call."""
         from libjitsi_tpu.kernels import g711
 
+        # pending-DTLS rows have a latched address (the handshake
+        # 5-tuple) but no tx keys yet: sending would emit zero-key
+        # "protected" garbage mid-handshake
         active = [sid for sid in self._codec
-                  if self.loop.addr_port[sid] != 0]
+                  if self.loop.addr_port[sid] != 0
+                  and sid not in self._dtls.pending]
         if not active:
             return 0
         payloads: Dict[int, bytes] = {}
@@ -172,19 +272,36 @@ class ConferenceBridge:
             by_kind.setdefault(self._codec[sid].name.upper(),
                                []).append(sid)
         for kind, rows in by_kind.items():
+            # mix rows are at the bridge clock; off-rate codec legs get
+            # one batched resample per kind before encoding
+            pcm = self._from_bridge_rate(rows, out[np.asarray(rows)])
             if kind in ("PCMU", "PCMA"):
                 fn = g711.ulaw_encode if kind == "PCMU" \
                     else g711.alaw_encode
-                enc = np.asarray(fn(out[np.asarray(rows)]),
-                                 dtype=np.uint8)
+                enc = np.asarray(fn(pcm), dtype=np.uint8)
                 for k, sid in enumerate(rows):
                     payloads[sid] = enc[k].tobytes()
             else:
-                for sid in rows:     # stateful: per-row C call
-                    payloads[sid] = self._codec[sid].encode(out[sid])
+                for k, sid in enumerate(rows):  # stateful: per-row C
+                    payloads[sid] = self._codec[sid].encode(pcm[k])
         sids = np.asarray(active, dtype=np.int64)
         steps = np.asarray([self._codec[s].ts_step for s in active],
                            dtype=np.int64)
+        return self._finish_send(active, payloads, sids, steps)
+
+    def _from_bridge_rate(self, rows: List[int], pcm: np.ndarray
+                          ) -> np.ndarray:
+        """Resample mix rows to a codec leg's clock (same kind => same
+        rate); identity when the leg runs at the bridge clock."""
+        rate = self._codec[rows[0]].sample_rate
+        if rate == self._rate:
+            return pcm
+        from libjitsi_tpu.kernels.resample import resample_to_frame
+
+        return resample_to_frame(pcm, self._rate, rate,
+                                 self._codec[rows[0]].frame_samples)
+
+    def _finish_send(self, active, payloads, sids, steps) -> int:
         batch = rtp_header.build(
             [payloads[s] for s in active], self._tx_seq[sids].tolist(),
             self._tx_ts[sids].tolist(), self._tx_ssrc[sids].tolist(),

@@ -241,15 +241,23 @@ class ReceiveBank:
     G711_ULAW, G711_ALAW, STATEFUL = 0, 1, 2
 
     def __init__(self, capacity: int, mixer=None, payload_cap: int = 256,
-                 depth: int = 16):
+                 depth: int = 16, mixer_rate: Optional[int] = None):
         from libjitsi_tpu.rtp.dense_jitter import DenseJitterBank
 
         self.capacity = capacity
         self.mixer = mixer
+        # sample rate of the mixer's frame clock; when set, streams of a
+        # DIFFERENT rate but the SAME ptime are accepted and their PCM
+        # is resampled to the mixer clock on deposit (reference:
+        # AudioMixer normalizing inputs via the Speex resampler,
+        # SURVEY §2.4/§2.5).  None = legacy strict mode (exact frame
+        # match or add_stream raises).
+        self.mixer_rate = mixer_rate
         self.jb = DenseJitterBank(capacity, depth=depth,
                                   payload_cap=payload_cap)
         self._kind = np.full(capacity, -1, dtype=np.int8)
         self._decode = {}                      # sid -> stateful decode fn
+        self._srate = np.zeros(capacity, dtype=np.int64)
         self.frame_samples = np.zeros(capacity, dtype=np.int32)
         self.decoded_frames = np.zeros(capacity, dtype=np.int64)
         self.lost_frames = np.zeros(capacity, dtype=np.int64)
@@ -262,12 +270,21 @@ class ReceiveBank:
     def add_stream(self, sid: int, codec: FrameCodec) -> None:
         if self.mixer is not None and \
                 codec.frame_samples != self.mixer.frame_samples:
-            # resampling belongs to the io/codec layer (mixer.py
-            # docstring); padding a mismatched frame would mix sped-up
-            # audio silently — fail loudly at config time instead
-            raise ValueError(
-                f"codec frame ({codec.frame_samples}) != mixer frame "
-                f"({self.mixer.frame_samples}); resample before deposit")
+            if self.mixer_rate is None:
+                # legacy strict mode: padding a mismatched frame would
+                # mix sped-up audio silently — fail loudly at config
+                raise ValueError(
+                    f"codec frame ({codec.frame_samples}) != mixer "
+                    f"frame ({self.mixer.frame_samples}); resample "
+                    f"before deposit")
+            # mixed-rate mode: same ptime required (resampling fixes
+            # rate, not frame duration)
+            if (codec.frame_samples * self.mixer_rate
+                    != self.mixer.frame_samples * codec.sample_rate):
+                raise ValueError(
+                    f"codec ptime ({codec.frame_samples}/"
+                    f"{codec.sample_rate}) != mixer ptime "
+                    f"({self.mixer.frame_samples}/{self.mixer_rate})")
         name = codec.name.upper()
         if name == "PCMU":
             self._kind[sid] = self.G711_ULAW
@@ -277,6 +294,7 @@ class ReceiveBank:
             self._kind[sid] = self.STATEFUL
             self._decode[sid] = codec.decode
         self.frame_samples[sid] = codec.frame_samples
+        self._srate[sid] = codec.sample_rate
         ptime_ms = codec.frame_samples * 1000.0 / codec.sample_rate
         self.jb.reset_streams([sid])          # recycled sids start fresh
         self.jb.configure_streams(
@@ -381,11 +399,33 @@ class ReceiveBank:
         out_sids.extend(s_sids)
         out_pcm.extend(s_pcm)
         if self.mixer is not None:
-            # frame sizes verified against the mixer at add_stream time;
-            # vectorized groups deposit as whole blocks
+            # frame sizes/ptimes verified against the mixer at
+            # add_stream time; vectorized groups deposit as whole
+            # blocks, off-rate groups resample to the mixer clock first
             for rows, pcm in mix_deposits:
-                self.mixer.push_batch(rows, pcm)
+                self.mixer.push_batch(rows, self._to_mixer_rate(rows,
+                                                                pcm))
             if s_sids:
-                self.mixer.push_batch(np.asarray(s_sids),
-                                      np.stack(s_pcm))
+                rows = np.asarray(s_sids)
+                # stateful rows may mix rates: one batched resample per
+                # distinct frame width (same width => same rate, ptime
+                # being bridge-uniform)
+                widths = np.asarray([len(p) for p in s_pcm])
+                for w in np.unique(widths):
+                    sel = np.nonzero(widths == w)[0]
+                    pcm = np.stack([s_pcm[i] for i in sel])
+                    self.mixer.push_batch(
+                        rows[sel], self._to_mixer_rate(rows[sel], pcm))
         return out_sids, out_pcm
+
+    def _to_mixer_rate(self, rows: np.ndarray, pcm: np.ndarray
+                       ) -> np.ndarray:
+        """Resample a same-rate row group to the mixer frame clock."""
+        if (self.mixer_rate is None
+                or pcm.shape[1] == self.mixer.frame_samples):
+            return pcm
+        from libjitsi_tpu.kernels.resample import resample_to_frame
+
+        return resample_to_frame(pcm, int(self._srate[rows[0]]),
+                                 self.mixer_rate,
+                                 self.mixer.frame_samples)
