@@ -32,12 +32,49 @@ from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.rtp import rtcp
 from libjitsi_tpu.service.media_stream import StreamRegistry
 from libjitsi_tpu.sfu import PacketCache, RtpTranslator
+from libjitsi_tpu.sfu import rtx as rtx_mod
 from libjitsi_tpu.sfu.rtcp_termination import RtcpTermination
+from libjitsi_tpu.sfu.simulcast import SimulcastForwarder
 from libjitsi_tpu.transform.header_ext import AbsSendTimeEngine
 from libjitsi_tpu.transform.srtp import SrtpProfile, SrtpStreamTable
 from libjitsi_tpu.utils.logging import get_logger
 
 _log = get_logger("service.sfu")
+
+
+class _VideoTrack:
+    """One sender's simulcast video track inside an SfuBridge.
+
+    Reference: `MediaStreamTrackDesc` + `RTPEncodingDesc` consumed by
+    `RTPTranslatorImpl` (SURVEY §2.3): L spatial layers arrive as
+    separate SSRCs; each receiver gets exactly one, projected through a
+    `SimulcastForwarder` into a single coherent stream.  Retransmissions
+    toward receivers ride RFC 4588 RTX streams (own SSRC = out_ssrc ^
+    "RTX", own SRTP row), served from a pre-SRTP cache of the rewritten
+    per-receiver packets.
+    """
+
+    RTX_SSRC_XOR = 0x00525458          # "RTX"
+
+    def __init__(self, sender_sid: int, out_ssrc: int, layer_ssrcs,
+                 layer_sids, layer_bps, rtx_pt: int):
+        self.sender_sid = sender_sid
+        self.out_ssrc = out_ssrc & 0xFFFFFFFF
+        self.rtx_ssrc = (out_ssrc ^ self.RTX_SSRC_XOR) & 0xFFFFFFFF
+        self.layer_ssrcs = [int(s) & 0xFFFFFFFF for s in layer_ssrcs]
+        self.layer_sids = list(layer_sids)
+        self.layer_bps = [float(b) for b in layer_bps]
+        self.rtx_pt = rtx_pt
+        self.fwd: Dict[int, SimulcastForwarder] = {}   # recv sid ->
+        self.rtx_seq: Dict[int, int] = {}              # recv sid ->
+        # dedicated SRTP tx rows per receiver: the projection and its
+        # RTX stream are each their own RTP stream (own SSRC, own seq
+        # space), so each gets its own row context — sharing the
+        # receiver's audio row would interleave independent seq spaces
+        # in one RFC 3711 index estimator
+        self.tx_sid: Dict[int, int] = {}               # recv sid ->
+        self.rtx_sid: Dict[int, int] = {}              # recv sid ->
+        self.precache = PacketCache()                  # pre-SRTP copies
 
 
 class SfuBridge:
@@ -91,6 +128,16 @@ class SfuBridge:
         from libjitsi_tpu.control.dtls import DtlsAssociationTable
         self._dtls = DtlsAssociationTable(self.loop, profile,
                                           self._install_dtls)
+        # video: layer-row sid -> its track; plus per-endpoint leg keys
+        # (kept to derive per-track projection/RTX rows) and receiver
+        # downlink REMBs
+        self._video: Dict[int, _VideoTrack] = {}
+        self._rx_keys: Dict[int, Tuple[bytes, bytes]] = {}
+        self._tx_keys: Dict[int, Tuple[bytes, bytes]] = {}
+        self._recv_bw: Dict[int, float] = {}   # recv sid -> REMB bps
+        # BWE transport row per stream row: GCC estimates per TRANSPORT
+        # (5-tuple), so a sender's video layer rows feed its primary row
+        self._transport_of = np.arange(capacity, dtype=np.int64)
 
     # ---------------------------------------------------------- endpoints
     def add_endpoint(self, ssrc: int, rx_key: Tuple[bytes, bytes],
@@ -103,7 +150,11 @@ class SfuBridge:
         self.translator.add_receiver(sid, *tx_key)
         self.registry.map_ssrc(ssrc, sid)
         self._ssrc_of[sid] = ssrc & 0xFFFFFFFF
+        self._rx_keys[sid] = tuple(rx_key)
+        self._tx_keys[sid] = tuple(tx_key)
         self._rebuild_routes()
+        for track in set(self._video.values()):
+            self._attach_video_receiver(track, sid)
         _log.info("endpoint_join", sid=sid, ssrc=ssrc)
         return sid
 
@@ -136,7 +187,13 @@ class SfuBridge:
         self.rx_table.add_stream(sid, rk, rsalt)
         self.tx_table.add_stream(sid, tk, tsalt)
         self.translator.add_receiver(sid, tk, tsalt)
+        self._rx_keys[sid] = (rk, rsalt)
+        self._tx_keys[sid] = (tk, tsalt)
         self._rebuild_routes()
+        # video tracks created while this endpoint was mid-handshake
+        # attach now that its leg keys exist
+        for track in set(self._video.values()):
+            self._attach_video_receiver(track, sid)
         _log.info("dtls_keys_installed", sid=sid, profile=profile.name)
 
     def remove_endpoint(self, sid: int) -> None:
@@ -151,11 +208,183 @@ class SfuBridge:
         self.bwe.reset_rows([sid])
         self._bwe_fed[sid] = False
         self._dtls.forget(sid)
+        self._rx_keys.pop(sid, None)
+        self._tx_keys.pop(sid, None)
+        self._recv_bw.pop(sid, None)
+        # as a video sender: tear the track + its layer rows down (the
+        # SSRC unmap matters: a recycled row must not demux the old
+        # layer SSRCs and latch the departed sender's address)
+        for lsid in [k for k, t in self._video.items()
+                     if t.sender_sid == sid]:
+            track = self._video.pop(lsid)
+            li = track.layer_sids.index(lsid)
+            self.registry.unmap_ssrc(track.layer_ssrcs[li])
+            self.rx_table.remove_stream(lsid)
+            self._transport_of[lsid] = lsid
+            self.registry.release(lsid)
+            for d in (track.tx_sid, track.rtx_sid):
+                for row in d.values():
+                    self.tx_table.remove_stream(row)
+                    self.registry.release(row)
+        # as a video receiver: drop forwarders + projection/RTX rows
+        for track in set(self._video.values()):
+            track.fwd.pop(sid, None)
+            track.rtx_seq.pop(sid, None)
+            for d in (track.tx_sid, track.rtx_sid):
+                row = d.pop(sid, None)
+                if row is not None:
+                    self.tx_table.remove_stream(row)
+                    self.registry.release(row)
         self.loop.addr_ip[sid] = 0
         self.loop.addr_port[sid] = 0
         self.registry.release(sid)
         self._rebuild_routes()
         _log.info("endpoint_leave", sid=sid)
+
+    # --------------------------------------------------------------- video
+    def add_video_track(self, sender_sid: int, layer_ssrcs,
+                        layer_bps, rtx_pt: int = 97) -> "_VideoTrack":
+        """Declare a joined endpoint's simulcast video track.
+
+        layer_ssrcs: the L spatial layers' SSRCs, low to high;
+        layer_bps: nominal bitrate of each layer (ascending) — layer
+        selection picks the highest layer whose rate fits the
+        receiver's advertised REMB.  Each layer gets its own SRTP row
+        (one row per SSRC: RFC 3711 contexts, replay windows and index
+        estimation are per-stream).  Reference: RTPEncodingDesc layers
+        under MediaStreamTrackDesc (SURVEY §2.3).
+        """
+        if sender_sid not in self._ssrc_of:
+            raise ValueError(f"sid {sender_sid} not joined")
+        if len(layer_ssrcs) != len(layer_bps):
+            raise ValueError("one nominal bitrate per layer")
+        rx_key = self._rx_keys[sender_sid]
+        layer_sids = []
+        for ssrc in layer_ssrcs:
+            lsid = self.registry.alloc(self)
+            self.rx_table.add_stream(lsid, *rx_key)
+            self.registry.map_ssrc(ssrc, lsid)
+            # GCC is per transport: layer rows feed the sender's row
+            self._transport_of[lsid] = sender_sid
+            layer_sids.append(lsid)
+        track = _VideoTrack(sender_sid, self._ssrc_of[sender_sid],
+                            layer_ssrcs, layer_sids, layer_bps, rtx_pt)
+        for lsid in layer_sids:
+            self._video[lsid] = track
+        for r in self._ssrc_of:
+            if r != sender_sid:
+                self._attach_video_receiver(track, r)
+        _log.info("video_track_added", sid=sender_sid,
+                  layers=len(layer_sids))
+        return track
+
+    def _attach_video_receiver(self, track: _VideoTrack,
+                               recv_sid: int) -> None:
+        if recv_sid == track.sender_sid or recv_sid in track.fwd:
+            return
+        if recv_sid not in self._tx_keys:
+            # no leg keys yet (mid-DTLS): attach happens at install
+            return
+        track.fwd[recv_sid] = SimulcastForwarder(
+            track.layer_ssrcs, out_ssrc=track.out_ssrc)
+        track.rtx_seq[recv_sid] = 0
+        # the projection and its RTX stream each get a dedicated row
+        # under this receiver's leg keys (RFC 4588: RTX is its own
+        # stream; RFC 3711: one index estimator per stream)
+        for d in (track.tx_sid, track.rtx_sid):
+            row = self.registry.alloc(self)
+            self.tx_table.add_stream(row, *self._tx_keys[recv_sid])
+            d[recv_sid] = row
+
+    def _forward_video(self, sub: PacketBatch, vrows: np.ndarray
+                       ) -> None:
+        """Project video rows through each receiver's forwarder, cache
+        the pre-SRTP rewrites for RTX, protect all legs in one launch."""
+        lens = np.asarray(sub.length)
+        rows_of: Dict[int, list] = {}      # id(track) -> batch rows
+        tracks: Dict[int, _VideoTrack] = {}
+        for i in vrows:
+            t = self._video[int(sub.stream[i])]
+            rows_of.setdefault(id(t), []).append(int(i))
+            tracks[id(t)] = t
+        out_payloads: list = []
+        out_rows: list = []                # SRTP row per packet
+        out_addr: list = []                # receiver sid per packet
+        for key_, trows in rows_of.items():
+            track = tracks[key_]
+            tb = PacketBatch(sub.data[trows], lens[trows],
+                             sub.stream[trows])
+            for r, fwd in track.fwd.items():
+                if self.loop.addr_port[r] == 0:
+                    continue
+                pkts = fwd.forward(tb)
+                key = (r << 32) | track.out_ssrc
+                for p in pkts:
+                    seq = int.from_bytes(p[2:4], "big")
+                    track.precache.insert(key, seq, p, now=self._now)
+                out_payloads.extend(pkts)
+                out_rows.extend([track.tx_sid[r]] * len(pkts))
+                out_addr.extend([r] * len(pkts))
+        if not out_payloads:
+            return
+        wb = PacketBatch.from_payloads(out_payloads, stream=out_rows)
+        wire = self.tx_table.protect_rtp(wb)
+        addr = np.asarray(out_addr, dtype=np.int64)
+        sent = self.loop.engine.send_batch(
+            wire, self.loop.addr_ip[addr], self.loop.addr_port[addr])
+        self.forwarded += sent
+
+    def _select_video_layers(self) -> None:
+        """Keyframe-gated layer selection from receiver REMBs: pick the
+        highest layer whose nominal rate fits each receiver's advertised
+        bandwidth; a pending switch keeps a PLI request live upstream
+        until the target layer's keyframe arrives."""
+        for track in set(self._video.values()):
+            for r, fwd in track.fwd.items():
+                bw = self._recv_bw.get(r)
+                if bw is None:
+                    continue
+                want = 0
+                for layer, bps in enumerate(track.layer_bps):
+                    if bps <= bw:
+                        want = layer
+                if want != fwd.target_layer:
+                    if fwd.request_layer(want):
+                        self.rtcp_term.request_keyframe(
+                            track.layer_ssrcs[want])
+                elif fwd.awaiting_keyframe:
+                    self.rtcp_term.request_keyframe(
+                        track.layer_ssrcs[fwd.target_layer])
+
+    def _serve_video_nack(self, sid: int, nack: "rtcp.Nack") -> bool:
+        """NACKed video returns as proper RTX encapsulation (not a raw
+        replay): pre-SRTP copies from the track's cache, OSN spliced in,
+        RTX SSRC/PT/seq space, protected under the receiver's RTX row."""
+        for track in set(self._video.values()):
+            if sid not in track.fwd or \
+                    nack.media_ssrc != track.out_ssrc:
+                continue
+            rtx_row = track.rtx_sid.get(sid)
+            if rtx_row is None:
+                return False
+            key = (sid << 32) | track.out_ssrc
+            copies = track.precache.lookup_nack(key, nack.lost_seqs)
+            if not copies:
+                return True          # ours, but aged out of the cache
+            b = PacketBatch.from_payloads(copies,
+                                          stream=[rtx_row] * len(copies))
+            out = rtx_mod.encapsulate_batch(b, track.rtx_ssrc,
+                                            track.rtx_pt,
+                                            track.rtx_seq[sid])
+            track.rtx_seq[sid] = (track.rtx_seq[sid]
+                                  + out.batch_size) & 0xFFFF
+            wire = self.tx_table.protect_rtp(out)
+            sent = self.loop.engine.send_batch(
+                wire, self.loop.addr_ip[sid], self.loop.addr_port[sid])
+            self.retransmitted += sent
+            _log.debug("video_nack_rtx", sid=sid, sent=sent)
+            return True
+        return False
 
     def _rebuild_routes(self) -> None:
         """Full mesh: every sender forwards to every OTHER endpoint.
@@ -181,7 +410,19 @@ class SfuBridge:
         # stamp the bridge's own abs-send-time before the fan-out so
         # every receiver leg can run receive-side GCC on its downlink
         sub, _ = self._ast.rtp_transformer.transform(sub)
-        wire, recv = self.translator.translate(sub, idx[rows])
+        idx_sel = idx[rows]
+        if self._video:
+            vmask = np.isin(sub.stream, list(self._video.keys()))
+            if vmask.any():
+                self._forward_video(sub, np.nonzero(vmask)[0])
+                keep = np.nonzero(~vmask)[0]
+                if len(keep) == 0:
+                    return None
+                sub = PacketBatch(sub.data[keep],
+                                  np.asarray(sub.length)[keep],
+                                  sub.stream[keep])
+                idx_sel = idx_sel[keep]
+        wire, recv = self.translator.translate(sub, idx_sel)
         if wire.batch_size == 0:
             return None
         # a just-joined leg has no latched address yet: sending to
@@ -230,10 +471,10 @@ class SfuBridge:
             arrival_ms = ats[rows][f].astype(np.float64) / 1e6
         else:
             arrival_ms = np.full(len(f), self._now * 1000.0)
-        sids = sub.stream[f].astype(np.int64)
-        self.bwe.incoming_batch(sids, arrival_ms, ast24,
+        tids = self._transport_of[sub.stream[f].astype(np.int64)]
+        self.bwe.incoming_batch(tids, arrival_ms, ast24,
                                 np.asarray(sub.length)[f])
-        self._bwe_fed[sids] = True
+        self._bwe_fed[tids] = True
 
     def own_estimate_bps(self, sid: int) -> Optional[float]:
         """The bridge's current receive-side estimate for a sender leg
@@ -258,7 +499,12 @@ class SfuBridge:
             self.rtcp_term.on_receiver_rtcp(sid, pkts)
             for p in pkts:
                 if isinstance(p, rtcp.Nack):
-                    self._serve_nack(sid, p)
+                    if not self._serve_video_nack(sid, p):
+                        self._serve_nack(sid, p)
+                elif isinstance(p, rtcp.Remb):
+                    # receiver's downlink estimate drives its simulcast
+                    # layer selection
+                    self._recv_bw[sid] = float(p.bitrate_bps)
 
     def _serve_nack(self, sid: int, nack: "rtcp.Nack") -> None:
         key = (sid << 32) | (nack.media_ssrc & 0xFFFFFFFF)
@@ -284,22 +530,36 @@ class SfuBridge:
         # (AIMD increase in normal state, beta-cut on overuse)
         if self._bwe_fed.any():
             self.bwe.update_estimate(now * 1000.0)
+        if self._video:
+            self._select_video_layers()
         for sid, ssrc in list(self._ssrc_of.items()):
             own = self.own_estimate_bps(sid)
-            if self.loop.addr_port[sid] == 0:
-                # no address: still drain to bound memory
-                self.rtcp_term.make_sender_feedback(ssrc, now=now,
-                                                    own_bps=own)
-                continue
             blobs = self.rtcp_term.make_sender_feedback(ssrc, now=now,
                                                         own_bps=own)
-            if not blobs:
+            # video senders also get per-layer feedback (the PLIs that
+            # gate a pending layer switch are keyed by layer SSRC)
+            for track in set(self._video.values()):
+                if track.sender_sid == sid:
+                    for lssrc in track.layer_ssrcs:
+                        blobs += self.rtcp_term.make_sender_feedback(
+                            lssrc, now=now)
+            # a video-only sender latches addresses on its LAYER rows,
+            # not the primary sid — fall back so PLIs still reach it
+            arow = sid
+            if self.loop.addr_port[arow] == 0:
+                for track in set(self._video.values()):
+                    if track.sender_sid != sid:
+                        continue
+                    arow = next((l for l in track.layer_sids
+                                 if self.loop.addr_port[l] != 0), sid)
+            if self.loop.addr_port[arow] == 0 or not blobs:
                 continue
             b = PacketBatch.from_payloads(
                 [rtcp.build_compound(blobs)], stream=[sid])
             wire = self.tx_table.protect_rtcp(b)
             sent += self.loop.engine.send_batch(
-                wire, self.loop.addr_ip[sid], self.loop.addr_port[sid])
+                wire, self.loop.addr_ip[arow],
+                self.loop.addr_port[arow])
         return sent
 
     def tick(self, now: Optional[float] = None) -> dict:

@@ -102,6 +102,11 @@ class RtcpTermination:
                 self._pli_pending.discard(media_ssrc)
         return out
 
+    def request_keyframe(self, media_ssrc: int) -> None:
+        """Queue a rate-limited PLI toward a sender (e.g. a simulcast
+        layer switch waiting on the target layer's keyframe)."""
+        self._pli_pending.add(media_ssrc & 0xFFFFFFFF)
+
     def min_remb(self, media_ssrc: int) -> Optional[float]:
         rembs = self._remb.get(media_ssrc)
         return min(rembs.values()) if rembs else None
