@@ -234,40 +234,113 @@ def aes_encrypt_bitsliced_nd(round_keys, blocks):
 
 
 # ------------------------------------------------------------ Pallas provider
+#
+# Round-2 postmortem (BENCH_r02 "error: MosaicError"): the first Pallas
+# twin ran `reshape(-1, 4, 4).transpose(0, 2, 1)` on uint8 INSIDE the
+# kernel — minor-dim relayout + 8-bit shifts, exactly what Mosaic
+# declines to lower.  This version is lane-native instead: the batch
+# rides the 128-wide lane axis, each bit plane is a [4, 4, 128] int32
+# tile (row, col, lane), bit extraction/packing happens OUTSIDE the
+# kernel as plain XLA, and the kernel body is nothing but elementwise
+# XOR/AND plus static sublane slice+concat (ShiftRows) and stacks
+# (MixColumns) — no transpose, no gather, no sub-32-bit arithmetic.
 
-def _pallas_kernel(blocks_ref, rk_ref, out_ref, *, nr: int):
-    """Whole-tile bitsliced AES in VMEM; no gathers anywhere."""
-    blocks = blocks_ref[:]
-    rk = rk_ref[:]
-    x = blocks.reshape(-1, 4, 4).transpose(0, 2, 1)
-    bits = [((x >> p) & 1).astype(jnp.uint8) for p in range(8)]
-    rk_bits = []
-    for r in range(nr + 1):
-        k = rk[:, r, :].reshape(-1, 4, 4).transpose(0, 2, 1)
-        rk_bits.append([((k >> p) & 1).astype(jnp.uint8)
-                        for p in range(8)])
-    out = _rounds(bits, rk_bits, nr, jnp.concatenate, jnp.stack)
-    acc = out[0]
-    for p in range(1, 8):
-        acc = acc | (out[p] << p)
-    out_ref[:] = acc.transpose(0, 2, 1).reshape(-1, 16).astype(jnp.uint8)
+_LANES = 128
+
+
+def _shift_rows_tile(bits):
+    """[4, 4, L] planes: row r rolls left by r columns (axis 1)."""
+    out = []
+    for p in bits:
+        rows = []
+        for r in range(4):
+            row = p[r]                       # [4 cols, L]
+            if r:
+                row = jnp.concatenate([row[r:], row[:r]], axis=0)
+            rows.append(row)
+        out.append(jnp.stack(rows, axis=0))
+    return out
+
+
+def _mix_columns_tile(bits):
+    rows = [[p[r] for p in bits] for r in range(4)]   # [4 cols, L] each
+    new_rows = []
+    for r in range(4):
+        a, b = rows[r], rows[(r + 1) % 4]
+        c, d = rows[(r + 2) % 4], rows[(r + 3) % 4]
+        new_rows.append(_vxor(_vxor(_xtime_bits(a),
+                                    _vxor(_xtime_bits(b), b)),
+                              _vxor(c, d)))
+    return [jnp.stack([new_rows[r][p] for r in range(4)], axis=0)
+            for p in range(8)]
+
+
+def _pallas_kernel(bits_ref, rk_ref, out_ref, *, nr: int):
+    """Bit-plane tile in VMEM: bits [8, 4, 4, L], rk [(nr+1)*8, 4, 4, L]."""
+    bits = [bits_ref[p] for p in range(8)]
+    rk_bits = [[rk_ref[r * 8 + p] for p in range(8)]
+               for r in range(nr + 1)]
+    bits = _vxor(bits, rk_bits[0])
+    for r in range(1, nr):
+        bits = _sbox_bits(bits)
+        bits = _shift_rows_tile(bits)
+        bits = _mix_columns_tile(bits)
+        bits = _vxor(bits, rk_bits[r])
+    bits = _sbox_bits(bits)
+    bits = _shift_rows_tile(bits)
+    bits = _vxor(bits, rk_bits[nr])
+    for p in range(8):
+        out_ref[p] = bits[p]
+
+
+def _to_lane_planes(x16):
+    """[B, 16] uint8 -> [8, 4, 4, B] int32 bit planes (row, col, lane).
+
+    byte i = row + 4*col, same state layout as the XLA provider."""
+    y = x16.reshape(-1, 4, 4).transpose(2, 1, 0)      # [row, col, B]
+    return jnp.stack([((y >> p) & 1).astype(jnp.int32)
+                      for p in range(8)], axis=0)
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def aes_encrypt_pallas_bitsliced(round_keys, blocks,
                                  interpret: bool = False):
-    """Pallas twin; may fail to lower on some Mosaic toolchains — the
-    registry records the error and keeps a working provider."""
+    """Pallas twin of `aes_encrypt_bitsliced` (lane-native layout)."""
     from jax.experimental import pallas as pl
 
     rk = jnp.asarray(round_keys, dtype=jnp.uint8)
     blocks = jnp.asarray(blocks, dtype=jnp.uint8)
     nr = rk.shape[-2] - 1
-    return pl.pallas_call(
+    b = blocks.shape[0]
+    pad = (-b) % _LANES
+    if pad:
+        blocks = jnp.pad(blocks, ((0, pad), (0, 0)))
+        rk = jnp.pad(rk, ((0, pad), (0, 0), (0, 0)))
+    bp = b + pad
+    bits = _to_lane_planes(blocks)                    # [8, 4, 4, BP]
+    rkb = _to_lane_planes(
+        rk.transpose(1, 0, 2).reshape(-1, 16)
+    ).reshape(8, 4, 4, nr + 1, bp)
+    # [(nr+1)*8, 4, 4, BP]: round-major so the kernel indexes r*8+p
+    rkb = rkb.transpose(3, 0, 1, 2, 4).reshape((nr + 1) * 8, 4, 4, bp)
+    out = pl.pallas_call(
         functools.partial(_pallas_kernel, nr=nr),
-        out_shape=jax.ShapeDtypeStruct(blocks.shape, jnp.uint8),
+        grid=(bp // _LANES,),
+        in_specs=[
+            pl.BlockSpec((8, 4, 4, _LANES), lambda i: (0, 0, 0, i)),
+            pl.BlockSpec(((nr + 1) * 8, 4, 4, _LANES),
+                         lambda i: (0, 0, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((8, 4, 4, _LANES),
+                               lambda i: (0, 0, 0, i)),
+        out_shape=jax.ShapeDtypeStruct((8, 4, 4, bp), jnp.int32),
         interpret=interpret,
-    )(blocks, rk)
+    )(bits, rkb)
+    acc = out[0]
+    for p in range(1, 8):
+        acc = acc | (out[p] << p)
+    res = acc.astype(jnp.uint8).transpose(2, 1, 0).reshape(-1, 16)
+    return res[:b] if pad else res
 
 
 # ------------------------------------------------------------------ registry
