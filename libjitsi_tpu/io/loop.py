@@ -56,10 +56,18 @@ class MediaLoop:
                  chain=None,
                  pcap_tap: Optional[PcapWriter] = None,
                  metrics: Optional[MetricsRegistry] = None,
-                 recv_window_ms: int = 1):
+                 recv_window_ms: int = 1,
+                 pipelined: bool = False):
         self.engine = engine
         self.registry = registry
         self.chain = chain
+        # pipelined: sink replies are DISPATCHED (device launch only)
+        # and their bytes flush at the top of the next tick, so the
+        # protect launch overlaps the next recv window instead of
+        # serializing with it (SURVEY §7 step 4's budget).  Costs one
+        # recv-window of latency on the reply path.
+        self.pipelined = pipelined
+        self._inflight: List[Tuple[object, np.ndarray]] = []
         # kernel arrival stamps ride along when the engine has them;
         # after each tick, `last_rtp_arrival_ns` aligns row-for-row with
         # the batch handed to on_media (BWE wants skb-receive times,
@@ -132,6 +140,10 @@ class MediaLoop:
             ats = None
         n = batch.batch_size
         self.ticks += 1
+        # the recv window just elapsed: anything dispatched last tick
+        # has had a full socket-wait of device time — flush it now
+        if self._inflight:
+            self.flush_sends()
         if n == 0:
             return 0
         self.rx_packets += n
@@ -219,7 +231,10 @@ class MediaLoop:
                 if self.on_media is not None:
                     reply = self.on_media(rtp, ok)
                     if reply is not None:
-                        self.send_media(reply)
+                        if self.pipelined:
+                            self.send_media_async(reply)
+                        else:
+                            self.send_media(reply)
             if len(rtcp_rows) and self.on_rtcp is not None:
                 rb = PacketBatch(sub.data[rtcp_rows],
                                  np.asarray(sub.length)[rtcp_rows],
@@ -253,6 +268,38 @@ class MediaLoop:
         sids = np.clip(out.stream, 0, self.registry.capacity - 1)
         sent = self.engine.send_batch(out, self.addr_ip[sids],
                                       self.addr_port[sids])
+        self.tx_packets += sent
+        return sent
+
+    def send_media_async(self, batch: PacketBatch) -> int:
+        """Dispatch the forward chain without materializing; protected
+        bytes go out on the next tick's flush (or an explicit
+        `flush_sends`)."""
+        if batch.batch_size == 0:
+            return 0
+        if self.chain is None:
+            return self.send_media(batch)       # nothing to overlap
+        with self.metrics.timing("forward_dispatch"):
+            pending, mask = self.chain.rtp_transformer.transform_async(
+                batch)
+        self._inflight.append((pending, mask))
+        return batch.batch_size
+
+    def flush_sends(self) -> int:
+        """Materialize + transmit every in-flight dispatched batch."""
+        sent = 0
+        inflight, self._inflight = self._inflight, []
+        for pending, mask in inflight:
+            out = pending.result()
+            rows = np.nonzero(mask)[0]
+            if len(rows) == 0:
+                continue
+            sub = PacketBatch(out.data[rows],
+                              np.asarray(out.length)[rows],
+                              out.stream[rows])
+            sids = np.clip(sub.stream, 0, self.registry.capacity - 1)
+            sent += self.engine.send_batch(sub, self.addr_ip[sids],
+                                           self.addr_port[sids])
         self.tx_packets += sent
         return sent
 

@@ -59,7 +59,8 @@ class ConferenceBridge:
                  recv_window_ms: int = 1,
                  audio_level_ext_id: int = 1,
                  on_speaker_change=None,
-                 recorder=None):
+                 recorder=None,
+                 pipelined: bool = False):
         self.capacity = capacity
         self.profile = profile
         self.ptime_ms = ptime_ms
@@ -90,7 +91,7 @@ class ConferenceBridge:
                       kernel_timestamps=kernel_timestamps),
             self.registry, on_media=self._on_media, chain=self.chain,
             on_dtls=lambda d, a: self._dtls.on_dtls(d, a),
-            recv_window_ms=recv_window_ms)
+            recv_window_ms=recv_window_ms, pipelined=pipelined)
         from libjitsi_tpu.control.dtls import DtlsAssociationTable
         self._dtls = DtlsAssociationTable(self.loop, profile,
                                           self._install_dtls)
@@ -309,6 +310,10 @@ class ConferenceBridge:
             stream=sids.tolist())
         self._tx_seq[sids] = (self._tx_seq[sids] + 1) & 0xFFFF
         self._tx_ts[sids] = (self._tx_ts[sids] + steps) & 0xFFFFFFFF
+        if self.loop.pipelined:
+            # dispatch only: the protect launch overlaps the next recv
+            # window; bytes flush at the top of the next tick
+            return self.loop.send_media_async(batch)
         return self.loop.send_media(batch)
 
     def run(self, duration_s: float) -> None:

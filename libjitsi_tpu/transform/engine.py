@@ -111,6 +111,36 @@ class _ChainTransformer(PacketTransformer):
             self.dropped[name] += max(0, int(before - mask.sum()))
         return batch, mask
 
+    def transform_async(self, batch, mask=None):
+        """Dispatch-only outbound pass: every engine up to the last runs
+        sync (host-cheap header work), the final engine — SRTP, by chain
+        discipline — is dispatched without materializing when it
+        supports it.  Returns (pending, mask); `pending.result()` gives
+        the transformed batch.  This is the double-buffering seam: the
+        device launch overlaps whatever the caller does next (typically
+        the next socket window)."""
+        mask = _ones(batch) if mask is None else mask.copy()
+        for name, t in self._ts[:-1]:
+            batch, ok = t.transform(batch, mask)
+            mask = self._fold(mask, ok)
+        if not self._ts:
+            return _DonePending(batch), mask
+        name, last = self._ts[-1]
+        if hasattr(last, "transform_async"):
+            return last.transform_async(batch, mask), mask
+        batch, ok = last.transform(batch, mask)
+        return _DonePending(batch), self._fold(mask, ok)
+
+
+class _DonePending:
+    """Degenerate pending for chains without an async tail."""
+
+    def __init__(self, batch):
+        self._batch = batch
+
+    def result(self):
+        return self._batch
+
 
 class TransformEngineChain(TransformEngine):
     """Ordered engine composition (reference: TransformEngineChain).

@@ -27,6 +27,11 @@ class _SrtpRtpTransformer(PacketTransformer):
         out = self.tx.protect_rtp(batch)
         return out, (np.ones(batch.batch_size, bool) if mask is None else mask)
 
+    def transform_async(self, batch, mask=None):
+        """Dispatch-only protect (see SrtpStreamTable.protect_rtp_async):
+        the chain's pipelined send path materializes on flush."""
+        return self.tx.protect_rtp_async(batch)
+
     def reverse_transform(self, batch, mask=None):
         out, ok = self.rx.unprotect_rtp(batch)
         if mask is not None:
