@@ -32,6 +32,7 @@ def test_bitsliced_matches_table(key_len):
     assert np.array_equal(got_p, want)
 
 
+@pytest.mark.slow   # compile-heavy; sibling tests keep core coverage
 def test_bitsliced_nd_wrapper_broadcast_keys():
     """The CTR path calls with [B, n, R, 16] broadcast keys."""
     rng = np.random.default_rng(2)

@@ -86,6 +86,7 @@ def test_demux_first_byte():
     assert not is_dtls(bytes([0]))                 # STUN would be 0..3
 
 
+@pytest.mark.slow   # compile-heavy; sibling tests keep core coverage
 def test_exported_keys_drive_srtp_tables():
     """End to end: DTLS handshake keys installed into SrtpStreamTables,
     protected media flows client -> server."""

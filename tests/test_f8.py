@@ -110,6 +110,7 @@ def test_f8_rtcp_roundtrip():
     assert ok.all() and dec.to_bytes(0) == sr
 
 
+@pytest.mark.slow   # compile-heavy; sibling tests keep core coverage
 def test_f8_snapshot_restore_preserves_schedules():
     tx = SrtpStreamTable(capacity=1, profile=SrtpProfile.F8_128_HMAC_SHA1_80)
     tx.add_stream(0, KEY, SALT)
@@ -122,6 +123,7 @@ def test_f8_snapshot_restore_preserves_schedules():
     assert a.to_bytes(0) == b.to_bytes(0) != first.to_bytes(0)
 
 
+@pytest.mark.slow   # compile-heavy; sibling tests keep core coverage
 def test_f8_srtcp_protect_matches_scalar_oracle():
     """Independent scalar SRTCP-f8 protect (RFC 3711 §3.4 + §4.1.2.4)
     written from the RFC, compared byte-for-byte with the batched path."""

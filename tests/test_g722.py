@@ -1,6 +1,7 @@
 """G.722 sub-band ADPCM: round-trip quality, batching, embedded modes."""
 
 import numpy as np
+import pytest
 
 from libjitsi_tpu.codecs import g722
 
@@ -36,6 +37,7 @@ def test_roundtrip_tone_64k():
     assert _best_snr_db(pcm, dec) > 20.0
 
 
+@pytest.mark.slow   # compile-heavy; sibling tests keep core coverage
 def test_roundtrip_speechlike_modes():
     # sum of low tones (speech band) — all three modes intelligible,
     # quality ordered 64k >= 56k >= 48k

@@ -34,6 +34,7 @@ def _pkt(seq, payload=b"kdrpayload" * 8):
                             stream=[0]).to_bytes(0)
 
 
+@pytest.mark.slow   # compile-heavy; sibling tests keep core coverage
 def test_kdr_rekeys_across_epochs_single_packets():
     tx = SrtpStreamTable(capacity=1)
     tx.add_stream(0, MK, MS, kdr=KDR)

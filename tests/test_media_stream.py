@@ -177,6 +177,7 @@ def test_stats2_pull_api_and_rtcp_listener(svc):
     assert "SdesPacket" in "".join(seen) or len(seen) >= 2
 
 
+@pytest.mark.slow   # compile-heavy; sibling tests keep core coverage
 def test_stats2_poller_resets_on_row_recycle(svc):
     """A recycled stream row must not difference rates against the dead
     stream's totals (would show huge negative pps)."""

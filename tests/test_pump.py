@@ -40,6 +40,7 @@ def test_send_pump_produces_protected_rtp():
         libjitsi_tpu.stop()
 
 
+@pytest.mark.slow   # compile-heavy; sibling tests keep core coverage
 def test_send_receive_pump_g722_roundtrip():
     libjitsi_tpu.init()
     try:
@@ -86,6 +87,7 @@ def test_pump_loss_plays_silence_and_recovers():
         libjitsi_tpu.stop()
 
 
+@pytest.mark.slow   # compile-heavy; sibling tests keep core coverage
 def test_conference_via_pumps_three_parties():
     """3 participants: send pumps -> receive pumps -> mixer device; each
     hears the other two (mix-minus)."""
@@ -177,6 +179,7 @@ def test_pump_survives_malformed_payload():
         libjitsi_tpu.stop()
 
 
+@pytest.mark.slow   # compile-heavy; sibling tests keep core coverage
 def test_receive_pump_clamps_oversize_payload():
     """A remote peer sending over-long payloads must not crash the tick."""
     libjitsi_tpu.init()

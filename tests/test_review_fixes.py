@@ -89,6 +89,7 @@ def test_ext_same_id_different_length_replaces_not_shadows():
     assert out2.to_bytes(0).endswith(b"payload")
 
 
+@pytest.mark.slow   # compile-heavy; sibling tests keep core coverage
 def test_unprotect_forged_oversize_ext_header_dropped():
     """A packet whose ext_words field claims a header beyond the buffer
     must be dropped by auth, not crash the uniform-offset fast path
