@@ -17,6 +17,14 @@
 #     add ASAN_OPTIONS=detect_leaks=0 — CPython itself trips LSan)
 set -e
 cd "$(dirname "$0")"
+
+# C++ OpenSSL differential oracle (no dev headers in the image: the
+# .cpp declares the stable EVP ABI; link the versioned lib directly)
+build_oracle() {
+  g++ -O2 -Wall -shared -fPIC -o libcrypto_oracle.so \
+      crypto_oracle.cpp /usr/lib/x86_64-linux-gnu/libcrypto.so.3
+}
+
 case "${1:-}" in
   tsan)
     g++ -O1 -g -Wall -fsanitize=thread -shared -fPIC \
@@ -26,7 +34,17 @@ case "${1:-}" in
     g++ -O1 -g -Wall -fsanitize=address -shared -fPIC \
         -o libudp_engine_asan.so udp_engine.cpp
     echo "built $(pwd)/libudp_engine_asan.so" ;;
+  oracle)
+    build_oracle
+    echo "built $(pwd)/libcrypto_oracle.so" ;;
   *)
     g++ -O2 -Wall -shared -fPIC -o libudp_engine.so udp_engine.cpp
-    echo "built $(pwd)/libudp_engine.so" ;;
+    # oracle is best-effort here: a box without libcrypto.so.3 still
+    # gets the UDP engine (tests needing the oracle build it
+    # explicitly via `build.sh oracle` and fail loudly there)
+    if build_oracle 2>/dev/null; then
+      echo "built $(pwd)/libudp_engine.so + libcrypto_oracle.so"
+    else
+      echo "built $(pwd)/libudp_engine.so (no libcrypto.so.3: oracle skipped)"
+    fi ;;
 esac

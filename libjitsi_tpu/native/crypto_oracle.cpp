@@ -1,0 +1,92 @@
+// C++ OpenSSL differential oracle (SURVEY §2.6-1's "C++ OpenSSL
+// fallback" row): one-shot AES-CTR / HMAC-SHA1 / AES-GCM primitives
+// backed by the SAME libcrypto the reference's JNI provider wraps,
+// exposed as a C ABI for ctypes.  This is the native twin of the
+// Python `cryptography`-package oracle the tests already use — both
+// call into libcrypto.so.3, so agreement between the TPU kernels and
+// BOTH oracles pins the kernels to OpenSSL itself, not to a Python
+// binding's interpretation of it.
+//
+// The image ships libcrypto.so.3 but no OpenSSL headers; the EVP/HMAC
+// entry points below are OpenSSL 3.x's stable public C ABI, declared
+// here verbatim from the documented signatures.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+// ---- libcrypto 3.x public ABI (subset) ----
+typedef struct evp_cipher_ctx_st EVP_CIPHER_CTX;
+typedef struct evp_cipher_st EVP_CIPHER;
+typedef struct evp_md_st EVP_MD;
+typedef struct engine_st ENGINE;
+
+EVP_CIPHER_CTX *EVP_CIPHER_CTX_new(void);
+void EVP_CIPHER_CTX_free(EVP_CIPHER_CTX *);
+int EVP_EncryptInit_ex(EVP_CIPHER_CTX *, const EVP_CIPHER *, ENGINE *,
+                       const unsigned char *key, const unsigned char *iv);
+int EVP_EncryptUpdate(EVP_CIPHER_CTX *, unsigned char *out, int *outl,
+                      const unsigned char *in, int inl);
+int EVP_EncryptFinal_ex(EVP_CIPHER_CTX *, unsigned char *out, int *outl);
+int EVP_CIPHER_CTX_ctrl(EVP_CIPHER_CTX *, int type, int arg, void *ptr);
+const EVP_CIPHER *EVP_aes_128_ctr(void);
+const EVP_CIPHER *EVP_aes_256_ctr(void);
+const EVP_CIPHER *EVP_aes_128_gcm(void);
+const EVP_MD *EVP_sha1(void);
+unsigned char *HMAC(const EVP_MD *, const void *key, int key_len,
+                    const unsigned char *data, size_t data_len,
+                    unsigned char *md, unsigned int *md_len);
+
+#define EVP_CTRL_AEAD_GET_TAG 0x10
+
+// ------------------------------------------------------------- oracle
+
+// AES-CTR keystream-encrypt `n` bytes (128- or 256-bit key by keylen).
+// Returns 0 on success.
+int oracle_aes_ctr(const uint8_t *key, int keylen, const uint8_t iv[16],
+                   const uint8_t *in, int n, uint8_t *out) {
+    const EVP_CIPHER *c =
+        keylen == 16 ? EVP_aes_128_ctr()
+                     : (keylen == 32 ? EVP_aes_256_ctr() : nullptr);
+    if (!c) return -1;
+    EVP_CIPHER_CTX *ctx = EVP_CIPHER_CTX_new();
+    if (!ctx) return -2;
+    int rc = -3, outl = 0, fin = 0;
+    if (EVP_EncryptInit_ex(ctx, c, nullptr, key, iv) == 1 &&
+        EVP_EncryptUpdate(ctx, out, &outl, in, n) == 1 &&
+        EVP_EncryptFinal_ex(ctx, out + outl, &fin) == 1 &&
+        outl + fin == n)
+        rc = 0;
+    EVP_CIPHER_CTX_free(ctx);
+    return rc;
+}
+
+// HMAC-SHA1 of `n` bytes; writes 20 bytes.  Returns 0 on success.
+int oracle_hmac_sha1(const uint8_t *key, int keylen, const uint8_t *msg,
+                     int n, uint8_t out[20]) {
+    unsigned int len = 0;
+    if (!HMAC(EVP_sha1(), key, keylen, msg, (size_t)n, out, &len))
+        return -1;
+    return len == 20 ? 0 : -2;
+}
+
+// AES-128-GCM seal: ct[n] + tag[16].  Returns 0 on success.
+int oracle_gcm_seal(const uint8_t *key, const uint8_t iv[12],
+                    const uint8_t *aad, int aadlen, const uint8_t *pt,
+                    int n, uint8_t *ct, uint8_t tag[16]) {
+    EVP_CIPHER_CTX *ctx = EVP_CIPHER_CTX_new();
+    if (!ctx) return -2;
+    int rc = -3, outl = 0, fin = 0, aadl = 0;
+    if (EVP_EncryptInit_ex(ctx, EVP_aes_128_gcm(), nullptr, key, iv) == 1 &&
+        (aadlen == 0 ||
+         EVP_EncryptUpdate(ctx, nullptr, &aadl, aad, aadlen) == 1) &&
+        EVP_EncryptUpdate(ctx, ct, &outl, pt, n) == 1 &&
+        EVP_EncryptFinal_ex(ctx, ct + outl, &fin) == 1 &&
+        outl + fin == n &&
+        EVP_CIPHER_CTX_ctrl(ctx, EVP_CTRL_AEAD_GET_TAG, 16, tag) == 1)
+        rc = 0;
+    EVP_CIPHER_CTX_free(ctx);
+    return rc;
+}
+
+}  // extern "C"

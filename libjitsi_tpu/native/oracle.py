@@ -1,0 +1,85 @@
+"""ctypes binding for the C++ OpenSSL differential oracle.
+
+SURVEY §2.6-1 names a native OpenSSL fallback beside the device crypto;
+`crypto_oracle.cpp` is that twin — the same libcrypto.so.3 the
+`cryptography` package wraps, reached through a C++ shim instead of a
+Python binding.  tests/test_native_oracle.py differential-checks the
+TPU kernels against it (test_srtp.py covers the Python-binding oracle),
+pinning the kernels to OpenSSL itself.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    if _lib is not None:
+        return _lib
+    so = os.path.join(_NATIVE_DIR, "libcrypto_oracle.so")
+    if not os.path.exists(so):
+        r = subprocess.run(
+            ["sh", os.path.join(_NATIVE_DIR, "build.sh"), "oracle"],
+            capture_output=True, text=True)
+        if r.returncode != 0:
+            raise RuntimeError(
+                f"crypto oracle build failed:\n{r.stderr}")
+    lib = ctypes.CDLL(so)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.oracle_aes_ctr.restype = ctypes.c_int
+    lib.oracle_aes_ctr.argtypes = [u8p, ctypes.c_int, u8p, u8p,
+                                   ctypes.c_int, u8p]
+    lib.oracle_hmac_sha1.restype = ctypes.c_int
+    lib.oracle_hmac_sha1.argtypes = [u8p, ctypes.c_int, u8p,
+                                     ctypes.c_int, u8p]
+    lib.oracle_gcm_seal.restype = ctypes.c_int
+    lib.oracle_gcm_seal.argtypes = [u8p, u8p, u8p, ctypes.c_int, u8p,
+                                    ctypes.c_int, u8p, u8p]
+    _lib = lib
+    return lib
+
+
+def _buf(data: bytes):
+    return (ctypes.c_uint8 * max(1, len(data))).from_buffer_copy(
+        data or b"\x00")
+
+
+def aes_ctr(key: bytes, iv16: bytes, data: bytes) -> bytes:
+    lib = _load()
+    out = (ctypes.c_uint8 * max(1, len(data)))()
+    rc = lib.oracle_aes_ctr(_buf(key), len(key), _buf(iv16), _buf(data),
+                            len(data), out)
+    if rc != 0:
+        raise RuntimeError(f"oracle_aes_ctr rc={rc}")
+    return bytes(out[:len(data)])
+
+
+def hmac_sha1(key: bytes, msg: bytes) -> bytes:
+    lib = _load()
+    out = (ctypes.c_uint8 * 20)()
+    rc = lib.oracle_hmac_sha1(_buf(key), len(key), _buf(msg), len(msg),
+                              out)
+    if rc != 0:
+        raise RuntimeError(f"oracle_hmac_sha1 rc={rc}")
+    return bytes(out)
+
+
+def gcm_seal(key16: bytes, iv12: bytes, aad: bytes,
+             plaintext: bytes) -> tuple:
+    """Returns (ciphertext, tag16)."""
+    lib = _load()
+    ct = (ctypes.c_uint8 * max(1, len(plaintext)))()
+    tag = (ctypes.c_uint8 * 16)()
+    rc = lib.oracle_gcm_seal(_buf(key16), _buf(iv12), _buf(aad),
+                             len(aad), _buf(plaintext), len(plaintext),
+                             ct, tag)
+    if rc != 0:
+        raise RuntimeError(f"oracle_gcm_seal rc={rc}")
+    return bytes(ct[:len(plaintext)]), bytes(tag)

@@ -1,0 +1,77 @@
+"""The C++ OpenSSL oracle (SURVEY §2.6-1's native fallback) vs the
+device kernels: the same libcrypto.so.3 the reference's JNI provider
+wraps, reached through a C++ shim instead of the `cryptography` Python
+binding.  Agreement here pins the TPU kernels to OpenSSL itself."""
+
+import numpy as np
+import jax.numpy as jnp
+
+from libjitsi_tpu.kernels import sha1 as K
+from libjitsi_tpu.kernels.aes import ctr_crypt_uniform, expand_keys_batch
+from libjitsi_tpu.native import oracle
+
+
+def test_cpp_oracle_aes_ctr_matches_kernel():
+    rng = np.random.default_rng(3)
+    n, width = 4, 96
+    keys = rng.integers(0, 256, (n, 16), dtype=np.uint8)
+    ivs = rng.integers(0, 256, (n, 16), dtype=np.uint8)
+    data = rng.integers(0, 256, (n, width), dtype=np.uint8)
+    lengths = np.full(n, width, np.int32)
+    rks = expand_keys_batch(keys)
+    out = np.asarray(ctr_crypt_uniform(jnp.asarray(rks),
+                                       jnp.asarray(ivs),
+                                       jnp.asarray(data), 0,
+                                       jnp.asarray(lengths)))
+    for i in range(n):
+        want = oracle.aes_ctr(keys[i].tobytes(), ivs[i].tobytes(),
+                              data[i].tobytes())
+        assert out[i].tobytes() == want, i
+
+
+def test_cpp_oracle_hmac_matches_kernel():
+    rng = np.random.default_rng(4)
+    keys = [rng.integers(0, 256, int(k), dtype=np.uint8).tobytes()
+            for k in (16, 20, 64)]
+    msgs = [rng.integers(0, 256, int(m), dtype=np.uint8).tobytes()
+            for m in (5, 56, 200)]
+    width = 256
+    data = np.zeros((3, width), np.uint8)
+    lengths = np.zeros(3, np.int32)
+    for i, m in enumerate(msgs):
+        data[i, :len(m)] = np.frombuffer(m, np.uint8)
+        lengths[i] = len(m)
+    mids = np.stack([K.hmac_precompute(k) for k in keys])
+    out = np.asarray(K.hmac_sha1(jnp.asarray(mids), jnp.asarray(data),
+                                 jnp.asarray(lengths)))
+    for i, (k, m) in enumerate(zip(keys, msgs)):
+        assert out[i].tobytes() == oracle.hmac_sha1(k, m), i
+
+
+def test_cpp_oracle_gcm_matches_kernel():
+    from libjitsi_tpu.kernels import gcm as G
+    from libjitsi_tpu.kernels.ghash import ghash_matrix
+    from libjitsi_tpu.kernels.aes import aes_encrypt_np, expand_key
+
+    rng = np.random.default_rng(5)
+    key = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+    iv12 = rng.integers(0, 256, 12, dtype=np.uint8).tobytes()
+    aad = rng.integers(0, 256, 12, dtype=np.uint8).tobytes()
+    pt = rng.integers(0, 256, 64, dtype=np.uint8).tobytes()
+    rk = expand_key(key)
+    h = aes_encrypt_np(rk, np.zeros((1, 16), np.uint8))[0].tobytes()
+    gm = ghash_matrix(h).astype(np.int8)
+    width = 96
+    data = np.zeros((1, width), np.uint8)
+    blob = aad + pt
+    data[0, :len(blob)] = np.frombuffer(blob, np.uint8)
+    out, outlen = G.gcm_protect(
+        jnp.asarray(data), jnp.asarray([len(blob)], jnp.int32),
+        jnp.asarray([len(aad)], jnp.int32),
+        jnp.asarray(rk[None].astype(np.uint8)),
+        jnp.asarray(gm[None]), jnp.asarray(
+            np.frombuffer(iv12, np.uint8)[None]))
+    out = np.asarray(out)[0]
+    ct, tag = oracle.gcm_seal(key, iv12, aad, pt)
+    assert out[len(aad):len(blob)].tobytes() == ct
+    assert out[len(blob):len(blob) + 16].tobytes() == tag
