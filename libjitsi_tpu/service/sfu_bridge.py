@@ -85,10 +85,14 @@ class SfuBridge:
                  SrtpProfile.AES_CM_128_HMAC_SHA1_80,
                  recv_window_ms: int = 1,
                  kernel_timestamps: bool = False,
-                 abs_send_time_ext_id: int = 3):
+                 abs_send_time_ext_id: int = 3,
+                 pipelined: bool = False):
         self.capacity = capacity
         self.profile = profile
         self.ast_ext_id = abs_send_time_ext_id
+        self.pipelined = pipelined
+        self._pending_fanout: list = []
+        self._media_ran = False
         self.registry = StreamRegistry(config, capacity=capacity)
         # rx_table: what endpoints SEND us (media + their SRTCP);
         # tx_table: what we send THEM (our SRTCP feedback; media forward
@@ -197,6 +201,11 @@ class SfuBridge:
         _log.info("dtls_keys_installed", sid=sid, profile=profile.name)
 
     def remove_endpoint(self, sid: int) -> None:
+        # ship in-flight fan-outs before the row is recycled: a pending
+        # batch flushed AFTER re-allocation would send the departed
+        # endpoint's old-key packets to the row's new occupant
+        if self._pending_fanout:
+            self._flush_fanout()
         ssrc = self._ssrc_of.pop(sid, None)
         if ssrc is not None:
             self.registry.unmap_ssrc(ssrc)
@@ -397,7 +406,15 @@ class SfuBridge:
 
     # --------------------------------------------------------------- tick
     def _on_media(self, batch: PacketBatch, _ok) -> None:
-        """Decrypt once, fan out, cache per-leg copies, send."""
+        """Decrypt once, fan out, cache per-leg copies, send.
+
+        Pipelined mode: the fan-out re-encrypt is DISPATCHED here and
+        its bytes ship at the start of the next tick's media handling
+        (after the recv window — the launch overlaps the socket wait),
+        same seam as MediaLoop's pipelined replies."""
+        self._media_ran = True
+        if self._pending_fanout:
+            self._flush_fanout()
         dec, ok, idx = self.rx_table.unprotect_rtp(batch,
                                                    return_index=True)
         rows = np.nonzero(ok)[0]
@@ -422,14 +439,26 @@ class SfuBridge:
                                   np.asarray(sub.length)[keep],
                                   sub.stream[keep])
                 idx_sel = idx_sel[keep]
-        wire, recv = self.translator.translate(sub, idx_sel)
-        if wire.batch_size == 0:
+        if self.pipelined:
+            self._pending_fanout.append(
+                self.translator.translate_async(sub, idx_sel))
             return None
+        self._emit_fanout(*self.translator.translate(sub, idx_sel))
+        return None
+
+    def _flush_fanout(self) -> None:
+        pending, self._pending_fanout = self._pending_fanout, []
+        for pend in pending:
+            self._emit_fanout(*pend.result())
+
+    def _emit_fanout(self, wire: PacketBatch, recv: np.ndarray) -> None:
+        if wire.batch_size == 0:
+            return
         # a just-joined leg has no latched address yet: sending to
         # 0.0.0.0:0 would EINVAL out of sendmmsg and crash the tick
         ready = self.loop.addr_port[recv] != 0
         if not ready.any():
-            return None
+            return
         rr = np.nonzero(ready)[0]
         wire = PacketBatch(wire.data[rr],
                            np.asarray(wire.length)[rr],
@@ -448,7 +477,6 @@ class SfuBridge:
         sent = self.loop.engine.send_batch(
             wire, self.loop.addr_ip[recv], self.loop.addr_port[recv])
         self.forwarded += sent
-        return None
 
     def _feed_bwe(self, sub: PacketBatch, rows: np.ndarray) -> None:
         """Drive the bridge's receive-side GCC from the senders'
@@ -564,11 +592,19 @@ class SfuBridge:
 
     def tick(self, now: Optional[float] = None) -> dict:
         self._now = time.time() if now is None else now
+        self._media_ran = False
         rx = self.loop.tick()
+        if self._pending_fanout and not self._media_ran:
+            # no media drove _on_media this tick: flush here instead
+            # (flushing a batch dispatched THIS tick would kill its
+            # overlap window, hence the flag, not an rx check)
+            self._flush_fanout()
         if self._dtls.pending:
             self._dtls.tick()
         return {"rx": rx, "forwarded": self.forwarded,
                 "retransmitted": self.retransmitted}
 
     def close(self) -> None:
+        if self._pending_fanout:
+            self._flush_fanout()     # the last tick's media still ships
         self.loop.engine.close()

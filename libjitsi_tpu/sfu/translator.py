@@ -143,6 +143,14 @@ class RtpTranslator:
         which leg each row goes to.  Packets from senders with no route
         produce no rows.
         """
+        pend = self.translate_async(batch, index)
+        return pend.result()
+
+    def translate_async(self, batch: PacketBatch, index: np.ndarray
+                        ) -> "PendingTranslate":
+        """Dispatch-only `translate`: the fan-out launch is enqueued,
+        results materialize on `.result()` — the SFU's pipelined tick
+        overlaps the launch with its next recv window."""
         stream = np.asarray(batch.stream, dtype=np.int64)
         index = np.asarray(index, dtype=np.int64)
         # build the (packet, receiver) expansion on host
@@ -155,7 +163,8 @@ class RtpTranslator:
             rows.append(i)
             recvs.append(rr)
         if not rows:
-            return PacketBatch.empty(0, batch.capacity), np.zeros(0, np.int64)
+            return PendingTranslate(None, None, np.zeros(0, np.int64),
+                                    batch.capacity)
         counts = np.array([len(r) for r in recvs])
         src = np.repeat(np.array(rows, dtype=np.int64), counts)
         recv = np.concatenate(recvs)
@@ -194,10 +203,9 @@ class RtpTranslator:
                 jnp.asarray((idx >> 16) & 0xFFFFFFFF, dtype=jnp.uint32),
                 self.policy.auth_tag_len,
                 self.policy.cipher != Cipher.NULL)
-        wire = PacketBatch(np.asarray(out),
-                           np.asarray(out_len, dtype=np.int32),
-                           recv.astype(np.int32))
-        return wire, recv
+        return PendingTranslate(out, out_len, recv, batch.capacity)
+
+    # (see PendingTranslate at module scope)
 
     def _translate_gcm(self, batch, rows, recvs, src, recv, data, length,
                        hdr, payload_off, ssrc, idx):
@@ -252,3 +260,32 @@ class RtpTranslator:
             jnp.asarray(data), jnp.asarray(length),
             jnp.asarray(payload_off), jnp.asarray(iv),
             aad_const=_uniform_off(payload_off, batch.capacity))
+
+
+class PendingTranslate:
+    """An in-flight `translate_async` fan-out.
+
+    Device work is enqueued; `result()` materializes once (blocking
+    transfer) and caches.  Mirrors `context.PendingProtect` — the same
+    double-buffering seam, for the SFU's per-leg re-encrypt launch.
+    """
+
+    def __init__(self, out, out_len, recv: np.ndarray, capacity: int):
+        self._out = out
+        self._out_len = out_len
+        self.recv = recv
+        self._capacity = capacity
+        self._done: "Tuple[PacketBatch, np.ndarray] | None" = None
+
+    def result(self) -> Tuple[PacketBatch, np.ndarray]:
+        if self._done is None:
+            if self._out is None:
+                wire = PacketBatch.empty(0, self._capacity)
+            else:
+                wire = PacketBatch(np.asarray(self._out),
+                                   np.asarray(self._out_len,
+                                              dtype=np.int32),
+                                   self.recv.astype(np.int32))
+            self._done = (wire, self.recv)
+            self._out = self._out_len = None
+        return self._done

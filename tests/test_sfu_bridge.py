@@ -451,3 +451,55 @@ def test_sfu_video_simulcast_layer_switch_and_rtx():
     recv_drain()
     assert want in rtx_got, f"seq {want} not re-delivered as RTX"
     sfu.close()
+
+
+@pytest.mark.slow
+def test_sfu_pipelined_fanout_delivers_everything():
+    """Pipelined SfuBridge: the fan-out launch dispatched in tick N
+    ships at tick N+1 (overlapping the recv window); every endpoint
+    still hears every other endpoint's media, and NACK service still
+    works against the flushed cache."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    sfu = SfuBridge(libjitsi_tpu.configuration_service(), port=0,
+                    capacity=8, recv_window_ms=0, pipelined=True)
+    eps = [_Endpoint(0x300 + 5 * k, sfu.port) for k in range(3)]
+    for e in eps:
+        sfu.add_endpoint(e.ssrc, e.rx_key, e.tx_key)
+        for other in eps:
+            if other is not e:
+                e.expect_sender(other.ssrc)
+
+    for rnd in range(4):
+        for e in eps:
+            e.send_media()
+        for _ in range(24):       # extra ticks: flush rides tick N+1
+            sfu.tick(now=70.0 + rnd * 0.02)
+        for e in eps:
+            for _ in range(4):
+                e.drain()
+    assert sfu.forwarded > 0
+    assert not sfu._pending_fanout, "pending fan-out never flushed"
+    for e in eps:
+        payloads = b"".join(e.got.values())
+        for other in eps:
+            if other is e:
+                continue
+            assert b"m-%08x" % other.ssrc in payloads, \
+                f"{e.ssrc:#x} missing media from {other.ssrc:#x}"
+        assert b"m-%08x" % e.ssrc not in payloads, "echoed own media"
+
+    # NACK service against the FLUSHED cache: the per-leg copies were
+    # inserted at flush time, not dispatch time
+    victim = eps[0]
+    victim.got.clear()
+    for ssrc, row in victim.row_of.items():
+        victim.open.add_stream(row, *victim.tx_key)
+    victim.send_nack(eps[1].ssrc, [500])
+    for _ in range(20):
+        sfu.tick(now=70.2)
+    for _ in range(4):
+        victim.drain()
+    assert sfu.retransmitted > 0
+    assert any(seq == 500 for _, seq in victim.got)
+    sfu.close()
