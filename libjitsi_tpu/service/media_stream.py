@@ -195,23 +195,35 @@ class MediaStream:
         self.remote_ssrc = ssrc & 0xFFFFFFFF
         self.registry.map_ssrc(self.remote_ssrc, self.sid)
 
-    def start(self) -> None:
+    def start(self, srtp_control=None) -> None:
         """Install negotiated keys and build the transform chain.
 
-        Reference: MediaStreamImpl.start() wiring the
-        TransformEngineChain with the SrtpControl's engine last.
+        `srtp_control`: any COMPLETED keying control exposing
+        ``srtp_keys() -> (profile, tx_key, tx_salt, rx_key, rx_salt)``
+        — a `DtlsSrtpEndpoint` or `ZrtpEndpoint`; default is the
+        stream's own SDES negotiation.  Reference:
+        MediaStreamImpl.start() wiring the TransformEngineChain with
+        whichever SrtpControl (SDES/DTLS/ZRTP) signaling chose.
         """
         if self._started:
             return
         tx_tab, rx_tab = self.registry.srtp_tables(self.profile)
-        if self.sdes.negotiated:
+        if srtp_control is not None:
+            profile, tk, tsalt, rk, rsalt = srtp_control.srtp_keys()
+            if profile != self.profile:
+                raise ValueError(
+                    f"control negotiated {profile.name}, stream built "
+                    f"for {self.profile.name}")
+            tx_tab.add_stream(self.sid, tk, tsalt)
+            rx_tab.add_stream(self.sid, rk, rsalt)
+        elif self.sdes.negotiated:
             lo, re = self.sdes.local, self.sdes.remote
             tx_tab.add_stream(self.sid, lo.master_key, lo.master_salt)
             rx_tab.add_stream(self.sid, re.master_key, re.master_salt)
         else:
             raise RuntimeError(
-                "no keys negotiated; complete SDES (or install keys on the "
-                "tables directly) before start()")
+                "no keys negotiated; complete SDES, or pass a completed "
+                "DTLS/ZRTP control to start()")
         engines = list(self._extra) + [SrtpTransformEngine(tx_tab, rx_tab)]
         self._chain = TransformEngineChain(engines)
         self._started = True

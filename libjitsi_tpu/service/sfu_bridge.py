@@ -148,6 +148,11 @@ class SfuBridge:
                      tx_key: Tuple[bytes, bytes]) -> int:
         if ssrc in self._ssrc_of.values():
             raise ValueError(f"ssrc {ssrc:#x} already joined")
+        # add_receiver rewrites the translator's key tensors in place;
+        # an in-flight pipelined fan-out may still read them (CPU
+        # zero-copy alias) — ship it first, like remove_endpoint does
+        if self._pending_fanout:
+            self._flush_fanout()
         sid = self.registry.alloc(self)
         self.rx_table.add_stream(sid, *rx_key)
         self.tx_table.add_stream(sid, *tx_key)
@@ -187,6 +192,8 @@ class SfuBridge:
         return sid, ep
 
     def _install_dtls(self, sid: int, ep) -> None:
+        if self._pending_fanout:
+            self._flush_fanout()     # see add_endpoint: alias race
         profile, tk, tsalt, rk, rsalt = ep.srtp_keys()
         self.rx_table.add_stream(sid, rk, rsalt)
         self.tx_table.add_stream(sid, tk, tsalt)

@@ -193,3 +193,53 @@ def test_stats2_poller_resets_on_row_recycle(svc):
     reg.stats2.poll(now=12.0)
     assert c.send_stats().packet_rate_pps == 0.0
     assert c.send_stats().packets == 0
+
+
+def test_stream_keyed_by_zrtp_control(svc):
+    """MediaStream.start accepts any completed keying control exposing
+    srtp_keys() — here ZRTP (reference: MediaStream + ZrtpControlImpl),
+    no SDES involved."""
+    from libjitsi_tpu.control.zrtp import ZrtpEndpoint
+    from test_zrtp import run_zrtp
+
+    a_ctl, b_ctl = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    run_zrtp(a_ctl, b_ctl)
+    a = svc.create_media_stream(local_ssrc=0x5A)
+    b = svc.create_media_stream(local_ssrc=0x5B)
+    a.set_remote_ssrc(b.local_ssrc)
+    b.set_remote_ssrc(a.local_ssrc)
+    a.start(srtp_control=a_ctl)
+    b.start(srtp_control=b_ctl)
+    from libjitsi_tpu.rtp import header as rtp_header
+
+    wire = a.send([b"zrtp-keyed-stream"])
+    got, ok = b.receive(wire)
+    assert ok.all()
+    hdr = rtp_header.parse(got)
+    assert got.to_bytes(0)[int(hdr.payload_off[0]):] == \
+        b"zrtp-keyed-stream"
+
+
+def test_stream_keyed_by_dtls_control(svc):
+    """Same uniform surface with DTLS-SRTP as the control."""
+    from libjitsi_tpu.control.dtls import DtlsSrtpEndpoint
+    from test_dtls import run_handshake
+
+    c = DtlsSrtpEndpoint("client")
+    s = DtlsSrtpEndpoint("server",
+                         remote_fingerprint=c.local_fingerprint)
+    run_handshake(c, s)
+    a = svc.create_media_stream(local_ssrc=0x6A)
+    b = svc.create_media_stream(local_ssrc=0x6B)
+    a.set_remote_ssrc(b.local_ssrc)
+    b.set_remote_ssrc(a.local_ssrc)
+    a.start(srtp_control=c)
+    b.start(srtp_control=s)
+    wire = a.send([b"dtls-keyed-stream"])
+    got, ok = b.receive(wire)
+    assert ok.all()
+    # profile mismatch is refused loudly
+    x = svc.create_media_stream(
+        local_ssrc=0x6C, profile=SrtpProfile.AEAD_AES_128_GCM)
+    with pytest.raises(ValueError):
+        x.start(srtp_control=c)
