@@ -148,11 +148,7 @@ class SfuBridge:
                      tx_key: Tuple[bytes, bytes]) -> int:
         if ssrc in self._ssrc_of.values():
             raise ValueError(f"ssrc {ssrc:#x} already joined")
-        # add_receiver rewrites the translator's key tensors in place;
-        # an in-flight pipelined fan-out may still read them (CPU
-        # zero-copy alias) — ship it first, like remove_endpoint does
-        if self._pending_fanout:
-            self._flush_fanout()
+        self._quiesce_fanout()
         sid = self.registry.alloc(self)
         self.rx_table.add_stream(sid, *rx_key)
         self.tx_table.add_stream(sid, *tx_key)
@@ -192,8 +188,7 @@ class SfuBridge:
         return sid, ep
 
     def _install_dtls(self, sid: int, ep) -> None:
-        if self._pending_fanout:
-            self._flush_fanout()     # see add_endpoint: alias race
+        self._quiesce_fanout()
         profile, tk, tsalt, rk, rsalt = ep.srtp_keys()
         self.rx_table.add_stream(sid, rk, rsalt)
         self.tx_table.add_stream(sid, tk, tsalt)
@@ -208,11 +203,7 @@ class SfuBridge:
         _log.info("dtls_keys_installed", sid=sid, profile=profile.name)
 
     def remove_endpoint(self, sid: int) -> None:
-        # ship in-flight fan-outs before the row is recycled: a pending
-        # batch flushed AFTER re-allocation would send the departed
-        # endpoint's old-key packets to the row's new occupant
-        if self._pending_fanout:
-            self._flush_fanout()
+        self._quiesce_fanout()
         ssrc = self._ssrc_of.pop(sid, None)
         if ssrc is not None:
             self.registry.unmap_ssrc(ssrc)
@@ -274,6 +265,7 @@ class SfuBridge:
             raise ValueError(f"sid {sender_sid} not joined")
         if len(layer_ssrcs) != len(layer_bps):
             raise ValueError("one nominal bitrate per layer")
+        self._quiesce_fanout()
         rx_key = self._rx_keys[sender_sid]
         layer_sids = []
         for ssrc in layer_ssrcs:
@@ -452,6 +444,17 @@ class SfuBridge:
             return None
         self._emit_fanout(*self.translator.translate(sub, idx_sel))
         return None
+
+    def _quiesce_fanout(self) -> None:
+        """Ship any in-flight pipelined fan-out BEFORE mutating state it
+        may still read: SRTP/translator key tensors are rewritten in
+        place (a dispatched launch can alias them zero-copy on CPU),
+        and a recycled row must not receive a departed endpoint's
+        old-key packets.  Every mutating entry point (add/remove
+        endpoint, DTLS install, video track/receiver attach) calls this
+        first."""
+        if self._pending_fanout:
+            self._flush_fanout()
 
     def _flush_fanout(self) -> None:
         pending, self._pending_fanout = self._pending_fanout, []

@@ -1,0 +1,134 @@
+"""VP9 SVC projection: per-receiver spatial/temporal subsetting of one
+layered stream (the layered twin of the VP8 simulcast forwarder)."""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.codecs import vp9
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.sfu.svc import Vp9SvcForwarder
+
+SSRC = 0xC0DE
+
+
+def _pkt(seq, pid, sid, tid, begin, end, key=False, marker=None):
+    desc = vp9.build_descriptor(
+        begin=begin, end=end, picture_id=pid, tid=tid, sid=sid,
+        tl0picidx=pid & 0xFF, inter_predicted=not key)
+    body = desc + bytes([0x80 | sid]) * 24
+    if marker is None:
+        marker = end and sid == 2
+    return rtp_header.build([body], [seq], [pid * 3000], [SSRC], [98],
+                            marker=[int(marker)], stream=[0])
+
+
+def _stream(n_pics, layers=3, key_every=6, start_seq=100):
+    """Pictures of `layers` spatial layers (tid alternates 0/1), one
+    packet per (picture, layer)."""
+    pkts, seq = [], start_seq
+    for p in range(n_pics):
+        key = (p % key_every) == 0
+        tid = 0 if key else (p % 2)
+        for s in range(layers):
+            pkts.append(_pkt(seq, 400 + p, s, tid, begin=(True),
+                             end=True, key=key and s == 0))
+            seq += 1
+    return pkts
+
+
+def _batch(pkts):
+    datas = [p.to_bytes(0) for p in pkts]
+    return PacketBatch.from_payloads(datas, stream=[0] * len(datas))
+
+
+def _seqs_and_markers(outs):
+    b = PacketBatch.from_payloads(outs)
+    h = rtp_header.parse(b)
+    return [int(s) for s in h.seq], [int(m) for m in h.marker]
+
+
+def test_base_layer_projection_is_gapless_and_remarked():
+    fwd = Vp9SvcForwarder(initial_sid=0)
+    outs = fwd.forward(_batch(_stream(6)))
+    # one packet per picture survives (sid 0), seq gapless from 0
+    assert len(outs) == 6
+    seqs, marks = _seqs_and_markers(outs)
+    assert seqs == list(range(6))
+    # every forwarded packet ends its (single-layer) picture: marker set
+    # even though the ORIGINAL marker rode the dropped sid-2 packet
+    assert all(m == 1 for m in marks)
+    assert fwd.dropped == 12
+
+
+def test_spatial_raise_waits_for_keyframe():
+    fwd = Vp9SvcForwarder(initial_sid=0)
+    pkts = _stream(13, key_every=6)        # keyframes at pictures 0, 6, 12
+    fwd.forward(_batch(pkts[:6]))          # pictures 0..1 projected @0
+    assert fwd.request_layers(sid=2) is True
+    assert fwd.awaiting_keyframe
+    # pictures 2..5: no keyframe yet -> still base layer only
+    outs = fwd.forward(_batch(pkts[6:18]))
+    assert len(outs) == 4 and fwd.current_sid == 0
+    # picture 6 is a keyframe: the raise lands, all 3 layers flow
+    outs = fwd.forward(_batch(pkts[18:21]))
+    assert fwd.current_sid == 2 and not fwd.awaiting_keyframe
+    assert len(outs) == 3
+    seqs, marks = _seqs_and_markers(outs)
+    assert seqs == sorted(seqs) and seqs[0] > 0    # continuous space
+    assert marks == [0, 0, 1]                      # top layer marks
+
+
+def test_temporal_downswitch_at_picture_boundary():
+    fwd = Vp9SvcForwarder(initial_sid=2)
+    pkts = _stream(8, key_every=100)       # keyframe only at picture 0
+    fwd.forward(_batch(pkts[:3]))
+    fwd.request_layers(tid=0)
+    outs = fwd.forward(_batch(pkts[3:]))
+    # odd pictures carry tid=1 and are dropped entirely
+    got = PacketBatch.from_payloads(outs)
+    d = vp9.parse_descriptors(got)
+    assert (np.asarray(d.tid)[np.asarray(d.valid)] <= 0).all()
+    seqs, _ = _seqs_and_markers(outs)
+    assert seqs == list(range(seqs[0], seqs[0] + len(seqs)))
+
+
+def test_redelivered_packet_keeps_its_output_seq():
+    fwd = Vp9SvcForwarder(initial_sid=0)
+    pkts = _stream(4)
+    fwd.forward(_batch(pkts))
+    # re-deliver picture 2's base-layer packet (e.g. RTX recovery):
+    # same original seq -> same output seq, not a fresh number
+    again = fwd.forward(_batch([pkts[6]]))
+    seqs, _ = _seqs_and_markers(again)
+    assert seqs == [2]
+
+
+def test_late_first_arrival_of_older_original_is_dropped():
+    """An upstream-lost kept packet recovered AFTER its successors were
+    compacted has no output hole left: dropped, not emitted with a
+    scrambled fresh seq (recovery rides the keyframe/PLI path)."""
+    fwd = Vp9SvcForwarder(initial_sid=0)
+    pkts = _stream(4)                      # originals 100,103,106,109...
+    fwd.forward(_batch([pkts[0], pkts[6], pkts[9]]))   # pic 0,2,3 kept
+    assert fwd.forwarded == 3
+    late = fwd.forward(_batch([pkts[3]]))  # pic 1 base, orig 103, late
+    assert late == [] and fwd.late_dropped == 1
+    # but a RE-delivery of an already-forwarded one still reuses its seq
+    again = fwd.forward(_batch([pkts[6]]))
+    seqs, _ = _seqs_and_markers(again)
+    assert seqs == [1]
+
+
+def test_marker_follows_actual_top_layer():
+    """Sender stops emitting upper layers: the marker re-derivation
+    follows the observed top (previous picture), not the stale target."""
+    fwd = Vp9SvcForwarder(initial_sid=2)
+    fwd.forward(_batch(_stream(2)))        # 3-layer pictures
+    only_base = [_pkt(900 + k, 500 + k, 0, 0, begin=True, end=True,
+                      key=(k == 0), marker=False) for k in range(3)]
+    outs = fwd.forward(_batch(only_base))
+    _, marks = _seqs_and_markers(outs)
+    # first base-only picture still judged against the 3-layer previous
+    # picture; from the next boundary on, markers flow again
+    assert marks[-1] == 1
