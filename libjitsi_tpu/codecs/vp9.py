@@ -48,9 +48,13 @@ class Vp9Descriptors:
     valid: np.ndarray
 
 
-def parse_descriptors(batch: PacketBatch) -> Vp9Descriptors:
-    """Vectorized draft-ietf-payload-vp9 §4.2 parse over RTP payloads."""
-    hdr = rtp_header.parse(batch)
+def parse_descriptors(batch: PacketBatch, hdr=None) -> Vp9Descriptors:
+    """Vectorized draft-ietf-payload-vp9 §4.2 parse over RTP payloads.
+
+    Pass `hdr` (a prior `rtp_header.parse(batch)`) to avoid re-parsing
+    on hot paths that already hold one (the SVC forwarder)."""
+    if hdr is None:
+        hdr = rtp_header.parse(batch)
     d = batch.data
     n, cap = d.shape
     ln = np.asarray(batch.length, dtype=np.int64)

@@ -42,6 +42,56 @@ from libjitsi_tpu.utils.logging import get_logger
 _log = get_logger("service.sfu")
 
 
+def _layer_for_bw(layer_bps, bw: float) -> int:
+    """Highest layer whose nominal rate fits the advertised bandwidth
+    (ascending rates; layer 0 always fits)."""
+    want = 0
+    for layer, bps in enumerate(layer_bps):
+        if bps <= bw:
+            want = layer
+    return want
+
+
+class _SvcTrack:
+    """One sender's VP9 SVC track: every layer in ONE SSRC, each
+    receiver gets a `Vp9SvcForwarder` projection (spatial/temporal
+    subsetting) instead of a simulcast stream pick.  Shares the
+    fan-out/RTX plumbing with `_VideoTrack` via the same duck surface
+    (fwd.forward, tx_sid/rtx_sid/rtx_seq, precache, out_ssrc)."""
+
+    def __init__(self, sender_sid: int, ssrc: int, svc_sid: int,
+                 layer_bps, rtx_pt: int):
+        from libjitsi_tpu.sfu.svc import Vp9SvcForwarder
+
+        self._fwd_cls = Vp9SvcForwarder
+        self.sender_sid = sender_sid
+        self.out_ssrc = ssrc & 0xFFFFFFFF     # projection keeps the ssrc
+        self.rtx_ssrc = (ssrc ^ _VideoTrack.RTX_SSRC_XOR) & 0xFFFFFFFF
+        self.layer_sids = [svc_sid]
+        self.layer_ssrcs = [self.out_ssrc]    # teardown/feedback key
+        self.layer_bps = [float(b) for b in layer_bps]
+        self.rtx_pt = rtx_pt
+        self.fwd: Dict[int, object] = {}
+        self.rtx_seq: Dict[int, int] = {}
+        self.tx_sid: Dict[int, int] = {}
+        self.rtx_sid: Dict[int, int] = {}
+        self.precache = PacketCache()
+
+    def make_forwarder(self):
+        return self._fwd_cls(initial_sid=0)
+
+    def select_layer(self, fwd, bw: float):
+        """Spatial-layer pick for `bw`; returns the SSRC to PLI when a
+        raise awaits a keyframe, else None."""
+        want = _layer_for_bw(self.layer_bps, bw)
+        if want != fwd.target_sid:
+            if fwd.request_layers(sid=want):
+                return self.out_ssrc
+        elif fwd.awaiting_keyframe:
+            return self.out_ssrc
+        return None
+
+
 class _VideoTrack:
     """One sender's simulcast video track inside an SfuBridge.
 
@@ -75,6 +125,21 @@ class _VideoTrack:
         self.tx_sid: Dict[int, int] = {}               # recv sid ->
         self.rtx_sid: Dict[int, int] = {}              # recv sid ->
         self.precache = PacketCache()                  # pre-SRTP copies
+
+    def make_forwarder(self):
+        return SimulcastForwarder(self.layer_ssrcs,
+                                  out_ssrc=self.out_ssrc)
+
+    def select_layer(self, fwd, bw: float):
+        """Simulcast-layer pick for `bw`; returns the layer SSRC to PLI
+        while a switch awaits its keyframe, else None."""
+        want = _layer_for_bw(self.layer_bps, bw)
+        if want != fwd.target_layer:
+            if fwd.request_layer(want):
+                return self.layer_ssrcs[want]
+        elif fwd.awaiting_keyframe:
+            return self.layer_ssrcs[fwd.target_layer]
+        return None
 
 
 class SfuBridge:
@@ -286,15 +351,36 @@ class SfuBridge:
                   layers=len(layer_sids))
         return track
 
-    def _attach_video_receiver(self, track: _VideoTrack,
-                               recv_sid: int) -> None:
+    def add_svc_track(self, sender_sid: int, ssrc: int, layer_bps,
+                      rtx_pt: int = 97) -> "_SvcTrack":
+        """Declare a joined endpoint's VP9 SVC track: one SSRC carrying
+        every spatial layer; each receiver gets a per-receiver
+        `Vp9SvcForwarder` projection (layer subsetting) driven by its
+        REMB, with the same RTX/PLI plumbing as simulcast.  layer_bps:
+        nominal cumulative rate per spatial layer, ascending."""
+        if sender_sid not in self._ssrc_of:
+            raise ValueError(f"sid {sender_sid} not joined")
+        self._quiesce_fanout()
+        svc_sid = self.registry.alloc(self)
+        self.rx_table.add_stream(svc_sid, *self._rx_keys[sender_sid])
+        self.registry.map_ssrc(ssrc, svc_sid)
+        self._transport_of[svc_sid] = sender_sid
+        track = _SvcTrack(sender_sid, ssrc, svc_sid, layer_bps, rtx_pt)
+        self._video[svc_sid] = track
+        for r in self._ssrc_of:
+            if r != sender_sid:
+                self._attach_video_receiver(track, r)
+        _log.info("svc_track_added", sid=sender_sid, ssrc=ssrc,
+                  layers=len(track.layer_bps))
+        return track
+
+    def _attach_video_receiver(self, track, recv_sid: int) -> None:
         if recv_sid == track.sender_sid or recv_sid in track.fwd:
             return
         if recv_sid not in self._tx_keys:
             # no leg keys yet (mid-DTLS): attach happens at install
             return
-        track.fwd[recv_sid] = SimulcastForwarder(
-            track.layer_ssrcs, out_ssrc=track.out_ssrc)
+        track.fwd[recv_sid] = track.make_forwarder()
         track.rtx_seq[recv_sid] = 0
         # the projection and its RTX stream each get a dedicated row
         # under this receiver's leg keys (RFC 4588: RTX is its own
@@ -352,17 +438,9 @@ class SfuBridge:
                 bw = self._recv_bw.get(r)
                 if bw is None:
                     continue
-                want = 0
-                for layer, bps in enumerate(track.layer_bps):
-                    if bps <= bw:
-                        want = layer
-                if want != fwd.target_layer:
-                    if fwd.request_layer(want):
-                        self.rtcp_term.request_keyframe(
-                            track.layer_ssrcs[want])
-                elif fwd.awaiting_keyframe:
-                    self.rtcp_term.request_keyframe(
-                        track.layer_ssrcs[fwd.target_layer])
+                kf_ssrc = track.select_layer(fwd, bw)
+                if kf_ssrc is not None:
+                    self.rtcp_term.request_keyframe(kf_ssrc)
 
     def _serve_video_nack(self, sid: int, nack: "rtcp.Nack") -> bool:
         """NACKed video returns as proper RTX encapsulation (not a raw
@@ -575,7 +653,8 @@ class SfuBridge:
             blobs = self.rtcp_term.make_sender_feedback(ssrc, now=now,
                                                         own_bps=own)
             # video senders also get per-layer feedback (the PLIs that
-            # gate a pending layer switch are keyed by layer SSRC)
+            # gate a pending layer switch are keyed by layer SSRC for
+            # simulcast, by the stream SSRC for SVC)
             for track in set(self._video.values()):
                 if track.sender_sid == sid:
                     for lssrc in track.layer_ssrcs:

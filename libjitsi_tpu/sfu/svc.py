@@ -89,7 +89,7 @@ class Vp9SvcForwarder:
         """Project one decrypted batch; returns rewritten (pre-SRTP)
         datagrams of the subset, in batch order."""
         hdr = rtp_header.parse(batch)
-        desc = vp9.parse_descriptors(batch)
+        desc = vp9.parse_descriptors(batch, hdr=hdr)
         out: List[bytes] = []
         for i in range(batch.batch_size):
             if not desc.valid[i]:
@@ -108,9 +108,12 @@ class Vp9SvcForwarder:
             self._pic_max_sid = max(self._pic_max_sid, sid)
             if (self.target_tid > self.current_tid
                     and desc.switching_up[i] == 1
-                    and tid <= self.target_tid):
-                # temporal raise at an explicit upswitch point (U bit)
-                self.current_tid = self.target_tid
+                    and self.current_tid < tid <= self.target_tid):
+                # temporal raise at an explicit upswitch point (U bit):
+                # step up to the U packet's OWN layer only — higher
+                # layers still need their own switch point (their
+                # frames may reference ones the receiver never got)
+                self.current_tid = tid
                 self.switches += 1
             if sid > self.current_sid or tid > self.current_tid:
                 self.dropped += 1
@@ -122,8 +125,11 @@ class Vp9SvcForwarder:
         return out
 
     def _on_picture_boundary(self, keyframe: bool, pid: int) -> None:
+        if self._cur_pid is not None:
+            # only a COMPLETED picture informs the observed-top-layer
+            # marker heuristic; the pre-stream zero must not
+            self._prev_pic_max_sid = self._pic_max_sid
         self._cur_pid = pid
-        self._prev_pic_max_sid = self._pic_max_sid
         self._pic_max_sid = 0
         changed = False
         # downswitches land at any picture boundary

@@ -503,3 +503,142 @@ def test_sfu_pipelined_fanout_delivers_everything():
     assert sfu.retransmitted > 0
     assert any(seq == 500 for _, seq in victim.got)
     sfu.close()
+
+
+@pytest.mark.slow
+def test_sfu_svc_track_projection_e2e():
+    """VP9 SVC through the assembled bridge: one SSRC carries two
+    spatial layers; the receiver's REMB drives the projection (raise
+    gated on a keyframe via PLI, downswitch at a picture boundary), the
+    receiver sees a gapless renumbered stream, and a NACKed projected
+    seq returns as RTX."""
+    from libjitsi_tpu.codecs import vp9
+    from libjitsi_tpu.core.packet import PacketBatch
+    from libjitsi_tpu.sfu import rtx as rtx_mod
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    sfu = SfuBridge(libjitsi_tpu.configuration_service(), port=0,
+                    capacity=16, recv_window_ms=0)
+    sender = _Endpoint(0xD0, sfu.port)
+    recv = _Endpoint(0xD1, sfu.port)
+    sid_s = sfu.add_endpoint(sender.ssrc, sender.rx_key, sender.tx_key)
+    sid_r = sfu.add_endpoint(recv.ssrc, recv.rx_key, recv.tx_key)
+    recv.send_media(1)
+    svc_ssrc = 0xD00
+    track = sfu.add_svc_track(sid_s, svc_ssrc,
+                              layer_bps=[100e3, 1e6])
+    fwd = track.fwd[sid_r]
+
+    tx = SrtpStreamTable(capacity=1)
+    tx.add_stream(0, *sender.rx_key)
+    fb = SrtpStreamTable(capacity=1)
+    fb.add_stream(0, *sender.tx_key)
+    rxt = SrtpStreamTable(capacity=2)
+    rxt.add_stream(0, *recv.tx_key)            # projected stream
+    rxt.add_stream(1, *recv.tx_key)            # RTX stream
+    state = {"seq": 100, "pic": 300}
+
+    def send_pic(key=False):
+        # every call is a NEW picture (the forwarder's switch logic
+        # lands at picture boundaries, keyed by picture id)
+        p = state["pic"]
+        state["pic"] += 1
+        pkts = []
+        for s in range(2):
+            desc = vp9.build_descriptor(
+                begin=True, end=True, picture_id=p & 0x7FFF,
+                tid=0, sid=s, tl0picidx=p & 0xFF,
+                inter_predicted=not (key and s == 0))
+            pkts.append(desc + bytes([0x90 + s]) * 40)
+        b = rtp_header.build(pkts, [state["seq"], state["seq"] + 1],
+                             [p * 3000] * 2, [svc_ssrc] * 2, [98] * 2,
+                             marker=[0, 1], stream=[0, 0])
+        state["seq"] += 2
+        sender.engine.send_batch(tx.protect_rtp(b), "127.0.0.1",
+                                 sfu.port)
+
+    got_seqs, got_sids, rtx_osn = [], [], []
+
+    def drain():
+        back, _, _ = recv.engine.recv_batch(timeout_ms=2)
+        if not back.batch_size:
+            return
+        hdr0 = rtp_header.parse(back)
+        rowmap = {svc_ssrc: 0, track.rtx_ssrc: 1}
+        back.stream[:] = [rowmap.get(int(s), -1) for s in hdr0.ssrc]
+        keep = np.nonzero(np.asarray(back.stream) >= 0)[0]
+        if len(keep) == 0:
+            return
+        sub = PacketBatch(back.data[keep],
+                          np.asarray(back.length)[keep],
+                          back.stream[keep])
+        dec, ok = rxt.unprotect_rtp(sub)
+        hdr = rtp_header.parse(dec)
+        vid = np.nonzero(ok & (np.asarray(dec.stream) == 0))[0]
+        if len(vid):
+            vb = PacketBatch(dec.data[vid],
+                             np.asarray(dec.length)[vid],
+                             dec.stream[vid])
+            d = vp9.parse_descriptors(vb)
+            got_seqs.extend(int(s) for s in rtp_header.parse(vb).seq)
+            got_sids.extend(int(s) for s in np.asarray(d.sid))
+        for i in np.nonzero(ok & (np.asarray(dec.stream) == 1))[0]:
+            one = PacketBatch(dec.data[i:i + 1],
+                              np.asarray(dec.length)[i:i + 1],
+                              dec.stream[i:i + 1])
+            _res, osn = rtx_mod.decapsulate_batch(one, svc_ssrc, 98)
+            rtx_osn.append(int(osn[0]))
+
+    def sender_handle_feedback():
+        back, _, _ = sender.engine.recv_batch(timeout_ms=3)
+        if not back.batch_size:
+            return False
+        back.stream[:] = 0
+        dec, ok = fb.unprotect_rtcp(back)
+        saw = False
+        for i in np.nonzero(np.asarray(ok))[0]:
+            try:
+                pkts = rtcp.parse_compound(dec.to_bytes(int(i)))
+            except ValueError:
+                continue
+            saw |= any(isinstance(p, rtcp.Pli)
+                       and p.media_ssrc == svc_ssrc for p in pkts)
+        return saw
+
+    def run(rounds, t0, remb, key_on_pli=False):
+        for t in range(rounds):
+            send_pic()
+            blob = rtcp.build_compound([rtcp.build_remb(rtcp.Remb(
+                recv.ssrc, int(remb), [svc_ssrc]))])
+            b = PacketBatch.from_payloads([blob], stream=[0])
+            recv.engine.send_batch(recv.protect.protect_rtcp(b),
+                                   "127.0.0.1", sfu.port)
+            for _ in range(10):
+                sfu.tick(now=60.0 + (t0 + t) * 0.1)
+            sfu.emit_feedback(now=60.0 + (t0 + t) * 0.1)
+            if sender_handle_feedback() and key_on_pli:
+                send_pic(key=True)
+                for _ in range(10):
+                    sfu.tick(now=60.0 + (t0 + t) * 0.1)
+            drain()
+
+    run(4, 0, remb=150_000)                 # base layer only
+    assert fwd.current_sid == 0
+    assert got_sids and max(got_sids) == 0
+    run(8, 4, remb=1_500_000, key_on_pli=True)   # raise: needs keyframe
+    assert fwd.current_sid == 1, "SVC raise never landed"
+    assert 1 in got_sids
+    run(4, 12, remb=150_000)                # starve: boundary downswitch
+    assert fwd.current_sid == 0
+    # gapless output seq space across every projection change
+    assert got_seqs == list(range(got_seqs[0],
+                                  got_seqs[0] + len(got_seqs)))
+    # NACK on a projected seq comes back as RTX with that OSN
+    want = got_seqs[-1]
+    recv.send_nack(svc_ssrc, [want])
+    for _ in range(10):
+        sfu.tick(now=60.0 + 16 * 0.1 + 0.05)
+    drain()
+    assert want in rtx_osn, f"seq {want} not re-delivered as RTX"
+    sfu.close()
