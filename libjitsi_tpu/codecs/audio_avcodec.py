@@ -1,0 +1,148 @@
+"""G.729 / iLBC / G.723.1 decode via the system libavcodec.
+
+Parity target: the reference's `...codec.audio.{g729,ilbc}.*` (SURVEY
+§2.5).  Those rows were recorded as lib-blocked in rounds 1-2 (no
+libbcg729/libilbc in the image) — but the system libavcodec 59 ships
+NATIVE decoders for g729, ilbc and g723_1, so the decode half closes
+through the same validated ctypes binding `codecs.avcodec` built for
+H.264 (AVOptions-only context config, probed AVFrame/AVPacket prefix
+offsets).  FFmpeg has no native encoders for these codecs, so the
+encode half remains honestly unavailable until a system encoder lib
+appears; conference legs that must SEND these codecs keep using G.711
+(the gateway posture the reference's SILK row takes vs Opus).
+
+Frame sizes (detected by the decoders from packet length):
+  g729    10 B / frame -> 80 samples  (10 ms @ 8 kHz)
+  ilbc    38 B -> 160 samples (20 ms) or 50 B -> 240 samples (30 ms)
+  g723_1  24 B -> 240 samples (30 ms @ 8 kHz; 6.3 kbit/s frames)
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List
+
+import numpy as np
+
+from libjitsi_tpu.codecs.avcodec import (_AVERROR_EAGAIN, _AVERROR_EOF,
+                                         _AvHandle, _F_DATA, _F_FMT,
+                                         _geti, _getp, _load)
+
+_F_NB_SAMPLES = 112          # FFmpeg 5.x AVFrame prefix (after w/h)
+_MAX_SAMPLES = 48_000        # refuse implausible counts (offset guard)
+_P_DATA, _P_SIZE = 24, 32
+_SAMPLE_FMT_S16, _SAMPLE_FMT_S16P = 1, 6
+
+_DECODERS = {"g729": 8000, "ilbc": 8000, "g723_1": 8000}
+
+
+def audio_decoder_available(name: str) -> bool:
+    try:
+        av, _ = _load()
+    except Exception:
+        return False
+    return bool(av.avcodec_find_decoder_by_name(name.encode()))
+
+
+class AvAudioDecoder(_AvHandle):
+    """Mono S16 frame decoder over libavcodec (g729/ilbc/g723_1)."""
+
+    def __init__(self, codec_name: str):
+        if codec_name not in _DECODERS:
+            raise ValueError(f"unsupported codec {codec_name!r}")
+        av, u = _load()
+        # probe the one offset the video binding doesn't: a fresh
+        # AVFrame must read nb_samples == 0 (the binding's refuse-to-
+        # run-on-layout-mismatch doctrine; _MAX_SAMPLES bounds the
+        # count again after every decode)
+        fr = u.av_frame_alloc()
+        nb0 = _geti(fr, _F_NB_SAMPLES)
+        u.av_frame_free(ctypes.byref(ctypes.c_void_p(fr)))
+        if nb0 != 0:
+            raise RuntimeError(
+                "AVFrame nb_samples offset mismatch (fresh frame read "
+                f"{nb0}); refusing raw offsets")
+        codec = av.avcodec_find_decoder_by_name(codec_name.encode())
+        if not codec:
+            raise RuntimeError(
+                f"{codec_name} decoder not present in libavcodec")
+        self._av, self._u = av, u
+        self.codec_name = codec_name
+        self.sample_rate = _DECODERS[codec_name]
+        ctx = av.avcodec_alloc_context3(codec)
+        # AVOptions only (name-based, version-stable): sample rate +
+        # mono; the decoders refuse to open without a channel count
+        u.av_opt_set_int.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                     ctypes.c_int64, ctypes.c_int]
+        u.av_opt_set_int(ctx, b"ar", self.sample_rate, 0)
+        u.av_opt_set_int(ctx, b"ac", 1, 0)
+        if av.avcodec_open2(ctx, codec, None) != 0:
+            raise RuntimeError(f"avcodec_open2({codec_name}) failed")
+        self._ctx = ctx
+        self._pkt = av.av_packet_alloc()
+        self._fr = u.av_frame_alloc()
+
+    def decode(self, frame: bytes) -> np.ndarray:
+        """One codec frame -> int16 PCM [samples] (mono).
+
+        G.729 Annex-B SID (comfort-noise) frames — 2 bytes, standard
+        with VAD — return empty PCM rather than erroring: callers fill
+        silence, same as a DTX gap."""
+        if self.codec_name == "g729" and len(frame) <= 2:
+            return np.zeros(0, dtype=np.int16)
+        av = self._av
+        pkt = self._pkt
+        if av.av_new_packet(pkt, len(frame)) != 0:
+            raise RuntimeError("av_new_packet failed")
+        ctypes.memmove(_getp(pkt, _P_DATA), frame, len(frame))
+        r = av.avcodec_send_packet(self._ctx, pkt)
+        av.av_packet_unref(pkt)
+        if r != 0:
+            raise ValueError(
+                f"{self.codec_name} rejected a {len(frame)}-byte frame "
+                f"({r})")
+        out = self._drain()
+        if not out:
+            return np.zeros(0, dtype=np.int16)
+        return np.concatenate(out)
+
+    def _drain(self) -> List[np.ndarray]:
+        av, u = self._av, self._u
+        fr = self._fr
+        out: List[np.ndarray] = []
+        while True:
+            r = av.avcodec_receive_frame(self._ctx, fr)
+            if r != 0:
+                if r in (_AVERROR_EAGAIN, _AVERROR_EOF):
+                    return out
+                raise RuntimeError(f"avcodec_receive_frame: {r}")
+            fmt = _geti(fr, _F_FMT)
+            if fmt not in (_SAMPLE_FMT_S16, _SAMPLE_FMT_S16P):
+                u.av_frame_unref(fr)
+                raise RuntimeError(
+                    f"unexpected sample format {fmt} from "
+                    f"{self.codec_name} (want S16/S16P)")
+            n = _geti(fr, _F_NB_SAMPLES)
+            if not 0 < n <= _MAX_SAMPLES:
+                u.av_frame_unref(fr)
+                raise RuntimeError(
+                    f"implausible nb_samples {n} (layout drift?)")
+            ptr = _getp(fr, _F_DATA)       # mono: plane 0 either way
+            pcm = np.frombuffer(ctypes.string_at(ptr, n * 2),
+                                dtype=np.int16).copy()
+            out.append(pcm)
+            u.av_frame_unref(fr)
+
+    # close()/__del__ inherited from _AvHandle
+
+
+def g729_decoder() -> AvAudioDecoder:
+    return AvAudioDecoder("g729")
+
+
+def ilbc_decoder() -> AvAudioDecoder:
+    return AvAudioDecoder("ilbc")
+
+
+def g723_1_decoder() -> AvAudioDecoder:
+    return AvAudioDecoder("g723_1")

@@ -115,6 +115,35 @@ def _probe_abi(av, u) -> None:
     av.av_packet_free(ctypes.byref(ctypes.c_void_p(pkt)))
 
 
+class _AvHandle:
+    """Shared lifecycle for (codec context, packet, frame) triples —
+    one teardown implementation for every codec class in this binding."""
+
+    _ctx = 0
+    _pkt = 0
+    _fr = 0
+
+    def close(self) -> None:
+        if getattr(self, "_ctx", 0):
+            self._av.avcodec_free_context(
+                ctypes.byref(ctypes.c_void_p(self._ctx)))
+            self._ctx = 0
+        if getattr(self, "_pkt", 0):
+            self._av.av_packet_free(
+                ctypes.byref(ctypes.c_void_p(self._pkt)))
+            self._pkt = 0
+        if getattr(self, "_fr", 0):
+            self._u.av_frame_free(
+                ctypes.byref(ctypes.c_void_p(self._fr)))
+            self._fr = 0
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
 def h264_available() -> bool:
     try:
         av, _ = _load()
@@ -137,7 +166,7 @@ def _drain_packets(av, ctx, pkt) -> List[bytes]:
         av.av_packet_unref(pkt)
 
 
-class H264Encoder:
+class H264Encoder(_AvHandle):
     """Encode I420 frames to H.264 Annex-B access units (libx264)."""
 
     def __init__(self, width: int, height: int, fps: int = 30,
@@ -207,28 +236,8 @@ class H264Encoder:
         av.avcodec_send_frame(self._ctx, None)
         return _drain_packets(av, self._ctx, self._pkt)
 
-    def close(self) -> None:
-        if self._ctx:
-            self._av.avcodec_free_context(
-                ctypes.byref(ctypes.c_void_p(self._ctx)))
-            self._ctx = 0
-        if self._pkt:
-            self._av.av_packet_free(
-                ctypes.byref(ctypes.c_void_p(self._pkt)))
-            self._pkt = 0
-        if self._fr:
-            self._u.av_frame_free(
-                ctypes.byref(ctypes.c_void_p(self._fr)))
-            self._fr = 0
 
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
-
-
-class H264Decoder:
+class H264Decoder(_AvHandle):
     """Decode H.264 Annex-B access units to I420 frames."""
 
     def __init__(self):
@@ -271,26 +280,6 @@ class H264Decoder:
     def flush(self) -> List[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
         self._av.avcodec_send_packet(self._ctx, None)
         return self._drain()
-
-    def close(self) -> None:
-        if self._ctx:
-            self._av.avcodec_free_context(
-                ctypes.byref(ctypes.c_void_p(self._ctx)))
-            self._ctx = 0
-        if self._pkt:
-            self._av.av_packet_free(
-                ctypes.byref(ctypes.c_void_p(self._pkt)))
-            self._pkt = 0
-        if self._fr:
-            self._u.av_frame_free(
-                ctypes.byref(ctypes.c_void_p(self._fr)))
-            self._fr = 0
-
-    def __del__(self):
-        try:
-            self.close()
-        except Exception:
-            pass
 
     def _drain(self):
         av, u = self._av, self._u

@@ -1,0 +1,69 @@
+"""G.729 / iLBC / G.723.1 decode via the system libavcodec — the rows
+recorded as lib-blocked in rounds 1-2 close (decode half) through the
+validated avcodec ctypes binding."""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.codecs.audio_avcodec import (AvAudioDecoder,
+                                               audio_decoder_available)
+
+def _need(name):
+    if not audio_decoder_available(name):
+        pytest.skip(f"libavcodec without the {name} decoder")
+
+
+@pytest.mark.parametrize("name,frame_bytes,samples", [
+    ("g729", 10, 80),        # 10 ms @ 8 kHz
+    ("ilbc", 38, 160),       # 20 ms mode
+    ("g723_1", 24, 240),     # 6.3 kbit/s 30 ms frames
+])
+def test_frame_geometry(name, frame_bytes, samples):
+    _need(name)
+    d = AvAudioDecoder(name)
+    rng = np.random.default_rng(7)
+    for _ in range(4):
+        frame = rng.integers(0, 256, frame_bytes,
+                             dtype=np.uint8).tobytes()
+        if name == "g723_1":
+            # frame type rides the low 2 bits of byte 0: force 6.3k
+            frame = bytes([frame[0] & ~0x03]) + frame[1:]
+        pcm = d.decode(frame)
+        assert pcm.dtype == np.int16 and len(pcm) == samples
+    assert d.sample_rate == 8000
+    d.close()
+
+
+def test_deterministic_and_stateful():
+    """Same input stream -> same output; the decoder carries state
+    across frames (predictors), so a replayed stream matches exactly."""
+    _need("g729")
+    frames = [bytes([i] * 10) for i in range(6)]
+    a, b = AvAudioDecoder("g729"), AvAudioDecoder("g729")
+    out_a = np.concatenate([a.decode(f) for f in frames])
+    out_b = np.concatenate([b.decode(f) for f in frames])
+    assert np.array_equal(out_a, out_b)
+    assert np.abs(out_a.astype(np.int64)).max() > 0
+    a.close()
+    b.close()
+
+
+def test_bad_frame_is_an_error_not_corruption():
+    _need("g729")
+    d = AvAudioDecoder("g729")
+    with pytest.raises(ValueError):
+        d.decode(b"\x01\x02\x03")      # not a whole G.729 frame
+    # decoder still usable afterwards
+    assert len(d.decode(bytes(10))) == 80
+    d.close()
+
+
+def test_g729_sid_frames_are_silence_not_errors():
+    """RFC 3551 Annex B comfort-noise frames (2 bytes) appear in any
+    VAD-enabled G.729 stream: they yield empty PCM, not a crash."""
+    _need("g729")
+    d = AvAudioDecoder("g729")
+    assert len(d.decode(bytes(10))) == 80
+    assert len(d.decode(b"\x12\x34")) == 0     # SID -> DTX gap
+    assert len(d.decode(bytes(10))) == 80      # stream continues
+    d.close()
