@@ -113,6 +113,31 @@ def smoke_mixer(platform: str) -> None:
           f"{platform}")
 
 
+def smoke_pallas_aes(platform: str) -> None:
+    """The lane-native Pallas bitsliced-AES kernel must LOWER and match
+    the XLA twin on the real chip — exactly the Mosaic regression class
+    the CPU-mesh suite cannot see (round 2 shipped a kernel that only
+    failed on hardware)."""
+    import jax
+
+    from libjitsi_tpu.kernels.aes import expand_keys_batch
+    from libjitsi_tpu.kernels.aes_bitsliced import (
+        aes_encrypt_bitsliced, aes_encrypt_pallas_bitsliced)
+
+    rng = np.random.default_rng(11)
+    b = 128                                 # one lane tile
+    rks = expand_keys_batch(rng.integers(0, 256, (b, 16), dtype=np.uint8))
+    blocks = rng.integers(0, 256, (b, 16), dtype=np.uint8)
+    got_dev = aes_encrypt_pallas_bitsliced(rks, blocks)
+    jax.block_until_ready(got_dev)
+    got = np.asarray(got_dev)
+    want = np.asarray(aes_encrypt_bitsliced(rks, blocks))
+    assert np.array_equal(got, want), \
+        f"Pallas bitsliced AES != XLA twin on {platform}"
+    print(f"[smoke] Pallas bitsliced AES lowers + bit-exact on "
+          f"{platform}")
+
+
 def main() -> int:
     import jax
 
@@ -124,6 +149,7 @@ def main() -> int:
               "exercises the CPU backend")
     smoke_srtp(platform)
     smoke_mixer(platform)
+    smoke_pallas_aes(platform)
     print("[smoke] PASS")
     return 0
 

@@ -67,3 +67,19 @@ def test_g729_sid_frames_are_silence_not_errors():
     assert len(d.decode(b"\x12\x34")) == 0     # SID -> DTX gap
     assert len(d.decode(bytes(10))) == 80      # stream continues
     d.close()
+
+
+def test_g729_multiframe_rtp_payload():
+    """RFC 3551: a 20 ms G.729 RTP payload is two 10-byte frames (plus
+    an optional SID tail) -> 160 samples."""
+    _need("g729")
+    d = AvAudioDecoder("g729")
+    assert len(d.decode_payload(bytes(20))) == 160
+    assert len(d.decode_payload(bytes(20) + b"\x11\x22")) == 160
+    d.close()
+
+
+def test_ilbc_30ms_mode_refused_not_misdecoded():
+    _need("ilbc")
+    with pytest.raises(RuntimeError):
+        AvAudioDecoder("ilbc", ilbc_mode_ms=30)
