@@ -194,3 +194,79 @@ def test_media_loop_hold_queues_and_releases():
                  np.full(3, 5555, np.uint16))
     loop.tick()
     assert loop.release_stream(2) == 2
+
+
+@pytest.mark.slow      # rides OpenSSL's real flight-timer backoff
+def test_association_table_spoofed_hello_cannot_lock_out_peer():
+    """A spoofed-source ClientHello may bind the pending row's address
+    first, but with cookie_exchange it can never round-trip the cookie,
+    so it never 'progresses' — the real peer supersedes the binding
+    (via its own flight retransmission) and completes."""
+    import time as _t
+
+    from libjitsi_tpu.control.dtls import DtlsAssociationTable
+
+    class _Eng:
+        def __init__(self):
+            self.out = []
+
+        def send_batch(self, batch, ip, port):
+            for i in range(batch.batch_size):
+                self.out.append((batch.to_bytes(i), (ip, port)))
+            return batch.batch_size
+
+    class _Loop:
+        def __init__(self):
+            self.addr_ip = np.zeros(8, np.uint32)
+            self.addr_port = np.zeros(8, np.uint16)
+            self.engine = _Eng()
+            self.released = []
+
+        def hold_stream(self, sid, max_packets=64):
+            pass
+
+        def release_stream(self, sid):
+            self.released.append(sid)
+            return 0
+
+        def discard_stream(self, sid):
+            pass
+
+    installed = []
+    loop = _Loop()
+    table = DtlsAssociationTable(
+        loop, SrtpProfile.AES_CM_128_HMAC_SHA1_80,
+        lambda sid, ep: installed.append(sid))
+    server_ep = table.join(3, role="server", cookie_exchange=True)
+
+    client = DtlsSrtpEndpoint("client")
+    first_flight = client.handshake_packets()
+    spoofed, real = (0x0A090909, 6666), (0x0A000002, 5004)
+    # attacker races the ClientHello bytes from a spoofed source: binds
+    # the row, receives the HelloVerifyRequest it can never answer
+    for d in first_flight:
+        table.on_dtls(d, spoofed)
+    assert table.addr_of[spoofed] == 3 and not server_ep.progressed
+
+    # the real peer drives from its own address; its retransmission
+    # timer re-elicits the HVR after the supersede (real-time: ~1-2 s)
+    pend = list(first_flight)
+    t0 = _t.time()
+    while not (client.complete and installed) and _t.time() - t0 < 40:
+        nxt = []
+        for d in pend:
+            for r in table.on_dtls(d, real):
+                nxt.extend(client.feed(r))
+        nxt.extend(client.tick())
+        table.tick()                     # server-side flight resends
+        for d, addr in loop.engine.out:
+            if addr == real:
+                nxt.extend(client.feed(d))
+        loop.engine.out.clear()
+        pend = nxt
+        _t.sleep(0.05)
+    assert installed == [3], "real peer never completed"
+    assert table.addr_of.get(real) == 3
+    assert loop.released == [3]
+    # the authenticated handshake's address latched for media return
+    assert int(loop.addr_port[3]) == real[1]
