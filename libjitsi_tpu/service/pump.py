@@ -106,6 +106,37 @@ def speex_codec(mode: str = "nb") -> FrameCodec:
         lambda b: dec.decode(b))
 
 
+def _no_encoder(name: str):
+    """Encode stub for receive-only codec legs (no encoder in image)."""
+    def enc(pcm):
+        raise RuntimeError(
+            f"no {name} encoder in this image — receive-only leg "
+            "(ReceivePump/ReceiveBank); send with G.711/Opus instead")
+    return enc
+
+
+def g729_rx_codec(ptime_ms: int = 20) -> FrameCodec:
+    """G.729 RECEIVE-ONLY leg (decode via the system libavcodec; the
+    image ships no G.729 encoder, so `encode` raises — reply legs use
+    `g711_codec()`/`opus_codec()`, the gateway posture).  RFC 3551:
+    pt 18, 8 kHz, N x 10-byte frames per packet (+ optional SID)."""
+    from libjitsi_tpu.codecs.audio_avcodec import g729_decoder
+
+    dec = g729_decoder()
+    n = 8000 * ptime_ms // 1000
+    return FrameCodec("G729", 18, 8000, n, n, _no_encoder("G.729"),
+                      lambda b: dec.decode_payload(b))
+
+
+def ilbc_rx_codec() -> FrameCodec:
+    """iLBC (RFC 3952, mode=20) receive-only leg; see g729_rx_codec."""
+    from libjitsi_tpu.codecs.audio_avcodec import ilbc_decoder
+
+    dec = ilbc_decoder()
+    return FrameCodec("iLBC", 97, 8000, 160, 160, _no_encoder("iLBC"),
+                      lambda b: dec.decode_payload(b))
+
+
 def opus_codec(ptime_ms: int = 20, bitrate: int = 32000) -> FrameCodec:
     from libjitsi_tpu.codecs.opus import OpusDecoder, OpusEncoder
 

@@ -83,3 +83,31 @@ def test_ilbc_30ms_mode_refused_not_misdecoded():
     _need("ilbc")
     with pytest.raises(RuntimeError):
         AvAudioDecoder("ilbc", ilbc_mode_ms=30)
+
+
+def test_g729_receive_only_leg_through_receive_bank():
+    """The decode-only codecs plug into the dense receive plane: a
+    G.729 stream lands in a ReceiveBank row, decodes per tick, and
+    deposits into the mixer; the encode direction refuses loudly."""
+    _need("g729")
+    from libjitsi_tpu.conference.mixer import AudioMixer
+    from libjitsi_tpu.rtp import header as rtp_header
+    from libjitsi_tpu.service.pump import ReceiveBank, g729_rx_codec
+
+    codec = g729_rx_codec()
+    with pytest.raises(RuntimeError):
+        codec.encode(np.zeros(160, np.int16))
+
+    mixer = AudioMixer(capacity=4, frame_samples=160)
+    bank = ReceiveBank(4, mixer=mixer, mixer_rate=8000)
+    bank.add_stream(1, codec)
+    mixer.add_participant(1)
+    now = 30.0
+    for k in range(4):
+        b = rtp_header.build([bytes(20)], [600 + k], [160 * k],
+                             [0xAA] * 1, [18], stream=[1])
+        bank.push_decrypted(b, np.ones(1, bool), now=now + k * 0.02)
+    sids, pcms = bank.tick(now=now + 0.081)
+    assert 1 in sids
+    assert len(pcms[sids.index(1)]) == 160
+    assert bank.decoded_frames[1] >= 1
