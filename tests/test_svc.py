@@ -132,3 +132,60 @@ def test_marker_follows_actual_top_layer():
     # first base-only picture still judged against the 3-layer previous
     # picture; from the next boundary on, markers flow again
     assert marks[-1] == 1
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_svc_projection_properties_random_trace(seed):
+    """Property check over random traces (loss, reorder within a
+    window, REMB-driven target changes): every forwarded packet is
+    within the layer targets that were CURRENT at its picture, output
+    seqs are strictly increasing with no gaps among first deliveries,
+    and spatial raises only ever land on keyframe pictures."""
+    rng = np.random.default_rng(seed)
+    fwd = Vp9SvcForwarder(initial_sid=0)
+    layers = 3
+    seq = 200
+    sent = []                       # (orig_seq, pid, sid, key)
+    for p in range(60):
+        key = p % 12 == 0
+        for s in range(layers):
+            sent.append((seq, 700 + p, s, key))
+            seq += 1
+    # drop ~10%, reorder within a small window
+    keep = [pkt for pkt in sent if rng.random() > 0.10]
+    for _ in range(len(keep) // 5):
+        a = int(rng.integers(0, len(keep) - 1))
+        b = min(len(keep) - 1, a + int(rng.integers(1, 3)))
+        keep[a], keep[b] = keep[b], keep[a]
+
+    out_seqs, out_sids = [], []
+    raise_pics = []
+    for i, (q, pid, s, key) in enumerate(keep):
+        if i % 17 == 5:            # REMB churn
+            want = int(rng.integers(0, layers))
+            fwd.request_layers(sid=want)
+        before = fwd.current_sid
+        outs = fwd.forward(_batch([_pkt(q, pid, s, 0, begin=True,
+                                        end=True, key=key and s == 0)]))
+        if fwd.current_sid > before:
+            raise_pics.append(pid)
+        for o in outs:
+            b2 = PacketBatch.from_payloads([o])
+            h = rtp_header.parse(b2)
+            d = vp9.parse_descriptors(b2)
+            out_seqs.append(int(h.seq[0]))
+            sid_out = max(int(np.asarray(d.sid)[0]), 0)
+            out_sids.append(sid_out)
+            # the layer-target property, asserted per packet: nothing
+            # above the projection's CURRENT spatial layer is emitted
+            assert sid_out <= fwd.current_sid, \
+                (sid_out, fwd.current_sid, pid)
+
+    # gapless, strictly increasing output space (first deliveries only)
+    assert out_seqs == list(range(out_seqs[0],
+                                  out_seqs[0] + len(out_seqs)))
+    # spatial raises landed only on keyframe pictures
+    key_pids = {700 + p for p in range(60) if p % 12 == 0}
+    assert set(raise_pics) <= key_pids, (raise_pics, key_pids)
+    assert fwd.forwarded == len(out_seqs)
+    assert out_sids, "trace forwarded nothing"
