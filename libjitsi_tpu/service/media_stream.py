@@ -86,6 +86,24 @@ class StreamRegistry:
         self.streams[sid] = stream
         return sid
 
+    def reserve(self, sid: int, stream) -> None:
+        """Claim a SPECIFIC row (checkpoint restore: a resumed bridge
+        must reoccupy its old sids so SRTP rows and demux keep lining
+        up).  Raises if the row is already taken."""
+        self.reserve_many([sid], stream)
+
+    def reserve_many(self, sids, stream) -> None:
+        """Bulk `reserve`: one pass over the free list regardless of
+        how many rows a restore reclaims (a 10k-endpoint resume must
+        not pay len(free) per row)."""
+        want = {int(s) for s in sids}
+        taken = want - set(self._free)
+        if taken:
+            raise ValueError(f"sids not free: {sorted(taken)}")
+        self._free = [s for s in self._free if s not in want]
+        for s in want:
+            self.streams[s] = stream
+
     def release(self, sid: int) -> None:
         self.streams.pop(sid, None)
         for tx, rx in self._srtp.values():

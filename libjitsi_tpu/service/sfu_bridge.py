@@ -693,6 +693,87 @@ class SfuBridge:
         return {"rx": rx, "forwarded": self.forwarded,
                 "retransmitted": self.retransmitted}
 
+    # ----------------------------------------------------------- resume
+    def snapshot(self) -> dict:
+        """Checkpoint the conference's durable state (SURVEY §5): SRTP
+        indices + replay windows (both tables), the per-sender BWE bank,
+        endpoint rows/keys/SSRCs, receiver REMBs and latched addresses —
+        a restarted bridge resumes mid-conference without re-keying, so
+        senders' SRTP counters keep authenticating and nothing glitches.
+
+        Transient state is deliberately excluded and re-established by
+        the protocol itself: mid-handshake DTLS endpoints (keyless —
+        they rejoin via signaling and fresh flights), video tracks
+        (re-attach via add_video_track/add_svc_track; their forwarders
+        re-anchor on the next keyframe), and the NACK caches (age out
+        in ~1 s anyway).
+        """
+        self._quiesce_fanout()
+        keyed = {sid: ssrc for sid, ssrc in self._ssrc_of.items()
+                 if sid in self._tx_keys}
+        return {
+            "capacity": self.capacity,
+            "profile": self.profile.name,
+            "ast_ext_id": self.ast_ext_id,
+            "rx_table": self.rx_table.snapshot(),
+            "tx_table": self.tx_table.snapshot(),
+            "bwe": self.bwe.snapshot(),
+            "bwe_fed": self._bwe_fed.copy(),
+            "ssrc_of": keyed,
+            "rx_keys": dict(self._rx_keys),
+            "tx_keys": dict(self._tx_keys),
+            "recv_bw": {s: bw for s, bw in self._recv_bw.items()
+                        if s in keyed},
+            "addr_ip": self.loop.addr_ip.copy(),
+            "addr_port": self.loop.addr_port.copy(),
+        }
+
+    @classmethod
+    def restore(cls, config, snap: dict, port: int = 0,
+                **kwargs) -> "SfuBridge":
+        """Resume a snapshotted conference (fresh socket on `port`).
+
+        Endpoint rows reoccupy their exact old sids (registry.reserve)
+        so the restored SRTP tables and SSRC demux line up; the
+        translator re-derives its per-leg session keys from the stored
+        leg master keys (derivation is deterministic, RFC 3711 KDF).
+        """
+        from libjitsi_tpu.transform.srtp import SrtpStreamTable as _T
+
+        bridge = cls(config, port=port, capacity=snap["capacity"],
+                     profile=SrtpProfile[snap["profile"]],
+                     abs_send_time_ext_id=snap["ast_ext_id"], **kwargs)
+        bridge.rx_table = _T.restore(snap["rx_table"])
+        bridge.tx_table = _T.restore(snap["tx_table"])
+        bridge.bwe = BatchedRemoteBitrateEstimator.restore(snap["bwe"])
+        bridge._bwe_fed = np.asarray(snap["bwe_fed"]).copy()
+        bridge._rx_keys = dict(snap["rx_keys"])
+        bridge._tx_keys = dict(snap["tx_keys"])
+        bridge._recv_bw = dict(snap["recv_bw"])
+        sids = sorted(snap["ssrc_of"])
+        bridge.registry.reserve_many(sids, bridge)
+        for sid in sids:
+            ssrc = snap["ssrc_of"][sid]
+            bridge.registry.map_ssrc(ssrc, sid)
+            bridge._ssrc_of[sid] = ssrc
+        bridge.translator.add_receivers(
+            sids, [bridge._tx_keys[s][0] for s in sids],
+            [bridge._tx_keys[s][1] for s in sids])
+        bridge._rebuild_routes()
+        # per-row state copies only onto RESERVED rows; anything else
+        # (old video layer rows, departed endpoints) must come back
+        # zeroed or a later alloc of that row would inherit a stale
+        # latched address / BWE estimate
+        keep = np.zeros(snap["capacity"], dtype=bool)
+        keep[sids] = True
+        bridge.loop.addr_ip[:] = np.where(keep, snap["addr_ip"], 0)
+        bridge.loop.addr_port[:] = np.where(keep, snap["addr_port"], 0)
+        bridge._bwe_fed &= keep
+        stale = np.nonzero(~keep)[0]
+        if len(stale):
+            bridge.bwe.reset_rows(stale)
+        return bridge
+
     def close(self) -> None:
         if self._pending_fanout:
             self._flush_fanout()     # the last tick's media still ships

@@ -102,6 +102,42 @@ class RtpTranslator:
         self.active[rid] = True
         self._dev = None
 
+    def add_receivers(self, rids, master_keys, master_salts) -> None:
+        """Vectorized bulk `add_receiver` (checkpoint restore, join
+        storms): one batched KDF/key-schedule/leg-constant pass instead
+        of a per-receiver Python loop — the same install-plane doctrine
+        as `SrtpStreamTable.add_streams`."""
+        from libjitsi_tpu.kernels.aes import expand_keys_batch
+        from libjitsi_tpu.kernels.ghash import ghash_matrix_batch
+        from libjitsi_tpu.kernels.sha1 import hmac_precompute_batch
+        from libjitsi_tpu.transform.srtp.kdf import \
+            derive_session_keys_batch
+
+        rids = np.asarray(rids, dtype=np.int64)
+        if len(rids) == 0:
+            return
+        p = self.policy
+
+        def rows(keys):          # accept bytes rows like add_receiver
+            return np.stack([np.frombuffer(bytes(k), dtype=np.uint8)
+                             for k in keys])
+
+        ksb = derive_session_keys_batch(
+            rows(master_keys), rows(master_salts),
+            enc_key_len=p.enc_key_len, auth_key_len=p.auth_key_len,
+            salt_len=p.salt_len)
+        self._rk[rids] = expand_keys_batch(ksb.rtp_enc)
+        if self._gcm:
+            h = aes_encrypt_np(self._rk[rids],
+                               np.zeros((len(rids), 16), np.uint8))
+            self._gm[rids] = ghash_matrix_batch(h).astype(np.int8)
+        else:
+            self._mid[rids] = hmac_precompute_batch(ksb.rtp_auth)
+        self._salt[rids, : p.salt_len] = ksb.rtp_salt
+        self._salt[rids, p.salt_len:] = 0
+        self.active[rids] = True
+        self._dev = None
+
     def remove_receiver(self, rid: int) -> None:
         self.active[rid] = False
         self._rk[rid] = 0

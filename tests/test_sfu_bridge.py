@@ -642,3 +642,69 @@ def test_sfu_svc_track_projection_e2e():
     drain()
     assert want in rtx_osn, f"seq {want} not re-delivered as RTX"
     sfu.close()
+
+
+@pytest.mark.slow
+def test_sfu_bridge_snapshot_resume_mid_conference():
+    """SURVEY §5 at assembly level: snapshot a live conference, tear
+    the bridge down, restore on a NEW port — endpoints keep their SRTP
+    counters running and media keeps flowing (replay windows moved with
+    the snapshot, so the old packets are rejected and new ones pass)."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    sfu = SfuBridge(libjitsi_tpu.configuration_service(), port=0,
+                    capacity=8, recv_window_ms=0)
+    eps = [_Endpoint(0x500 + 3 * k, sfu.port) for k in range(3)]
+    for e in eps:
+        sfu.add_endpoint(e.ssrc, e.rx_key, e.tx_key)
+        for other in eps:
+            if other is not e:
+                e.expect_sender(other.ssrc)
+    for rnd in range(2):
+        for e in eps:
+            e.send_media()
+        for _ in range(16):
+            sfu.tick(now=40.0 + rnd * 0.02)
+        for e in eps:
+            e.drain()
+    assert sfu.forwarded > 0
+
+    snap = sfu.snapshot()
+    sfu.close()
+
+    sfu2 = SfuBridge.restore(libjitsi_tpu.configuration_service(),
+                             snap, port=0, recv_window_ms=0)
+    assert sfu2.port != 0
+    for e in eps:
+        e.bridge_port = sfu2.port       # "signaling" moves endpoints
+        e.got.clear()
+    before = sfu2.forwarded
+    for rnd in range(3):
+        for e in eps:
+            e.send_media()              # SRTP counters CONTINUE
+        for _ in range(16):
+            sfu2.tick(now=41.0 + rnd * 0.02)
+        for e in eps:
+            for _ in range(3):
+                e.drain()
+    assert sfu2.forwarded > before
+    for e in eps:
+        payloads = b"".join(e.got.values())
+        for other in eps:
+            if other is e:
+                continue
+            assert b"m-%08x" % other.ssrc in payloads, \
+                f"{e.ssrc:#x} missing post-restore media from " \
+                f"{other.ssrc:#x}"
+    # replayed pre-snapshot wire must NOT re-enter (windows resumed)
+    rx_before = sfu2.forwarded
+    replay = rtp_header.build([b"replay"], [500], [0],
+                              [eps[0].ssrc], [96], stream=[0])
+    old_tab = SrtpStreamTable(capacity=1)
+    old_tab.add_stream(0, *eps[0].rx_key)
+    eps[0].engine.send_batch(old_tab.protect_rtp(replay), "127.0.0.1",
+                             sfu2.port)
+    for _ in range(10):
+        sfu2.tick(now=41.2)
+    assert sfu2.forwarded == rx_before, "replayed old seq re-forwarded"
+    sfu2.close()

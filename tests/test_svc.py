@@ -181,6 +181,7 @@ def test_svc_projection_properties_random_trace(seed):
             assert sid_out <= fwd.current_sid, \
                 (sid_out, fwd.current_sid, pid)
 
+    assert out_sids, "trace forwarded nothing"
     # gapless, strictly increasing output space (first deliveries only)
     assert out_seqs == list(range(out_seqs[0],
                                   out_seqs[0] + len(out_seqs)))
@@ -188,4 +189,3 @@ def test_svc_projection_properties_random_trace(seed):
     key_pids = {700 + p for p in range(60) if p % 12 == 0}
     assert set(raise_pics) <= key_pids, (raise_pics, key_pids)
     assert fwd.forwarded == len(out_seqs)
-    assert out_sids, "trace forwarded nothing"
