@@ -73,6 +73,7 @@ class ConferenceBridge:
         # chain; the reverse chain extracts participants' RFC 6464
         # levels for free.  Reference: .csrc.CsrcTransformEngine.
         self._egress_levels = np.full(capacity, 127, dtype=np.uint8)
+        self._level_ext_id = audio_level_ext_id
         self.levels_engine = CsrcAudioLevelEngine(
             audio_level_ext_id, capacity,
             level_of=lambda sids: self._egress_levels[sids])
@@ -133,6 +134,20 @@ class ConferenceBridge:
             raise ValueError(
                 f"codec ptime {codec.frame_samples * 1000.0 / codec.sample_rate:.1f} ms "
                 f"!= bridge ptime {self.ptime_ms} ms")
+        if ssrc in [s for s in self._ssrc_of.values()]:
+            # silently remapping would mute the existing participant
+            raise ValueError(f"ssrc {ssrc:#x} already joined")
+        sid = self.registry.alloc(self)
+        self._attach_media_row(sid, ssrc, codec)
+        return sid
+
+    def _attach_media_row(self, sid: int, ssrc: int,
+                          codec: FrameCodec) -> None:
+        """Join bookkeeping for a CLAIMED row (alloc'd or reserved):
+        bridge clock/mixer/bank bootstrap on first attach, demux map,
+        bank/mixer/speaker rows, randomized TX counters (checkpoint
+        restore overwrites those afterwards).  Shared by live joins and
+        `restore` so resumed conferences cannot diverge from live ones."""
         if self._frame_samples is None:
             # the first participant's codec sets the bridge clock; later
             # joins at other rates resample to it (reference: AudioMixer
@@ -145,10 +160,6 @@ class ConferenceBridge:
                                     payload_cap=max(256,
                                                     codec.frame_samples),
                                     mixer_rate=codec.sample_rate)
-        if ssrc in [s for s in self._ssrc_of.values()]:
-            # silently remapping would mute the existing participant
-            raise ValueError(f"ssrc {ssrc:#x} already joined")
-        sid = self.registry.alloc(self)
         self.registry.map_ssrc(ssrc, sid)
         self.bank.add_stream(sid, codec)
         self.mixer.add_participant(sid)
@@ -158,7 +169,6 @@ class ConferenceBridge:
         self._tx_seq[sid] = int.from_bytes(np.random.bytes(2), "big")
         self._tx_ts[sid] = int.from_bytes(np.random.bytes(4), "big")
         self._tx_ssrc[sid] = (0x42000000 + sid) & 0xFFFFFFFF
-        return sid
 
     def add_participant_dtls(self, ssrc: int,
                              codec: Optional[FrameCodec] = None,
@@ -315,6 +325,98 @@ class ConferenceBridge:
             # window; bytes flush at the top of the next tick
             return self.loop.send_media_async(batch)
         return self.loop.send_media(batch)
+
+    # ----------------------------------------------------------- resume
+    _STATELESS = ("PCMU", "PCMA")
+
+    def snapshot(self) -> dict:
+        """Checkpoint the conference (SURVEY §5 at assembly level):
+        SRTP tables (indices + replay windows), the dense jitter rings,
+        participant rows/keys/SSRCs, TX counters, speaker-detector
+        scores and latched addresses — a restarted bridge resumes the
+        playout windows so nothing glitches.
+
+        Scope: legs must use STATELESS codecs (G.711) — stateful codec
+        predictor state (opus/gsm/speex/g722 C objects) cannot be
+        serialized, and resuming them desynced would corrupt audio, so
+        this refuses instead.  Mid-DTLS participants are excluded (they
+        rejoin via signaling), like the SFU snapshot.
+        """
+        self.loop.flush_sends()      # a pipelined tick's last frame
+        keyed = {sid: ssrc for sid, ssrc in self._ssrc_of.items()
+                 if sid not in self._dtls.pending}
+        bad = {s: self._codec[s].name for s in keyed
+               if self._codec[s].name.upper() not in self._STATELESS}
+        if bad:
+            raise RuntimeError(
+                f"checkpoint supports stateless codec legs only "
+                f"(G.711); rows {bad} hold C codec state that cannot "
+                f"be serialized")
+        return {
+            "capacity": self.capacity,
+            "profile": self.profile.name,
+            "ptime_ms": self.ptime_ms,
+            "level_ext_id": self._level_ext_id,
+            "rx_table": self.rx_table.snapshot(),
+            "tx_table": self.tx_table.snapshot(),
+            "jb": self.bank.jb.snapshot() if self.bank else None,
+            "ssrc_of": keyed,
+            "codec_ulaw": {s: self._codec[s].name.upper() == "PCMU"
+                           for s in keyed},
+            "tx_seq": self._tx_seq.copy(),
+            "tx_ts": self._tx_ts.copy(),
+            "tx_ssrc": self._tx_ssrc.copy(),
+            "addr_ip": self.loop.addr_ip.copy(),
+            "addr_port": self.loop.addr_port.copy(),
+            "speaker": {
+                "immediate": self.speaker.immediate.copy(),
+                "medium": self.speaker.medium.copy(),
+                "long": self.speaker.long.copy(),
+                "dominant": self.speaker.dominant,
+            },
+        }
+
+    @classmethod
+    def restore(cls, config, snap: dict, port: int = 0,
+                **kwargs) -> "ConferenceBridge":
+        """Resume a snapshotted conference on a fresh socket."""
+        from libjitsi_tpu.rtp.dense_jitter import DenseJitterBank
+        from libjitsi_tpu.transform.srtp import SrtpStreamTable as _T
+
+        bridge = cls(config, port=port, capacity=snap["capacity"],
+                     profile=SrtpProfile[snap["profile"]],
+                     ptime_ms=snap["ptime_ms"],
+                     audio_level_ext_id=snap["level_ext_id"], **kwargs)
+        sids = sorted(snap["ssrc_of"])
+        bridge.registry.reserve_many(sids, bridge)
+        for sid in sids:
+            bridge._attach_media_row(
+                sid, snap["ssrc_of"][sid],
+                g711_codec(ulaw=snap["codec_ulaw"][sid],
+                           ptime_ms=snap["ptime_ms"]))
+        # the crypto, playout and counter state resumes verbatim (jb
+        # AFTER add_stream: add_stream resets rows, restore overrides)
+        bridge.rx_table = _T.restore(snap["rx_table"])
+        bridge.tx_table = _T.restore(snap["tx_table"])
+        bridge.chain = TransformEngineChain(
+            [bridge.levels_engine,
+             SrtpTransformEngine(bridge.tx_table, bridge.rx_table)])
+        bridge.loop.chain = bridge.chain
+        if snap["jb"] is not None and bridge.bank is not None:
+            bridge.bank.jb = DenseJitterBank.restore(snap["jb"])
+        bridge._tx_seq = np.asarray(snap["tx_seq"]).copy()
+        bridge._tx_ts = np.asarray(snap["tx_ts"]).copy()
+        bridge._tx_ssrc = np.asarray(snap["tx_ssrc"]).copy()
+        keep = np.zeros(snap["capacity"], dtype=bool)
+        keep[sids] = True
+        bridge.loop.addr_ip[:] = np.where(keep, snap["addr_ip"], 0)
+        bridge.loop.addr_port[:] = np.where(keep, snap["addr_port"], 0)
+        sp = snap["speaker"]
+        bridge.speaker.immediate[:] = sp["immediate"]
+        bridge.speaker.medium[:] = sp["medium"]
+        bridge.speaker.long[:] = sp["long"]
+        bridge.speaker.dominant = sp["dominant"]
+        return bridge
 
     def run(self, duration_s: float) -> None:
         """Drive real-time ticks for a bounded interval."""
