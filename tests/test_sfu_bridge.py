@@ -644,6 +644,103 @@ def test_sfu_svc_track_projection_e2e():
     sfu.close()
 
 
+def test_sfu_video_simulcast_forward_and_switch_core():
+    """Core-gate video SFU (VERDICT r3 #4): tiny-shape simulcast
+    forward + REMB-driven layer switch with SYNTHETIC VP8 frames (every
+    frame a keyframe, so switches land without a PLI round trip) — no
+    libvpx, few packets, seconds not minutes.  The per-change gate now
+    fails if SfuBridge video forwarding breaks."""
+    from libjitsi_tpu.codecs import vp8 as vp8_mod
+    from libjitsi_tpu.core.packet import PacketBatch
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    sfu = SfuBridge(libjitsi_tpu.configuration_service(), port=0,
+                    capacity=16, recv_window_ms=0)
+    send = _Endpoint(0xE0, sfu.port)
+    recv = _Endpoint(0xE4, sfu.port)
+    sid_s = sfu.add_endpoint(send.ssrc, send.rx_key, send.tx_key)
+    sid_r = sfu.add_endpoint(recv.ssrc, recv.rx_key, recv.tx_key)
+    recv.send_media(1)                         # latch receiver address
+    layer_ssrcs = [0xE00, 0xE01]
+    track = sfu.add_video_track(sid_s, layer_ssrcs,
+                                layer_bps=[100e3, 1e6], rtx_pt=97)
+    fwd = track.fwd[sid_r]
+
+    tx = SrtpStreamTable(capacity=2)
+    for k in range(2):
+        tx.add_stream(k, *send.rx_key)
+    rxt = SrtpStreamTable(capacity=1)
+    rxt.add_stream(0, *recv.tx_key)            # projected stream
+    seqs, pids = [1000, 2000], [10, 20]
+    got_layers, got_seqs = [], []
+
+    def send_video(t):
+        # synthetic VP8: frame tag LSB 0 => keyframe; payload byte
+        # encodes the layer so the projection is attributable
+        for k in range(2):
+            frame = bytes([0x00, 0xE0 + k]) * 20
+            pls = vp8_mod.packetize(frame, picture_id=pids[k])
+            pids[k] = (pids[k] + 1) & 0x7FFF
+            n = len(pls)
+            b = rtp_header.build(
+                pls, [(seqs[k] + i) & 0xFFFF for i in range(n)],
+                [t * 3000] * n, [layer_ssrcs[k]] * n, [96] * n,
+                marker=[0] * (n - 1) + [1], stream=[k] * n)
+            seqs[k] = (seqs[k] + n) & 0xFFFF
+            send.engine.send_batch(tx.protect_rtp(b), "127.0.0.1",
+                                   sfu.port)
+
+    def drain():
+        back, _, _ = recv.engine.recv_batch(timeout_ms=2)
+        if not back.batch_size:
+            return
+        hdr0 = rtp_header.parse(back)
+        back.stream[:] = [0 if int(s) == send.ssrc else -1
+                          for s in hdr0.ssrc]
+        keep = np.nonzero(np.asarray(back.stream) >= 0)[0]
+        if len(keep) == 0:
+            return
+        sub = PacketBatch(back.data[keep],
+                          np.asarray(back.length)[keep],
+                          back.stream[keep])
+        dec, ok = rxt.unprotect_rtp(sub)
+        hdr = rtp_header.parse(dec)
+        for i in np.nonzero(ok)[0]:
+            i = int(i)
+            payload = dec.to_bytes(i)[int(hdr.payload_off[i]):]
+            got_layers.append(payload[-1] - 0xE0)
+            got_seqs.append(int(hdr.seq[i]))
+
+    def run(rounds, t0, remb):
+        for t in range(rounds):
+            blob = rtcp.build_compound([rtcp.build_remb(rtcp.Remb(
+                recv.ssrc, int(remb), [track.out_ssrc]))])
+            b = PacketBatch.from_payloads([blob], stream=[0])
+            recv.engine.send_batch(recv.protect.protect_rtcp(b),
+                                   "127.0.0.1", sfu.port)
+            for _ in range(3):
+                sfu.tick(now=95.0 + (t0 + t) * 0.1)
+            sfu.emit_feedback(now=95.0 + (t0 + t) * 0.1)
+            send_video(t0 + t)
+            for _ in range(6):
+                sfu.tick(now=95.0 + (t0 + t) * 0.1 + 0.05)
+            drain()
+
+    run(3, 0, remb=2_000_000)        # bandwidth for the high layer
+    assert fwd.current_layer == 1, f"no upswitch: {fwd.current_layer}"
+    assert 1 in got_layers, "high-layer media never projected"
+    run(3, 3, remb=150_000)          # starved to the base layer
+    assert fwd.current_layer == 0, f"no downswitch: {fwd.current_layer}"
+    assert got_layers[-1] == 0, "post-downswitch media not base layer"
+    # the projection renumbers into one gapless seq space across the
+    # switches
+    assert got_seqs == list(range(got_seqs[0],
+                                  got_seqs[0] + len(got_seqs)))
+    assert sfu.forwarded > 0
+    sfu.close()
+
+
 @pytest.mark.slow
 def test_sfu_bridge_snapshot_resume_mid_conference():
     """SURVEY §5 at assembly level: snapshot a live conference, tear
