@@ -204,17 +204,26 @@ class AudioMixer:
     resamples to 48k before deposit).
     """
 
-    def __init__(self, capacity: int = 256, frame_samples: int = 960):
+    def __init__(self, capacity: int = 256, frame_samples: int = 960,
+                 mix_fn=None):
         # 960 samples = 20 ms @ 48 kHz, the dominant Opus/RTP ptime.
+        # mix_fn overrides the provider registry with a caller-built
+        # launcher — the mesh bridge passes sharded_mix_minus(mesh) so
+        # the participant axis psums over ICI (libjitsi_tpu.mesh).
         self.capacity = capacity
         self.frame_samples = frame_samples
         self.active = np.zeros(capacity, dtype=bool)
         self._frame = np.zeros((capacity, frame_samples), dtype=np.int16)
+        self._mix_fn = mix_fn
         # compile + provider-benchmark NOW, at setup time — a 20 ms mix
         # tick must never absorb jit compiles or the registry's timing
         # runs (reference analog: crypto.Aes benches providers at startup)
-        _registry.warmup("mix_minus", jnp.asarray(self._frame),
-                         jnp.asarray(self.active))
+        if mix_fn is None:
+            _registry.warmup("mix_minus", jnp.asarray(self._frame),
+                             jnp.asarray(self.active))
+        else:
+            jax.block_until_ready(mix_fn(jnp.asarray(self._frame),
+                                         jnp.asarray(self.active)))
 
     def add_participant(self, sid: int) -> None:
         self.active[sid] = True
@@ -249,8 +258,13 @@ class AudioMixer:
         contribute silence (the reference's pull model blocks briefly then
         pads silence; a server mixer must never block on a slow sender).
         """
-        out, levels = _registry.call("mix_minus", jnp.asarray(self._frame),
-                                     jnp.asarray(self.active))
+        if self._mix_fn is not None:
+            out, levels = self._mix_fn(jnp.asarray(self._frame),
+                                       jnp.asarray(self.active))
+        else:
+            out, levels = _registry.call("mix_minus",
+                                         jnp.asarray(self._frame),
+                                         jnp.asarray(self.active))
         # materialize BEFORE zeroing: on the CPU backend jnp.asarray can
         # alias the host buffer and dispatch is async — zeroing first
         # races the device read (seen as a rare wrong-mix flake)

@@ -8,3 +8,4 @@ from libjitsi_tpu.mesh.sharded import (  # noqa: F401
     sharded_srtp_protect,
     sharded_media_step,
 )
+from libjitsi_tpu.mesh.table import ShardedSrtpTable  # noqa: F401

@@ -1,0 +1,133 @@
+"""Mesh-vs-single-chip parity rehearsals for the PRODUCT objects.
+
+One harness, two consumers: the driver's multi-chip dry run
+(`__graft_entry__.dryrun_multichip`) and the pytest suite
+(tests/test_mesh_table.py) both assert that the sharded
+`ShardedSrtpTable` and the mesh-mode `ConferenceBridge` are
+bit-identical to their single-chip twins — keeping the harness here
+means the dryrun and CI can never drift apart on what "parity" means.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+
+def assert_table_parity(mesh, capacity: int, batch_size: int,
+                        rounds: int = 2) -> None:
+    """Sharded table protect/unprotect must match the plain table byte
+    for byte, including the host replay planes."""
+    from libjitsi_tpu.mesh import ShardedSrtpTable
+
+    rng = np.random.default_rng(23)
+    mks = rng.integers(0, 256, (capacity, 16), dtype=np.uint8)
+    mss = rng.integers(0, 256, (capacity, 14), dtype=np.uint8)
+
+    def build_pair():
+        sh = ShardedSrtpTable(capacity, mesh)
+        sh.add_streams(np.arange(capacity), mks, mss)
+        pl = SrtpStreamTable(capacity)
+        pl.add_streams(np.arange(capacity), mks, mss)
+        return sh, pl
+
+    def batch(seq0):
+        # own generator per call: both tables must see IDENTICAL batches
+        r = np.random.default_rng(seq0)
+        streams = r.integers(0, capacity, batch_size)
+        pls = [bytes([seq0 & 0xFF]) * 40 for _ in range(batch_size)]
+        return rtp_header.build(
+            pls, [seq0 + i for i in range(batch_size)],
+            [0] * batch_size, (0x7000 + streams).tolist(),
+            [96] * batch_size, stream=streams.tolist())
+
+    sh_tx, pl_tx = build_pair()
+    sh_rx, pl_rx = build_pair()
+    for k in range(rounds):
+        seq0 = 100 * (k + 1)
+        w_sh = sh_tx.protect_rtp(batch(seq0))
+        w_pl = pl_tx.protect_rtp(batch(seq0))
+        for i in range(w_sh.batch_size):
+            if w_sh.to_bytes(i) != w_pl.to_bytes(i):
+                raise AssertionError(
+                    f"sharded TABLE protect != single-chip at row {i}")
+        if not np.array_equal(sh_tx.tx_ext, pl_tx.tx_ext):
+            raise AssertionError("sharded TABLE tx state diverged")
+        d_sh, ok_sh = sh_rx.unprotect_rtp(w_sh)
+        d_pl, ok_pl = pl_rx.unprotect_rtp(w_pl)
+        if not (bool(np.all(ok_sh)) and bool(np.all(ok_pl))):
+            raise AssertionError("sharded TABLE unprotect auth failed")
+        for i in range(d_sh.batch_size):
+            if d_sh.to_bytes(i) != d_pl.to_bytes(i):
+                raise AssertionError(
+                    f"sharded TABLE unprotect != single-chip at row {i}")
+        if not (np.array_equal(sh_rx.rx_max, pl_rx.rx_max)
+                and np.array_equal(sh_rx.rx_mask, pl_rx.rx_mask)):
+            raise AssertionError("sharded TABLE replay state diverged")
+
+
+def run_bridge_once(cfg, mesh, capacity: int, rounds: int = 2) -> dict:
+    """One tiny G.711 conference through a ConferenceBridge (mesh-mode
+    when `mesh` is not None) over real loopback UDP with pinned TX
+    counters; returns {(client, seq): wire_bytes} for comparison."""
+    from libjitsi_tpu.io import UdpEngine
+    from libjitsi_tpu.kernels import g711
+    from libjitsi_tpu.service.bridge import ConferenceBridge
+
+    bridge = ConferenceBridge(cfg, port=0, capacity=capacity,
+                              recv_window_ms=0, mesh=mesh)
+    clis = []
+    for ssrc in (10, 20):
+        prot = SrtpStreamTable(capacity=1)
+        rx_key = (bytes([ssrc]) * 16, bytes([ssrc + 1]) * 14)
+        prot.add_stream(0, *rx_key)
+        eng = UdpEngine(port=0, max_batch=16)
+        bridge.add_participant(
+            ssrc, rx_key, (bytes([ssrc + 2]) * 16,
+                           bytes([ssrc + 3]) * 14))
+        clis.append((ssrc, prot, eng))
+    # pin the randomized TX counters so two runs' egress is comparable
+    bridge._tx_seq[:] = 300
+    bridge._tx_ts[:] = 7000
+    got = {}
+    now = 50.0
+    try:
+        for k in range(rounds):
+            for ssrc, prot, eng in clis:
+                pcm = ((1000 + 500 * ssrc)
+                       * np.ones(160)).astype(np.int16)
+                pay = np.asarray(g711.ulaw_encode(pcm[None]))[0]
+                b = rtp_header.build([pay.tobytes()], [50 + k],
+                                     [k * 160], [ssrc], [0],
+                                     stream=[0])
+                eng.send_batch(prot.protect_rtp(b), "127.0.0.1",
+                               bridge.port)
+            for _ in range(10):
+                if bridge.tick(now=now)["rx"]:
+                    break
+            bridge.tick(now=now + 0.001)
+            for j, (_ssrc, _prot, eng) in enumerate(clis):
+                back, _, _ = eng.recv_batch(timeout_ms=2)
+                for i in range(back.batch_size):
+                    hdr = rtp_header.parse(back)
+                    got[(j, int(hdr.seq[i]))] = back.to_bytes(i)
+            now += 0.020
+    finally:
+        for _ssrc, _prot, eng in clis:
+            eng.close()
+        bridge.close()
+    return got
+
+
+def assert_bridge_parity(cfg, mesh, capacity: int) -> None:
+    """Assembled mesh-mode ConferenceBridge egress must be byte-
+    identical to the single-chip bridge for the same conference."""
+    plain = run_bridge_once(cfg, None, capacity)
+    meshed = run_bridge_once(cfg, mesh, capacity)
+    if len(plain) < 2:
+        raise AssertionError("bridge parity run produced no egress")
+    if plain != meshed:
+        raise AssertionError(
+            "assembled mesh ConferenceBridge egress != single-chip")

@@ -60,13 +60,30 @@ class ConferenceBridge:
                  audio_level_ext_id: int = 1,
                  on_speaker_change=None,
                  recorder=None,
-                 pipelined: bool = False):
+                 pipelined: bool = False,
+                 mesh=None):
         self.capacity = capacity
         self.profile = profile
         self.ptime_ms = ptime_ms
         self.registry = StreamRegistry(config, capacity=capacity)
-        self.rx_table = SrtpStreamTable(capacity, profile)
-        self.tx_table = SrtpStreamTable(capacity, profile)
+        # mesh mode (SURVEY §2.7, VERDICT r3 #2): the bridge's SRTP
+        # tables row-partition over the device mesh and the mixer's
+        # participant axis psums over ICI — the ASSEMBLED bridge tick
+        # runs sharded, not just its kernels
+        self._mesh = mesh
+        if mesh is not None:
+            if pipelined:
+                # the sharded table's scatter materializes on the host,
+                # so the pipelined dispatch seam cannot overlap in mesh
+                # mode — refuse rather than silently run synchronous
+                raise ValueError("mesh mode does not support "
+                                 "pipelined=True yet")
+            from libjitsi_tpu.mesh import ShardedSrtpTable
+            self.rx_table = ShardedSrtpTable(capacity, mesh, profile)
+            self.tx_table = ShardedSrtpTable(capacity, mesh, profile)
+        else:
+            self.rx_table = SrtpStreamTable(capacity, profile)
+            self.tx_table = SrtpStreamTable(capacity, profile)
         # egress audio-level stamping (RFC 6465 mixer-to-client, the
         # engine's one-byte element = the loudest contributor heard in
         # that receiver's mix-minus) sits BEFORE SRTP in the forward
@@ -154,8 +171,13 @@ class ConferenceBridge:
             # normalizing via the Speex resampler, SURVEY §2.4/§2.5)
             self._frame_samples = codec.frame_samples
             self._rate = codec.sample_rate
+            mix_fn = None
+            if self._mesh is not None:
+                from libjitsi_tpu.mesh import sharded_mix_minus
+                mix_fn = sharded_mix_minus(self._mesh)
             self.mixer = AudioMixer(capacity=self.capacity,
-                                    frame_samples=codec.frame_samples)
+                                    frame_samples=codec.frame_samples,
+                                    mix_fn=mix_fn)
             self.bank = ReceiveBank(self.capacity, mixer=self.mixer,
                                     payload_cap=max(256,
                                                     codec.frame_samples),

@@ -695,7 +695,8 @@ class SrtpStreamTable:
         idx = chain_packet_indices(stream, hdr.seq, self.tx_ext)
         v = idx >> 16
 
-        tab_rk, tab_aux, _, _ = self._device()
+        if self._gcm or self._f8:   # CM fetches its tables in its seam
+            tab_rk, tab_aux, _, _ = self._device()
         if self._gcm:
             iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
             grid = _gcm_grid(stream)
@@ -728,15 +729,37 @@ class SrtpStreamTable:
                 tab_f8=self._dev_f8[0])
         else:
             iv = self._cm_iv(self._salt_rtp[stream], hdr.ssrc, idx)
-            data, length = _protect_rtp_dev(
-                tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
-                jnp.asarray(batch.data), jnp.asarray(batch.length),
-                jnp.asarray(hdr.payload_off), jnp.asarray(iv),
-                jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
-                self.policy.auth_tag_len, self.policy.cipher != Cipher.NULL,
-                off_const=_uniform_off(hdr.payload_off, batch.capacity))
+            data, length = self._cm_rtp_protect_call(stream, batch, hdr,
+                                                     iv, v)
         np.maximum.at(self.tx_ext, stream, idx)
         return data, length, batch.stream
+
+    def _cm_rtp_protect_call(self, stream, batch, hdr, iv, v):
+        """AES-CM/NULL RTP protect device call — the mesh table
+        (mesh/table.py) overrides exactly this seam with a shard_map
+        over row-partitioned key tables; the host plane above is
+        shared verbatim."""
+        tab_rk, tab_mid, _, _ = self._device()
+        return _protect_rtp_dev(
+            tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
+            jnp.asarray(batch.data), jnp.asarray(batch.length),
+            jnp.asarray(hdr.payload_off), jnp.asarray(iv),
+            jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
+            self.policy.auth_tag_len, self.policy.cipher != Cipher.NULL,
+            off_const=_uniform_off(hdr.payload_off, batch.capacity))
+
+    def _cm_rtp_unprotect_call(self, stream, batch, hdr, iv, v, length):
+        """AES-CM/NULL RTP unprotect device call (see
+        _cm_rtp_protect_call); returns (data, media_len, auth_ok)."""
+        p = self.policy
+        tab_rk, tab_mid, _, _ = self._device()
+        return _unprotect_rtp_dev(
+            tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
+            jnp.asarray(batch.data), jnp.asarray(length),
+            jnp.asarray(hdr.payload_off), jnp.asarray(iv),
+            jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
+            p.auth_tag_len, p.cipher != Cipher.NULL,
+            off_const=_uniform_off(hdr.payload_off, batch.capacity))
 
     def unprotect_rtp(self, batch: PacketBatch, return_index: bool = False):
         """Auth-check, replay-check and decrypt incoming RTP.
@@ -813,7 +836,8 @@ class SrtpStreamTable:
         v = idx >> 16
         not_replayed = replay.check(self.rx_max, self.rx_mask, stream, idx)
 
-        tab_rk, tab_aux, _, _ = self._device()
+        if self._gcm or self._f8:   # CM fetches its tables in its seam
+            tab_rk, tab_aux, _, _ = self._device()
         if self._gcm:
             iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
             grid = _gcm_grid(stream)
@@ -846,13 +870,8 @@ class SrtpStreamTable:
                 tab_f8=self._dev_f8[0])
         else:
             iv = self._cm_iv(self._salt_rtp[stream], hdr.ssrc, idx)
-            data, mlen, auth_ok = _unprotect_rtp_dev(
-                tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
-                jnp.asarray(batch.data), jnp.asarray(length),
-                jnp.asarray(hdr.payload_off), jnp.asarray(iv),
-                jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
-                p.auth_tag_len, p.cipher != Cipher.NULL,
-                off_const=_uniform_off(hdr.payload_off, batch.capacity))
+            data, mlen, auth_ok = self._cm_rtp_unprotect_call(
+                stream, batch, hdr, iv, v, length)
         ok = valid & not_replayed & np.asarray(auth_ok)
         # in-batch duplicate indices: keep the first *authenticated*
         # occurrence (a forged front-runner fails auth and must not block

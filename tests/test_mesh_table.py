@@ -1,0 +1,103 @@
+"""ShardedSrtpTable: the PRODUCT table sharded over the mesh must be
+bit-identical to the single-chip SrtpStreamTable (VERDICT r3 #2 — shard
+the product objects, not just the kernels)."""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.mesh import ShardedSrtpTable, make_media_mesh
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.transform.srtp import SrtpProfile, SrtpStreamTable
+
+CAP = 16
+
+
+def _tables(profile=SrtpProfile.AES_CM_128_HMAC_SHA1_80):
+    rng = np.random.default_rng(41)
+    mks = rng.integers(0, 256, (CAP, 16), dtype=np.uint8)
+    mss = rng.integers(0, 256, (CAP, 14), dtype=np.uint8)
+    mesh = make_media_mesh()
+    sh = ShardedSrtpTable(CAP, mesh, profile)
+    sh.add_streams(np.arange(CAP), mks, mss)
+    plain = SrtpStreamTable(CAP, profile)
+    plain.add_streams(np.arange(CAP), mks, mss)
+    return sh, plain
+
+
+def _batch(rng, n, seq0, sizes=(160,)):   # one size class: one compile pair
+    streams = rng.integers(0, CAP, n)
+    lens = rng.choice(sizes, n)
+    payloads = [rng.integers(0, 256, l, dtype=np.uint8).tobytes()
+                for l in lens]
+    return rtp_header.build(
+        payloads, [seq0 + i for i in range(n)], [i * 160 for i in range(n)],
+        (0x5000 + streams).tolist(), [96] * n, stream=streams.tolist())
+
+
+def test_sharded_protect_unprotect_bit_identical():
+    sh_tx, plain_tx = _tables()
+    sh_rx, plain_rx = _tables()
+    rng = np.random.default_rng(42)
+    for k in range(2):
+        b = _batch(np.random.default_rng(100 + k), 24, 100 + 24 * k)
+        b2 = _batch(np.random.default_rng(100 + k), 24, 100 + 24 * k)
+        w_sh = sh_tx.protect_rtp(b)
+        w_pl = plain_tx.protect_rtp(b2)
+        for i in range(w_sh.batch_size):
+            assert w_sh.to_bytes(i) == w_pl.to_bytes(i), f"row {i}"
+        # host tx plane advanced identically
+        np.testing.assert_array_equal(sh_tx.tx_ext, plain_tx.tx_ext)
+
+        d_sh, ok_sh = sh_rx.unprotect_rtp(w_sh)
+        d_pl, ok_pl = plain_rx.unprotect_rtp(w_pl)
+        assert bool(np.all(ok_sh)) and bool(np.all(ok_pl))
+        for i in range(d_sh.batch_size):
+            assert d_sh.to_bytes(i) == d_pl.to_bytes(i)
+        np.testing.assert_array_equal(sh_rx.rx_max, plain_rx.rx_max)
+        np.testing.assert_array_equal(sh_rx.rx_mask, plain_rx.rx_mask)
+
+
+def test_sharded_replay_and_tamper_rejection():
+    sh_tx, _ = _tables()
+    sh_rx, _ = _tables()
+    b = _batch(np.random.default_rng(7), 16, 500)
+    w = sh_tx.protect_rtp(b)
+    d, ok = sh_rx.unprotect_rtp(w)
+    assert bool(np.all(ok))
+    # replay: same wire again must be rejected by the (host) windows
+    w2 = sh_tx.protect_rtp(_batch(np.random.default_rng(7), 16, 500))
+    _, ok2 = sh_rx.unprotect_rtp(w2)
+    assert not bool(np.any(ok2))
+    # tamper: flip one payload byte on a fresh batch -> that row fails
+    w3 = sh_tx.protect_rtp(_batch(np.random.default_rng(8), 16, 600))
+    w3.data[3, 20] ^= 0xFF
+    _, ok3 = sh_rx.unprotect_rtp(w3)
+    assert not ok3[3] and bool(np.sum(ok3) >= 14)
+
+
+def test_sharded_table_rejects_unsupported():
+    mesh = make_media_mesh()
+    with pytest.raises(ValueError):
+        ShardedSrtpTable(CAP, mesh, SrtpProfile.AEAD_AES_128_GCM)
+    with pytest.raises(ValueError):
+        ShardedSrtpTable(CAP + 1, mesh)
+
+
+def test_mesh_bridge_tick_matches_single_chip():
+    """The ASSEMBLED ConferenceBridge in mesh mode (sharded SRTP tables
+    + psum mixer) must emit byte-identical wire packets to the plain
+    single-chip bridge — via the parity harness shared with the
+    driver's multi-chip dryrun (libjitsi_tpu.mesh.parity)."""
+    import libjitsi_tpu
+    from libjitsi_tpu.mesh.parity import assert_bridge_parity
+    from libjitsi_tpu.service.bridge import ConferenceBridge
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    mesh = make_media_mesh()
+    assert_bridge_parity(cfg, mesh, capacity=16)
+    # the pipelined dispatch seam cannot overlap in mesh mode: refused
+    with pytest.raises(ValueError):
+        ConferenceBridge(cfg, port=0, capacity=16, mesh=mesh,
+                         pipelined=True)
