@@ -139,8 +139,12 @@ def bucket_by_size(batch: "PacketBatch",
     Returns a list of (orig_rows, sub_batch, n_real): `orig_rows` are the
     source row indices (length n_real); `sub_batch` has capacity
     class+headroom and its row count padded up to a ROW_CLASSES size by
-    repeating the last real row (see module comment for why that is
-    SRTP-state-safe).
+    CYCLING the real rows (see module comment for why repeating real
+    rows is SRTP-state-safe).  Cycling — rather than repeating one row —
+    keeps per-stream multiplicity within 2x, so the GCM grouped-GHASH
+    grid's skew statistics see the real distribution, not a pad
+    artifact (a single repeated row used to read as one hot stream and
+    force the per-row path).
     """
     ln = np.asarray(batch.length)
     out = []
@@ -155,7 +159,7 @@ def bucket_by_size(batch: "PacketBatch",
         cap = cls + headroom
         n_real = len(rows)
         n_pad = _round_rows(n_real)
-        idx = np.concatenate([rows, np.full(n_pad - n_real, rows[-1])])
+        idx = np.resize(rows, n_pad)     # pads cycle the real rows
         data = np.zeros((n_pad, cap), dtype=np.uint8)
         take = min(cap, batch.capacity)
         data[:, :take] = batch.data[idx, :take]

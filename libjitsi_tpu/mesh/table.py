@@ -114,18 +114,31 @@ class ShardedSrtpTable(SrtpStreamTable):
         if value is None:
             self._sh_dev = None
 
+    @classmethod
+    def restore(cls, snap: dict, mesh: Mesh) -> "ShardedSrtpTable":
+        """Resume a snapshot as a MESH table (a checkpointed mesh
+        deployment must come back sharded, not silently single-chip)."""
+        from libjitsi_tpu.transform.srtp.policy import SrtpProfile
+
+        t = cls(len(snap["active"]), mesh,
+                SrtpProfile(snap["profile"]))
+        t._load_state(snap)
+        return t
+
     def warmup(self, max_batch: int, off_const=12) -> None:
         """Pre-compile the shard_map protect/unprotect ladder so live
         ticks never absorb an XLA compile (the same discipline as
         AudioMixer's setup-time warmup): lane counts are power-of-two
-        padded, so compiling the pow2 ladder up to `max_batch/n_dev`
-        covers every shape a batch up to `max_batch` can produce for
-        the given payload offset.  Other offsets (rare: header
-        extensions vary per batch) still compile lazily, like the
-        size-class bucketing elsewhere."""
+        padded and bounded by the BATCH size (worst-case skew parks a
+        whole batch on one chip), so the pow2 ladder up to `max_batch`
+        covers every lane shape a batch that size can produce for the
+        given payload offset.  Other offsets (rare: header extensions
+        vary per batch) still compile lazily, like the size-class
+        bucketing elsewhere.  Called by ConferenceBridge.warmup();
+        standalone deployments call it before going live."""
         tab_rk, tab_mid = self._sharded_device()
         lanes = 4
-        top = max(4, -(-max_batch // self.n_dev))
+        top = max(4, max_batch)
         while True:
             for op in ("protect", "unprotect"):
                 fn = self._shard_fn(op, self.policy.auth_tag_len,
