@@ -169,19 +169,7 @@ class ConferenceBridge:
             # the first participant's codec sets the bridge clock; later
             # joins at other rates resample to it (reference: AudioMixer
             # normalizing via the Speex resampler, SURVEY §2.4/§2.5)
-            self._frame_samples = codec.frame_samples
-            self._rate = codec.sample_rate
-            mix_fn = None
-            if self._mesh is not None:
-                from libjitsi_tpu.mesh import sharded_mix_minus
-                mix_fn = sharded_mix_minus(self._mesh)
-            self.mixer = AudioMixer(capacity=self.capacity,
-                                    frame_samples=codec.frame_samples,
-                                    mix_fn=mix_fn)
-            self.bank = ReceiveBank(self.capacity, mixer=self.mixer,
-                                    payload_cap=max(256,
-                                                    codec.frame_samples),
-                                    mixer_rate=codec.sample_rate)
+            self._bootstrap_clock(codec.frame_samples, codec.sample_rate)
         self.registry.map_ssrc(ssrc, sid)
         self.bank.add_stream(sid, codec)
         self.mixer.add_participant(sid)
@@ -191,6 +179,36 @@ class ConferenceBridge:
         self._tx_seq[sid] = int.from_bytes(np.random.bytes(2), "big")
         self._tx_ts[sid] = int.from_bytes(np.random.bytes(4), "big")
         self._tx_ssrc[sid] = (0x42000000 + sid) & 0xFFFFFFFF
+
+    def warmup(self) -> None:
+        """Pre-compile the tick's device programs before going live so
+        no 20 ms tick absorbs an XLA compile (reference analog: the
+        crypto.Aes startup benchmark).  The mixer warms at construction;
+        this warms the SRTP tables — in mesh mode the shard_map lane
+        ladder, and for GCM profiles the grouped/per-row measurement."""
+        max_batch = 4 * self.capacity
+        for table in (self.rx_table, self.tx_table):
+            if hasattr(table, "warmup"):          # mesh table ladder
+                table.warmup(max_batch)
+            else:
+                table.warmup_rtp(min(max_batch, 256))
+
+    def _bootstrap_clock(self, frame_samples: int, rate: int) -> None:
+        """Fix the bridge clock and build the mixer + receive bank
+        (first join live; snapshot restore re-applies the RECORDED
+        clock so a mixed-rate conference resumes on the same one)."""
+        self._frame_samples = frame_samples
+        self._rate = rate
+        mix_fn = None
+        if self._mesh is not None:
+            from libjitsi_tpu.mesh import sharded_mix_minus
+            mix_fn = sharded_mix_minus(self._mesh)
+        self.mixer = AudioMixer(capacity=self.capacity,
+                                frame_samples=frame_samples,
+                                mix_fn=mix_fn)
+        self.bank = ReceiveBank(self.capacity, mixer=self.mixer,
+                                payload_cap=max(256, frame_samples),
+                                mixer_rate=rate)
 
     def add_participant_dtls(self, ssrc: int,
                              codec: Optional[FrameCodec] = None,
@@ -358,33 +376,34 @@ class ConferenceBridge:
         scores and latched addresses — a restarted bridge resumes the
         playout windows so nothing glitches.
 
-        Scope: legs must use STATELESS codecs (G.711) — stateful codec
-        predictor state (opus/gsm/speex/g722 C objects) cannot be
-        serialized, and resuming them desynced would corrupt audio, so
-        this refuses instead.  Mid-DTLS participants are excluded (they
-        rejoin via signaling), like the SFU snapshot.
+        Codec legs: stateless codecs (G.711) resume bit-exactly.
+        Stateful codecs (opus/G.722/GSM/speex — C predictor state that
+        cannot be serialized) resume DEGRADED: the codec re-initializes
+        on restore (decoder PLC warms up over the first frames, encoder
+        restarts with default tuning) while SRTP counters and replay
+        windows carry over exactly — streams survive instead of dying
+        (SURVEY §5 checkpoint row).  `degraded_rows` in the snapshot
+        names the affected legs.  Mid-DTLS participants are excluded
+        (they rejoin via signaling), like the SFU snapshot.
         """
         self.loop.flush_sends()      # a pipelined tick's last frame
         keyed = {sid: ssrc for sid, ssrc in self._ssrc_of.items()
                  if sid not in self._dtls.pending}
-        bad = {s: self._codec[s].name for s in keyed
-               if self._codec[s].name.upper() not in self._STATELESS}
-        if bad:
-            raise RuntimeError(
-                f"checkpoint supports stateless codec legs only "
-                f"(G.711); rows {bad} hold C codec state that cannot "
-                f"be serialized")
         return {
             "capacity": self.capacity,
             "profile": self.profile.name,
             "ptime_ms": self.ptime_ms,
             "level_ext_id": self._level_ext_id,
+            "rate": self._rate,
+            "frame_samples": self._frame_samples,
             "rx_table": self.rx_table.snapshot(),
             "tx_table": self.tx_table.snapshot(),
             "jb": self.bank.jb.snapshot() if self.bank else None,
             "ssrc_of": keyed,
-            "codec_ulaw": {s: self._codec[s].name.upper() == "PCMU"
-                           for s in keyed},
+            "codec_name": {s: self._codec[s].name for s in keyed},
+            "degraded_rows": sorted(
+                s for s in keyed
+                if self._codec[s].name.upper() not in self._STATELESS),
             "tx_seq": self._tx_seq.copy(),
             "tx_ts": self._tx_ts.copy(),
             "tx_ssrc": self._tx_ssrc.copy(),
@@ -405,21 +424,42 @@ class ConferenceBridge:
         from libjitsi_tpu.rtp.dense_jitter import DenseJitterBank
         from libjitsi_tpu.transform.srtp import SrtpStreamTable as _T
 
+        from libjitsi_tpu.service.pump import codec_from_name
+
         bridge = cls(config, port=port, capacity=snap["capacity"],
                      profile=SrtpProfile[snap["profile"]],
                      ptime_ms=snap["ptime_ms"],
                      audio_level_ext_id=snap["level_ext_id"], **kwargs)
         sids = sorted(snap["ssrc_of"])
         bridge.registry.reserve_many(sids, bridge)
+        if snap.get("rate"):
+            # resume on the RECORDED bridge clock (a mixed-rate
+            # conference's clock came from its first joiner, who may
+            # not be first in row order here)
+            bridge._bootstrap_clock(snap["frame_samples"], snap["rate"])
+        names = snap.get("codec_name")
+        if names is None:      # pre-degraded-resume snapshot format
+            names = {s: "PCMU" if snap["codec_ulaw"][s] else "PCMA"
+                     for s in sids}
         for sid in sids:
+            # stateful codecs come back freshly initialized — the
+            # documented degraded-resume semantics (see snapshot)
             bridge._attach_media_row(
                 sid, snap["ssrc_of"][sid],
-                g711_codec(ulaw=snap["codec_ulaw"][sid],
-                           ptime_ms=snap["ptime_ms"]))
+                codec_from_name(names[sid], snap["ptime_ms"]))
         # the crypto, playout and counter state resumes verbatim (jb
-        # AFTER add_stream: add_stream resets rows, restore overrides)
-        bridge.rx_table = _T.restore(snap["rx_table"])
-        bridge.tx_table = _T.restore(snap["tx_table"])
+        # AFTER add_stream: add_stream resets rows, restore overrides);
+        # a mesh bridge must come back with MESH tables — a silent
+        # single-chip fallback would un-shard the deployment
+        if bridge._mesh is not None:
+            from libjitsi_tpu.mesh import ShardedSrtpTable
+            bridge.rx_table = ShardedSrtpTable.restore(snap["rx_table"],
+                                                       bridge._mesh)
+            bridge.tx_table = ShardedSrtpTable.restore(snap["tx_table"],
+                                                       bridge._mesh)
+        else:
+            bridge.rx_table = _T.restore(snap["rx_table"])
+            bridge.tx_table = _T.restore(snap["tx_table"])
         bridge.chain = TransformEngineChain(
             [bridge.levels_engine,
              SrtpTransformEngine(bridge.tx_table, bridge.rx_table)])

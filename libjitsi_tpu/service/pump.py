@@ -137,11 +137,42 @@ def ilbc_rx_codec() -> FrameCodec:
                       lambda b: dec.decode_payload(b))
 
 
+def codec_from_name(name: str, ptime_ms: int) -> FrameCodec:
+    """Rebuild a codec leg from its wire name (checkpoint restore).
+
+    Stateless codecs (G.711) resume bit-exactly.  Stateful codecs
+    (opus/G.722/GSM/speex) come back with FRESH C state — the
+    degraded-resume semantics (SURVEY §5 checkpoint row): the decoder's
+    PLC warms up over the first frames and the encoder restarts clean
+    (default tuning; signaling re-applies custom bitrates), while SRTP
+    counters and replay windows carry over exactly.  The alternative —
+    refusing to checkpoint any conference using the codec real
+    conferences use — kills every stream instead (round-3 verdict #5).
+    """
+    u = name.upper()
+    if u == "PCMU":
+        return g711_codec(True, ptime_ms)
+    if u == "PCMA":
+        return g711_codec(False, ptime_ms)
+    if u == "G722":
+        return g722_codec(ptime_ms)
+    if u == "GSM":
+        return gsm_codec()
+    if u == "OPUS":
+        return opus_codec(ptime_ms)
+    if u.startswith("SPEEX"):
+        rate = name.split("/", 1)[1] if "/" in name else "8000"
+        return speex_codec({"8000": "nb", "16000": "wb",
+                            "32000": "uwb"}[rate])
+    raise ValueError(f"cannot rebuild codec {name!r} on restore")
+
+
 def opus_codec(ptime_ms: int = 20, bitrate: int = 32000) -> FrameCodec:
     from libjitsi_tpu.codecs.opus import OpusDecoder, OpusEncoder
 
     n = 48000 * ptime_ms // 1000
-    enc = OpusEncoder(sample_rate=48000, channels=1, bitrate=bitrate)
+    enc = OpusEncoder(sample_rate=48000, channels=1)
+    enc.set_bitrate(bitrate)
     dec = OpusDecoder(sample_rate=48000, channels=1)
     return FrameCodec(
         "opus", 111, 48000, n, n,

@@ -101,3 +101,38 @@ def test_mesh_bridge_tick_matches_single_chip():
     with pytest.raises(ValueError):
         ConferenceBridge(cfg, port=0, capacity=16, mesh=mesh,
                          pipelined=True)
+
+
+def test_mesh_bridge_restore_stays_sharded_and_warmup():
+    """A checkpointed mesh bridge must resume with MESH tables (not a
+    silent single-chip fallback), and warmup() must pre-compile the
+    lane ladder / measurement off the tick path."""
+    import libjitsi_tpu
+    from libjitsi_tpu.service.bridge import ConferenceBridge
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    mesh = make_media_mesh()
+    bridge = ConferenceBridge(cfg, port=0, capacity=16,
+                              recv_window_ms=0, mesh=mesh)
+    bridge.add_participant(5, (b"\x05" * 16, b"\x06" * 14),
+                           (b"\x07" * 16, b"\x08" * 14))
+    snap = bridge.snapshot()
+    bridge.close()
+    b2 = ConferenceBridge.restore(cfg, snap, port=0, recv_window_ms=0,
+                                  mesh=mesh)
+    assert isinstance(b2.rx_table, ShardedSrtpTable)
+    assert isinstance(b2.tx_table, ShardedSrtpTable)
+    # sharded warmup ladder: compiles banked before any tick
+    b2.rx_table.warmup(max_batch=8)
+    assert ("protect", 10, True, 12) in b2.rx_table._sh_fns
+    b2.close()
+    # non-mesh warmup path (scratch table, real state untouched)
+    b3 = ConferenceBridge(cfg, port=0, capacity=8, recv_window_ms=0)
+    b3.add_participant(6, (b"\x01" * 16, b"\x02" * 14),
+                       (b"\x03" * 16, b"\x04" * 14))
+    tx_before = b3.tx_table.tx_ext.copy()
+    b3.warmup()
+    np.testing.assert_array_equal(b3.tx_table.tx_ext, tx_before)
+    b3.close()
