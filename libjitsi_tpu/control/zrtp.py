@@ -37,6 +37,7 @@ HASH_S256 = b"S256"
 CIPHER_AES1 = b"AES1"
 AUTH_HS80 = b"HS80"
 KA_EC25 = b"EC25"
+KA_MULT = b"Mult"
 SAS_B32 = b"B32 "
 
 _B32_ALPHABET = "ybndrfg8ejkmcpqxot1uwisza345h769"  # RFC 6189 §5.1.6
@@ -116,6 +117,48 @@ def _parse_msg(msg: bytes) -> Optional[Tuple[bytes, bytes]]:
     return msg[4:12], msg[12:]
 
 
+# -------------------------------------------------------------- zid cache --
+
+class ZidCache:
+    """RFC 6189 §4.9 retained-secret cache: peer ZID → (rs1, rs2).
+
+    After every completed DH-mode session both sides derive the same
+    fresh retained secret and shift it in (rs1 → rs2, new → rs1); the
+    next session's s0 then mixes the matching secret as s1 — KEY
+    CONTINUITY: a MITM who wasn't in the first session cannot produce
+    the continuity secret even if the SAS is never compared.  Keeping
+    TWO generations tolerates one-sided update loss (a side that
+    crashed before updating still matches the peer's rs2).
+
+    In-memory; `snapshot()`/`restore()` give the caller a serializable
+    form (the reference's zrtp4j persists its ZidFile likewise).
+    """
+
+    def __init__(self):
+        self._store: Dict[bytes, Tuple[bytes, Optional[bytes]]] = {}
+
+    def lookup(self, zid: bytes) -> Tuple[Optional[bytes], Optional[bytes]]:
+        return self._store.get(bytes(zid), (None, None))
+
+    def update(self, zid: bytes, rs_new: bytes) -> None:
+        rs1, _ = self.lookup(zid)
+        self._store[bytes(zid)] = (bytes(rs_new), rs1)
+
+    def forget(self, zid: bytes) -> None:
+        self._store.pop(bytes(zid), None)
+
+    def snapshot(self) -> dict:
+        return {z: (rs1, rs2) for z, (rs1, rs2) in self._store.items()}
+
+    @classmethod
+    def restore(cls, snap: dict) -> "ZidCache":
+        c = cls()
+        c._store = {bytes(z): (bytes(rs1), None if rs2 is None
+                               else bytes(rs2))
+                    for z, (rs1, rs2) in snap.items()}
+        return c
+
+
 # --------------------------------------------------------------- endpoint --
 
 class ZrtpProtocolError(RuntimeError):
@@ -135,9 +178,36 @@ class ZrtpEndpoint:
     string (the MITM defense: both users compare the 4 chars).
     """
 
-    def __init__(self, zid: Optional[bytes] = None, ssrc: int = 0):
+    def __init__(self, zid: Optional[bytes] = None, ssrc: int = 0,
+                 cache: Optional[ZidCache] = None,
+                 multistream_from: Optional["ZrtpEndpoint"] = None):
+        """`cache`: RFC 6189 §4.9 retained-secret store — sessions with
+        a cached peer mix the shared secret into s0 (key continuity)
+        and rotate it on completion.  `multistream_from`: a COMPLETED
+        DH-mode endpoint of the same peer association; this endpoint
+        then keys via Multistream mode (§4.4.3) — no DH, s0 derived
+        from the parent's ZRTPSess session key."""
+        if multistream_from is not None:
+            if multistream_from.session_key is None:
+                raise RuntimeError(
+                    "multistream_from endpoint has no session key "
+                    "(DH-mode exchange not complete)")
+            zid = multistream_from.zid if zid is None else zid
         self.zid = zid if zid is not None else os.urandom(12)
         self.ssrc = ssrc
+        self.cache = cache
+        self._zrtp_sess = (None if multistream_from is None
+                           else multistream_from.session_key)
+        # _mult is the NEGOTIATED mode: seeded by capability here, but a
+        # peer's DH-mode Commit flips it off (a mult-capable responder
+        # must follow the wire, not its constructor)
+        self._mult = multistream_from is not None
+        self._mult_nonce: Optional[bytes] = None
+        self._rotated = False
+        # outcomes (read after complete): did a retained secret match
+        # (key continuity held), and this session's exportable ZRTPSess
+        self.secret_continuity = False
+        self.session_key: Optional[bytes] = None
         # hash image chain (RFC 6189 §9)
         self._h0 = os.urandom(32)
         self._h1 = _sha256(self._h0)
@@ -174,6 +244,14 @@ class ZrtpEndpoint:
         return core[:-8] + mac
 
     def _make_commit(self) -> bytes:
+        if self._mult:
+            # Multistream mode (RFC 6189 §4.4.3): no DH — a fresh nonce
+            # rides where DH mode carries the hvi commitment
+            self._mult_nonce = os.urandom(16)
+            payload = self._h2 + self.zid + HASH_S256 + CIPHER_AES1 + \
+                AUTH_HS80 + KA_MULT + SAS_B32 + self._mult_nonce
+            core = _msg(b"Commit  ", payload + b"\x00" * 8)
+            return core[:-8] + _hmac(self._h1, core[:-8])[:8]
         dh2 = self._make_dhpart(b"DHPart2 ")
         hvi = _sha256(dh2 + self._peer[b"Hello   "])
         payload = self._h2 + self.zid + HASH_S256 + CIPHER_AES1 + \
@@ -183,9 +261,23 @@ class ZrtpEndpoint:
         self._my_dhpart = dh2
         return core[:-8] + mac
 
+    def _secret_ids(self, role_label: bytes) -> bytes:
+        """RFC 6189 §4.3.1 rs1ID/rs2ID (+ random aux/pbx IDs): each is
+        MAC(secret, sender-role label) truncated to 8 bytes; a side with
+        no cached secret for this peer sends random IDs, which simply
+        never match."""
+        rs1 = rs2 = None
+        if self.cache is not None and b"Hello   " in self._peer:
+            rs1, rs2 = self.cache.lookup(self._peer_zid())
+        ids = b""
+        for rs in (rs1, rs2):
+            ids += (_hmac(rs, role_label)[:8] if rs is not None
+                    else os.urandom(8))
+        return ids + os.urandom(16)      # auxsecretID, pbxsecretID
+
     def _make_dhpart(self, mtype: bytes) -> bytes:
-        rs = os.urandom(32)  # 4 independent secret-IDs (no cached secrets)
-        payload = self._h1 + rs + self._pub_bytes()
+        label = b"Initiator" if mtype == b"DHPart2 " else b"Responder"
+        payload = self._h1 + self._secret_ids(label) + self._pub_bytes()
         core = _msg(mtype, payload + b"\x00" * 8)
         mac = _hmac(self._h0, core[:-8])[:8]
         return core[:-8] + mac
@@ -276,18 +368,36 @@ class ZrtpEndpoint:
                 self._my_commit = None
                 self._my_dhpart = None
             if mtype in self._peer:
-                if self._peer[mtype] != msg or self._my_dhpart is None:
+                if self._peer[mtype] != msg:
                     return []
-                # duplicate Commit: resend the SAME DHPart1 (regenerating
-                # would fork total_hash between the two sides)
+                # duplicate Commit: resend the SAME reply (regenerating
+                # a DHPart1 would fork total_hash between the sides)
+                if self._mult and self._s0 is not None:
+                    return [self._send(self._make_confirm(b"Confirm1"))]
+                if self._my_dhpart is None:
+                    return []
                 return [self._send(self._my_dhpart)]
             peer_h2 = payload[:32]
             if _sha256(peer_h2) != self._peer_hello_h3():
                 raise ZrtpProtocolError("ZRTP: Commit H2 does not chain to H3")
             # H2 now known -> verify the peer Hello's MAC retroactively
             self._check_mac(self._peer[b"Hello   "], peer_h2, "Hello")
+            if payload[56:60] == KA_MULT:
+                # Multistream commit (§4.4.3): no DH round — derive s0
+                # from the shared ZRTPSess and confirm directly
+                if self._zrtp_sess is None:
+                    raise ZrtpProtocolError(
+                        "ZRTP: Multistream Commit but no session key "
+                        "(no completed DH-mode association)")
+                self._peer[mtype] = msg
+                self.role = "responder"
+                self._mult = True
+                self._derive()
+                out.append(self._send(self._make_confirm(b"Confirm1")))
+                return out
             self._peer[mtype] = msg
             self.role = "responder"
+            self._mult = False        # peer chose DH mode: follow it
             self._my_dhpart = self._make_dhpart(b"DHPart1 ")
             out.append(self._send(self._my_dhpart))
         elif mtype == b"DHPart1 ":
@@ -334,19 +444,35 @@ class ZrtpEndpoint:
             self._derive()
             out.append(self._send(self._make_confirm(b"Confirm1")))
         elif mtype == b"Confirm1":
-            if self.role != "initiator" or b"DHPart1 " not in self._peer:
+            if self.role != "initiator" or \
+                    (b"DHPart1 " not in self._peer and not self._mult):
                 return []
             self._derive()
             self._verify_confirm(payload)
             out.append(self._send(self._make_confirm(b"Confirm2")))
             self.complete = True
+            self._on_complete()
         elif mtype == b"Confirm2":
             if self.role != "responder" or self._s0 is None:
                 return []
             self._verify_confirm(payload)
             out.append(self._send(_msg(b"Conf2ACK", b"")))
             self.complete = True
+            self._on_complete()
         return out
+
+    def _on_complete(self) -> None:
+        """Post-completion continuity bookkeeping (DH mode): rotate the
+        retained secret both sides derive identically (§4.5.2) — the
+        NEXT session's s0 then proves this one wasn't MITM'd.
+        Idempotent: Confirms retransmit on lossy paths, and a double
+        rotation would overwrite BOTH cached generations with the same
+        value, losing the drift tolerance rs2 exists for."""
+        if self._mult or self.cache is None or self._rotated:
+            return
+        self._rotated = True
+        rs_new = _kdf(self._s0, b"retained secret", self._ctx, 256)
+        self.cache.update(self._peer_zid(), rs_new)
 
     # ---------------------------------------------------------- key sched
     def _peer_hello_h3(self) -> bytes:
@@ -371,28 +497,73 @@ class ZrtpEndpoint:
         return self._ec_priv.exchange(ec.ECDH(),
                                       self._parse_point(self._peer_pub))
 
+    def _match_retained(self) -> Optional[bytes]:
+        """s1 selection (RFC 6189 §4.3): compare the PEER's rs1ID/rs2ID
+        (from its DHPart, keyed by the peer's role label) against our
+        cached generations; first match wins.  Both sides hold the same
+        rotated values, so they pick the same secret — and the 2x2 scan
+        tolerates one side having missed one rotation."""
+        if self.cache is None:
+            return None
+        peer_dh = self._peer.get(b"DHPart1 " if self.role == "initiator"
+                                 else b"DHPart2 ")
+        if peer_dh is None:
+            return None
+        ids = (peer_dh[12 + 32:12 + 40], peer_dh[12 + 40:12 + 48])
+        peer_label = b"Responder" if self.role == "initiator" \
+            else b"Initiator"
+        for mine in self.cache.lookup(self._peer_zid()):
+            if mine is not None and _hmac(mine, peer_label)[:8] in ids:
+                return mine
+        return None
+
     def _derive(self) -> None:
         if self._s0 is not None:
             return
+        if self._mult:
+            self._derive_mult()
+            return
+        zidi, zidr, hello_r, commit = self._session_parties()
         if self.role == "initiator":
-            zidi, zidr = self.zid, self._peer_zid()
-            hello_r = self._peer[b"Hello   "]
-            commit = self._my_commit
             dh1 = self._peer[b"DHPart1 "]
             dh2 = self._my_dhpart
         else:
-            zidi, zidr = self._peer_zid(), self.zid
-            hello_r = self._my_hello
-            commit = self._peer[b"Commit  "]
             dh1 = self._my_dhpart
             dh2 = self._peer[b"DHPart2 "]
         total_hash = _sha256(hello_r + commit + dh1 + dh2)
         dhr = self._dh_result()
-        # RFC 6189 §4.4.1.4 (no cached secrets: s1=s2=s3 null)
+        # RFC 6189 §4.4.1.4: s1 = matching retained secret (key
+        # continuity) or null; aux/pbx (s2, s3) not modeled -> null
+        s1 = self._match_retained()
+        self.secret_continuity = s1 is not None
         null = struct.pack("!I", 0)
+        s1_part = (struct.pack("!I", len(s1)) + s1) if s1 else null
         self._s0 = _sha256(struct.pack("!I", 1) + dhr + b"ZRTP-HMAC-KDF" +
-                           zidi + zidr + total_hash + null + null + null)
+                           zidi + zidr + total_hash + s1_part + null + null)
         self._ctx = zidi + zidr + total_hash
+        self.sas = sas_b32(_kdf(self._s0, b"SAS", self._ctx, 256))
+        # exportable session key: Multistream children key off this
+        # (§4.5.2), so additional media streams skip the DH entirely
+        self.session_key = _kdf(self._s0, b"ZRTP Session Key",
+                                self._ctx, 256)
+
+    def _session_parties(self):
+        """Role-dependent (zidi, zidr, responder-Hello, Commit) shared
+        by the DH and Multistream derivations."""
+        if self.role == "initiator":
+            return (self.zid, self._peer_zid(),
+                    self._peer[b"Hello   "], self._my_commit)
+        return (self._peer_zid(), self.zid,
+                self._my_hello, self._peer[b"Commit  "])
+
+    def _derive_mult(self) -> None:
+        """Multistream s0 (RFC 6189 §4.4.3.2): KDF from the parent
+        association's ZRTPSess over THIS stream's negotiation hash (the
+        Commit carries a fresh nonce, so every stream's keys differ)."""
+        zidi, zidr, hello_r, commit = self._session_parties()
+        total_hash = _sha256(hello_r + commit)
+        self._ctx = zidi + zidr + total_hash
+        self._s0 = _kdf(self._zrtp_sess, b"ZRTP MSK", self._ctx, 256)
         self.sas = sas_b32(_kdf(self._s0, b"SAS", self._ctx, 256))
 
     def _peer_zid(self) -> bytes:
@@ -414,8 +585,8 @@ class ZrtpEndpoint:
         if not hmac_mod.compare_digest(
                 _hmac(self._mackey_peer(), peer_h0)[:8], mac):
             raise ZrtpProtocolError("ZRTP: Confirm MAC mismatch")
-        # retroactive checks: H0 -> H1 seen in peer DHPart, and H0 keys
-        # the DHPart message MAC (RFC 6189 §8.1.1)
+        # retroactive checks (RFC 6189 §8.1.1): H0 -> H1 seen in peer
+        # DHPart, and H0 keys the DHPart message MAC
         dh = self._peer.get(b"DHPart1 " if self.role == "initiator"
                             else b"DHPart2 ")
         if dh is not None:
@@ -423,6 +594,22 @@ class ZrtpEndpoint:
                 raise ZrtpProtocolError(
                     "ZRTP: H0 does not chain to DHPart H1")
             self._check_mac(dh, peer_h0, "DHPart")
+        if self._mult:
+            # no DHPart revealed intermediate images in mult mode: the
+            # Confirm's H0 must chain all the way to the peer Hello's
+            # H3, and (responder side) it keys the Commit MAC the DH
+            # path verifies via DHPart2
+            h1 = _sha256(peer_h0)
+            h2 = _sha256(h1)
+            if _sha256(h2) != self._peer_hello_h3():
+                raise ZrtpProtocolError(
+                    "ZRTP: Confirm H0 does not chain to Hello H3")
+            commit = self._peer.get(b"Commit  ")
+            if commit is not None:     # peer was the mult initiator
+                if h2 != commit[12:44]:
+                    raise ZrtpProtocolError(
+                        "ZRTP: Confirm H0 does not chain to Commit H2")
+                self._check_mac(commit, h1, "Commit")
 
     # -------------------------------------------------------------- export
     def srtp_keys(self):

@@ -275,3 +275,152 @@ def test_zrtp_alerts_bounded():
     for _ in range(300):
         b.feed(forged)
     assert len(b.alerts) <= 64
+
+
+def test_zrtp_retained_secret_continuity_across_sessions():
+    """VERDICT r3 #8 (RFC 6189 §4.3/§4.9): a second session between the
+    same endpoints mixes the cached retained secret into s0 — key
+    continuity holds and the caches rotate in lockstep."""
+    from libjitsi_tpu.control.zrtp import ZidCache
+
+    ca, cb = ZidCache(), ZidCache()
+    zid_a, zid_b = b"A" * 12, b"B" * 12
+    a1 = ZrtpEndpoint(zid=zid_a, ssrc=1, cache=ca)
+    b1 = ZrtpEndpoint(zid=zid_b, ssrc=2, cache=cb)
+    run_zrtp(a1, b1)
+    # first contact: nothing cached yet
+    assert not a1.secret_continuity and not b1.secret_continuity
+    rs1_a, rs2_a = ca.lookup(zid_b)
+    assert rs1_a is not None and rs2_a is None
+    assert ca.lookup(zid_b) == cb.lookup(zid_a), "caches must rotate in sync"
+
+    a2 = ZrtpEndpoint(zid=zid_a, ssrc=1, cache=ca)
+    b2 = ZrtpEndpoint(zid=zid_b, ssrc=2, cache=cb)
+    run_zrtp(a2, b2)
+    assert a2.secret_continuity and b2.secret_continuity
+    assert a2.srtp_keys()[1] != a1.srtp_keys()[1], "sessions must re-key"
+    # rotation: old rs1 shifted to rs2
+    assert ca.lookup(zid_b) == (ca.lookup(zid_b)[0], rs1_a)
+
+    # one-generation drift: A lost its newest secret (restored old
+    # cache) -> rs2 cross-match still gives continuity
+    ca2 = ZidCache.restore({zid_b: (rs1_a, None)})
+    a3 = ZrtpEndpoint(zid=zid_a, ssrc=1, cache=ca2)
+    b3 = ZrtpEndpoint(zid=zid_b, ssrc=2, cache=cb)
+    run_zrtp(a3, b3)
+    assert a3.secret_continuity and b3.secret_continuity
+
+
+def test_zrtp_cache_mismatch_still_completes():
+    """A peer with no (or a wrong) cache falls back to a null s1: the
+    handshake completes, continuity just reads False on both sides."""
+    from libjitsi_tpu.control.zrtp import ZidCache
+
+    ca, cb = ZidCache(), ZidCache()
+    zid_a, zid_b = b"C" * 12, b"D" * 12
+    run_zrtp(ZrtpEndpoint(zid=zid_a, ssrc=1, cache=ca),
+             ZrtpEndpoint(zid=zid_b, ssrc=2, cache=cb))
+    a = ZrtpEndpoint(zid=zid_a, ssrc=1, cache=ZidCache())  # lost cache
+    b = ZrtpEndpoint(zid=zid_b, ssrc=2, cache=cb)
+    run_zrtp(a, b)
+    assert not a.secret_continuity and not b.secret_continuity
+    pa, atk, ats, ark, ars = a.srtp_keys()
+    pb, btk, bts, brk, brs = b.srtp_keys()
+    assert (atk, ats) == (brk, brs), "mismatch must not fork the keys"
+
+
+def test_zrtp_multistream_keys_second_stream_without_dh():
+    """RFC 6189 §4.4.3: a second media stream keys off the first
+    association's ZRTPSess — Commit(Mult, nonce) -> Confirm, no DH
+    round, per-stream keys distinct from the parent's."""
+    a1, b1 = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    run_zrtp(a1, b1)
+    assert a1.session_key == b1.session_key is not None
+
+    a2 = ZrtpEndpoint(ssrc=3, multistream_from=a1)
+    b2 = ZrtpEndpoint(ssrc=4, multistream_from=b1)
+    run_zrtp(a2, b2)
+    # no DH messages crossed the wire for the second stream
+    assert b"DHPart1 " not in a2._peer and b"DHPart2 " not in b2._peer
+    pa, atk, ats, ark, ars = a2.srtp_keys()
+    pb, btk, bts, brk, brs = b2.srtp_keys()
+    assert (atk, ats) == (brk, brs) and (ark, ars) == (btk, bts)
+    assert atk != a1.srtp_keys()[1], "per-stream keys must differ"
+
+    # keys drive real SRTP both streams
+    tx = SrtpStreamTable(capacity=1, profile=pa)
+    tx.add_stream(0, atk, ats)
+    rx = SrtpStreamTable(capacity=1, profile=pb)
+    rx.add_stream(0, brk, brs)
+    pkt = rtp_header.build([b"mult-keyed"], [1], [0], [9], [96],
+                           stream=[0])
+    dec, ok = rx.unprotect_rtp(tx.protect_rtp(pkt))
+    assert ok.all() and dec.to_bytes(0) == pkt.to_bytes(0)
+
+    # a non-multistream endpoint refuses a Mult commit (alert, drop)
+    c = ZrtpEndpoint(ssrc=5)
+    a3 = ZrtpEndpoint(ssrc=6, multistream_from=a1)
+    wire = [(0, p) for p in a3.hello_packets()] + \
+           [(1, p) for p in c.hello_packets()]
+    for _ in range(4):
+        nxt = []
+        for who, pkt in wire:
+            ep = c if who == 0 else a3
+            nxt += [(1 - who, p) for p in ep.feed(pkt)]
+        wire = nxt
+        if b"Hello   " in a3._peer and a3.role is None:
+            wire += [(0, p) for p in a3.initiate()]
+    assert not c.complete
+    assert any("session key" in s for s in c.alerts)
+
+
+def test_zrtp_duplicate_confirm_does_not_double_rotate():
+    """Retransmitted Confirms must not rotate the retained-secret cache
+    twice (a double rotation overwrites both generations with the same
+    value, losing the one-generation drift tolerance)."""
+    from libjitsi_tpu.control.zrtp import ZidCache
+
+    ca, cb = ZidCache(), ZidCache()
+    zid_a, zid_b = b"E" * 12, b"F" * 12
+    run_zrtp(ZrtpEndpoint(zid=zid_a, ssrc=1, cache=ca),
+             ZrtpEndpoint(zid=zid_b, ssrc=2, cache=cb))
+    gen1 = ca.lookup(zid_b)
+
+    a = ZrtpEndpoint(zid=zid_a, ssrc=1, cache=ca)
+    b = ZrtpEndpoint(zid=zid_b, ssrc=2, cache=cb)
+    # capture + replay every packet once (lossy-path retransmit shape)
+    wire = [(0, p) for p in a.hello_packets()] + \
+           [(1, p) for p in b.hello_packets()]
+    started = False
+    for _ in range(30):
+        nxt = []
+        for who, pkt in wire:
+            ep = b if who == 0 else a
+            nxt += [(1 - who, p) for p in ep.feed(pkt)]
+            nxt += [(1 - who, p) for p in ep.feed(pkt)]   # duplicate
+        wire = nxt
+        if not started and b"Hello   " in a._peer:
+            wire += [(0, p) for p in a.initiate()]
+            started = True
+        if a.complete and b.complete:
+            break
+    assert a.complete and b.complete
+    rs1, rs2 = ca.lookup(zid_b)
+    assert rs2 == gen1[0], "old generation must survive one rotation"
+    assert rs1 != rs2
+    assert ca.lookup(zid_b) == cb.lookup(zid_a)
+
+
+def test_zrtp_mult_capable_endpoint_follows_peer_dh_commit():
+    """A multistream-capable responder whose peer commits in DH mode
+    must key via DH (the negotiated mode, not the constructor flag)."""
+    a1, b1 = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    run_zrtp(a1, b1)
+    dh_init = ZrtpEndpoint(ssrc=3)                       # plain DH peer
+    mult_resp = ZrtpEndpoint(ssrc=4, multistream_from=b1)
+    run_zrtp(dh_init, mult_resp)
+    assert dh_init.complete and mult_resp.complete
+    assert not mult_resp._mult, "wire-negotiated mode must win"
+    pa, atk, ats, _, _ = dh_init.srtp_keys()
+    _, _, _, brk, brs = mult_resp.srtp_keys()
+    assert (atk, ats) == (brk, brs)
