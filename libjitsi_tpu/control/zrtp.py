@@ -356,17 +356,29 @@ class ZrtpEndpoint:
             if b"Hello   " not in self._peer:
                 return []
             if self.role == "initiator":
-                # Commit contention (RFC 6189 §4.2): both sides committed.
-                # The LOWER hvi backs down to responder and processes the
-                # peer's Commit; the higher one drops the peer's.
-                hvi_off = 12 + 32 + 12 + 20
-                ours = self._my_commit[hvi_off:hvi_off + 32]
-                theirs = msg[hvi_off:hvi_off + 32]
-                if ours >= theirs:
+                # Commit contention (RFC 6189 §4.2): both sides
+                # committed.  A DH-mode Commit beats a Multistream one
+                # (comparing the 32B hvi against a 16B nonce would be
+                # meaningless, and the DH side cannot process Mult);
+                # same-mode ties break on the LOWER value backing down
+                # to responder and processing the peer's Commit.
+                ka_off = 12 + 32 + 12 + 12
+                ours_ka = self._my_commit[ka_off:ka_off + 4]
+                theirs_ka = msg[ka_off:ka_off + 4]
+                if ours_ka != theirs_ka:
+                    if ours_ka != KA_MULT:
+                        return []          # our DH Commit wins
+                    we_lose = True         # our Mult loses to their DH
+                else:
+                    hvi_off = 12 + 32 + 12 + 20
+                    we_lose = self._my_commit[hvi_off:hvi_off + 32] < \
+                        msg[hvi_off:hvi_off + 32]
+                if not we_lose:
                     return []               # we win; peer backs down
                 self.role = None            # back down, re-process below
                 self._my_commit = None
                 self._my_dhpart = None
+                self._mult_nonce = None
             if mtype in self._peer:
                 if self._peer[mtype] != msg:
                     return []
@@ -565,6 +577,10 @@ class ZrtpEndpoint:
         self._ctx = zidi + zidr + total_hash
         self._s0 = _kdf(self._zrtp_sess, b"ZRTP MSK", self._ctx, 256)
         self.sas = sas_b32(_kdf(self._s0, b"SAS", self._ctx, 256))
+        # ZRTPSess is per ASSOCIATION (§4.5.2): propagate it so further
+        # streams can key off this endpoint even when the caller only
+        # kept the newest one
+        self.session_key = self._zrtp_sess
 
     def _peer_zid(self) -> bytes:
         hello = self._peer[b"Hello   "]

@@ -424,3 +424,52 @@ def test_zrtp_mult_capable_endpoint_follows_peer_dh_commit():
     pa, atk, ats, _, _ = dh_init.srtp_keys()
     _, _, _, brk, brs = mult_resp.srtp_keys()
     assert (atk, ats) == (brk, brs)
+
+
+def test_zrtp_mult_vs_dh_commit_contention_resolves_to_dh():
+    """RFC 6189 §4.2 cross-mode contention: when a Multistream Commit
+    races a DH Commit, the DH side wins (a DH peer cannot process Mult)
+    and the handshake completes in DH mode."""
+    a1, b1 = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    run_zrtp(a1, b1)
+    mult = ZrtpEndpoint(ssrc=3, multistream_from=a1)
+    dh = ZrtpEndpoint(ssrc=4)
+    # both initiate after the hello exchange
+    wire = [(0, p) for p in mult.hello_packets()] + \
+           [(1, p) for p in dh.hello_packets()]
+    committed = False
+    for _ in range(30):
+        nxt = []
+        for who, pkt in wire:
+            ep = dh if who == 0 else mult
+            nxt += [(1 - who, p) for p in ep.feed(pkt)]
+        wire = nxt
+        if not committed and b"Hello   " in mult._peer \
+                and b"Hello   " in dh._peer:
+            wire += [(0, p) for p in mult.initiate()]
+            wire += [(1, p) for p in dh.initiate()]
+            committed = True
+        if mult.complete and dh.complete:
+            break
+    assert mult.complete and dh.complete, "cross-mode contention wedged"
+    assert dh.role == "initiator" and mult.role == "responder"
+    assert not mult._mult, "resolved session must be DH mode"
+    pa, atk, ats, _, _ = dh.srtp_keys()
+    _, _, _, brk, brs = mult.srtp_keys()
+    assert (atk, ats) == (brk, brs)
+
+
+def test_zrtp_multistream_chains_from_mult_endpoint():
+    """ZRTPSess is per association: a further stream can key off the
+    NEWEST completed endpoint, not only the original DH one."""
+    a1, b1 = ZrtpEndpoint(ssrc=1), ZrtpEndpoint(ssrc=2)
+    run_zrtp(a1, b1)
+    a2 = ZrtpEndpoint(ssrc=3, multistream_from=a1)
+    b2 = ZrtpEndpoint(ssrc=4, multistream_from=b1)
+    run_zrtp(a2, b2)
+    assert a2.session_key == a1.session_key is not None
+    a3 = ZrtpEndpoint(ssrc=5, multistream_from=a2)   # chained off mult
+    b3 = ZrtpEndpoint(ssrc=6, multistream_from=b2)
+    run_zrtp(a3, b3)
+    assert a3.srtp_keys()[1] == b3.srtp_keys()[3]
+    assert a3.srtp_keys()[1] != a2.srtp_keys()[1]
