@@ -17,19 +17,24 @@ from libjitsi_tpu.transform.srtp import SrtpStreamTable
 
 
 def assert_table_parity(mesh, capacity: int, batch_size: int,
-                        rounds: int = 2) -> None:
+                        rounds: int = 2, profile=None) -> None:
     """Sharded table protect/unprotect must match the plain table byte
-    for byte, including the host replay planes."""
+    for byte, including the host replay planes (any supported profile:
+    CM and GCM both ride this)."""
     from libjitsi_tpu.mesh import ShardedSrtpTable
+    from libjitsi_tpu.transform.srtp import SrtpProfile
 
+    if profile is None:
+        profile = SrtpProfile.AES_CM_128_HMAC_SHA1_80
+    salt_len = profile.policy.salt_len
     rng = np.random.default_rng(23)
     mks = rng.integers(0, 256, (capacity, 16), dtype=np.uint8)
-    mss = rng.integers(0, 256, (capacity, 14), dtype=np.uint8)
+    mss = rng.integers(0, 256, (capacity, salt_len), dtype=np.uint8)
 
     def build_pair():
-        sh = ShardedSrtpTable(capacity, mesh)
+        sh = ShardedSrtpTable(capacity, mesh, profile)
         sh.add_streams(np.arange(capacity), mks, mss)
-        pl = SrtpStreamTable(capacity)
+        pl = SrtpStreamTable(capacity, profile)
         pl.add_streams(np.arange(capacity), mks, mss)
         return sh, pl
 

@@ -20,8 +20,10 @@ Reference: `SRTPTransformer`'s per-SSRC context map scaled by running
 more JVMs; here the ONE table spans the mesh and `RTPTranslatorImpl`-
 scale fan-outs (SURVEY §3.4) ride the same row partition.
 
-Profile scope: AES-CM / NULL-cipher profiles (the hot SRTP suites).
-GCM's grouped-GHASH grid and F8's second schedule stay single-chip for
+Profile scope: AES-CM / NULL / AES-GCM profiles.  GCM shards via its
+PER-ROW form (key schedule + GHASH matrix gathers are chip-local; the
+grouped-GHASH grid would span shards and per-row is the measured winner
+below ~32k rows anyway).  F8's second schedule stays single-chip for
 now — the table raises rather than silently falling back.  SRTCP
 (low-rate control traffic) intentionally uses the inherited single-chip
 path.
@@ -86,10 +88,11 @@ class ShardedSrtpTable(SrtpStreamTable):
     def __init__(self, capacity: int, mesh: Mesh,
                  profile: SrtpProfile =
                  SrtpProfile.AES_CM_128_HMAC_SHA1_80):
-        if profile.policy.cipher not in (Cipher.AES_CM, Cipher.NULL):
+        if profile.policy.cipher not in (Cipher.AES_CM, Cipher.NULL,
+                                         Cipher.AES_GCM):
             raise ValueError(
-                f"ShardedSrtpTable supports AES-CM/NULL profiles; "
-                f"{profile.value} stays single-chip for now")
+                f"ShardedSrtpTable supports AES-CM/NULL/AES-GCM "
+                f"profiles; {profile.value} stays single-chip for now")
         n_dev = int(mesh.devices.size)
         if capacity % n_dev:
             raise ValueError(f"capacity {capacity} not divisible by "
@@ -136,22 +139,28 @@ class ShardedSrtpTable(SrtpStreamTable):
         vary per batch) still compile lazily, like the size-class
         bucketing elsewhere.  Called by ConferenceBridge.warmup();
         standalone deployments call it before going live."""
-        tab_rk, tab_mid = self._sharded_device()
+        tab_rk, tab_aux = self._sharded_device()
+        gcm = self._gcm
+        ops = ("gcm_protect", "gcm_unprotect") if gcm \
+            else ("protect", "unprotect")
         lanes = 4
         top = max(4, max_batch)
         while True:
-            for op in ("protect", "unprotect"):
+            for op in ops:
                 fn = self._shard_fn(op, self.policy.auth_tag_len,
                                     self.policy.cipher != Cipher.NULL,
                                     off_const)
                 shape = (self.n_dev, lanes)
-                args = (tab_rk, tab_mid,
+                args = [tab_rk, tab_aux,
                         jnp.zeros(shape, jnp.int32),
                         jnp.zeros(shape + (256,), jnp.uint8),
                         jnp.full(shape, 64, jnp.int32),
-                        jnp.full(shape, off_const, jnp.int32),
-                        jnp.zeros(shape + (16,), jnp.uint8),
-                        jnp.zeros(shape, jnp.uint32))
+                        jnp.full(shape, off_const, jnp.int32)]
+                if gcm:
+                    args.append(jnp.zeros(shape + (12,), jnp.uint8))
+                else:
+                    args += [jnp.zeros(shape + (16,), jnp.uint8),
+                             jnp.zeros(shape, jnp.uint32)]
                 jax.block_until_ready(fn(*args))
             if lanes >= top:
                 break
@@ -160,8 +169,9 @@ class ShardedSrtpTable(SrtpStreamTable):
     def _sharded_device(self):
         if self._sh_dev is None:
             spec = NamedSharding(self.mesh, P(AXIS, None, None))
+            aux = self._gm_rtp if self._gcm else self._mid_rtp
             self._sh_dev = (jax.device_put(self._rk_rtp, spec),
-                            jax.device_put(self._mid_rtp, spec))
+                            jax.device_put(aux, spec))
             # sharded placement copies, but flag anyway so _cow_tables
             # repoints before any in-place mutation (same discipline as
             # the single-chip device cache)
@@ -169,47 +179,46 @@ class ShardedSrtpTable(SrtpStreamTable):
         return self._sh_dev
 
     # ------------------------------------------------------- sharded seams
-    def _cm_rtp_protect_call(self, stream, batch, hdr, iv, v):
-        tab_rk, tab_mid = self._sharded_device()
+    def _run_sharded(self, op: str, stream, batch, hdr, length,
+                     tail_args):
+        """Plan/gather/dispatch/scatter shared by ALL the seams: route
+        batch rows to their owning chips, run the op under shard_map,
+        scatter results back to wire order.  `tail_args` are the op's
+        trailing per-row arrays in batch-row order (iv/roc for CM,
+        iv12 for GCM)."""
+        tab_rk, tab_aux = self._sharded_device()
         plan = _OwnerPlan(stream, self.capacity, self.rows_per,
                           self.n_dev)
         off_const = _uniform_off(hdr.payload_off, batch.capacity)
-        fn = self._shard_fn("protect", self.policy.auth_tag_len,
+        fn = self._shard_fn(op, self.policy.auth_tag_len,
                             self.policy.cipher != Cipher.NULL, off_const)
         local = self._local_streams(stream, plan)
-        data, length = fn(
-            tab_rk, tab_mid, local,
-            jnp.asarray(batch.data[plan.slot]),
-            jnp.asarray(np.asarray(batch.length,
-                                   dtype=np.int32)[plan.slot]),
-            jnp.asarray(np.asarray(hdr.payload_off)[plan.slot]),
-            jnp.asarray(iv[plan.slot]),
-            jnp.asarray((np.asarray(v, dtype=np.uint64)
-                         & 0xFFFFFFFF).astype(np.uint32)[plan.slot]))
-        out = np.asarray(data).reshape(-1, np.asarray(data).shape[-1])
-        olen = np.asarray(length).reshape(-1)
-        return out[plan.inv], olen[plan.inv].astype(np.int32)
-
-    def _cm_rtp_unprotect_call(self, stream, batch, hdr, iv, v, length):
-        tab_rk, tab_mid = self._sharded_device()
-        plan = _OwnerPlan(stream, self.capacity, self.rows_per,
-                          self.n_dev)
-        off_const = _uniform_off(hdr.payload_off, batch.capacity)
-        fn = self._shard_fn("unprotect", self.policy.auth_tag_len,
-                            self.policy.cipher != Cipher.NULL, off_const)
-        local = self._local_streams(stream, plan)
-        data, mlen, auth_ok = fn(
-            tab_rk, tab_mid, local,
+        outs = fn(
+            tab_rk, tab_aux, local,
             jnp.asarray(batch.data[plan.slot]),
             jnp.asarray(np.asarray(length, dtype=np.int32)[plan.slot]),
             jnp.asarray(np.asarray(hdr.payload_off)[plan.slot]),
-            jnp.asarray(iv[plan.slot]),
-            jnp.asarray((np.asarray(v, dtype=np.uint64)
-                         & 0xFFFFFFFF).astype(np.uint32)[plan.slot]))
-        out = np.asarray(data).reshape(-1, np.asarray(data).shape[-1])
-        return (out[plan.inv],
-                np.asarray(mlen).reshape(-1)[plan.inv].astype(np.int32),
-                np.asarray(auth_ok).reshape(-1)[plan.inv])
+            *(jnp.asarray(np.asarray(a)[plan.slot]) for a in tail_args))
+        data = np.asarray(outs[0])
+        data = data.reshape(-1, data.shape[-1])[plan.inv]
+        rest = [np.asarray(o).reshape(-1)[plan.inv] for o in outs[1:]]
+        return (data, *rest)
+
+    @staticmethod
+    def _roc32(v) -> np.ndarray:
+        return (np.asarray(v, dtype=np.uint64)
+                & 0xFFFFFFFF).astype(np.uint32)
+
+    def _cm_rtp_protect_call(self, stream, batch, hdr, iv, v):
+        data, olen = self._run_sharded("protect", stream, batch, hdr,
+                                       batch.length, [iv, self._roc32(v)])
+        return data, olen.astype(np.int32)
+
+    def _cm_rtp_unprotect_call(self, stream, batch, hdr, iv, v, length):
+        data, mlen, auth_ok = self._run_sharded(
+            "unprotect", stream, batch, hdr, length,
+            [iv, self._roc32(v)])
+        return data, mlen.astype(np.int32), auth_ok
 
     def _local_streams(self, stream: np.ndarray, plan: _OwnerPlan):
         """Per-lane chip-local row indices: global row minus the owning
@@ -222,10 +231,54 @@ class ShardedSrtpTable(SrtpStreamTable):
         return jnp.asarray(np.clip(s - base, 0, self.rows_per - 1)
                            .astype(np.int32))
 
+    # ----------------------------------------------------- GCM (per row)
+    def _gcm_rtp_protect_call(self, stream, batch, hdr, iv12):
+        """Sharded AEAD: the PER-ROW form is row-local (key schedule +
+        GHASH matrix gather with chip-local indices), so it shards like
+        CM with zero collectives.  The grouped-GHASH form needs its
+        grid built per shard — future work; per-row is the measured
+        winner below ~32k rows anyway (BASELINE round-4 crossover)."""
+        data, olen = self._run_sharded("gcm_protect", stream, batch,
+                                       hdr, batch.length, [iv12])
+        return data, olen.astype(np.int32)
+
+    def _gcm_rtp_unprotect_call(self, stream, batch, hdr, iv12, length):
+        data, mlen, auth_ok = self._run_sharded(
+            "gcm_unprotect", stream, batch, hdr, length, [iv12])
+        return data, mlen.astype(np.int32), auth_ok
+
     def _shard_fn(self, op: str, tag_len: int, encrypt: bool, off_const):
+        if op.startswith("gcm_"):
+            # GCM's tag/encrypt are fixed by the kernel: normalize them
+            # OUT of the cache key so warmup and the live seams can
+            # never build the same program under different keys
+            tag_len, encrypt = 0, True
         key = (op, tag_len, encrypt, off_const)
         fn = self._sh_fns.get(key)
         if fn is not None:
+            return fn
+        row3 = P(AXIS, None, None)
+        lanes = P(AXIS, None)
+        if op.startswith("gcm_"):
+            from libjitsi_tpu.kernels import gcm as gcm_kernel
+
+            gfn = gcm_kernel.gcm_protect if op == "gcm_protect" \
+                else gcm_kernel.gcm_unprotect
+
+            def _run(tab_rk, tab_gm, local, data, length, off, iv12):
+                out = gfn(data[0], length[0], off[0], tab_rk[local[0]],
+                          tab_gm[local[0]], iv12[0],
+                          aad_const=off_const)
+                return tuple(o[None] for o in out)
+
+            n_out = 2 if op == "gcm_protect" else 3
+            fn = jax.jit(jax.shard_map(
+                _run, mesh=self.mesh,
+                in_specs=(row3, row3, lanes, row3, lanes, lanes, row3),
+                out_specs=(row3, lanes) if n_out == 2
+                else (row3, lanes, lanes),
+                check_vma=False))
+            self._sh_fns[key] = fn
             return fn
         kfn = kernel.srtp_protect if op == "protect" \
             else kernel.srtp_unprotect
@@ -237,8 +290,6 @@ class ShardedSrtpTable(SrtpStreamTable):
                       encrypt, payload_off_const=off_const)
             return tuple(o[None] for o in out)
 
-        row3 = P(AXIS, None, None)
-        lanes = P(AXIS, None)
         n_out = 2 if op == "protect" else 3
         fn = jax.jit(jax.shard_map(
             _run, mesh=self.mesh,

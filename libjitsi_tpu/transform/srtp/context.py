@@ -772,28 +772,12 @@ class SrtpStreamTable:
         idx = chain_packet_indices(stream, hdr.seq, self.tx_ext)
         v = idx >> 16
 
-        if self._gcm or self._f8:   # CM fetches its tables in its seam
+        if self._f8:                # CM/GCM fetch tables in their seams
             tab_rk, tab_aux, _, _ = self._device()
         if self._gcm:
             iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
-            grid = _gcm_grid(stream)
-            aad_const = _uniform_off(hdr.payload_off, batch.capacity)
-            if grid is not None:
-                gr, us, inv = grid
-                # grouped vs per-row: measured per shape signature
-                data, length = _registry.call(
-                    "gcm_rtp_protect", tab_rk, tab_aux,
-                    jnp.asarray(stream, dtype=jnp.int32),
-                    jnp.asarray(batch.data), jnp.asarray(batch.length),
-                    jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
-                    jnp.asarray(gr), jnp.asarray(us, dtype=jnp.int32),
-                    jnp.asarray(inv), aad_const)
-            else:    # skew: the padded grid is structurally wasteful
-                data, length = _protect_gcm_dev(
-                    tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
-                    jnp.asarray(batch.data), jnp.asarray(batch.length),
-                    jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
-                    aad_const=aad_const)
+            data, length = self._gcm_rtp_protect_call(stream, batch,
+                                                      hdr, iv12)
         elif self._f8:
             iv = self._f8_rtp_iv(hdr, v)
             data, length = _protect_rtp_dev(
@@ -810,6 +794,51 @@ class SrtpStreamTable:
                                                      iv, v)
         np.maximum.at(self.tx_ext, stream, idx)
         return data, length, batch.stream
+
+    def _gcm_rtp_protect_call(self, stream, batch, hdr, iv12):
+        """AEAD-GCM RTP protect device call — like the CM seam, the
+        mesh table overrides exactly this (per-row form, row-local);
+        single-chip picks grouped vs per-row by registry measurement."""
+        aad_const = _uniform_off(hdr.payload_off, batch.capacity)
+        tab_rk, tab_gm, _, _ = self._device()
+        grid = _gcm_grid(stream)
+        if grid is not None:
+            gr, us, inv = grid
+            # grouped vs per-row: measured per shape signature
+            return _registry.call(
+                "gcm_rtp_protect", tab_rk, tab_gm,
+                jnp.asarray(stream, dtype=jnp.int32),
+                jnp.asarray(batch.data), jnp.asarray(batch.length),
+                jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
+                jnp.asarray(gr), jnp.asarray(us, dtype=jnp.int32),
+                jnp.asarray(inv), aad_const)
+        # skew: the padded grid is structurally wasteful
+        return _protect_gcm_dev(
+            tab_rk, tab_gm, jnp.asarray(stream, dtype=jnp.int32),
+            jnp.asarray(batch.data), jnp.asarray(batch.length),
+            jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
+            aad_const=aad_const)
+
+    def _gcm_rtp_unprotect_call(self, stream, batch, hdr, iv12, length):
+        """AEAD-GCM RTP unprotect seam; returns (data, media_len,
+        auth_ok) — see _gcm_rtp_protect_call."""
+        aad_const = _uniform_off(hdr.payload_off, batch.capacity)
+        tab_rk, tab_gm, _, _ = self._device()
+        grid = _gcm_grid(stream)
+        if grid is not None:
+            gr, us, inv = grid
+            return _registry.call(
+                "gcm_rtp_unprotect", tab_rk, tab_gm,
+                jnp.asarray(stream, dtype=jnp.int32),
+                jnp.asarray(batch.data), jnp.asarray(length),
+                jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
+                jnp.asarray(gr), jnp.asarray(us, dtype=jnp.int32),
+                jnp.asarray(inv), aad_const)
+        return _unprotect_gcm_dev(
+            tab_rk, tab_gm, jnp.asarray(stream, dtype=jnp.int32),
+            jnp.asarray(batch.data), jnp.asarray(length),
+            jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
+            aad_const=aad_const)
 
     def _cm_rtp_protect_call(self, stream, batch, hdr, iv, v):
         """AES-CM/NULL RTP protect device call — the mesh table
@@ -913,27 +942,12 @@ class SrtpStreamTable:
         v = idx >> 16
         not_replayed = replay.check(self.rx_max, self.rx_mask, stream, idx)
 
-        if self._gcm or self._f8:   # CM fetches its tables in its seam
+        if self._f8:                # CM/GCM fetch tables in their seams
             tab_rk, tab_aux, _, _ = self._device()
         if self._gcm:
             iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
-            grid = _gcm_grid(stream)
-            aad_const = _uniform_off(hdr.payload_off, batch.capacity)
-            if grid is not None:
-                gr, us, inv = grid
-                data, mlen, auth_ok = _registry.call(
-                    "gcm_rtp_unprotect", tab_rk, tab_aux,
-                    jnp.asarray(stream, dtype=jnp.int32),
-                    jnp.asarray(batch.data), jnp.asarray(length),
-                    jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
-                    jnp.asarray(gr), jnp.asarray(us, dtype=jnp.int32),
-                    jnp.asarray(inv), aad_const)
-            else:
-                data, mlen, auth_ok = _unprotect_gcm_dev(
-                    tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
-                    jnp.asarray(batch.data), jnp.asarray(length),
-                    jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
-                    aad_const=aad_const)
+            data, mlen, auth_ok = self._gcm_rtp_unprotect_call(
+                stream, batch, hdr, iv12, length)
         elif self._f8:
             iv = self._f8_rtp_iv(hdr, v)
             data, mlen, auth_ok = _unprotect_rtp_dev(

@@ -77,8 +77,9 @@ def test_sharded_replay_and_tamper_rejection():
 
 def test_sharded_table_rejects_unsupported():
     mesh = make_media_mesh()
+    # GCM is supported since round 4; F8 remains single-chip
     with pytest.raises(ValueError):
-        ShardedSrtpTable(CAP, mesh, SrtpProfile.AEAD_AES_128_GCM)
+        ShardedSrtpTable(CAP, mesh, SrtpProfile.F8_128_HMAC_SHA1_80)
     with pytest.raises(ValueError):
         ShardedSrtpTable(CAP + 1, mesh)
 
@@ -136,3 +137,33 @@ def test_mesh_bridge_restore_stays_sharded_and_warmup():
     b3.warmup()
     np.testing.assert_array_equal(b3.tx_table.tx_ext, tx_before)
     b3.close()
+
+
+def test_sharded_gcm_table_parity_and_rtcp():
+    """AEAD-GCM on the sharded table: per-row-form shard_map must be
+    bit-identical to the single-chip GCM table (which itself picks
+    grouped/per-row by measurement), and the inherited single-chip
+    SRTCP path must work on the sharded object."""
+    from libjitsi_tpu.core.packet import PacketBatch
+    from libjitsi_tpu.mesh.parity import assert_table_parity
+
+    mesh = make_media_mesh()
+    assert_table_parity(mesh, capacity=CAP, batch_size=24,
+                        profile=SrtpProfile.AEAD_AES_128_GCM)
+    # SRTCP through the sharded object (inherited path)
+    rng = np.random.default_rng(3)
+    mks = rng.integers(0, 256, (CAP, 16), dtype=np.uint8)
+    mss = rng.integers(0, 256, (CAP, 12), dtype=np.uint8)
+    tx = ShardedSrtpTable(CAP, mesh, SrtpProfile.AEAD_AES_128_GCM)
+    tx.add_streams(np.arange(CAP), mks, mss)
+    rx = ShardedSrtpTable(CAP, mesh, SrtpProfile.AEAD_AES_128_GCM)
+    rx.add_streams(np.arange(CAP), mks, mss)
+    blob = b"\x81\xc8\x00\x06" + (0x1234).to_bytes(4, "big") + b"x" * 20
+    b = PacketBatch.from_payloads([blob], stream=[2])
+    wire = tx.protect_rtcp(b)
+    dec, ok = rx.unprotect_rtcp(wire)
+    assert bool(np.all(ok)) and dec.to_bytes(0) == blob
+    # warmup and the live seams must share one fn-cache key (the gcm
+    # ops normalize tag/encrypt out of the key)
+    tx.warmup(max_batch=8)
+    assert ("gcm_protect", 0, True, 12) in tx._sh_fns
