@@ -9,3 +9,4 @@ from libjitsi_tpu.mesh.sharded import (  # noqa: F401
     sharded_media_step,
 )
 from libjitsi_tpu.mesh.table import ShardedSrtpTable  # noqa: F401
+from libjitsi_tpu.mesh.translator import ShardedRtpTranslator  # noqa: F401

@@ -115,9 +115,10 @@ def run_bridge_once(cfg, mesh, capacity: int, rounds: int = 2) -> dict:
             bridge.tick(now=now + 0.001)
             for j, (_ssrc, _prot, eng) in enumerate(clis):
                 back, _, _ = eng.recv_batch(timeout_ms=2)
-                for i in range(back.batch_size):
+                if back.batch_size:
                     hdr = rtp_header.parse(back)
-                    got[(j, int(hdr.seq[i]))] = back.to_bytes(i)
+                    for i in range(back.batch_size):
+                        got[(j, int(hdr.seq[i]))] = back.to_bytes(i)
             now += 0.020
     finally:
         for _ssrc, _prot, eng in clis:
@@ -136,3 +137,63 @@ def assert_bridge_parity(cfg, mesh, capacity: int) -> None:
     if plain != meshed:
         raise AssertionError(
             "assembled mesh ConferenceBridge egress != single-chip")
+
+
+def run_sfu_once(cfg, mesh, capacity: int, rounds: int = 3) -> dict:
+    """One tiny 3-endpoint audio SFU conference over loopback UDP
+    (mesh-mode when `mesh` is not None), deterministic tick clock;
+    returns {(endpoint, sender_ssrc, seq): wire_bytes}."""
+    from libjitsi_tpu.io import UdpEngine
+    from libjitsi_tpu.service.sfu_bridge import SfuBridge
+
+    sfu = SfuBridge(cfg, port=0, capacity=capacity, recv_window_ms=0,
+                    mesh=mesh)
+    eps = []
+    for k in range(3):
+        ssrc = 0x600 + 9 * k
+        rx_key = (bytes([ssrc & 0xFF]) * 16,
+                  bytes([(ssrc + 1) & 0xFF]) * 14)
+        tx_key = (bytes([(ssrc + 2) & 0xFF]) * 16,
+                  bytes([(ssrc + 3) & 0xFF]) * 14)
+        prot = SrtpStreamTable(capacity=1)
+        prot.add_stream(0, *rx_key)
+        eng = UdpEngine(port=0, max_batch=64)
+        sfu.add_endpoint(ssrc, rx_key, tx_key)
+        eps.append((ssrc, prot, eng))
+    got = {}
+    now = 60.0
+    try:
+        for r in range(rounds):
+            for ssrc, prot, eng in eps:
+                b = rtp_header.build(
+                    [b"sfu-%08x-%d" % (ssrc, r)], [400 + r], [r * 960],
+                    [ssrc], [96], stream=[0])
+                eng.send_batch(prot.protect_rtp(b), "127.0.0.1",
+                               sfu.port)
+            for _ in range(12):
+                sfu.tick(now=now)
+            for j, (_ssrc, _prot, eng) in enumerate(eps):
+                back, _, _ = eng.recv_batch(timeout_ms=2)
+                if back.batch_size:
+                    hdr = rtp_header.parse(back)
+                    for i in range(back.batch_size):
+                        got[(j, int(hdr.ssrc[i]), int(hdr.seq[i]))] = \
+                            back.to_bytes(i)
+            now += 0.020
+    finally:
+        for _ssrc, _prot, eng in eps:
+            eng.close()
+        sfu.close()
+    return got
+
+
+def assert_sfu_parity(cfg, mesh, capacity: int) -> None:
+    """Assembled mesh-mode SfuBridge fan-out must be byte-identical to
+    the single-chip bridge for the same conference."""
+    plain = run_sfu_once(cfg, None, capacity)
+    meshed = run_sfu_once(cfg, mesh, capacity)
+    if len(plain) < 6:
+        raise AssertionError("sfu parity run produced too little egress")
+    if plain != meshed:
+        raise AssertionError(
+            "assembled mesh SfuBridge egress != single-chip")

@@ -51,6 +51,19 @@ from libjitsi_tpu.transform.srtp.context import SrtpStreamTable, _uniform_off
 from libjitsi_tpu.transform.srtp.policy import Cipher, SrtpProfile
 
 
+def local_rows(plan: "_OwnerPlan", ids: np.ndarray, capacity: int,
+               rows_per: int, n_dev: int) -> np.ndarray:
+    """Per-lane chip-local row indices for a planned batch: global row
+    id minus the owning chip's base offset (lanes holding another
+    chip's pad row clamp into range and produce garbage the scatter
+    drops).  ONE implementation for every sharded consumer — the table
+    and the fan-out translator must agree with _OwnerPlan's layout."""
+    s = np.clip(np.asarray(ids, dtype=np.int64), 0, capacity - 1)[
+        plan.slot]
+    base = (np.arange(n_dev, dtype=np.int64) * rows_per)[:, None]
+    return np.clip(s - base, 0, rows_per - 1).astype(np.int32)
+
+
 class _OwnerPlan:
     """Host-side routing of one batch onto the row partition: `slot`
     [n_dev, per] gathers batch rows into per-device lanes (pads repeat a
@@ -221,15 +234,8 @@ class ShardedSrtpTable(SrtpStreamTable):
         return data, mlen.astype(np.int32), auth_ok
 
     def _local_streams(self, stream: np.ndarray, plan: _OwnerPlan):
-        """Per-lane chip-local row indices: global row minus the owning
-        chip's base offset.  Lanes holding another chip's pad row clamp
-        into range and produce garbage that the scatter drops."""
-        s = np.clip(np.asarray(stream, dtype=np.int64), 0,
-                    self.capacity - 1)[plan.slot]
-        base = (np.arange(self.n_dev, dtype=np.int64)
-                * self.rows_per)[:, None]
-        return jnp.asarray(np.clip(s - base, 0, self.rows_per - 1)
-                           .astype(np.int32))
+        return jnp.asarray(local_rows(plan, stream, self.capacity,
+                                      self.rows_per, self.n_dev))
 
     # ----------------------------------------------------- GCM (per row)
     def _gcm_rtp_protect_call(self, stream, batch, hdr, iv12):

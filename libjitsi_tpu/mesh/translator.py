@@ -1,0 +1,123 @@
+"""ShardedRtpTranslator — the SFU fan-out primitive on a device mesh.
+
+The decrypt-once / re-encrypt-N fan-out (BASELINE config #5, reference
+`RTPTranslatorImpl`, SURVEY §3.4) is embarrassingly parallel over the
+RECEIVER axis: each output row's key material belongs to exactly one
+receiver leg, so partitioning legs across chips makes every key gather
+chip-local — zero collectives, the same stream-data-parallel doctrine
+as `ShardedSrtpTable` (the packets each chip needs are routed to it by
+the host plan, which already expands the (packet × receiver) matrix).
+
+The routing/expansion/IV host plane is `RtpTranslator`'s, unchanged;
+only the CM protect launch seam is overridden.  GCM fan-outs stay
+single-chip at product level for now (`mesh/sharded.py`'s
+`sharded_gcm_fanout` covers the kernel; the grouped per-leg matrix form
+needs a per-shard grid) — the constructor refuses rather than silently
+falling back.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from libjitsi_tpu.mesh.sharded import AXIS
+from libjitsi_tpu.mesh.table import _OwnerPlan, local_rows
+from libjitsi_tpu.sfu.translator import RtpTranslator
+from libjitsi_tpu.transform.srtp import kernel
+from libjitsi_tpu.transform.srtp.policy import Cipher, SrtpProfile
+
+
+class ShardedRtpTranslator(RtpTranslator):
+    """`RtpTranslator` whose re-encrypt fan-out runs sharded by leg.
+
+    Async caveat: `translate_async` still works, but the sharded seam
+    scatters results on the HOST, so the pending object holds already-
+    materialized arrays — there is no launch/recv overlap in mesh mode.
+    Callers that depend on the overlap must not use the mesh translator
+    (SfuBridge refuses mesh+pipelined for exactly this reason).
+    """
+
+    def __init__(self, capacity: int, mesh: Mesh,
+                 profile: SrtpProfile =
+                 SrtpProfile.AES_CM_128_HMAC_SHA1_80):
+        if profile.policy.cipher not in (Cipher.AES_CM, Cipher.NULL):
+            raise ValueError(
+                f"ShardedRtpTranslator supports AES-CM/NULL profiles; "
+                f"{profile.value} stays single-chip for now")
+        n_dev = int(mesh.devices.size)
+        if capacity % n_dev:
+            raise ValueError(f"capacity {capacity} not divisible by "
+                             f"{n_dev} mesh devices")
+        self.mesh = mesh
+        self.n_dev = n_dev
+        self.rows_per = capacity // n_dev
+        self._sh_dev = None
+        self._sh_fns = {}
+        super().__init__(capacity, profile)
+
+    # mirror the parent's invalidation signal onto the sharded copies
+    @property
+    def _dev(self):
+        return self.__dev
+
+    @_dev.setter
+    def _dev(self, value):
+        self.__dev = value
+        if value is None:
+            self._sh_dev = None
+
+    def _sharded_device(self):
+        if self._sh_dev is None:
+            spec = NamedSharding(self.mesh, P(AXIS, None, None))
+            self._sh_dev = (jax.device_put(self._rk, spec),
+                            jax.device_put(self._mid, spec))
+        return self._sh_dev
+
+    def _cm_fanout_call(self, recv, data, length, payload_off, iv, idx):
+        tab_rk, tab_mid = self._sharded_device()
+        plan = _OwnerPlan(np.asarray(recv, dtype=np.int64),
+                          self.capacity, self.rows_per, self.n_dev)
+        local = local_rows(plan, recv, self.capacity, self.rows_per,
+                           self.n_dev)
+        fn = self._fanout_fn()
+        out, out_len = fn(
+            tab_rk, tab_mid, jnp.asarray(local),
+            jnp.asarray(np.asarray(data)[plan.slot]),
+            jnp.asarray(np.asarray(length,
+                                   dtype=np.int32)[plan.slot]),
+            jnp.asarray(np.asarray(payload_off)[plan.slot]),
+            jnp.asarray(np.asarray(iv)[plan.slot]),
+            jnp.asarray(((np.asarray(idx) >> 16) & 0xFFFFFFFF)
+                        .astype(np.uint32)[plan.slot]))
+        o = np.asarray(out)
+        return (o.reshape(-1, o.shape[-1])[plan.inv],
+                np.asarray(out_len).reshape(-1)[plan.inv]
+                .astype(np.int32))
+
+    def _fanout_fn(self):
+        key = ("fanout", self.policy.auth_tag_len,
+               self.policy.cipher != Cipher.NULL)
+        fn = self._sh_fns.get(key)
+        if fn is not None:
+            return fn
+        tag_len = self.policy.auth_tag_len
+        encrypt = self.policy.cipher != Cipher.NULL
+
+        def _run(tab_rk, tab_mid, local, data, length, off, iv, roc):
+            out = kernel.srtp_protect(
+                data[0], length[0], off[0], tab_rk[local[0]], iv[0],
+                tab_mid[local[0]], roc[0], tag_len, encrypt)
+            return tuple(o[None] for o in out)
+
+        row3 = P(AXIS, None, None)
+        lanes = P(AXIS, None)
+        fn = jax.jit(jax.shard_map(
+            _run, mesh=self.mesh,
+            in_specs=(row3, row3, lanes, row3, lanes, lanes, row3,
+                      lanes),
+            out_specs=(row3, lanes), check_vma=False))
+        self._sh_fns[key] = fn
+        return fn

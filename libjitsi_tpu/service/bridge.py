@@ -392,6 +392,7 @@ class ConferenceBridge:
         return {
             "capacity": self.capacity,
             "profile": self.profile.name,
+            "sharded": self._mesh is not None,
             "ptime_ms": self.ptime_ms,
             "level_ext_id": self._level_ext_id,
             "rate": self._rate,
@@ -451,6 +452,11 @@ class ConferenceBridge:
         # AFTER add_stream: add_stream resets rows, restore overrides);
         # a mesh bridge must come back with MESH tables — a silent
         # single-chip fallback would un-shard the deployment
+        if snap.get("sharded") and bridge._mesh is None:
+            raise ValueError(
+                "snapshot came from a MESH bridge; pass mesh=... to "
+                "restore (resuming single-chip would silently un-shard "
+                "the deployment)")
         if bridge._mesh is not None:
             from libjitsi_tpu.mesh import ShardedSrtpTable
             bridge.rx_table = ShardedSrtpTable.restore(snap["rx_table"],

@@ -151,7 +151,8 @@ class SfuBridge:
                  recv_window_ms: int = 1,
                  kernel_timestamps: bool = False,
                  abs_send_time_ext_id: int = 3,
-                 pipelined: bool = False):
+                 pipelined: bool = False,
+                 mesh=None):
         self.capacity = capacity
         self.profile = profile
         self.ast_ext_id = abs_send_time_ext_id
@@ -161,11 +162,28 @@ class SfuBridge:
         self.registry = StreamRegistry(config, capacity=capacity)
         # rx_table: what endpoints SEND us (media + their SRTCP);
         # tx_table: what we send THEM (our SRTCP feedback; media forward
-        # crypto is the translator's per-leg fan-out)
-        self.rx_table = SrtpStreamTable(capacity, profile)
-        self.tx_table = SrtpStreamTable(capacity, profile)
-        self.translator = RtpTranslator(capacity=capacity,
-                                        profile=profile)
+        # crypto is the translator's per-leg fan-out).  Mesh mode
+        # (SURVEY §2.7, VERDICT r3 #2): tables row-partition and the
+        # fan-out shards by receiver leg — the assembled SFU tick runs
+        # sharded, not just its kernels.
+        self._mesh = mesh
+        if mesh is not None:
+            if pipelined:
+                # sharded scatters materialize on host: the overlap
+                # seam would silently be a no-op (see mesh/table.py)
+                raise ValueError("mesh mode does not support "
+                                 "pipelined=True yet")
+            from libjitsi_tpu.mesh import (ShardedRtpTranslator,
+                                           ShardedSrtpTable)
+            self.rx_table = ShardedSrtpTable(capacity, mesh, profile)
+            self.tx_table = ShardedSrtpTable(capacity, mesh, profile)
+            self.translator = ShardedRtpTranslator(capacity, mesh,
+                                                   profile)
+        else:
+            self.rx_table = SrtpStreamTable(capacity, profile)
+            self.tx_table = SrtpStreamTable(capacity, profile)
+            self.translator = RtpTranslator(capacity=capacity,
+                                            profile=profile)
         self.cache = PacketCache()
         self.rtcp_term = RtcpTermination(bridge_ssrc=0x5F0BFF)
         self.loop = MediaLoop(
@@ -714,6 +732,7 @@ class SfuBridge:
         return {
             "capacity": self.capacity,
             "profile": self.profile.name,
+            "sharded": self._mesh is not None,
             "ast_ext_id": self.ast_ext_id,
             "rx_table": self.rx_table.snapshot(),
             "tx_table": self.tx_table.snapshot(),
@@ -743,8 +762,22 @@ class SfuBridge:
         bridge = cls(config, port=port, capacity=snap["capacity"],
                      profile=SrtpProfile[snap["profile"]],
                      abs_send_time_ext_id=snap["ast_ext_id"], **kwargs)
-        bridge.rx_table = _T.restore(snap["rx_table"])
-        bridge.tx_table = _T.restore(snap["tx_table"])
+        if snap.get("sharded") and bridge._mesh is None:
+            raise ValueError(
+                "snapshot came from a MESH bridge; pass mesh=... to "
+                "restore (resuming single-chip would silently un-shard "
+                "the deployment)")
+        if bridge._mesh is not None:
+            # a mesh deployment must resume SHARDED, not silently
+            # single-chip (same rule as ConferenceBridge.restore)
+            from libjitsi_tpu.mesh import ShardedSrtpTable
+            bridge.rx_table = ShardedSrtpTable.restore(
+                snap["rx_table"], bridge._mesh)
+            bridge.tx_table = ShardedSrtpTable.restore(
+                snap["tx_table"], bridge._mesh)
+        else:
+            bridge.rx_table = _T.restore(snap["rx_table"])
+            bridge.tx_table = _T.restore(snap["tx_table"])
         bridge.bwe = BatchedRemoteBitrateEstimator.restore(snap["bwe"])
         bridge._bwe_fed = np.asarray(snap["bwe_fed"]).copy()
         bridge._rx_keys = dict(snap["rx_keys"])

@@ -231,15 +231,23 @@ class RtpTranslator:
                 iv[:, 8 + k] ^= ((idx >> (8 * (5 - k))) & 0xFF
                                  ).astype(np.uint8)
 
-            tab_rk, tab_mid = self._device()
-            out, out_len = _fanout_protect(
-                tab_rk, tab_mid, jnp.asarray(recv, dtype=jnp.int32),
-                jnp.asarray(data), jnp.asarray(length),
-                jnp.asarray(payload_off), jnp.asarray(iv),
-                jnp.asarray((idx >> 16) & 0xFFFFFFFF, dtype=jnp.uint32),
-                self.policy.auth_tag_len,
-                self.policy.cipher != Cipher.NULL)
+            out, out_len = self._cm_fanout_call(recv, data, length,
+                                                payload_off, iv, idx)
         return PendingTranslate(out, out_len, recv, batch.capacity)
+
+    def _cm_fanout_call(self, recv, data, length, payload_off, iv, idx):
+        """AES-CM fan-out device call — the mesh translator
+        (mesh/translator.py) overrides exactly this seam, sharding the
+        output rows by owning receiver chip; everything above (routing,
+        expansion, IVs) is shared verbatim."""
+        tab_rk, tab_mid = self._device()
+        return _fanout_protect(
+            tab_rk, tab_mid, jnp.asarray(recv, dtype=jnp.int32),
+            jnp.asarray(data), jnp.asarray(length),
+            jnp.asarray(payload_off), jnp.asarray(iv),
+            jnp.asarray((idx >> 16) & 0xFFFFFFFF, dtype=jnp.uint32),
+            self.policy.auth_tag_len,
+            self.policy.cipher != Cipher.NULL)
 
     # (see PendingTranslate at module scope)
 

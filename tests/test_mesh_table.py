@@ -167,3 +167,30 @@ def test_sharded_gcm_table_parity_and_rtcp():
     # ops normalize tag/encrypt out of the key)
     tx.warmup(max_batch=8)
     assert ("gcm_protect", 0, True, 12) in tx._sh_fns
+
+
+def test_mesh_sfu_bridge_fanout_matches_single_chip():
+    """The ASSEMBLED SfuBridge in mesh mode (sharded tables + leg-
+    sharded fan-out translator) must emit byte-identical forwarded wire
+    to the single-chip bridge."""
+    import libjitsi_tpu
+    from libjitsi_tpu.mesh.parity import assert_sfu_parity
+    from libjitsi_tpu.service.sfu_bridge import SfuBridge
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    mesh = make_media_mesh()
+    assert_sfu_parity(cfg, mesh, capacity=16)
+    with pytest.raises(ValueError):
+        SfuBridge(cfg, port=0, capacity=16, mesh=mesh, pipelined=True)
+    # a mesh snapshot refuses a single-chip restore (un-sharding a
+    # deployment must be loud, not silent)
+    sfu = SfuBridge(cfg, port=0, capacity=16, recv_window_ms=0,
+                    mesh=mesh)
+    snap = sfu.snapshot()
+    sfu.close()
+    with pytest.raises(ValueError):
+        SfuBridge.restore(cfg, snap, port=0)
+    back = SfuBridge.restore(cfg, snap, port=0, mesh=mesh)
+    back.close()
