@@ -45,10 +45,60 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from libjitsi_tpu.mesh.sharded import AXIS
 from libjitsi_tpu.transform.srtp import kernel
 from libjitsi_tpu.transform.srtp.context import SrtpStreamTable, _uniform_off
 from libjitsi_tpu.transform.srtp.policy import Cipher, SrtpProfile
+
+
+class ShardedRowsMixin:
+    """Shared sharding scaffolding for row-partitioned product objects
+    (the SRTP table and the fan-out translator must keep identical
+    geometry or same-mesh deployments desync): partition sizes, the
+    `_dev`-invalidation mirror, and the sharded device cache."""
+
+    def _init_sharding(self, mesh: Mesh, capacity: int) -> None:
+        n_dev = int(mesh.devices.size)
+        if capacity % n_dev:
+            raise ValueError(f"capacity {capacity} not divisible by "
+                             f"{n_dev} mesh devices")
+        self.mesh = mesh
+        # rows map over EVERY mesh axis: a 1-D "streams" mesh and the
+        # 2-D (dcn, streams) multi-host mesh both flatten onto the row
+        # partition (device order = row-major over the axes)
+        self._axes = tuple(mesh.axis_names)
+        self.n_dev = n_dev
+        self.rows_per = capacity // n_dev
+        self._sh_dev = None
+        self._sh_fns: Dict[Tuple, "jax.stages.Wrapped"] = {}
+
+    # the parent classes use `self._dev = None` as their invalidation
+    # signal (every key mutator sets it); mirror that onto the sharded
+    # copies so they re-place on the next launch after any re-keying
+    @property
+    def _dev(self):
+        return self.__dev
+
+    @_dev.setter
+    def _dev(self, value):
+        self.__dev = value
+        if value is None:
+            self._sh_dev = None
+
+    def _sharded_tables(self):
+        """Subclass hook: the (round-keys, aux) numpy masters to place."""
+        raise NotImplementedError
+
+    def _sharded_device(self):
+        if self._sh_dev is None:
+            spec = NamedSharding(self.mesh, P(self._axes, None, None))
+            rk, aux = self._sharded_tables()
+            self._sh_dev = (jax.device_put(rk, spec),
+                            jax.device_put(aux, spec))
+            if hasattr(self, "_aliased"):
+                # the table's COW discipline repoints masters before
+                # in-place mutation when this is set
+                self._aliased = True
+        return self._sh_dev
 
 
 def local_rows(plan: "_OwnerPlan", ids: np.ndarray, capacity: int,
@@ -95,7 +145,7 @@ class _OwnerPlan:
                 self.slot[d, :] = fallback
 
 
-class ShardedSrtpTable(SrtpStreamTable):
+class ShardedSrtpTable(ShardedRowsMixin, SrtpStreamTable):
     """`SrtpStreamTable` whose RTP crypto runs sharded over a mesh."""
 
     def __init__(self, capacity: int, mesh: Mesh,
@@ -106,29 +156,12 @@ class ShardedSrtpTable(SrtpStreamTable):
             raise ValueError(
                 f"ShardedSrtpTable supports AES-CM/NULL/AES-GCM "
                 f"profiles; {profile.value} stays single-chip for now")
-        n_dev = int(mesh.devices.size)
-        if capacity % n_dev:
-            raise ValueError(f"capacity {capacity} not divisible by "
-                             f"{n_dev} mesh devices")
-        self.mesh = mesh
-        self.n_dev = n_dev
-        self.rows_per = capacity // n_dev
-        self._sh_dev = None
-        self._sh_fns: Dict[Tuple, "jax.stages.Wrapped"] = {}
+        self._init_sharding(mesh, capacity)
         super().__init__(capacity, profile)
 
-    # _dev doubles as the parent's invalidation signal (every key
-    # mutator sets it to None); mirror that onto the sharded copies so
-    # they re-place on the next launch after any re-keying
-    @property
-    def _dev(self):
-        return self.__dev
-
-    @_dev.setter
-    def _dev(self, value):
-        self.__dev = value
-        if value is None:
-            self._sh_dev = None
+    def _sharded_tables(self):
+        return (self._rk_rtp,
+                self._gm_rtp if self._gcm else self._mid_rtp)
 
     @classmethod
     def restore(cls, snap: dict, mesh: Mesh) -> "ShardedSrtpTable":
@@ -178,18 +211,6 @@ class ShardedSrtpTable(SrtpStreamTable):
             if lanes >= top:
                 break
             lanes *= 2
-
-    def _sharded_device(self):
-        if self._sh_dev is None:
-            spec = NamedSharding(self.mesh, P(AXIS, None, None))
-            aux = self._gm_rtp if self._gcm else self._mid_rtp
-            self._sh_dev = (jax.device_put(self._rk_rtp, spec),
-                            jax.device_put(aux, spec))
-            # sharded placement copies, but flag anyway so _cow_tables
-            # repoints before any in-place mutation (same discipline as
-            # the single-chip device cache)
-            self._aliased = True
-        return self._sh_dev
 
     # ------------------------------------------------------- sharded seams
     def _run_sharded(self, op: str, stream, batch, hdr, length,
@@ -263,8 +284,8 @@ class ShardedSrtpTable(SrtpStreamTable):
         fn = self._sh_fns.get(key)
         if fn is not None:
             return fn
-        row3 = P(AXIS, None, None)
-        lanes = P(AXIS, None)
+        row3 = P(self._axes, None, None)
+        lanes = P(self._axes, None)
         if op.startswith("gcm_"):
             from libjitsi_tpu.kernels import gcm as gcm_kernel
 

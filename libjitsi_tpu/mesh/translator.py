@@ -21,16 +21,16 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
-from libjitsi_tpu.mesh.sharded import AXIS
-from libjitsi_tpu.mesh.table import _OwnerPlan, local_rows
+from libjitsi_tpu.mesh.table import (_OwnerPlan, ShardedRowsMixin,
+                                     local_rows)
 from libjitsi_tpu.sfu.translator import RtpTranslator
 from libjitsi_tpu.transform.srtp import kernel
 from libjitsi_tpu.transform.srtp.policy import Cipher, SrtpProfile
 
 
-class ShardedRtpTranslator(RtpTranslator):
+class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
     """`RtpTranslator` whose re-encrypt fan-out runs sharded by leg.
 
     Async caveat: `translate_async` still works, but the sharded seam
@@ -47,34 +47,11 @@ class ShardedRtpTranslator(RtpTranslator):
             raise ValueError(
                 f"ShardedRtpTranslator supports AES-CM/NULL profiles; "
                 f"{profile.value} stays single-chip for now")
-        n_dev = int(mesh.devices.size)
-        if capacity % n_dev:
-            raise ValueError(f"capacity {capacity} not divisible by "
-                             f"{n_dev} mesh devices")
-        self.mesh = mesh
-        self.n_dev = n_dev
-        self.rows_per = capacity // n_dev
-        self._sh_dev = None
-        self._sh_fns = {}
+        self._init_sharding(mesh, capacity)
         super().__init__(capacity, profile)
 
-    # mirror the parent's invalidation signal onto the sharded copies
-    @property
-    def _dev(self):
-        return self.__dev
-
-    @_dev.setter
-    def _dev(self, value):
-        self.__dev = value
-        if value is None:
-            self._sh_dev = None
-
-    def _sharded_device(self):
-        if self._sh_dev is None:
-            spec = NamedSharding(self.mesh, P(AXIS, None, None))
-            self._sh_dev = (jax.device_put(self._rk, spec),
-                            jax.device_put(self._mid, spec))
-        return self._sh_dev
+    def _sharded_tables(self):
+        return self._rk, self._mid
 
     def _cm_fanout_call(self, recv, data, length, payload_off, iv, idx):
         tab_rk, tab_mid = self._sharded_device()
@@ -112,8 +89,8 @@ class ShardedRtpTranslator(RtpTranslator):
                 tab_mid[local[0]], roc[0], tag_len, encrypt)
             return tuple(o[None] for o in out)
 
-        row3 = P(AXIS, None, None)
-        lanes = P(AXIS, None)
+        row3 = P(self._axes, None, None)
+        lanes = P(self._axes, None)
         fn = jax.jit(jax.shard_map(
             _run, mesh=self.mesh,
             in_specs=(row3, row3, lanes, row3, lanes, lanes, row3,

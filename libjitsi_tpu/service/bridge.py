@@ -201,8 +201,14 @@ class ConferenceBridge:
         self._rate = rate
         mix_fn = None
         if self._mesh is not None:
-            from libjitsi_tpu.mesh import sharded_mix_minus
-            mix_fn = sharded_mix_minus(self._mesh)
+            from libjitsi_tpu.mesh import (sharded_mix_minus,
+                                           sharded_mix_minus_2d)
+            from libjitsi_tpu.mesh.sharded import DCN_AXIS
+            # on the 2-D (dcn, streams) mesh the participant sum must
+            # psum over BOTH axes (ICI within a host, DCN across)
+            mix_fn = (sharded_mix_minus_2d(self._mesh)
+                      if DCN_AXIS in self._mesh.axis_names
+                      else sharded_mix_minus(self._mesh))
         self.mixer = AudioMixer(capacity=self.capacity,
                                 frame_samples=frame_samples,
                                 mix_fn=mix_fn)

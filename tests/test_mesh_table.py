@@ -194,3 +194,29 @@ def test_mesh_sfu_bridge_fanout_matches_single_chip():
         SfuBridge.restore(cfg, snap, port=0)
     back = SfuBridge.restore(cfg, snap, port=0, mesh=mesh)
     back.close()
+
+
+def test_sharded_table_on_2d_multihost_mesh():
+    """DCN rehearsal at PRODUCT level: the sharded table partitions its
+    rows over the 2-D (dcn, streams) mesh — same parity contract as the
+    1-D mesh (SURVEY §2.7 DCN row)."""
+    from libjitsi_tpu.mesh import make_multihost_mesh
+    from libjitsi_tpu.mesh.parity import assert_table_parity
+
+    mesh2d = make_multihost_mesh(2)
+    assert mesh2d.devices.shape == (2, 4)
+    assert_table_parity(mesh2d, capacity=CAP, batch_size=24, rounds=1)
+
+
+def test_mesh_bridge_on_2d_multihost_mesh():
+    """The assembled ConferenceBridge also runs on the 2-D multi-host
+    mesh (rows over (dcn x streams); mixer psums over both axes) —
+    byte-identical to single-chip."""
+    import libjitsi_tpu
+    from libjitsi_tpu.mesh import make_multihost_mesh
+    from libjitsi_tpu.mesh.parity import assert_bridge_parity
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    assert_bridge_parity(libjitsi_tpu.configuration_service(),
+                         make_multihost_mesh(2), capacity=16)
