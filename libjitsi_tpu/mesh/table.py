@@ -100,6 +100,28 @@ class ShardedRowsMixin:
                 self._aliased = True
         return self._sh_dev
 
+    def _sharded_launch(self, fn, ids, data, length, off, tail_args):
+        """Plan/gather/dispatch/scatter shared by EVERY sharded seam
+        (table CM/GCM, translator CM/GCM fan-outs): route rows to their
+        owning chips, run `fn` under shard_map, scatter results back to
+        wire order.  `tail_args` are the op's trailing per-row arrays
+        (iv/roc for CM, iv12 for GCM)."""
+        tab_rk, tab_aux = self._sharded_device()
+        ids = np.asarray(ids, dtype=np.int64)
+        plan = _OwnerPlan(ids, self.capacity, self.rows_per, self.n_dev)
+        local = local_rows(plan, ids, self.capacity, self.rows_per,
+                           self.n_dev)
+        outs = fn(
+            tab_rk, tab_aux, jnp.asarray(local),
+            jnp.asarray(np.asarray(data)[plan.slot]),
+            jnp.asarray(np.asarray(length, dtype=np.int32)[plan.slot]),
+            jnp.asarray(np.asarray(off)[plan.slot]),
+            *(jnp.asarray(np.asarray(a)[plan.slot]) for a in tail_args))
+        d = np.asarray(outs[0])
+        d = d.reshape(-1, d.shape[-1])[plan.inv]
+        rest = [np.asarray(o).reshape(-1)[plan.inv] for o in outs[1:]]
+        return (d, *rest)
+
 
 def local_rows(plan: "_OwnerPlan", ids: np.ndarray, capacity: int,
                rows_per: int, n_dev: int) -> np.ndarray:
@@ -215,28 +237,11 @@ class ShardedSrtpTable(ShardedRowsMixin, SrtpStreamTable):
     # ------------------------------------------------------- sharded seams
     def _run_sharded(self, op: str, stream, batch, hdr, length,
                      tail_args):
-        """Plan/gather/dispatch/scatter shared by ALL the seams: route
-        batch rows to their owning chips, run the op under shard_map,
-        scatter results back to wire order.  `tail_args` are the op's
-        trailing per-row arrays in batch-row order (iv/roc for CM,
-        iv12 for GCM)."""
-        tab_rk, tab_aux = self._sharded_device()
-        plan = _OwnerPlan(stream, self.capacity, self.rows_per,
-                          self.n_dev)
         off_const = _uniform_off(hdr.payload_off, batch.capacity)
         fn = self._shard_fn(op, self.policy.auth_tag_len,
                             self.policy.cipher != Cipher.NULL, off_const)
-        local = self._local_streams(stream, plan)
-        outs = fn(
-            tab_rk, tab_aux, local,
-            jnp.asarray(batch.data[plan.slot]),
-            jnp.asarray(np.asarray(length, dtype=np.int32)[plan.slot]),
-            jnp.asarray(np.asarray(hdr.payload_off)[plan.slot]),
-            *(jnp.asarray(np.asarray(a)[plan.slot]) for a in tail_args))
-        data = np.asarray(outs[0])
-        data = data.reshape(-1, data.shape[-1])[plan.inv]
-        rest = [np.asarray(o).reshape(-1)[plan.inv] for o in outs[1:]]
-        return (data, *rest)
+        return self._sharded_launch(fn, stream, batch.data, length,
+                                    hdr.payload_off, tail_args)
 
     @staticmethod
     def _roc32(v) -> np.ndarray:
@@ -253,10 +258,6 @@ class ShardedSrtpTable(ShardedRowsMixin, SrtpStreamTable):
             "unprotect", stream, batch, hdr, length,
             [iv, self._roc32(v)])
         return data, mlen.astype(np.int32), auth_ok
-
-    def _local_streams(self, stream: np.ndarray, plan: _OwnerPlan):
-        return jnp.asarray(local_rows(plan, stream, self.capacity,
-                                      self.rows_per, self.n_dev))
 
     # ----------------------------------------------------- GCM (per row)
     def _gcm_rtp_protect_call(self, stream, batch, hdr, iv12):

@@ -9,11 +9,12 @@ as `ShardedSrtpTable` (the packets each chip needs are routed to it by
 the host plan, which already expands the (packet × receiver) matrix).
 
 The routing/expansion/IV host plane is `RtpTranslator`'s, unchanged;
-only the CM protect launch seam is overridden.  GCM fan-outs stay
-single-chip at product level for now (`mesh/sharded.py`'s
-`sharded_gcm_fanout` covers the kernel; the grouped per-leg matrix form
-needs a per-shard grid) — the constructor refuses rather than silently
-falling back.
+only the protect launch seams are overridden.  GCM fan-outs shard via
+the PER-ROW form (each output row's key schedule + GHASH matrix gather
+is chip-local); the full-mesh per-LEG-matrix fast path is disabled in
+mesh mode because its leg grid would span shards — a future
+optimization is a leg-partitioned `sharded_gcm_fanout` product path
+(the kernel already exists in mesh/sharded.py).
 """
 
 from __future__ import annotations
@@ -23,8 +24,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
-from libjitsi_tpu.mesh.table import (_OwnerPlan, ShardedRowsMixin,
-                                     local_rows)
+from libjitsi_tpu.mesh.table import ShardedRowsMixin
 from libjitsi_tpu.sfu.translator import RtpTranslator
 from libjitsi_tpu.transform.srtp import kernel
 from libjitsi_tpu.transform.srtp.policy import Cipher, SrtpProfile
@@ -43,36 +43,57 @@ class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
     def __init__(self, capacity: int, mesh: Mesh,
                  profile: SrtpProfile =
                  SrtpProfile.AES_CM_128_HMAC_SHA1_80):
-        if profile.policy.cipher not in (Cipher.AES_CM, Cipher.NULL):
+        if profile.policy.cipher not in (Cipher.AES_CM, Cipher.NULL,
+                                         Cipher.AES_GCM):
             raise ValueError(
-                f"ShardedRtpTranslator supports AES-CM/NULL profiles; "
-                f"{profile.value} stays single-chip for now")
+                f"ShardedRtpTranslator supports AES-CM/NULL/AES-GCM "
+                f"profiles; {profile.value} stays single-chip for now")
         self._init_sharding(mesh, capacity)
         super().__init__(capacity, profile)
+        # the full-mesh per-LEG-matrix GCM fast path would need its leg
+        # grid to span shards; the sharded per-row form runs instead
+        self._uniform_gcm_fanout = False
 
     def _sharded_tables(self):
-        return self._rk, self._mid
+        return self._rk, (self._gm if self._gcm else self._mid)
 
     def _cm_fanout_call(self, recv, data, length, payload_off, iv, idx):
-        tab_rk, tab_mid = self._sharded_device()
-        plan = _OwnerPlan(np.asarray(recv, dtype=np.int64),
-                          self.capacity, self.rows_per, self.n_dev)
-        local = local_rows(plan, recv, self.capacity, self.rows_per,
-                           self.n_dev)
-        fn = self._fanout_fn()
-        out, out_len = fn(
-            tab_rk, tab_mid, jnp.asarray(local),
-            jnp.asarray(np.asarray(data)[plan.slot]),
-            jnp.asarray(np.asarray(length,
-                                   dtype=np.int32)[plan.slot]),
-            jnp.asarray(np.asarray(payload_off)[plan.slot]),
-            jnp.asarray(np.asarray(iv)[plan.slot]),
-            jnp.asarray(((np.asarray(idx) >> 16) & 0xFFFFFFFF)
-                        .astype(np.uint32)[plan.slot]))
-        o = np.asarray(out)
-        return (o.reshape(-1, o.shape[-1])[plan.inv],
-                np.asarray(out_len).reshape(-1)[plan.inv]
-                .astype(np.int32))
+        roc = ((np.asarray(idx) >> 16) & 0xFFFFFFFF).astype(np.uint32)
+        out, out_len = self._sharded_launch(
+            self._fanout_fn(), recv, data, length, payload_off,
+            [iv, roc])
+        return out, out_len.astype(np.int32)
+
+    def _gcm_fanout_call(self, recv, data, length, payload_off, iv12,
+                         capacity):
+        from libjitsi_tpu.transform.srtp.context import _uniform_off
+
+        fn = self._gcm_fanout_fn(_uniform_off(payload_off, capacity))
+        out, out_len = self._sharded_launch(fn, recv, data, length,
+                                            payload_off, [iv12])
+        return out, out_len.astype(np.int32)
+
+    def _gcm_fanout_fn(self, off_const):
+        key = ("gcm_fanout", off_const)
+        fn = self._sh_fns.get(key)
+        if fn is not None:
+            return fn
+        from libjitsi_tpu.kernels import gcm as gcm_kernel
+
+        def _run(tab_rk, tab_gm, local, data, length, off, iv12):
+            out = gcm_kernel.gcm_protect(
+                data[0], length[0], off[0], tab_rk[local[0]],
+                tab_gm[local[0]], iv12[0], aad_const=off_const)
+            return tuple(o[None] for o in out)
+
+        row3 = P(self._axes, None, None)
+        lanes = P(self._axes, None)
+        fn = jax.jit(jax.shard_map(
+            _run, mesh=self.mesh,
+            in_specs=(row3, row3, lanes, row3, lanes, lanes, row3),
+            out_specs=(row3, lanes), check_vma=False))
+        self._sh_fns[key] = fn
+        return fn
 
     def _fanout_fn(self):
         key = ("fanout", self.policy.auth_tag_len,

@@ -80,6 +80,9 @@ class RtpTranslator:
             self._gm = np.zeros((capacity, 128, 128), dtype=np.int8)
         self._salt = np.zeros((capacity, 16), dtype=np.uint8)
         self._dev = None
+        # full-mesh per-LEG-matrix GCM fast path; the mesh subclass
+        # turns it off (the leg grid would span shards)
+        self._uniform_gcm_fanout = True
         # routing: sender sid -> sorted receiver id array
         self._routes: Dict[int, np.ndarray] = {}
 
@@ -262,18 +265,20 @@ class RtpTranslator:
         Reference: RTPTranslatorImpl's cipher-agnostic per-leg
         transform (SURVEY §3.4).
         """
-        tab_rk, tab_gm = self._device()
         off0 = np.asarray(hdr.payload_off)[rows]
         # the offset bound mirrors _uniform_off: a forged ext_words field
         # can claim a header larger than the packet; such batches take
         # the general path, which clamps per row (the packets then die
-        # at the receiving legs, not in our trace)
-        uniform = (len(recvs) > 1 and
+        # at the receiving legs, not in our trace).  The mesh translator
+        # disables the full-mesh fast path (its per-LEG matrix grid
+        # would span shards) and shards the per-row form instead.
+        uniform = (self._uniform_gcm_fanout and len(recvs) > 1 and
                    all(len(r) == len(recvs[0]) and np.array_equal(
                        r, recvs[0]) for r in recvs[1:])
                    and off0.size and np.all(off0 == off0[0])
                    and 0 <= int(off0[0]) < batch.capacity)
         if uniform:
+            tab_rk, tab_gm = self._device()
             rr = recvs[0]
             p_rows = np.asarray(rows, dtype=np.int64)
             pdata = batch.data[p_rows]
@@ -298,12 +303,22 @@ class RtpTranslator:
                                (1, len(rr))).reshape(-1)
             return out, out_len
         iv = gcm_kernel.srtp_gcm_iv(self._salt[recv], ssrc, idx)
+        return self._gcm_fanout_call(recv, data, length, payload_off,
+                                     iv, batch.capacity)
+
+    def _gcm_fanout_call(self, recv, data, length, payload_off, iv12,
+                         capacity):
+        """Per-row AEAD fan-out device call — the mesh translator
+        overrides exactly this seam (leg-sharded, chip-local matrix
+        gathers)."""
         from libjitsi_tpu.transform.srtp.context import _uniform_off
+
+        tab_rk, tab_gm = self._device()
         return _fanout_protect_gcm(
             tab_rk, tab_gm, jnp.asarray(recv, dtype=jnp.int32),
             jnp.asarray(data), jnp.asarray(length),
-            jnp.asarray(payload_off), jnp.asarray(iv),
-            aad_const=_uniform_off(payload_off, batch.capacity))
+            jnp.asarray(payload_off), jnp.asarray(iv12),
+            aad_const=_uniform_off(payload_off, capacity))
 
 
 class PendingTranslate:
