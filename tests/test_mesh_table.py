@@ -104,6 +104,7 @@ def test_mesh_bridge_tick_matches_single_chip():
                          pipelined=True)
 
 
+@pytest.mark.slow
 def test_mesh_bridge_restore_stays_sharded_and_warmup():
     """A checkpointed mesh bridge must resume with MESH tables (not a
     silent single-chip fallback), and warmup() must pre-compile the
@@ -169,6 +170,7 @@ def test_sharded_gcm_table_parity_and_rtcp():
     assert ("gcm_protect", 0, True, 12) in tx._sh_fns
 
 
+@pytest.mark.slow
 def test_mesh_sfu_bridge_fanout_matches_single_chip():
     """The ASSEMBLED SfuBridge in mesh mode (sharded tables + leg-
     sharded fan-out translator) must emit byte-identical forwarded wire
@@ -208,6 +210,7 @@ def test_sharded_table_on_2d_multihost_mesh():
     assert_table_parity(mesh2d, capacity=CAP, batch_size=24, rounds=1)
 
 
+@pytest.mark.slow
 def test_mesh_bridge_on_2d_multihost_mesh():
     """The assembled ConferenceBridge also runs on the 2-D multi-host
     mesh (rows over (dcn x streams); mixer psums over both axes) —
