@@ -271,3 +271,32 @@ def test_sharded_translator_cm_and_gcm_parity():
             outs.append({(int(recv[i]), i): out.to_bytes(i)
                          for i in range(out.batch_size)})
         assert outs[0] == outs[1], f"{profile} sharded fan-out diverged"
+
+
+def test_sharded_table_kdr_rekey_parity():
+    """kdr epoch re-keying on the SHARDED table: _install_session_keys
+    mutates the key masters mid-stream, which must invalidate the
+    sharded device copies through the _dev mirror — wire stays byte-
+    identical to the plain table across an epoch boundary."""
+    kdr = 8
+    rng = np.random.default_rng(77)
+    mk = rng.integers(0, 256, 16, dtype=np.uint8).tobytes()
+    ms = rng.integers(0, 256, 14, dtype=np.uint8).tobytes()
+    mesh = make_media_mesh()
+    sh = ShardedSrtpTable(8, mesh)
+    sh.add_stream(3, mk, ms, kdr=kdr)
+    pl = SrtpStreamTable(8)
+    pl.add_stream(3, mk, ms, kdr=kdr)
+
+    def batch(start):
+        return rtp_header.build([b"k" * 48] * 4,
+                                [start + i for i in range(4)],
+                                [0] * 4, [0x42] * 4, [96] * 4,
+                                stream=[3] * 4)
+
+    for start in (0, 6, 14, 30):       # crosses epochs 0->1->3
+        w_sh = sh.protect_rtp(batch(start))
+        w_pl = pl.protect_rtp(batch(start))
+        for i in range(4):
+            assert w_sh.to_bytes(i) == w_pl.to_bytes(i), (start, i)
+    assert sh._epoch_rtp[3] == pl._epoch_rtp[3] >= 1
