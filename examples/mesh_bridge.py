@@ -1,0 +1,47 @@
+"""Demo: the ASSEMBLED ConferenceBridge running sharded over a mesh.
+
+Three SRTP participants join a mesh-mode bridge whose SRTP tables are
+row-partitioned over an 8-device mesh and whose mix-minus psums over
+the participant axis — the whole tick runs sharded, byte-identical to
+the single-chip bridge (the parity harness proves it here, live).
+
+Run:  PYTHONPATH=. python examples/mesh_bridge.py
+(uses a virtual 8-device CPU mesh; on a real v5e-8 the same code runs
+over ICI unchanged)
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import libjitsi_tpu  # noqa: E402
+from libjitsi_tpu.mesh import make_media_mesh  # noqa: E402
+from libjitsi_tpu.mesh.parity import (assert_bridge_parity,  # noqa: E402
+                                      run_bridge_once)
+
+
+def main() -> None:
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    mesh = make_media_mesh()
+    print(f"mesh: {mesh.devices.size} devices, axes {mesh.axis_names}")
+
+    wire = run_bridge_once(cfg, mesh, capacity=16)
+    print(f"mesh bridge forwarded {len(wire)} SRTP mix packets over "
+          f"loopback UDP")
+
+    assert_bridge_parity(cfg, mesh, capacity=16)
+    print("parity: mesh-mode egress byte-identical to single-chip")
+    print("demo ok: assembled conference tick sharded over the mesh")
+
+
+if __name__ == "__main__":
+    main()
