@@ -50,9 +50,6 @@ class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
                 f"profiles; {profile.value} stays single-chip for now")
         self._init_sharding(mesh, capacity)
         super().__init__(capacity, profile)
-        # the full-mesh per-LEG-matrix GCM fast path would need its leg
-        # grid to span shards; the sharded per-row form runs instead
-        self._uniform_gcm_fanout = False
 
     def _sharded_tables(self):
         return self._rk, (self._gm if self._gcm else self._mid)
@@ -72,6 +69,45 @@ class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
         out, out_len = self._sharded_launch(fn, recv, data, length,
                                             payload_off, [iv12])
         return out, out_len.astype(np.int32)
+
+    def _gcm_uniform_fanout_call(self, rr, pdata, plen, iv, aad_const):
+        """Leg-partitioned full-mesh AEAD fan-out: the per-LEG GHASH
+        matrices shard over chips while the P packets broadcast — each
+        chip seals the same packets for ITS legs with zero collectives
+        (the product form of mesh/sharded.py's sharded_gcm_fanout).
+        Legs pad to a multiple of the mesh size; pad outputs drop."""
+        rr = np.asarray(rr, dtype=np.int64)
+        g = len(rr)
+        g_pad = -(-g // self.n_dev) * self.n_dev
+        rr_pad = np.resize(rr, g_pad)        # pads cycle the real legs
+        iv_pad = np.resize(np.asarray(iv), (g_pad,) + np.asarray(
+            iv).shape[1:])
+        fn = self._gcm_uniform_fn(aad_const)
+        out_gp, out_len_p = fn(
+            jnp.asarray(self._rk[rr_pad]), jnp.asarray(self._gm[rr_pad]),
+            jnp.asarray(pdata), jnp.asarray(np.asarray(plen,
+                                                       dtype=np.int32)),
+            jnp.asarray(iv_pad))
+        return np.asarray(out_gp)[:g], np.asarray(out_len_p)
+
+    def _gcm_uniform_fn(self, off_const):
+        key = ("gcm_uniform_fanout", off_const)
+        fn = self._sh_fns.get(key)
+        if fn is not None:
+            return fn
+        from libjitsi_tpu.kernels import gcm as gcm_kernel
+
+        def _run(rks, gms, data, length, iv):
+            return gcm_kernel.gcm_protect_fanout(
+                data, length, rks, gms, iv, aad_const=off_const)
+
+        legs3 = P(self._axes, None, None)
+        fn = jax.jit(jax.shard_map(
+            _run, mesh=self.mesh,
+            in_specs=(legs3, legs3, P(None, None), P(None), legs3),
+            out_specs=(legs3, P(None)), check_vma=False))
+        self._sh_fns[key] = fn
+        return fn
 
     def _gcm_fanout_fn(self, off_const):
         key = ("gcm_fanout", off_const)

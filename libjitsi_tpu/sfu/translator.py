@@ -278,7 +278,6 @@ class RtpTranslator:
                    and off0.size and np.all(off0 == off0[0])
                    and 0 <= int(off0[0]) < batch.capacity)
         if uniform:
-            tab_rk, tab_gm = self._device()
             rr = recvs[0]
             p_rows = np.asarray(rows, dtype=np.int64)
             pdata = batch.data[p_rows]
@@ -291,20 +290,29 @@ class RtpTranslator:
                 np.broadcast_to(self._salt[rr][:, None, :12],
                                 (len(rr), len(p_rows), 12)),
                 pssrc[None, :], pidx[None, :])
-            out_gp, out_len_p = gcm_kernel.gcm_protect_fanout(
-                jnp.asarray(pdata), jnp.asarray(plen),
-                tab_rk[jnp.asarray(rr)], tab_gm[jnp.asarray(rr)],
-                jnp.asarray(iv), aad_const=int(off0[0]))
+            out_gp, out_len_p = self._gcm_uniform_fanout_call(
+                rr, pdata, plen, iv, int(off0[0]))
             # grouped output is leg-major [G, P, W]; the contract is
             # packet-major rows (p0r0, p0r1, ...) matching `src`/`recv`
-            out = jnp.transpose(out_gp, (1, 0, 2)).reshape(
+            out = jnp.transpose(jnp.asarray(out_gp), (1, 0, 2)).reshape(
                 len(p_rows) * len(rr), batch.capacity)
-            out_len = jnp.tile(out_len_p[:, None],
+            out_len = jnp.tile(jnp.asarray(out_len_p)[:, None],
                                (1, len(rr))).reshape(-1)
             return out, out_len
         iv = gcm_kernel.srtp_gcm_iv(self._salt[recv], ssrc, idx)
         return self._gcm_fanout_call(recv, data, length, payload_off,
                                      iv, batch.capacity)
+
+    def _gcm_uniform_fanout_call(self, rr, pdata, plen, iv, aad_const):
+        """Full-mesh per-LEG-matrix fan-out device call: P packets
+        sealed for G legs, one GHASH matrix read per LEG — the mesh
+        translator overrides this seam with the legs partitioned over
+        chips.  Returns leg-major (out [G, P, W], out_len [P])."""
+        tab_rk, tab_gm = self._device()
+        return gcm_kernel.gcm_protect_fanout(
+            jnp.asarray(pdata), jnp.asarray(plen),
+            tab_rk[jnp.asarray(rr)], tab_gm[jnp.asarray(rr)],
+            jnp.asarray(iv), aad_const=aad_const)
 
     def _gcm_fanout_call(self, recv, data, length, payload_off, iv12,
                          capacity):
