@@ -7,37 +7,37 @@ RFC 3711 App A index estimation, replay windows, kdr epochs, size-class
 bucketing — all of context.py, unchanged) with the DEVICE side row-
 partitioned over a `jax.sharding.Mesh`:
 
-- key tables `[S, R, 16]` / `[S, 2, 5]` live sharded on the row axis —
-  device d owns rows [d*S/n, (d+1)*S/n); nothing is replicated;
+- key tables `[S, R, 16]` / `[S, 2, 5]` / `[S, 128, 128]` live sharded
+  on the row axis — device d owns rows [d*S/n, (d+1)*S/n); nothing is
+  replicated;
 - each batch is grouped by owning device on the host (the control plane
   already knows every packet's row), padded per device to a power-of-two
   lane count, and the crypto runs under `shard_map` with ZERO
   collectives: a packet's key material is chip-local by construction —
   stream-data-parallelism exactly as SURVEY §2.7 prescribes;
-- results scatter back to wire order on the host.
+- results stay DEVICE-RESIDENT in lane layout until materialized: the
+  scatter back to wire order is deferred (`_LazyArray`), so
+  `protect_rtp_async` keeps its launch-overlap contract in mesh mode
+  and the bridges compose `mesh=...` with `pipelined=True`
+  (VERDICT r4 #2 — the 8-chip deployment is exactly the one that needs
+  launch overlap).
 
 Reference: `SRTPTransformer`'s per-SSRC context map scaled by running
 more JVMs; here the ONE table spans the mesh and `RTPTranslatorImpl`-
 scale fan-outs (SURVEY §3.4) ride the same row partition.
 
-Profile scope: AES-CM / NULL / AES-GCM profiles.  GCM shards via its
-PER-ROW form (key schedule + GHASH matrix gathers are chip-local; the
-grouped-GHASH grid would span shards and per-row is the measured winner
-below ~32k rows anyway).  F8's second schedule stays single-chip for
-now — the table raises rather than silently falling back.  SRTCP
-(low-rate control traffic) intentionally uses the inherited single-chip
-path.
-
-Async caveat: the sharded seams materialize results on the host (the
-scatter back to wire order needs the bytes), so `protect_rtp_async`'s
-deferred-materialization contract does not overlap launches in mesh
-mode — callers that rely on the double-buffering seam must say so and
-be refused (ConferenceBridge rejects mesh+pipelined) rather than get a
-silent no-op.
+Profile scope: ALL four cipher modes shard (VERDICT r4 #6).  AES-CM /
+NULL ride the two-table seam; AES-F8's second key schedule is one more
+`[S, R, 16]` tensor on the same row partition; AES-GCM shards both its
+per-row form AND the grouped-GHASH form (per-device group grids —
+picked per shape by `kernels.registry` measurement, same doctrine as
+the single-chip table).  SRTCP runs sharded on the RTCP key tables —
+control traffic must not silently hop to a single-chip path.
 """
 
 from __future__ import annotations
 
+import weakref
 from typing import Dict, Tuple
 
 import jax
@@ -45,16 +45,63 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from libjitsi_tpu.kernels import registry as _registry
 from libjitsi_tpu.transform.srtp import kernel
 from libjitsi_tpu.transform.srtp.context import SrtpStreamTable, _uniform_off
 from libjitsi_tpu.transform.srtp.policy import Cipher, SrtpProfile
+
+
+class _LazyArray:
+    """Deferred scatter-to-wire-order of one sharded-launch output.
+
+    Holds the device array in `[n_dev, per(, W)]` lane layout plus the
+    plan's inverse map; the D2H transfer and host scatter happen on
+    first materialization (`np.asarray`, `block_until_ready`, or
+    `astype` of an already-materialized value).  This deferral is what
+    lets `protect_rtp_async`/`translate_async` overlap launches in mesh
+    mode: `PendingProtect`/`PendingTranslate` hold these until
+    `.result()` while the next batch's plan/dispatch proceeds.
+    """
+
+    __slots__ = ("_dev", "_inv", "_dtype", "_np")
+
+    def __init__(self, dev, inv, dtype=None):
+        self._dev, self._inv, self._dtype = dev, inv, dtype
+        self._np = None
+
+    def _materialize(self) -> np.ndarray:
+        if self._np is None:
+            a = np.asarray(self._dev)
+            a = (a.reshape(-1, *a.shape[2:]) if a.ndim > 1 else a)[
+                self._inv]
+            if self._dtype is not None:
+                a = a.astype(self._dtype)
+            self._np = a
+            self._dev = None
+        return self._np
+
+    def astype(self, dtype):
+        if self._np is not None:
+            return self._np.astype(dtype)
+        return _LazyArray(self._dev, self._inv, dtype)
+
+    def block_until_ready(self):
+        self._materialize()
+        return self
+
+    def __array__(self, dtype=None, copy=None):
+        a = self._materialize()
+        if dtype is not None and a.dtype != np.dtype(dtype):
+            a = a.astype(dtype)
+        return a
 
 
 class ShardedRowsMixin:
     """Shared sharding scaffolding for row-partitioned product objects
     (the SRTP table and the fan-out translator must keep identical
     geometry or same-mesh deployments desync): partition sizes, the
-    `_dev`-invalidation mirror, and the sharded device cache."""
+    `_dev`-invalidation mirror, and the sharded device cache (one entry
+    per named table group — "rtp", "rtcp")."""
 
     def _init_sharding(self, mesh: Mesh, capacity: int) -> None:
         n_dev = int(mesh.devices.size)
@@ -68,7 +115,7 @@ class ShardedRowsMixin:
         self._axes = tuple(mesh.axis_names)
         self.n_dev = n_dev
         self.rows_per = capacity // n_dev
-        self._sh_dev = None
+        self._sh_dev: Dict[str, Tuple] = {}
         self._sh_fns: Dict[Tuple, "jax.stages.Wrapped"] = {}
 
     # the parent classes use `self._dev = None` as their invalidation
@@ -82,45 +129,50 @@ class ShardedRowsMixin:
     def _dev(self, value):
         self.__dev = value
         if value is None:
-            self._sh_dev = None
+            self._sh_dev = {}
 
-    def _sharded_tables(self):
-        """Subclass hook: the (round-keys, aux) numpy masters to place."""
+    def _sharded_tables(self, group: str):
+        """Subclass hook: the numpy master tensors to place for a named
+        group ("rtp"/"rtcp"), all `[S, ...]` row-major."""
         raise NotImplementedError
 
-    def _sharded_device(self):
-        if self._sh_dev is None:
+    def _sharded_device(self, group: str = "rtp") -> Tuple:
+        got = self._sh_dev.get(group)
+        if got is None:
             spec = NamedSharding(self.mesh, P(self._axes, None, None))
-            rk, aux = self._sharded_tables()
-            self._sh_dev = (jax.device_put(rk, spec),
-                            jax.device_put(aux, spec))
+            got = tuple(jax.device_put(t, spec)
+                        for t in self._sharded_tables(group))
+            self._sh_dev[group] = got
             if hasattr(self, "_aliased"):
                 # the table's COW discipline repoints masters before
                 # in-place mutation when this is set
                 self._aliased = True
-        return self._sh_dev
+        return got
 
-    def _sharded_launch(self, fn, ids, data, length, off, tail_args):
-        """Plan/gather/dispatch/scatter shared by EVERY sharded seam
-        (table CM/GCM, translator CM/GCM fan-outs): route rows to their
-        owning chips, run `fn` under shard_map, scatter results back to
-        wire order.  `tail_args` are the op's trailing per-row arrays
-        (iv/roc for CM, iv12 for GCM)."""
-        tab_rk, tab_aux = self._sharded_device()
+    def _sharded_launch(self, fn, tabs, ids, lane_args, extra_args=(),
+                        plan=None):
+        """Plan/gather/dispatch shared by EVERY sharded seam (table
+        CM/F8/GCM/SRTCP, translator fan-outs): route rows to their
+        owning chips, run `fn` under shard_map, and return one
+        `_LazyArray` per output — the scatter back to wire order is
+        DEFERRED until materialization, keeping the async contract.
+        `lane_args` are per-row arrays (1-D like length/off/roc or
+        N-D like data/iv) routed through the plan; `extra_args` are
+        already device-wide arrays passed through as-is (grouped-GCM
+        grids, fan-out packet blocks).  Callers that pre-built the
+        plan (to derive grids from it) pass it via `plan`.
+        """
         ids = np.asarray(ids, dtype=np.int64)
-        plan = _OwnerPlan(ids, self.capacity, self.rows_per, self.n_dev)
+        if plan is None:
+            plan = _OwnerPlan(ids, self.capacity, self.rows_per,
+                              self.n_dev)
         local = local_rows(plan, ids, self.capacity, self.rows_per,
                            self.n_dev)
-        outs = fn(
-            tab_rk, tab_aux, jnp.asarray(local),
-            jnp.asarray(np.asarray(data)[plan.slot]),
-            jnp.asarray(np.asarray(length, dtype=np.int32)[plan.slot]),
-            jnp.asarray(np.asarray(off)[plan.slot]),
-            *(jnp.asarray(np.asarray(a)[plan.slot]) for a in tail_args))
-        d = np.asarray(outs[0])
-        d = d.reshape(-1, d.shape[-1])[plan.inv]
-        rest = [np.asarray(o).reshape(-1)[plan.inv] for o in outs[1:]]
-        return (d, *rest)
+        outs = fn(*tabs, jnp.asarray(local),
+                  *(jnp.asarray(np.asarray(a)[plan.slot])
+                    for a in lane_args),
+                  *(jnp.asarray(e) for e in extra_args))
+        return tuple(_LazyArray(o, plan.inv) for o in outs)
 
 
 def local_rows(plan: "_OwnerPlan", ids: np.ndarray, capacity: int,
@@ -140,50 +192,169 @@ class _OwnerPlan:
     """Host-side routing of one batch onto the row partition: `slot`
     [n_dev, per] gathers batch rows into per-device lanes (pads repeat a
     real row — crypto on device is stateless, pads are dropped at
-    scatter); `inv` [B] maps each original row to its flat lane."""
+    scatter); `inv` [B] maps each original row to its flat lane.
+    Fully vectorized — no Python loop over devices (VERDICT r4 weak #6:
+    the loop showed at 64k-batch x 8-device shapes)."""
 
     __slots__ = ("slot", "inv", "per")
 
     def __init__(self, stream: np.ndarray, capacity: int, rows_per: int,
                  n_dev: int):
         s = np.clip(stream, 0, capacity - 1)
+        n = len(s)
         owner = s // rows_per
         order = np.argsort(owner, kind="stable")
         counts = np.bincount(owner, minlength=n_dev)
-        top = int(counts.max()) if len(stream) else 1
-        self.per = 1 << max(int(top - 1).bit_length(), 2)  # pow2, >= 4
-        self.slot = np.zeros((n_dev, self.per), dtype=np.int64)
-        self.inv = np.empty(len(stream), dtype=np.int64)
-        fallback = order[0] if len(order) else 0
-        pos = 0
-        for d in range(n_dev):
-            rows = order[pos:pos + counts[d]]
-            pos += counts[d]
-            if len(rows):
-                self.slot[d, :len(rows)] = rows
-                self.slot[d, len(rows):] = rows[0]
-                self.inv[rows] = d * self.per + np.arange(len(rows))
-            else:
-                self.slot[d, :] = fallback
+        top = int(counts.max()) if n else 1
+        self.per = per = 1 << max(int(top - 1).bit_length(), 2)
+        starts = np.concatenate(([0], np.cumsum(counts)))
+        dev_sorted = owner[order]
+        lane = np.arange(n, dtype=np.int64) - starts[dev_sorted]
+        # pads repeat each device's FIRST routed row; devices with no
+        # rows fall back to the batch's first row overall
+        first = np.full(n_dev, order[0] if n else 0, dtype=np.int64)
+        has = counts > 0
+        first[has] = order[starts[:-1][has]]
+        self.slot = np.broadcast_to(first[:, None], (n_dev, per)).copy()
+        self.slot[dev_sorted, lane] = order
+        self.inv = np.empty(n, dtype=np.int64)
+        self.inv[order] = dev_sorted * per + lane
+
+
+def mesh_gcm_grid(local: np.ndarray):
+    """Per-device grouped-GHASH grids over an `_OwnerPlan`'s lane
+    layout — the mesh form of `context._gcm_grid` (VERDICT r4 #4: the
+    sharded table must not be pinned to the per-row form the round-4
+    data showed losing 2.3x at 64k rows).
+
+    `local` [n_dev, per] are chip-local key rows per lane.  Returns
+    (grid [n_dev, Gp, Pp] int32 lane-index-or-minus-one, us [n_dev, Gp]
+    int32 local stream rows, inv [n_dev, per] int32) with Gp/Pp shared
+    pow2 shapes across devices, or None when structurally unusable
+    (tiny lanes, all-distinct streams, or skew so heavy the padded grid
+    would more than double the GHASH work — same guards as the
+    single-chip grid).
+    """
+    n_dev, per = local.shape
+    if per < 8:
+        return None
+    order2 = np.argsort(local, axis=1, kind="stable")
+    ss = np.take_along_axis(local, order2, 1)
+    firsts = np.ones_like(ss, dtype=bool)
+    firsts[:, 1:] = ss[:, 1:] != ss[:, :-1]
+    grp = np.cumsum(firsts, axis=1) - 1
+    g = int(grp[:, -1].max()) + 1
+    if g == per:      # every lane its own stream: grouped ≡ per-row
+        return None
+    pos = np.arange(per, dtype=np.int64)[None, :]
+    fpos = np.maximum.accumulate(np.where(firsts, pos, 0), axis=1)
+    rank = pos - fpos
+    p = int(rank.max()) + 1
+    gp = 1 << max(g - 1, 0).bit_length()
+    pp = 1 << max(p - 1, 0).bit_length()
+    if gp * pp > 2 * per:
+        return None
+    d_idx = np.repeat(np.arange(n_dev), per)
+    grid = np.full((n_dev, gp, pp), -1, dtype=np.int32)
+    grid[d_idx, grp.ravel(), rank.ravel()] = \
+        order2.ravel().astype(np.int32)
+    us = np.zeros((n_dev, gp), dtype=np.int32)
+    us[d_idx, grp.ravel()] = ss.ravel().astype(np.int32)
+    inv = np.empty((n_dev, per), dtype=np.int32)
+    np.put_along_axis(inv, order2, (grp * pp + rank).astype(np.int32), 1)
+    return grid, us, inv
+
+
+class _MeshSeamToken:
+    """Registry handle for a mesh table's GCM seam.
+
+    The module-global `kernels.registry` keys its measured choices by
+    argument signature; passing the TABLE itself would retain every
+    table (and its ~16 MiB GHASH masters) in the registry's choice
+    dict forever and force a re-benchmark per instance.  This token
+    hashes by GEOMETRY (capacity, mesh size, profile) — tables with
+    identical geometry share one measured choice (their shard programs
+    are identical), and the weakref lets dead tables be collected.
+    """
+
+    __slots__ = ("geom", "ref")
+
+    def __init__(self, table: "ShardedSrtpTable"):
+        self.geom = (table.capacity, table.n_dev, table.profile.value)
+        self.ref = weakref.ref(table)
+
+    def __hash__(self):
+        return hash(self.geom)
+
+    def __eq__(self, other):
+        return (isinstance(other, _MeshSeamToken)
+                and self.geom == other.geom)
+
+
+# Measured grouped-vs-per-row choice for the MESH table, mirroring the
+# single-chip registry pattern (context.py): both providers take the
+# full argument list; per_row ignores the grid machinery.  The seam
+# token rides in the signature, so choices are per (geometry, batch
+# shape) — measured once per deployment geometry, shared by same-shape
+# tables (warmup's scratch table pins the live table's choice).
+
+def _mesh_gcm_protect_grouped(token, stream, data, length, off, iv12,
+                              off_const):
+    return token.ref()._gcm_mesh_launch("gcm_protect_grouped", stream,
+                                        data, length, off, iv12,
+                                        off_const)
+
+
+def _mesh_gcm_protect_per_row(token, stream, data, length, off, iv12,
+                              off_const):
+    return token.ref()._gcm_mesh_launch("gcm_protect", stream, data,
+                                        length, off, iv12, off_const)
+
+
+def _mesh_gcm_unprotect_grouped(token, stream, data, length, off, iv12,
+                                off_const):
+    return token.ref()._gcm_mesh_launch("gcm_unprotect_grouped", stream,
+                                        data, length, off, iv12,
+                                        off_const)
+
+
+def _mesh_gcm_unprotect_per_row(token, stream, data, length, off, iv12,
+                                off_const):
+    return token.ref()._gcm_mesh_launch("gcm_unprotect", stream, data,
+                                        length, off, iv12, off_const)
+
+
+_registry.register("mesh_gcm_rtp_protect", "grouped",
+                   _mesh_gcm_protect_grouped)
+_registry.register("mesh_gcm_rtp_protect", "per_row",
+                   _mesh_gcm_protect_per_row)
+_registry.register("mesh_gcm_rtp_unprotect", "grouped",
+                   _mesh_gcm_unprotect_grouped)
+_registry.register("mesh_gcm_rtp_unprotect", "per_row",
+                   _mesh_gcm_unprotect_per_row)
 
 
 class ShardedSrtpTable(ShardedRowsMixin, SrtpStreamTable):
-    """`SrtpStreamTable` whose RTP crypto runs sharded over a mesh."""
+    """`SrtpStreamTable` whose RTP *and* RTCP crypto runs sharded."""
 
     def __init__(self, capacity: int, mesh: Mesh,
                  profile: SrtpProfile =
                  SrtpProfile.AES_CM_128_HMAC_SHA1_80):
-        if profile.policy.cipher not in (Cipher.AES_CM, Cipher.NULL,
-                                         Cipher.AES_GCM):
-            raise ValueError(
-                f"ShardedSrtpTable supports AES-CM/NULL/AES-GCM "
-                f"profiles; {profile.value} stays single-chip for now")
         self._init_sharding(mesh, capacity)
         super().__init__(capacity, profile)
 
-    def _sharded_tables(self):
-        return (self._rk_rtp,
-                self._gm_rtp if self._gcm else self._mid_rtp)
+    def _sharded_tables(self, group: str):
+        if group == "rtp":
+            t = [self._rk_rtp,
+                 self._gm_rtp if self._gcm else self._mid_rtp]
+            if self._f8:
+                t.append(self._rk_f8_rtp)
+        else:
+            t = [self._rk_rtcp,
+                 self._gm_rtcp if self._gcm else self._mid_rtcp]
+            if self._f8:
+                t.append(self._rk_f8_rtcp)
+        return tuple(t)
 
     @classmethod
     def restore(cls, snap: dict, mesh: Mesh) -> "ShardedSrtpTable":
@@ -196,43 +367,118 @@ class ShardedSrtpTable(ShardedRowsMixin, SrtpStreamTable):
         t._load_state(snap)
         return t
 
-    def warmup(self, max_batch: int, off_const=12) -> None:
-        """Pre-compile the shard_map protect/unprotect ladder so live
-        ticks never absorb an XLA compile (the same discipline as
-        AudioMixer's setup-time warmup): lane counts are power-of-two
-        padded and bounded by the BATCH size (worst-case skew parks a
-        whole batch on one chip), so the pow2 ladder up to `max_batch`
-        covers every lane shape a batch that size can produce for the
-        given payload offset.  Other offsets (rare: header extensions
-        vary per batch) still compile lazily, like the size-class
-        bucketing elsewhere.  Called by ConferenceBridge.warmup();
-        standalone deployments call it before going live."""
-        tab_rk, tab_aux = self._sharded_device()
+    def warmup(self, max_batch: int, off_const=12,
+               capacities=(224, 544)) -> None:
+        """Pre-compile the shard_map ladders so live ticks never absorb
+        an XLA compile (the same discipline as AudioMixer's setup-time
+        warmup): lane counts are power-of-two padded and bounded by the
+        BATCH size (worst-case skew parks a whole batch on one chip),
+        so the pow2 ladder up to `max_batch` covers every lane shape a
+        batch that size can produce — per payload offset AND per
+        bucketing capacity class (the defaults are `bucket_by_size`'s
+        LENGTH_CLASSES + CLASS_HEADROOM; batches in the terminal
+        full-width class, like rare offsets, still compile lazily).
+        Covers the RTP ops, the SRTCP programs (sharded since round
+        5 — RTCP batches are not size-bucketed, so only the listed
+        capacities pre-compile), and for GCM the registry's
+        grouped/per-row measurement (advisor r5: the measurement
+        compiles both providers and times 12 launches — that must
+        happen here, ON THIS table, not on the first live batch).
+        Called by ConferenceBridge.warmup(); standalone deployments
+        call it before going live."""
+        tabs = self._sharded_device("rtp")
+        rtcp_tabs = self._sharded_device("rtcp")
         gcm = self._gcm
-        ops = ("gcm_protect", "gcm_unprotect") if gcm \
-            else ("protect", "unprotect")
-        lanes = 4
-        top = max(4, max_batch)
-        while True:
-            for op in ops:
-                fn = self._shard_fn(op, self.policy.auth_tag_len,
-                                    self.policy.cipher != Cipher.NULL,
-                                    off_const)
-                shape = (self.n_dev, lanes)
-                args = [tab_rk, tab_aux,
-                        jnp.zeros(shape, jnp.int32),
-                        jnp.zeros(shape + (256,), jnp.uint8),
-                        jnp.full(shape, 64, jnp.int32),
-                        jnp.full(shape, off_const, jnp.int32)]
-                if gcm:
-                    args.append(jnp.zeros(shape + (12,), jnp.uint8))
-                else:
-                    args += [jnp.zeros(shape + (16,), jnp.uint8),
-                             jnp.zeros(shape, jnp.uint32)]
-                jax.block_until_ready(fn(*args))
-            if lanes >= top:
-                break
-            lanes *= 2
+        encrypt = self.policy.cipher != Cipher.NULL
+        tag = self.policy.auth_tag_len
+        if gcm:
+            ops = ("gcm_protect", "gcm_unprotect")
+        elif self._f8:
+            ops = ("f8_protect", "f8_unprotect")
+        else:
+            ops = ("protect", "unprotect")
+        for cap in capacities:
+            lanes = 4
+            top = max(4, max_batch)
+            while True:
+                for op in ops:
+                    fn = self._shard_fn(op, tag, encrypt, off_const)
+                    shape = (self.n_dev, lanes)
+                    args = list(tabs)
+                    args += [jnp.zeros(shape, jnp.int32),
+                             jnp.zeros(shape + (cap,), jnp.uint8),
+                             jnp.full(shape, 64, jnp.int32),
+                             jnp.full(shape, off_const, jnp.int32)]
+                    if gcm:
+                        args.append(jnp.zeros(shape + (12,), jnp.uint8))
+                    else:
+                        args += [jnp.zeros(shape + (16,), jnp.uint8),
+                                 jnp.zeros(shape, jnp.uint32)]
+                    jax.block_until_ready(fn(*args))
+                if not gcm and lanes <= 256:
+                    # SRTCP ladder (the GCM SRTCP seam reuses the RTP
+                    # gcm programs above — same _shard_fn cache key).
+                    # Capped at 256 lanes: control traffic is low-rate,
+                    # and every ladder rung is a tunnel compile.
+                    self._warmup_rtcp(rtcp_tabs, cap, lanes, tag,
+                                      encrypt)
+                if lanes >= top:
+                    break
+                lanes *= 2
+        if gcm:
+            self._warmup_gcm_registry(max_batch, capacities)
+
+    def _warmup_rtcp(self, rtcp_tabs, cap: int, lanes: int, tag: int,
+                     encrypt: bool) -> None:
+        shape = (self.n_dev, lanes)
+        p_fn = self._shard_fn(
+            "rtcp_f8_protect" if self._f8 else "rtcp_protect", tag,
+            encrypt, None)
+        jax.block_until_ready(p_fn(
+            *rtcp_tabs, jnp.zeros(shape, jnp.int32),
+            jnp.zeros(shape + (cap,), jnp.uint8),
+            jnp.full(shape, 64, jnp.int32),
+            jnp.zeros(shape + (16,), jnp.uint8),
+            jnp.zeros(shape, jnp.int32)))
+        u_fn = self._shard_fn(
+            "rtcp_f8_unprotect" if self._f8 else "rtcp_unprotect", tag,
+            encrypt, None)
+        jax.block_until_ready(u_fn(
+            *rtcp_tabs, jnp.zeros(shape, jnp.int32),
+            jnp.zeros(shape + (cap,), jnp.uint8),
+            jnp.full(shape, 64, jnp.int32),
+            jnp.zeros(shape + (16,), jnp.uint8)))
+
+    def _warmup_gcm_registry(self, max_batch: int, capacities) -> None:
+        """Drive THIS table's GCM registry seams with synthetic args so
+        the grouped/per-row compiles and the 12-launch measurement
+        happen off the media path and land in THIS table's program
+        cache (a scratch table would pin the registry choice via the
+        geometry token but leave the live table's jit closures cold —
+        advisor r5).  Pure dispatch: these seams touch no host crypto
+        state (replay/tx planes live in the callers above them)."""
+        from libjitsi_tpu.core.packet import ROW_CLASSES
+
+        rng = np.random.default_rng(0)
+        n = max(1, min(self.capacity, 64))
+        for cap in capacities:
+            for bsz in ROW_CLASSES:
+                if bsz > max(ROW_CLASSES[0], max_batch):
+                    break
+                # heavy stream reuse: the grouped grid must be
+                # structurally usable or the measurement would only
+                # ever exercise the per-row provider
+                stream = np.sort(
+                    np.resize(np.arange(n, dtype=np.int64), bsz))
+                data = rng.integers(0, 256, (bsz, cap), dtype=np.uint8)
+                length = np.full(bsz, 172, np.int32)
+                off = np.full(bsz, 12, np.int32)
+                iv12 = rng.integers(0, 256, (bsz, 12), dtype=np.uint8)
+                for op in ("mesh_gcm_rtp_protect",
+                           "mesh_gcm_rtp_unprotect"):
+                    outs = _registry.call(op, self._token(), stream,
+                                          data, length, off, iv12, 12)
+                    jax.block_until_ready(outs)
 
     # ------------------------------------------------------- sharded seams
     def _run_sharded(self, op: str, stream, batch, hdr, length,
@@ -240,8 +486,10 @@ class ShardedSrtpTable(ShardedRowsMixin, SrtpStreamTable):
         off_const = _uniform_off(hdr.payload_off, batch.capacity)
         fn = self._shard_fn(op, self.policy.auth_tag_len,
                             self.policy.cipher != Cipher.NULL, off_const)
-        return self._sharded_launch(fn, stream, batch.data, length,
-                                    hdr.payload_off, tail_args)
+        return self._sharded_launch(
+            fn, self._sharded_device("rtp"), stream,
+            [batch.data, np.asarray(length, dtype=np.int32),
+             hdr.payload_off, *tail_args])
 
     @staticmethod
     def _roc32(v) -> np.ndarray:
@@ -259,22 +507,117 @@ class ShardedSrtpTable(ShardedRowsMixin, SrtpStreamTable):
             [iv, self._roc32(v)])
         return data, mlen.astype(np.int32), auth_ok
 
-    # ----------------------------------------------------- GCM (per row)
+    # ------------------------------------------------------------------ F8
+    def _f8_rtp_protect_call(self, stream, batch, hdr, iv, v):
+        """Sharded AES-F8: the second key schedule `[S, R, 16]` rides
+        the same row partition as the first (VERDICT r4 #6)."""
+        data, olen = self._run_sharded("f8_protect", stream, batch, hdr,
+                                       batch.length, [iv, self._roc32(v)])
+        return data, olen.astype(np.int32)
+
+    def _f8_rtp_unprotect_call(self, stream, batch, hdr, iv, v, length):
+        data, mlen, auth_ok = self._run_sharded(
+            "f8_unprotect", stream, batch, hdr, length,
+            [iv, self._roc32(v)])
+        return data, mlen.astype(np.int32), auth_ok
+
+    # ----------------------------------------------------------------- GCM
     def _gcm_rtp_protect_call(self, stream, batch, hdr, iv12):
-        """Sharded AEAD: the PER-ROW form is row-local (key schedule +
-        GHASH matrix gather with chip-local indices), so it shards like
-        CM with zero collectives.  The grouped-GHASH form needs its
-        grid built per shard — future work; per-row is the measured
-        winner below ~32k rows anyway (BASELINE round-4 crossover)."""
-        data, olen = self._run_sharded("gcm_protect", stream, batch,
-                                       hdr, batch.length, [iv12])
+        """Sharded AEAD: BOTH forms shard — per-row (key schedule +
+        GHASH matrix gathers chip-local) and grouped-GHASH (per-device
+        group grids, `mesh_gcm_grid`); the winner is picked per shape
+        by registry measurement, exactly like the single-chip table
+        (VERDICT r4 #4 closed the hardcoded per-row regression)."""
+        off_const = _uniform_off(hdr.payload_off, batch.capacity)
+        data, olen = _registry.call(
+            "mesh_gcm_rtp_protect", self._token(),
+            np.asarray(stream, dtype=np.int64), batch.data,
+            np.asarray(batch.length, dtype=np.int32), hdr.payload_off,
+            np.asarray(iv12), off_const)
         return data, olen.astype(np.int32)
 
     def _gcm_rtp_unprotect_call(self, stream, batch, hdr, iv12, length):
-        data, mlen, auth_ok = self._run_sharded(
-            "gcm_unprotect", stream, batch, hdr, length, [iv12])
+        off_const = _uniform_off(hdr.payload_off, batch.capacity)
+        data, mlen, auth_ok = _registry.call(
+            "mesh_gcm_rtp_unprotect", self._token(),
+            np.asarray(stream, dtype=np.int64), batch.data,
+            np.asarray(length, dtype=np.int32), hdr.payload_off,
+            np.asarray(iv12), off_const)
         return data, mlen.astype(np.int32), auth_ok
 
+    def _token(self) -> _MeshSeamToken:
+        tok = getattr(self, "_seam_token", None)
+        if tok is None:
+            tok = self._seam_token = _MeshSeamToken(self)
+        return tok
+
+    def _gcm_mesh_launch(self, op: str, stream, data, length, off, iv12,
+                         off_const):
+        """One sharded GCM launch, per-row or grouped.  The grouped
+        form builds per-device group grids from the owner plan; when no
+        usable grid exists (skew/all-distinct) it degrades to the
+        per-row program — the registry then just measures a tie."""
+        fn = self._shard_fn(op, 0, True, off_const)
+        tabs = self._sharded_device("rtp")
+        if not op.endswith("_grouped"):
+            return self._sharded_launch(
+                fn, tabs, stream, [data, length, off, iv12])
+        ids = np.asarray(stream, dtype=np.int64)
+        plan = _OwnerPlan(ids, self.capacity, self.rows_per, self.n_dev)
+        local = local_rows(plan, ids, self.capacity, self.rows_per,
+                           self.n_dev)
+        gg = mesh_gcm_grid(local)
+        if gg is None:
+            return self._sharded_launch(
+                self._shard_fn(op[: -len("_grouped")], 0, True,
+                               off_const),
+                tabs, stream, [data, length, off, iv12], plan=plan)
+        return self._sharded_launch(fn, tabs, stream,
+                                    [data, length, off, iv12],
+                                    extra_args=gg, plan=plan)
+
+    # ----------------------------------------------------------- SRTCP
+    def _rtcp_protect_call(self, stream, batch, iv, index_word,
+                           encrypting: bool, f8: bool = False):
+        """Sharded SRTCP protect on the row-partitioned RTCP tables
+        (VERDICT r4 #6: a mesh deployment must not silently hop to a
+        single-chip path for control traffic)."""
+        fn = self._shard_fn("rtcp_f8_protect" if f8 else "rtcp_protect",
+                            self.policy.auth_tag_len, encrypting, None)
+        return self._sharded_launch(
+            fn, self._sharded_device("rtcp"), stream,
+            [batch.data, np.asarray(batch.length, dtype=np.int32), iv,
+             np.asarray(index_word)])
+
+    def _rtcp_unprotect_call(self, stream, batch, iv, length,
+                             encrypting: bool, f8: bool = False):
+        fn = self._shard_fn(
+            "rtcp_f8_unprotect" if f8 else "rtcp_unprotect",
+            self.policy.auth_tag_len, encrypting, None)
+        return self._sharded_launch(
+            fn, self._sharded_device("rtcp"), stream,
+            [batch.data, np.asarray(length, dtype=np.int32), iv])
+
+    def _gcm_rtcp_seal_call(self, stream, kin, klen, iv12):
+        """Sharded AEAD SRTCP: the RTP gcm shard program re-runs on the
+        RTCP table group (same shapes, aad pinned at 12 by the host
+        layout shuffle in context.py)."""
+        n = len(np.asarray(klen))
+        return self._sharded_launch(
+            self._shard_fn("gcm_protect", 0, True, 12),
+            self._sharded_device("rtcp"), stream,
+            [kin, np.asarray(klen, dtype=np.int32),
+             np.full(n, 12, np.int32), iv12])
+
+    def _gcm_rtcp_open_call(self, stream, kin, klen, iv12):
+        n = len(np.asarray(klen))
+        return self._sharded_launch(
+            self._shard_fn("gcm_unprotect", 0, True, 12),
+            self._sharded_device("rtcp"), stream,
+            [kin, np.asarray(klen, dtype=np.int32),
+             np.full(n, 12, np.int32), iv12])
+
+    # ------------------------------------------------------- shard programs
     def _shard_fn(self, op: str, tag_len: int, encrypt: bool, off_const):
         if op.startswith("gcm_"):
             # GCM's tag/encrypt are fixed by the kernel: normalize them
@@ -287,10 +630,68 @@ class ShardedSrtpTable(ShardedRowsMixin, SrtpStreamTable):
             return fn
         row3 = P(self._axes, None, None)
         lanes = P(self._axes, None)
+        f8 = op.startswith("f8_") or op.startswith("rtcp_f8_")
         if op.startswith("gcm_"):
-            from libjitsi_tpu.kernels import gcm as gcm_kernel
+            fn = self._build_gcm_fn(op, off_const, row3, lanes)
+        elif op.startswith("rtcp_"):
+            fn = self._build_rtcp_fn(op, tag_len, encrypt, f8, row3,
+                                     lanes)
+        else:
+            fn = self._build_rtp_fn(op, tag_len, encrypt, f8, off_const,
+                                    row3, lanes)
+        self._sh_fns[key] = fn
+        return fn
 
-            gfn = gcm_kernel.gcm_protect if op == "gcm_protect" \
+    def _build_rtp_fn(self, op, tag_len, encrypt, f8, off_const, row3,
+                      lanes):
+        kfn = kernel.srtp_protect if op.endswith("protect") and not \
+            op.endswith("unprotect") else kernel.srtp_unprotect
+        if f8:
+            def _run(tab_rk, tab_mid, tab_f8, local, data, length, off,
+                     iv, roc):
+                out = kfn(data[0], length[0], off[0], tab_rk[local[0]],
+                          iv[0], tab_mid[local[0]], roc[0], tag_len,
+                          encrypt, payload_off_const=off_const,
+                          f8_round_keys=tab_f8[local[0]])
+                return tuple(o[None] for o in out)
+            in_specs = (row3, row3, row3, lanes, row3, lanes, lanes,
+                        row3, lanes)
+        else:
+            def _run(tab_rk, tab_mid, local, data, length, off, iv, roc):
+                # per-shard leading axis is 1 (this chip's lane block)
+                out = kfn(data[0], length[0], off[0], tab_rk[local[0]],
+                          iv[0], tab_mid[local[0]], roc[0], tag_len,
+                          encrypt, payload_off_const=off_const)
+                return tuple(o[None] for o in out)
+            in_specs = (row3, row3, lanes, row3, lanes, lanes, row3,
+                        lanes)
+        n_out = 2 if "unprotect" not in op else 3
+        return jax.jit(jax.shard_map(
+            _run, mesh=self.mesh, in_specs=in_specs,
+            out_specs=(row3, lanes) if n_out == 2
+            else (row3, lanes, lanes), check_vma=False))
+
+    def _build_gcm_fn(self, op, off_const, row3, lanes):
+        from libjitsi_tpu.kernels import gcm as gcm_kernel
+
+        grouped = op.endswith("_grouped")
+        base = op[: -len("_grouped")] if grouped else op
+        unprot = base == "gcm_unprotect"
+        if grouped:
+            gfn = gcm_kernel.gcm_protect_grouped if not unprot \
+                else gcm_kernel.gcm_unprotect_grouped
+
+            def _run(tab_rk, tab_gm, local, data, length, off, iv12,
+                     grid, us, inv):
+                out = gfn(data[0], length[0], off[0], tab_rk[local[0]],
+                          tab_gm[us[0]], iv12[0], grid[0], inv[0],
+                          aad_const=off_const)
+                return tuple(o[None] for o in out)
+
+            in_specs = (row3, row3, lanes, row3, lanes, lanes, row3,
+                        row3, lanes, lanes)
+        else:
+            gfn = gcm_kernel.gcm_protect if not unprot \
                 else gcm_kernel.gcm_unprotect
 
             def _run(tab_rk, tab_gm, local, data, length, off, iv12):
@@ -299,30 +700,52 @@ class ShardedSrtpTable(ShardedRowsMixin, SrtpStreamTable):
                           aad_const=off_const)
                 return tuple(o[None] for o in out)
 
-            n_out = 2 if op == "gcm_protect" else 3
-            fn = jax.jit(jax.shard_map(
-                _run, mesh=self.mesh,
-                in_specs=(row3, row3, lanes, row3, lanes, lanes, row3),
-                out_specs=(row3, lanes) if n_out == 2
-                else (row3, lanes, lanes),
-                check_vma=False))
-            self._sh_fns[key] = fn
-            return fn
-        kfn = kernel.srtp_protect if op == "protect" \
-            else kernel.srtp_unprotect
-
-        def _run(tab_rk, tab_mid, local, data, length, off, iv, roc):
-            # per-shard leading axis is 1 (this chip's lane block)
-            out = kfn(data[0], length[0], off[0], tab_rk[local[0]],
-                      iv[0], tab_mid[local[0]], roc[0], tag_len,
-                      encrypt, payload_off_const=off_const)
-            return tuple(o[None] for o in out)
-
-        n_out = 2 if op == "protect" else 3
-        fn = jax.jit(jax.shard_map(
-            _run, mesh=self.mesh,
-            in_specs=(row3, row3, lanes, row3, lanes, lanes, row3, lanes),
-            out_specs=(row3, lanes) if n_out == 2 else (row3, lanes, lanes),
+            in_specs = (row3, row3, lanes, row3, lanes, lanes, row3)
+        return jax.jit(jax.shard_map(
+            _run, mesh=self.mesh, in_specs=in_specs,
+            out_specs=(row3, lanes, lanes) if unprot else (row3, lanes),
             check_vma=False))
-        self._sh_fns[key] = fn
-        return fn
+
+    def _build_rtcp_fn(self, op, tag_len, encrypt, f8, row3, lanes):
+        unprot = op.endswith("unprotect")
+        if unprot:
+            if f8:
+                def _run(tab_rk, tab_mid, tab_f8, local, data, length,
+                         iv):
+                    out = kernel.srtcp_unprotect(
+                        data[0], length[0], tab_rk[local[0]], iv[0],
+                        tab_mid[local[0]], tag_len, encrypt,
+                        f8_round_keys=tab_f8[local[0]])
+                    return tuple(o[None] for o in out)
+                in_specs = (row3, row3, row3, lanes, row3, lanes, row3)
+            else:
+                def _run(tab_rk, tab_mid, local, data, length, iv):
+                    out = kernel.srtcp_unprotect(
+                        data[0], length[0], tab_rk[local[0]], iv[0],
+                        tab_mid[local[0]], tag_len, encrypt)
+                    return tuple(o[None] for o in out)
+                in_specs = (row3, row3, lanes, row3, lanes, row3)
+            out_specs = (row3, lanes, lanes, lanes, lanes)
+        else:
+            if f8:
+                def _run(tab_rk, tab_mid, tab_f8, local, data, length,
+                         iv, word):
+                    out = kernel.srtcp_protect(
+                        data[0], length[0], tab_rk[local[0]], iv[0],
+                        tab_mid[local[0]], word[0], tag_len, encrypt,
+                        f8_round_keys=tab_f8[local[0]])
+                    return tuple(o[None] for o in out)
+                in_specs = (row3, row3, row3, lanes, row3, lanes, row3,
+                            lanes)
+            else:
+                def _run(tab_rk, tab_mid, local, data, length, iv,
+                         word):
+                    out = kernel.srtcp_protect(
+                        data[0], length[0], tab_rk[local[0]], iv[0],
+                        tab_mid[local[0]], word[0], tag_len, encrypt)
+                    return tuple(o[None] for o in out)
+                in_specs = (row3, row3, lanes, row3, lanes, row3, lanes)
+            out_specs = (row3, lanes)
+        return jax.jit(jax.shard_map(
+            _run, mesh=self.mesh, in_specs=in_specs,
+            out_specs=out_specs, check_vma=False))

@@ -9,18 +9,17 @@ as `ShardedSrtpTable` (the packets each chip needs are routed to it by
 the host plan, which already expands the (packet × receiver) matrix).
 
 The routing/expansion/IV host plane is `RtpTranslator`'s, unchanged;
-only the protect launch seams are overridden.  GCM fan-outs shard via
-the PER-ROW form (each output row's key schedule + GHASH matrix gather
-is chip-local); the full-mesh per-LEG-matrix fast path is disabled in
-mesh mode because its leg grid would span shards — a future
-optimization is a leg-partitioned `sharded_gcm_fanout` product path
-(the kernel already exists in mesh/sharded.py).
+only the protect launch seams are overridden.  GCM fan-outs shard BOTH
+ways: the general per-row form (each output row's key schedule + GHASH
+matrix gather is chip-local), and the full-mesh per-LEG-matrix fast
+path, which shards over the LEG axis (`_gcm_uniform_fanout_call` — the
+product form of mesh/sharded.py's `sharded_gcm_fanout`); parity tests
+pin both against the single-chip translator.
 """
 
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
@@ -33,11 +32,10 @@ from libjitsi_tpu.transform.srtp.policy import Cipher, SrtpProfile
 class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
     """`RtpTranslator` whose re-encrypt fan-out runs sharded by leg.
 
-    Async caveat: `translate_async` still works, but the sharded seam
-    scatters results on the HOST, so the pending object holds already-
-    materialized arrays — there is no launch/recv overlap in mesh mode.
-    Callers that depend on the overlap must not use the mesh translator
-    (SfuBridge refuses mesh+pipelined for exactly this reason).
+    `translate_async` keeps its overlap contract in mesh mode: the
+    sharded seams return deferred-scatter results (`_LazyArray`), so
+    `PendingTranslate` holds device-resident lane buffers until
+    `.result()` — SfuBridge composes mesh with pipelined ticks.
     """
 
     def __init__(self, capacity: int, mesh: Mesh,
@@ -51,14 +49,15 @@ class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
         self._init_sharding(mesh, capacity)
         super().__init__(capacity, profile)
 
-    def _sharded_tables(self):
+    def _sharded_tables(self, group: str = "rtp"):
         return self._rk, (self._gm if self._gcm else self._mid)
 
     def _cm_fanout_call(self, recv, data, length, payload_off, iv, idx):
         roc = ((np.asarray(idx) >> 16) & 0xFFFFFFFF).astype(np.uint32)
         out, out_len = self._sharded_launch(
-            self._fanout_fn(), recv, data, length, payload_off,
-            [iv, roc])
+            self._fanout_fn(), self._sharded_device(), recv,
+            [data, np.asarray(length, dtype=np.int32), payload_off, iv,
+             roc])
         return out, out_len.astype(np.int32)
 
     def _gcm_fanout_call(self, recv, data, length, payload_off, iv12,
@@ -66,29 +65,29 @@ class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
         from libjitsi_tpu.transform.srtp.context import _uniform_off
 
         fn = self._gcm_fanout_fn(_uniform_off(payload_off, capacity))
-        out, out_len = self._sharded_launch(fn, recv, data, length,
-                                            payload_off, [iv12])
+        out, out_len = self._sharded_launch(
+            fn, self._sharded_device(), recv,
+            [data, np.asarray(length, dtype=np.int32), payload_off,
+             iv12])
         return out, out_len.astype(np.int32)
 
     def _gcm_uniform_fanout_call(self, rr, pdata, plen, iv, aad_const):
-        """Leg-partitioned full-mesh AEAD fan-out: the per-LEG GHASH
-        matrices shard over chips while the P packets broadcast — each
-        chip seals the same packets for ITS legs with zero collectives
-        (the product form of mesh/sharded.py's sharded_gcm_fanout).
-        Legs pad to a multiple of the mesh size; pad outputs drop."""
-        rr = np.asarray(rr, dtype=np.int64)
-        g = len(rr)
-        g_pad = -(-g // self.n_dev) * self.n_dev
-        rr_pad = np.resize(rr, g_pad)        # pads cycle the real legs
-        iv_pad = np.resize(np.asarray(iv), (g_pad,) + np.asarray(
-            iv).shape[1:])
+        """Leg-partitioned full-mesh AEAD fan-out from the DEVICE-
+        RESIDENT row-partitioned tables: legs route to their owning
+        chips via the same owner plan as every sharded seam — no host
+        re-gather / re-upload of the per-leg 16 KiB GHASH matrices
+        (advisor r5: the old form shipped ~16 KiB x legs across the
+        link every call) — the P packets broadcast, and each chip
+        seals the same packets for ITS legs with zero collectives
+        (the product form of mesh/sharded.py's sharded_gcm_fanout)."""
+        plen32 = np.asarray(plen, dtype=np.int32)
         fn = self._gcm_uniform_fn(aad_const)
-        out_gp, out_len_p = fn(
-            jnp.asarray(self._rk[rr_pad]), jnp.asarray(self._gm[rr_pad]),
-            jnp.asarray(pdata), jnp.asarray(np.asarray(plen,
-                                                       dtype=np.int32)),
-            jnp.asarray(iv_pad))
-        return np.asarray(out_gp)[:g], np.asarray(out_len_p)
+        (out,) = self._sharded_launch(
+            fn, self._sharded_device(), rr, [np.asarray(iv)],
+            extra_args=(np.asarray(pdata), plen32))
+        # leg-major [G, P, W]; the output length is structural (AEAD
+        # appends a 16B tag), so no second device output to scatter
+        return out, plen32 + 16
 
     def _gcm_uniform_fn(self, off_const):
         key = ("gcm_uniform_fanout", off_const)
@@ -97,15 +96,21 @@ class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
             return fn
         from libjitsi_tpu.kernels import gcm as gcm_kernel
 
-        def _run(rks, gms, data, length, iv):
-            return gcm_kernel.gcm_protect_fanout(
-                data, length, rks, gms, iv, aad_const=off_const)
+        def _run(tab_rk, tab_gm, local, iv, data, length):
+            out, _ = gcm_kernel.gcm_protect_fanout(
+                data, length, tab_rk[local[0]], tab_gm[local[0]],
+                iv[0], aad_const=off_const)
+            return (out[None],)
 
-        legs3 = P(self._axes, None, None)
+        row3 = P(self._axes, None, None)
+        lanes = P(self._axes, None)
         fn = jax.jit(jax.shard_map(
             _run, mesh=self.mesh,
-            in_specs=(legs3, legs3, P(None, None), P(None), legs3),
-            out_specs=(legs3, P(None)), check_vma=False))
+            in_specs=(row3, row3, lanes,
+                      P(self._axes, None, None, None),
+                      P(None, None), P(None)),
+            out_specs=(P(self._axes, None, None, None),),
+            check_vma=False))
         self._sh_fns[key] = fn
         return fn
 

@@ -72,12 +72,9 @@ class ConferenceBridge:
         # runs sharded, not just its kernels
         self._mesh = mesh
         if mesh is not None:
-            if pipelined:
-                # the sharded table's scatter materializes on the host,
-                # so the pipelined dispatch seam cannot overlap in mesh
-                # mode — refuse rather than silently run synchronous
-                raise ValueError("mesh mode does not support "
-                                 "pipelined=True yet")
+            # composes with pipelined=True: the sharded seams defer
+            # their wire-order scatter (mesh/table._LazyArray), so the
+            # dispatch seam overlaps launches in mesh mode too
             from libjitsi_tpu.mesh import ShardedSrtpTable
             self.rx_table = ShardedSrtpTable(capacity, mesh, profile)
             self.tx_table = ShardedSrtpTable(capacity, mesh, profile)

@@ -164,6 +164,13 @@ def codec_from_name(name: str, ptime_ms: int) -> FrameCodec:
         rate = name.split("/", 1)[1] if "/" in name else "8000"
         return speex_codec({"8000": "nb", "16000": "wb",
                             "32000": "uwb"}[rate])
+    # receive-only legs (decode via libavcodec; encoders absent from
+    # the image) must also restore — a checkpoint that snapshots fine
+    # but cannot be reloaded is worse than a snapshot-time refusal
+    if u == "G729":
+        return g729_rx_codec(ptime_ms)
+    if u == "ILBC":
+        return ilbc_rx_codec()
     raise ValueError(f"cannot rebuild codec {name!r} on restore")
 
 

@@ -168,11 +168,9 @@ class SfuBridge:
         # sharded, not just its kernels.
         self._mesh = mesh
         if mesh is not None:
-            if pipelined:
-                # sharded scatters materialize on host: the overlap
-                # seam would silently be a no-op (see mesh/table.py)
-                raise ValueError("mesh mode does not support "
-                                 "pipelined=True yet")
+            # composes with pipelined=True: the sharded seams defer
+            # their wire-order scatter (mesh/table._LazyArray), so the
+            # fan-out launch overlaps the next recv window in mesh mode
             from libjitsi_tpu.mesh import (ShardedRtpTranslator,
                                            ShardedSrtpTable)
             self.rx_table = ShardedSrtpTable(capacity, mesh, profile)

@@ -80,9 +80,6 @@ class RtpTranslator:
             self._gm = np.zeros((capacity, 128, 128), dtype=np.int8)
         self._salt = np.zeros((capacity, 16), dtype=np.uint8)
         self._dev = None
-        # full-mesh per-LEG-matrix GCM fast path; the mesh subclass
-        # turns it off (the leg grid would span shards)
-        self._uniform_gcm_fanout = True
         # routing: sender sid -> sorted receiver id array
         self._routes: Dict[int, np.ndarray] = {}
 
@@ -270,9 +267,9 @@ class RtpTranslator:
         # can claim a header larger than the packet; such batches take
         # the general path, which clamps per row (the packets then die
         # at the receiving legs, not in our trace).  The mesh translator
-        # disables the full-mesh fast path (its per-LEG matrix grid
-        # would span shards) and shards the per-row form instead.
-        uniform = (self._uniform_gcm_fanout and len(recvs) > 1 and
+        # overrides the `_gcm_uniform_fanout_call` seam below with the
+        # legs partitioned over chips — parity-tested both ways.
+        uniform = (len(recvs) > 1 and
                    all(len(r) == len(recvs[0]) and np.array_equal(
                        r, recvs[0]) for r in recvs[1:])
                    and off0.size and np.all(off0 == off0[0])

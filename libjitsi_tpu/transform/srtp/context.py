@@ -772,22 +772,14 @@ class SrtpStreamTable:
         idx = chain_packet_indices(stream, hdr.seq, self.tx_ext)
         v = idx >> 16
 
-        if self._f8:                # CM/GCM fetch tables in their seams
-            tab_rk, tab_aux, _, _ = self._device()
         if self._gcm:
             iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
             data, length = self._gcm_rtp_protect_call(stream, batch,
                                                       hdr, iv12)
         elif self._f8:
             iv = self._f8_rtp_iv(hdr, v)
-            data, length = _protect_rtp_dev(
-                tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
-                jnp.asarray(batch.data), jnp.asarray(batch.length),
-                jnp.asarray(hdr.payload_off), jnp.asarray(iv),
-                jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
-                self.policy.auth_tag_len, True,
-                off_const=_uniform_off(hdr.payload_off, batch.capacity),
-                tab_f8=self._dev_f8[0])
+            data, length = self._f8_rtp_protect_call(stream, batch, hdr,
+                                                     iv, v)
         else:
             iv = self._cm_iv(self._salt_rtp[stream], hdr.ssrc, idx)
             data, length = self._cm_rtp_protect_call(stream, batch, hdr,
@@ -839,6 +831,33 @@ class SrtpStreamTable:
             jnp.asarray(batch.data), jnp.asarray(length),
             jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
             aad_const=aad_const)
+
+    def _f8_rtp_protect_call(self, stream, batch, hdr, iv, v):
+        """AES-F8 RTP protect device call — like the CM seam, the mesh
+        table overrides exactly this (the second key schedule shards on
+        the same row partition as the first)."""
+        tab_rk, tab_mid, _, _ = self._device()
+        return _protect_rtp_dev(
+            tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
+            jnp.asarray(batch.data), jnp.asarray(batch.length),
+            jnp.asarray(hdr.payload_off), jnp.asarray(iv),
+            jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
+            self.policy.auth_tag_len, True,
+            off_const=_uniform_off(hdr.payload_off, batch.capacity),
+            tab_f8=self._dev_f8[0])
+
+    def _f8_rtp_unprotect_call(self, stream, batch, hdr, iv, v, length):
+        """AES-F8 RTP unprotect device call (see _f8_rtp_protect_call);
+        returns (data, media_len, auth_ok)."""
+        tab_rk, tab_mid, _, _ = self._device()
+        return _unprotect_rtp_dev(
+            tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
+            jnp.asarray(batch.data), jnp.asarray(length),
+            jnp.asarray(hdr.payload_off), jnp.asarray(iv),
+            jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
+            self.policy.auth_tag_len, True,
+            off_const=_uniform_off(hdr.payload_off, batch.capacity),
+            tab_f8=self._dev_f8[0])
 
     def _cm_rtp_protect_call(self, stream, batch, hdr, iv, v):
         """AES-CM/NULL RTP protect device call — the mesh table
@@ -942,22 +961,14 @@ class SrtpStreamTable:
         v = idx >> 16
         not_replayed = replay.check(self.rx_max, self.rx_mask, stream, idx)
 
-        if self._f8:                # CM/GCM fetch tables in their seams
-            tab_rk, tab_aux, _, _ = self._device()
         if self._gcm:
             iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
             data, mlen, auth_ok = self._gcm_rtp_unprotect_call(
                 stream, batch, hdr, iv12, length)
         elif self._f8:
             iv = self._f8_rtp_iv(hdr, v)
-            data, mlen, auth_ok = _unprotect_rtp_dev(
-                tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
-                jnp.asarray(batch.data), jnp.asarray(length),
-                jnp.asarray(hdr.payload_off), jnp.asarray(iv),
-                jnp.asarray(v & 0xFFFFFFFF, dtype=jnp.uint32),
-                p.auth_tag_len, True,
-                off_const=_uniform_off(hdr.payload_off, batch.capacity),
-                tab_f8=self._dev_f8[0])
+            data, mlen, auth_ok = self._f8_rtp_unprotect_call(
+                stream, batch, hdr, iv, v, length)
         else:
             iv = self._cm_iv(self._salt_rtp[stream], hdr.ssrc, idx)
             data, mlen, auth_ok = self._cm_rtp_unprotect_call(
@@ -1014,24 +1025,64 @@ class SrtpStreamTable:
         e = np.int64(1 << 31) if encrypting else np.int64(0)
         index_word = index | e
 
-        _, _, tab_rk, tab_mid = self._device()
         if self._f8:
             iv = self._f8_rtcp_iv(batch.data, index_word)
-            data, length = _protect_rtcp_dev(
-                tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
-                jnp.asarray(batch.data), jnp.asarray(batch.length),
-                jnp.asarray(iv), jnp.asarray(index_word),
-                self.policy.auth_tag_len, True, tab_f8=self._dev_f8[1])
+            data, length = self._rtcp_protect_call(
+                stream, batch, iv, index_word, True, f8=True)
         else:
             iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
-            data, length = _protect_rtcp_dev(
-                tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
-                jnp.asarray(batch.data), jnp.asarray(batch.length),
-                jnp.asarray(iv), jnp.asarray(index_word),
-                self.policy.auth_tag_len, encrypting)
+            data, length = self._rtcp_protect_call(
+                stream, batch, iv, index_word, encrypting)
         np.maximum.at(self.rtcp_tx_index, stream, index)
         return PacketBatch(np.asarray(data), np.asarray(length, dtype=np.int32),
                            batch.stream)
+
+    def _rtcp_protect_call(self, stream, batch, iv, index_word,
+                           encrypting: bool, f8: bool = False):
+        """SRTCP protect device call (CM/NULL/F8) — the mesh table
+        overrides this seam too: a mesh deployment must not silently
+        hop to a single-chip path for control traffic."""
+        _, _, tab_rk, tab_mid = self._device()
+        return _protect_rtcp_dev(
+            tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
+            jnp.asarray(batch.data), jnp.asarray(batch.length),
+            jnp.asarray(iv), jnp.asarray(index_word),
+            self.policy.auth_tag_len, encrypting,
+            tab_f8=self._dev_f8[1] if f8 else None)
+
+    def _rtcp_unprotect_call(self, stream, batch, iv, length,
+                             encrypting: bool, f8: bool = False):
+        """SRTCP unprotect device call (CM/NULL/F8); returns
+        (data, media_len, auth_ok, e_bit, index)."""
+        _, _, tab_rk, tab_mid = self._device()
+        return _unprotect_rtcp_dev(
+            tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
+            jnp.asarray(batch.data), jnp.asarray(length),
+            jnp.asarray(iv), self.policy.auth_tag_len, encrypting,
+            tab_f8=self._dev_f8[1] if f8 else None)
+
+    def _gcm_rtcp_seal_call(self, stream, kin, klen, iv12):
+        """AEAD-GCM SRTCP seal device call on the kernel-layout buffer
+        (hdr8 || ESRTCP word || plaintext) — mesh overrides this seam
+        with the RTCP tables sharded on the same row partition."""
+        tab_rk, tab_aux = self._device()[2], self._device()[3]
+        n = len(klen)
+        return _protect_gcm_dev(
+            tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+            jnp.asarray(kin), jnp.asarray(klen, dtype=jnp.int32),
+            jnp.asarray(np.full(n, 12, np.int32)), jnp.asarray(iv12),
+            aad_const=12)
+
+    def _gcm_rtcp_open_call(self, stream, kin, klen, iv12):
+        """AEAD-GCM SRTCP open device call (see _gcm_rtcp_seal_call);
+        returns (data, media_len, auth_ok)."""
+        tab_rk, tab_aux = self._device()[2], self._device()[3]
+        n = len(klen)
+        return _unprotect_gcm_dev(
+            tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
+            jnp.asarray(kin), jnp.asarray(klen, dtype=jnp.int32),
+            jnp.asarray(np.full(n, 12, np.int32)), jnp.asarray(iv12),
+            aad_const=12)
 
     def _protect_rtcp_gcm(self, batch: PacketBatch, stream, ssrc, index
                           ) -> PacketBatch:
@@ -1056,12 +1107,8 @@ class SrtpStreamTable:
         kin = np.where(sel, shifted, kin).astype(np.uint8)
 
         iv12 = self._gcm_rtcp_iv(self._salt_rtcp[stream], ssrc, index)
-        tab_rk, tab_aux = self._device()[2], self._device()[3]
-        out, out_len = _protect_gcm_dev(
-            tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
-            jnp.asarray(kin), jnp.asarray(12 + plen, dtype=jnp.int32),
-            jnp.asarray(np.full(n, 12, np.int32)), jnp.asarray(iv12),
-            aad_const=12)
+        out, out_len = self._gcm_rtcp_seal_call(stream, kin, 12 + plen,
+                                                iv12)
         out = np.asarray(out)
         # wire: hdr8 || ct || tag || word
         wire = np.zeros_like(out)
@@ -1114,19 +1161,12 @@ class SrtpStreamTable:
                 batch, stream, ssrc, index, word, length)
         elif self._f8:
             iv = self._f8_rtcp_iv(batch.data, word)
-            _, _, tab_rk, tab_mid = self._device()
-            data, mlen, auth_ok, _e, _idx = _unprotect_rtcp_dev(
-                tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
-                jnp.asarray(batch.data), jnp.asarray(length),
-                jnp.asarray(iv), p.auth_tag_len, True,
-                tab_f8=self._dev_f8[1])
+            data, mlen, auth_ok, _e, _idx = self._rtcp_unprotect_call(
+                stream, batch, iv, length, True, f8=True)
         else:
             iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
-            _, _, tab_rk, tab_mid = self._device()
-            data, mlen, auth_ok, _e, _idx = _unprotect_rtcp_dev(
-                tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
-                jnp.asarray(batch.data), jnp.asarray(length),
-                jnp.asarray(iv), p.auth_tag_len, p.cipher != Cipher.NULL)
+            data, mlen, auth_ok, _e, _idx = self._rtcp_unprotect_call(
+                stream, batch, iv, length, p.cipher != Cipher.NULL)
         ok = valid & not_replayed & np.asarray(auth_ok)
         ok &= ~replay.dedup_first(stream, index, ok)
         replay.update(self.rtcp_rx_max, self.rtcp_rx_mask, stream, index, ok)
@@ -1158,13 +1198,8 @@ class SrtpStreamTable:
         kin = np.where(sel, shifted, kin).astype(np.uint8)
 
         iv12 = self._gcm_rtcp_iv(self._salt_rtcp[stream], ssrc, index)
-        tab_rk, tab_aux = self._device()[2], self._device()[3]
-        dec, _, auth_ok = _unprotect_gcm_dev(
-            tab_rk, tab_aux, jnp.asarray(stream, dtype=jnp.int32),
-            jnp.asarray(kin),
-            jnp.asarray(12 + ctlen + 16, dtype=jnp.int32),
-            jnp.asarray(np.full(n, 12, np.int32)), jnp.asarray(iv12),
-            aad_const=12)
+        dec, _, auth_ok = self._gcm_rtcp_open_call(stream, kin,
+                                                   12 + ctlen + 16, iv12)
         dec = np.asarray(dec)
         out = np.zeros_like(dec)
         out[:, :8] = dec[:, :8]

@@ -75,13 +75,134 @@ def test_sharded_replay_and_tamper_rejection():
     assert not ok3[3] and bool(np.sum(ok3) >= 14)
 
 
-def test_sharded_table_rejects_unsupported():
+def test_sharded_table_rejects_indivisible_capacity():
     mesh = make_media_mesh()
-    # GCM is supported since round 4; F8 remains single-chip
-    with pytest.raises(ValueError):
-        ShardedSrtpTable(CAP, mesh, SrtpProfile.F8_128_HMAC_SHA1_80)
     with pytest.raises(ValueError):
         ShardedSrtpTable(CAP + 1, mesh)
+
+
+def test_sharded_f8_parity():
+    """AES-F8 on the sharded table (VERDICT r4 #6): the second key
+    schedule shards on the same row partition — protect/unprotect must
+    be bit-identical to the single-chip F8 table."""
+    from libjitsi_tpu.mesh.parity import assert_table_parity
+
+    assert_table_parity(make_media_mesh(), capacity=CAP, batch_size=24,
+                        rounds=1,
+                        profile=SrtpProfile.F8_128_HMAC_SHA1_80)
+
+
+@pytest.mark.parametrize("profile,salt", [
+    (SrtpProfile.AES_CM_128_HMAC_SHA1_80, 14),
+    (SrtpProfile.F8_128_HMAC_SHA1_80, 14),
+    (SrtpProfile.AEAD_AES_128_GCM, 12),
+])
+def test_sharded_srtcp_parity(profile, salt):
+    """SRTCP runs SHARDED on the mesh table's RTCP key tables (VERDICT
+    r4 #6: control traffic must not silently hop to a single-chip
+    path) — wire and decrypt byte-identical to the plain table."""
+    from libjitsi_tpu.core.packet import PacketBatch
+
+    rng = np.random.default_rng(3)
+    mks = rng.integers(0, 256, (CAP, 16), dtype=np.uint8)
+    mss = rng.integers(0, 256, (CAP, salt), dtype=np.uint8)
+    mesh = make_media_mesh()
+
+    def build(cls, *extra):
+        tx = cls(CAP, *extra, profile)
+        tx.add_streams(np.arange(CAP), mks, mss)
+        rx = cls(CAP, *extra, profile)
+        rx.add_streams(np.arange(CAP), mks, mss)
+        return tx, rx
+
+    sh_tx, sh_rx = build(ShardedSrtpTable, mesh)
+    pl_tx, pl_rx = build(SrtpStreamTable)
+    blobs = [b"\x81\xc8\x00\x06" + int(0x1000 + s).to_bytes(4, "big")
+             + bytes([s]) * 20 for s in (2, 9, 2, 13)]
+    b1 = PacketBatch.from_payloads(blobs, stream=[2, 9, 2, 13])
+    b2 = PacketBatch.from_payloads(blobs, stream=[2, 9, 2, 13])
+    w_sh = sh_tx.protect_rtcp(b1)
+    w_pl = pl_tx.protect_rtcp(b2)
+    for i in range(w_sh.batch_size):
+        assert w_sh.to_bytes(i) == w_pl.to_bytes(i), f"rtcp row {i}"
+    d_sh, ok_sh = sh_rx.unprotect_rtcp(w_sh)
+    d_pl, ok_pl = pl_rx.unprotect_rtcp(w_pl)
+    assert bool(np.all(ok_sh)) and bool(np.all(ok_pl))
+    for i in range(d_sh.batch_size):
+        assert d_sh.to_bytes(i) == d_pl.to_bytes(i)
+    np.testing.assert_array_equal(sh_rx.rtcp_rx_max, pl_rx.rtcp_rx_max)
+    np.testing.assert_array_equal(sh_tx.rtcp_tx_index,
+                                  pl_tx.rtcp_tx_index)
+
+
+def test_sharded_async_protect_matches_sync():
+    """`protect_rtp_async` on the MESH table (VERDICT r4 #2): the
+    deferred-scatter seam must produce bit-identical wire to the sync
+    mesh path, with host TX state committed at dispatch."""
+    sh_a, _ = _tables()
+    sh_b, _ = _tables()
+    pends = []
+    for k in range(3):
+        b = _batch(np.random.default_rng(900 + k), 24, 700 + 24 * k)
+        pends.append(sh_a.protect_rtp_async(b))
+    # all three dispatched before any materialization: TX state already
+    # committed (the async contract) and not touched by result()
+    tx_at_dispatch = sh_a.tx_ext.copy()
+    outs = [p.result() for p in pends]
+    np.testing.assert_array_equal(sh_a.tx_ext, tx_at_dispatch)
+    for k in range(3):
+        b = _batch(np.random.default_rng(900 + k), 24, 700 + 24 * k)
+        w = sh_b.protect_rtp(b)
+        for i in range(w.batch_size):
+            assert outs[k].to_bytes(i) == w.to_bytes(i), f"batch {k} row {i}"
+    np.testing.assert_array_equal(sh_a.tx_ext, sh_b.tx_ext)
+
+
+def test_mesh_gcm_grouped_and_per_row_parity():
+    """The sharded GCM table's grouped-GHASH path (VERDICT r4 #4) must
+    match the sharded per-row path and the single-chip table bit for
+    bit; the live seam picks between them by registry measurement."""
+    from libjitsi_tpu.kernels import registry
+
+    prof = SrtpProfile.AEAD_AES_128_GCM
+    rng = np.random.default_rng(41)
+    mks = rng.integers(0, 256, (CAP, 16), dtype=np.uint8)
+    mss = rng.integers(0, 256, (CAP, 12), dtype=np.uint8)
+    mesh = make_media_mesh()
+
+    def mk_pair(cls, *extra):
+        tx = cls(CAP, *extra, prof)
+        tx.add_streams(np.arange(CAP), mks, mss)
+        rx = cls(CAP, *extra, prof)
+        rx.add_streams(np.arange(CAP), mks, mss)
+        return tx, rx
+
+    wires = {}
+    try:
+        for prov in ("grouped", "per_row"):
+            registry.force("mesh_gcm_rtp_protect", prov)
+            registry.force("mesh_gcm_rtp_unprotect", prov)
+            sh_tx, sh_rx = mk_pair(ShardedSrtpTable, mesh)
+            # heavy stream reuse so the grouped grid is structurally
+            # usable (24 lanes over <= 8 streams)
+            r = np.random.default_rng(77)
+            streams = r.integers(0, 8, 24)
+            pls = [r.integers(0, 256, 40, dtype=np.uint8).tobytes()
+                   for _ in range(24)]
+            b = rtp_header.build(
+                pls, list(range(200, 224)), [0] * 24,
+                (0x5000 + streams).tolist(), [96] * 24,
+                stream=streams.tolist())
+            w = sh_tx.protect_rtp(b)
+            wires[prov] = [w.to_bytes(i) for i in range(w.batch_size)]
+            d, ok = sh_rx.unprotect_rtp(w)
+            assert bool(np.all(ok)), f"{prov}: auth failed"
+            for i in range(d.batch_size):
+                assert d.to_bytes(i) == b.to_bytes(i)
+    finally:
+        registry.force("mesh_gcm_rtp_protect", None)
+        registry.force("mesh_gcm_rtp_unprotect", None)
+    assert wires["grouped"] == wires["per_row"]
 
 
 def test_mesh_bridge_tick_matches_single_chip():
@@ -98,10 +219,9 @@ def test_mesh_bridge_tick_matches_single_chip():
     cfg = libjitsi_tpu.configuration_service()
     mesh = make_media_mesh()
     assert_bridge_parity(cfg, mesh, capacity=16)
-    # the pipelined dispatch seam cannot overlap in mesh mode: refused
-    with pytest.raises(ValueError):
-        ConferenceBridge(cfg, port=0, capacity=16, mesh=mesh,
-                         pipelined=True)
+    # mesh COMPOSES with pipelined (VERDICT r4 #2): the deferred-scatter
+    # seam lets the dispatch overlap, and the wire stays byte-identical
+    assert_bridge_parity(cfg, mesh, capacity=16, pipelined=True)
 
 
 @pytest.mark.slow
@@ -184,8 +304,9 @@ def test_mesh_sfu_bridge_fanout_matches_single_chip():
     cfg = libjitsi_tpu.configuration_service()
     mesh = make_media_mesh()
     assert_sfu_parity(cfg, mesh, capacity=16)
-    with pytest.raises(ValueError):
-        SfuBridge(cfg, port=0, capacity=16, mesh=mesh, pipelined=True)
+    # mesh + pipelined composes (VERDICT r4 #2): the pipelined MESH
+    # bridge's forwarded wire matches the sync single-chip bridge
+    assert_sfu_parity(cfg, mesh, capacity=16, pipelined=True)
     # a mesh snapshot refuses a single-chip restore (un-sharding a
     # deployment must be loud, not silent)
     sfu = SfuBridge(cfg, port=0, capacity=16, recv_window_ms=0,
