@@ -34,11 +34,70 @@ PREAMBLE = 0x505A
 VERSION = b"1.10"
 
 HASH_S256 = b"S256"
+HASH_S384 = b"S384"
 CIPHER_AES1 = b"AES1"
+CIPHER_AES3 = b"AES3"
 AUTH_HS80 = b"HS80"
+AUTH_HS32 = b"HS32"
 KA_EC25 = b"EC25"
+KA_DH3K = b"DH3k"
 KA_MULT = b"Mult"
 SAS_B32 = b"B32 "
+
+# ------------------------------------------------ algorithm agility tables --
+# RFC 6189 §4.1.2: each Hello advertises ORDERED preference lists per
+# slot; the committing endpoint selects, per slot, the first algorithm
+# in its own order that the peer also advertised (preference
+# intersection).  The old fixed suite (S256/AES1/HS80/EC25/B32) is the
+# head of every default list, so default deployments negotiate exactly
+# what they always did.
+
+HASH_FNS = {HASH_S256: hashlib.sha256, HASH_S384: hashlib.sha384}
+CIPHER_KEY_BITS = {CIPHER_AES1: 128, CIPHER_AES3: 256}
+AUTH_TAG_BITS = {AUTH_HS80: 80, AUTH_HS32: 32}
+
+# RFC 3526 §4 3072-bit MODP group ("DH3k", RFC 6189 §5.1.5): p =
+# 2^3072 - 2^3008 - 1 + 2^64*(floor(2^2942 pi) + 1690314), generator 2.
+# The constant below was re-derived from that formula (and the same
+# derivation reproduces the published 2048-bit group-14 value bit for
+# bit); p and (p-1)/2 are both prime.
+DH3K_P = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD129024E088A67CC74"
+    "020BBEA63B139B22514A08798E3404DDEF9519B3CD3A431B302B0A6DF25F1437"
+    "4FE1356D6D51C245E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3DC2007CB8A163BF05"
+    "98DA48361C55D39A69163FA8FD24CF5F83655D23DCA3AD961C62F356208552BB"
+    "9ED529077096966D670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9DE2BCBF695581718"
+    "3995497CEA956AE515D2261898FA051015728E5A8AAAC42DAD33170D04507A33"
+    "A85521ABDF1CBA64ECFB850458DBEF0A8AEA71575D060C7DB3970F85A6E1E4C7"
+    "ABF5AE8CDB0933D71E8C94E04A25619DCEE3D2261AD2EE6BF12FFA06D98A0864"
+    "D87602733EC86A64521F2B18177B200CBBE117577A615D6C770988C0BAD946E2"
+    "08E24FA074E5AB3143DB5BFCE0FD108E4B82D120A93AD2CAFFFFFFFFFFFFFFFF",
+    16)
+DH3K_G = 2
+KA_PUB_LEN = {KA_EC25: 64, KA_DH3K: 384}
+
+DEFAULT_PREFS = {
+    "hash": (HASH_S256, HASH_S384),
+    "cipher": (CIPHER_AES1, CIPHER_AES3),
+    "auth": (AUTH_HS80, AUTH_HS32),
+    "ka": (KA_EC25, KA_DH3K),
+    "sas": (SAS_B32,),
+}
+_SLOT_CODES = {
+    "hash": tuple(HASH_FNS), "cipher": tuple(CIPHER_KEY_BITS),
+    "auth": tuple(AUTH_TAG_BITS), "ka": tuple(KA_PUB_LEN),
+    "sas": (SAS_B32,),
+}
+
+# (cipher, auth) -> the SRTP profile the negotiated keys feed
+PROFILE_BY_SUITE = {
+    (CIPHER_AES1, AUTH_HS80): SrtpProfile.AES_CM_128_HMAC_SHA1_80,
+    (CIPHER_AES1, AUTH_HS32): SrtpProfile.AES_CM_128_HMAC_SHA1_32,
+    (CIPHER_AES3, AUTH_HS80): SrtpProfile.AES_256_CM_HMAC_SHA1_80,
+    (CIPHER_AES3, AUTH_HS32): SrtpProfile.AES_256_CM_HMAC_SHA1_32,
+}
 
 _B32_ALPHABET = "ybndrfg8ejkmcpqxot1uwisza345h769"  # RFC 6189 §5.1.6
 
@@ -180,13 +239,17 @@ class ZrtpEndpoint:
 
     def __init__(self, zid: Optional[bytes] = None, ssrc: int = 0,
                  cache: Optional[ZidCache] = None,
-                 multistream_from: Optional["ZrtpEndpoint"] = None):
+                 multistream_from: Optional["ZrtpEndpoint"] = None,
+                 algorithms: Optional[Dict[str, tuple]] = None):
         """`cache`: RFC 6189 §4.9 retained-secret store — sessions with
         a cached peer mix the shared secret into s0 (key continuity)
         and rotate it on completion.  `multistream_from`: a COMPLETED
         DH-mode endpoint of the same peer association; this endpoint
         then keys via Multistream mode (§4.4.3) — no DH, s0 derived
-        from the parent's ZRTPSess session key."""
+        from the parent's ZRTPSess session key.  `algorithms`: ordered
+        preference lists per slot ("hash"/"cipher"/"auth"/"ka"/"sas",
+        RFC 6189 §4.1.2) — defaults to DEFAULT_PREFS; the committing
+        side selects the first of ITS preferences the peer advertised."""
         if multistream_from is not None:
             if multistream_from.session_key is None:
                 raise RuntimeError(
@@ -213,7 +276,22 @@ class ZrtpEndpoint:
         self._h1 = _sha256(self._h0)
         self._h2 = _sha256(self._h1)
         self._h3 = _sha256(self._h2)
-        self._ec_priv = ec.generate_private_key(ec.SECP256R1())
+        # algorithm agility (RFC 6189 §4.1.2): validated preference
+        # lists; the NEGOTIATED suite is pinned at Commit time
+        prefs = dict(DEFAULT_PREFS)
+        if algorithms:
+            for slot, lst in algorithms.items():
+                if slot not in _SLOT_CODES:
+                    raise ValueError(f"unknown algorithm slot {slot!r}")
+                lst = tuple(lst)
+                bad = [c for c in lst if c not in _SLOT_CODES[slot]]
+                if bad or not lst:
+                    raise ValueError(f"unsupported {slot} codes {bad}")
+                prefs[slot] = lst
+        self._prefs = prefs
+        self.suite: Optional[Dict[str, bytes]] = None
+        self._hash = hashlib.sha256       # until a suite is negotiated
+        self._ka_priv = None              # lazy; depends on suite["ka"]
         self._seq = int.from_bytes(os.urandom(2), "big")
         self.role: Optional[str] = None
         self.complete = False
@@ -228,34 +306,109 @@ class ZrtpEndpoint:
         self._my_dhpart: Optional[bytes] = None
         self._peer_pub: Optional[bytes] = None
 
+    # ------------------------------------------------- negotiated suite
+    def _nh(self, b: bytes) -> bytes:
+        """Negotiated-hash digest (hvi, total_hash, s0 — §4.4.1)."""
+        return self._hash(b).digest()
+
+    def _nkdf(self, ki: bytes, label: bytes, context: bytes,
+              length_bits: int) -> bytes:
+        """§4.5.1 KDF under the NEGOTIATED hash (the message-MAC /
+        hash-image-chain domain stays SHA-256: those run before any
+        suite exists on the wire)."""
+        data = struct.pack("!I", 1) + label + b"\x00" + context + \
+            struct.pack("!I", length_bits)
+        return hmac_mod.new(ki, data, self._hash).digest()[
+            : length_bits // 8]
+
+    def _peer_hello_algs(self) -> Dict[str, tuple]:
+        """Parse the peer Hello's per-slot advertised algorithm lists."""
+        hello = self._peer[b"Hello   "]
+        off = 12 + 4 + 16 + 32 + 12
+        cnt = hello[off:off + 8]
+        pos = off + 8
+        out: Dict[str, tuple] = {}
+        for slot, n in (("hash", cnt[1]), ("cipher", cnt[2]),
+                        ("auth", cnt[3]), ("ka", cnt[4]),
+                        ("sas", cnt[5])):
+            out[slot] = tuple(hello[pos + 4 * i: pos + 4 * (i + 1)]
+                              for i in range(n))
+            pos += 4 * n
+        return out
+
+    def _select_suite(self) -> Dict[str, bytes]:
+        """RFC 6189 §4.1.2 preference intersection: per slot, the first
+        algorithm in OUR ordered list the peer also advertised."""
+        peer = self._peer_hello_algs()
+        suite: Dict[str, bytes] = {}
+        for slot in ("hash", "cipher", "auth", "ka", "sas"):
+            theirs = set(peer.get(slot, ()))
+            pick = next((c for c in self._prefs[slot] if c in theirs),
+                        None)
+            if pick is None:
+                raise ZrtpProtocolError(
+                    f"ZRTP: no common {slot} algorithm "
+                    f"(ours {self._prefs[slot]}, theirs "
+                    f"{sorted(theirs)})")
+            suite[slot] = pick
+        return suite
+
+    def _adopt_suite(self, suite: Dict[str, bytes]) -> None:
+        self.suite = dict(suite)
+        self._hash = HASH_FNS[suite["hash"]]
+
+    def _ka(self) -> bytes:
+        return (self.suite or {}).get("ka", KA_EC25)
+
     # ------------------------------------------------------------ builders
+    def _gen_ka(self):
+        if self._ka_priv is None:
+            if self._ka() == KA_DH3K:
+                # 256-bit exponent per RFC 6189 §4.4.1.3 (DH3k)
+                self._ka_priv = int.from_bytes(os.urandom(32), "big")
+            else:
+                self._ka_priv = ec.generate_private_key(ec.SECP256R1())
+        return self._ka_priv
+
     def _pub_bytes(self) -> bytes:
-        return self._ec_priv.public_key().public_bytes(
+        priv = self._gen_ka()
+        if self._ka() == KA_DH3K:
+            return pow(DH3K_G, priv, DH3K_P).to_bytes(384, "big")
+        return priv.public_key().public_bytes(
             serialization.Encoding.X962,
             serialization.PublicFormat.UncompressedPoint)[1:]  # 64B x||y
 
     def _make_hello(self) -> bytes:
         payload = VERSION + b"libjitsi-tpu    "[:16] + self._h3 + self.zid
-        # flags + one algorithm of each kind (0x10101011-style counts)
-        payload += bytes([0, 1, 1, 1]) + HASH_S256 + CIPHER_AES1 + \
-            AUTH_HS80 + KA_EC25 + SAS_B32
+        # flags byte + per-slot counts, then the ORDERED lists (§4.1.2)
+        p = self._prefs
+        payload += bytes([0, len(p["hash"]), len(p["cipher"]),
+                          len(p["auth"]), len(p["ka"]), len(p["sas"]),
+                          0, 0])
+        for slot in ("hash", "cipher", "auth", "ka", "sas"):
+            payload += b"".join(p[slot])
         core = _msg(b"Hello   ", payload + b"\x00" * 8)
         mac = _hmac(self._h2, core[:-8])[:8]
         return core[:-8] + mac
 
     def _make_commit(self) -> bytes:
+        suite = self._select_suite()
         if self._mult:
             # Multistream mode (RFC 6189 §4.4.3): no DH — a fresh nonce
             # rides where DH mode carries the hvi commitment
+            self._adopt_suite(dict(suite, ka=KA_MULT))
             self._mult_nonce = os.urandom(16)
-            payload = self._h2 + self.zid + HASH_S256 + CIPHER_AES1 + \
-                AUTH_HS80 + KA_MULT + SAS_B32 + self._mult_nonce
+            payload = self._h2 + self.zid + suite["hash"] + \
+                suite["cipher"] + suite["auth"] + KA_MULT + \
+                suite["sas"] + self._mult_nonce
             core = _msg(b"Commit  ", payload + b"\x00" * 8)
             return core[:-8] + _hmac(self._h1, core[:-8])[:8]
+        self._adopt_suite(suite)
         dh2 = self._make_dhpart(b"DHPart2 ")
-        hvi = _sha256(dh2 + self._peer[b"Hello   "])
-        payload = self._h2 + self.zid + HASH_S256 + CIPHER_AES1 + \
-            AUTH_HS80 + KA_EC25 + SAS_B32 + hvi
+        hvi = self._nh(dh2 + self._peer[b"Hello   "])[:32]
+        payload = self._h2 + self.zid + suite["hash"] + \
+            suite["cipher"] + suite["auth"] + suite["ka"] + \
+            suite["sas"] + hvi
         core = _msg(b"Commit  ", payload + b"\x00" * 8)
         mac = _hmac(self._h1, core[:-8])[:8]
         self._my_dhpart = dh2
@@ -363,13 +516,17 @@ class ZrtpEndpoint:
                 # same-mode ties break on the LOWER value backing down
                 # to responder and processing the peer's Commit.
                 ka_off = 12 + 32 + 12 + 12
-                ours_ka = self._my_commit[ka_off:ka_off + 4]
-                theirs_ka = msg[ka_off:ka_off + 4]
-                if ours_ka != theirs_ka:
-                    if ours_ka != KA_MULT:
-                        return []          # our DH Commit wins
-                    we_lose = True         # our Mult loses to their DH
+                ours_mult = self._my_commit[ka_off:ka_off + 4] == KA_MULT
+                theirs_mult = msg[ka_off:ka_off + 4] == KA_MULT
+                if ours_mult != theirs_mult:
+                    # a DH-mode Commit beats a Multistream one (§4.2;
+                    # comparing a 32B hvi against a 16B nonce would be
+                    # meaningless, and the DH side cannot process Mult)
+                    we_lose = ours_mult
                 else:
+                    # same MODE (two DH Commits — even with different
+                    # KA choices — or two Mults): lower hvi/nonce backs
+                    # down, §4.2's symmetric tie-break
                     hvi_off = 12 + 32 + 12 + 20
                     we_lose = self._my_commit[hvi_off:hvi_off + 32] < \
                         msg[hvi_off:hvi_off + 32]
@@ -379,6 +536,7 @@ class ZrtpEndpoint:
                 self._my_commit = None
                 self._my_dhpart = None
                 self._mult_nonce = None
+                self._ka_priv = None        # peer's suite may differ
             if mtype in self._peer:
                 if self._peer[mtype] != msg:
                     return []
@@ -394,6 +552,19 @@ class ZrtpEndpoint:
                 raise ZrtpProtocolError("ZRTP: Commit H2 does not chain to H3")
             # H2 now known -> verify the peer Hello's MAC retroactively
             self._check_mac(self._peer[b"Hello   "], peer_h2, "Hello")
+            # the initiator's chosen suite (§4.1.2): every code must be
+            # one WE advertised — a Commit naming an alien algorithm is
+            # a downgrade/um-mismatch attack or a broken peer
+            chosen = {"hash": payload[44:48], "cipher": payload[48:52],
+                      "auth": payload[52:56], "sas": payload[60:64]}
+            ka_code = payload[56:60]
+            if ka_code != KA_MULT:
+                chosen["ka"] = ka_code
+            for slot, code in chosen.items():
+                if code not in self._prefs[slot]:
+                    raise ZrtpProtocolError(
+                        f"ZRTP: Commit selects {slot} {code!r} we did "
+                        "not offer")
             if payload[56:60] == KA_MULT:
                 # Multistream commit (§4.4.3): no DH round — derive s0
                 # from the shared ZRTPSess and confirm directly
@@ -404,12 +575,15 @@ class ZrtpEndpoint:
                 self._peer[mtype] = msg
                 self.role = "responder"
                 self._mult = True
+                self._adopt_suite(dict(chosen, ka=KA_MULT))
                 self._derive()
                 out.append(self._send(self._make_confirm(b"Confirm1")))
                 return out
             self._peer[mtype] = msg
             self.role = "responder"
             self._mult = False        # peer chose DH mode: follow it
+            self._adopt_suite(chosen)
+            self._ka_priv = None      # KA is the initiator's choice
             self._my_dhpart = self._make_dhpart(b"DHPart1 ")
             out.append(self._send(self._my_dhpart))
         elif mtype == b"DHPart1 ":
@@ -426,7 +600,7 @@ class ZrtpEndpoint:
             if _sha256(peer_h2) != self._peer_hello_h3():
                 raise ZrtpProtocolError("ZRTP: DHPart1 H1 does not chain to H3")
             self._check_mac(self._peer[b"Hello   "], peer_h2, "Hello")
-            pub = payload[32 + 32:32 + 32 + 64]
+            pub = payload[64:64 + KA_PUB_LEN[self._ka()]]
             self._parse_point(pub)       # reject junk at receive time
             self._peer[mtype] = msg
             self._peer_pub = pub
@@ -441,7 +615,7 @@ class ZrtpEndpoint:
             # verify commitment: hvi in Commit == hash(DHPart2||our Hello)
             commit = self._peer[b"Commit  "]
             hvi = commit[12 + 32 + 12 + 20:12 + 32 + 12 + 20 + 32]
-            if _sha256(msg + self._my_hello) != hvi:
+            if self._nh(msg + self._my_hello)[:32] != hvi:
                 raise ZrtpProtocolError("ZRTP: DHPart2 does not match hvi "
                                         "commitment (possible MITM)")
             # H1 revealed -> chains to Commit H2, and keys the Commit MAC
@@ -449,7 +623,7 @@ class ZrtpEndpoint:
             if _sha256(peer_h1) != commit[12:44]:
                 raise ZrtpProtocolError("ZRTP: DHPart2 H1 does not chain to H2")
             self._check_mac(commit, peer_h1, "Commit")
-            pub = payload[32 + 32:32 + 32 + 64]
+            pub = payload[64:64 + KA_PUB_LEN[self._ka()]]
             self._parse_point(pub)
             self._peer[mtype] = msg
             self._peer_pub = pub
@@ -483,7 +657,7 @@ class ZrtpEndpoint:
         if self._mult or self.cache is None or self._rotated:
             return
         self._rotated = True
-        rs_new = _kdf(self._s0, b"retained secret", self._ctx, 256)
+        rs_new = self._nkdf(self._s0, b"retained secret", self._ctx, 256)
         self.cache.update(self._peer_zid(), rs_new)
 
     # ---------------------------------------------------------- key sched
@@ -491,12 +665,22 @@ class ZrtpEndpoint:
         hello = self._peer[b"Hello   "]
         return hello[12 + 4 + 16:12 + 4 + 16 + 32]
 
-    @staticmethod
-    def _parse_point(raw: bytes) -> ec.EllipticCurvePublicKey:
-        """Validate a peer's 64-byte x||y P-256 point.  Raises
-        ZrtpProtocolError (dropped+alerted by feed) on junk — an invalid
-        point must not escape as ValueError into the I/O loop, nor reach
-        the ECDH as an invalid-curve input."""
+    def _parse_point(self, raw: bytes):
+        """Validate a peer's public KA value for the NEGOTIATED group —
+        64-byte x||y P-256 point (EC25) or 384-byte MODP element
+        (DH3k).  Raises ZrtpProtocolError (dropped+alerted by feed) on
+        junk — an invalid value must not escape as ValueError into the
+        I/O loop, nor reach the agreement as an invalid-curve /
+        small-subgroup input."""
+        if self._ka() == KA_DH3K:
+            if len(raw) != 384:
+                raise ZrtpProtocolError(
+                    "ZRTP: DH3k public value truncated")
+            y = int.from_bytes(raw, "big")
+            if not 1 < y < DH3K_P - 1:
+                raise ZrtpProtocolError(
+                    "ZRTP: DH3k public value out of range")
+            return y
         if len(raw) != 64:
             raise ZrtpProtocolError("ZRTP: DHPart public value truncated")
         try:
@@ -506,8 +690,10 @@ class ZrtpEndpoint:
             raise ZrtpProtocolError(f"ZRTP: invalid EC point ({e})") from e
 
     def _dh_result(self) -> bytes:
-        return self._ec_priv.exchange(ec.ECDH(),
-                                      self._parse_point(self._peer_pub))
+        peer = self._parse_point(self._peer_pub)
+        if self._ka() == KA_DH3K:
+            return pow(peer, self._gen_ka(), DH3K_P).to_bytes(384, "big")
+        return self._gen_ka().exchange(ec.ECDH(), peer)
 
     def _match_retained(self) -> Optional[bytes]:
         """s1 selection (RFC 6189 §4.3): compare the PEER's rs1ID/rs2ID
@@ -542,7 +728,7 @@ class ZrtpEndpoint:
         else:
             dh1 = self._my_dhpart
             dh2 = self._peer[b"DHPart2 "]
-        total_hash = _sha256(hello_r + commit + dh1 + dh2)
+        total_hash = self._nh(hello_r + commit + dh1 + dh2)
         dhr = self._dh_result()
         # RFC 6189 §4.4.1.4: s1 = matching retained secret (key
         # continuity) or null; aux/pbx (s2, s3) not modeled -> null
@@ -550,14 +736,14 @@ class ZrtpEndpoint:
         self.secret_continuity = s1 is not None
         null = struct.pack("!I", 0)
         s1_part = (struct.pack("!I", len(s1)) + s1) if s1 else null
-        self._s0 = _sha256(struct.pack("!I", 1) + dhr + b"ZRTP-HMAC-KDF" +
-                           zidi + zidr + total_hash + s1_part + null + null)
+        self._s0 = self._nh(struct.pack("!I", 1) + dhr + b"ZRTP-HMAC-KDF" +
+                            zidi + zidr + total_hash + s1_part + null + null)
         self._ctx = zidi + zidr + total_hash
-        self.sas = sas_b32(_kdf(self._s0, b"SAS", self._ctx, 256))
+        self.sas = sas_b32(self._nkdf(self._s0, b"SAS", self._ctx, 256))
         # exportable session key: Multistream children key off this
         # (§4.5.2), so additional media streams skip the DH entirely
-        self.session_key = _kdf(self._s0, b"ZRTP Session Key",
-                                self._ctx, 256)
+        self.session_key = self._nkdf(self._s0, b"ZRTP Session Key",
+                                      self._ctx, 256)
 
     def _session_parties(self):
         """Role-dependent (zidi, zidr, responder-Hello, Commit) shared
@@ -573,10 +759,10 @@ class ZrtpEndpoint:
         association's ZRTPSess over THIS stream's negotiation hash (the
         Commit carries a fresh nonce, so every stream's keys differ)."""
         zidi, zidr, hello_r, commit = self._session_parties()
-        total_hash = _sha256(hello_r + commit)
+        total_hash = self._nh(hello_r + commit)
         self._ctx = zidi + zidr + total_hash
-        self._s0 = _kdf(self._zrtp_sess, b"ZRTP MSK", self._ctx, 256)
-        self.sas = sas_b32(_kdf(self._s0, b"SAS", self._ctx, 256))
+        self._s0 = self._nkdf(self._zrtp_sess, b"ZRTP MSK", self._ctx, 256)
+        self.sas = sas_b32(self._nkdf(self._s0, b"SAS", self._ctx, 256))
         # ZRTPSess is per ASSOCIATION (§4.5.2): propagate it so further
         # streams can key off this endpoint even when the caller only
         # kept the newest one
@@ -589,12 +775,12 @@ class ZrtpEndpoint:
     def _mackey_own(self) -> bytes:
         label = b"Initiator HMAC key" if self.role == "initiator" else \
             b"Responder HMAC key"
-        return _kdf(self._s0, label, self._ctx, 256)
+        return self._nkdf(self._s0, label, self._ctx, 256)
 
     def _mackey_peer(self) -> bytes:
         label = b"Responder HMAC key" if self.role == "initiator" else \
             b"Initiator HMAC key"
-        return _kdf(self._s0, label, self._ctx, 256)
+        return self._nkdf(self._s0, label, self._ctx, 256)
 
     def _verify_confirm(self, payload: bytes) -> None:
         mac, peer_h0 = payload[:8], payload[8:40]
@@ -630,14 +816,21 @@ class ZrtpEndpoint:
     # -------------------------------------------------------------- export
     def srtp_keys(self):
         """(profile, tx_key, tx_salt, rx_key, rx_salt) — initiator sends
-        with the initiator key (RFC 6189 §4.5.3)."""
+        with the initiator key (RFC 6189 §4.5.3); key length and SRTP
+        profile follow the NEGOTIATED cipher/auth suite."""
         if self._s0 is None:
             raise RuntimeError("ZRTP not negotiated")
-        ki = _kdf(self._s0, b"Initiator SRTP master key", self._ctx, 128)
-        si = _kdf(self._s0, b"Initiator SRTP master salt", self._ctx, 112)
-        kr = _kdf(self._s0, b"Responder SRTP master key", self._ctx, 128)
-        sr = _kdf(self._s0, b"Responder SRTP master salt", self._ctx, 112)
-        profile = SrtpProfile.AES_CM_128_HMAC_SHA1_80
+        suite = self.suite or {"cipher": CIPHER_AES1, "auth": AUTH_HS80}
+        bits = CIPHER_KEY_BITS[suite["cipher"]]
+        ki = self._nkdf(self._s0, b"Initiator SRTP master key",
+                        self._ctx, bits)
+        si = self._nkdf(self._s0, b"Initiator SRTP master salt",
+                        self._ctx, 112)
+        kr = self._nkdf(self._s0, b"Responder SRTP master key",
+                        self._ctx, bits)
+        sr = self._nkdf(self._s0, b"Responder SRTP master salt",
+                        self._ctx, 112)
+        profile = PROFILE_BY_SUITE[(suite["cipher"], suite["auth"])]
         if self.role == "initiator":
             return profile, ki, si, kr, sr
         return profile, kr, sr, ki, si

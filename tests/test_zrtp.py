@@ -473,3 +473,143 @@ def test_zrtp_multistream_chains_from_mult_endpoint():
     run_zrtp(a3, b3)
     assert a3.srtp_keys()[1] == b3.srtp_keys()[3]
     assert a3.srtp_keys()[1] != a2.srtp_keys()[1]
+
+
+# ------------------------------------------------ algorithm agility (§4.1.2)
+
+def test_negotiation_converges_with_different_orderings():
+    """RFC 6189 §4.1.2 preference intersection: endpoints with DIFFERENT
+    orderings converge on ONE suite — the initiator's first preference
+    the responder also advertised — and both export identical keys."""
+    from libjitsi_tpu.control.zrtp import (
+        AUTH_HS32, AUTH_HS80, CIPHER_AES1, CIPHER_AES3, HASH_S256,
+        HASH_S384, KA_DH3K, KA_EC25)
+    from libjitsi_tpu.transform.srtp import SrtpProfile
+
+    a = ZrtpEndpoint(ssrc=1, algorithms={
+        "hash": (HASH_S384, HASH_S256),
+        "cipher": (CIPHER_AES3, CIPHER_AES1),
+        "auth": (AUTH_HS80, AUTH_HS32),
+        "ka": (KA_DH3K, KA_EC25)})
+    b = ZrtpEndpoint(ssrc=2, algorithms={
+        "hash": (HASH_S256, HASH_S384),
+        "cipher": (CIPHER_AES1, CIPHER_AES3),
+        "auth": (AUTH_HS32, AUTH_HS80),
+        "ka": (KA_EC25, KA_DH3K)})
+    run_zrtp(a, b)
+    # initiator (a) preference wins on the intersection
+    assert a.suite == b.suite
+    assert a.suite["hash"] == HASH_S384
+    assert a.suite["cipher"] == CIPHER_AES3
+    assert a.suite["auth"] == AUTH_HS80
+    assert a.suite["ka"] == KA_DH3K
+    assert a.sas == b.sas
+    pa, aki, asi, akr, asr = a.srtp_keys()
+    pb, bki, bsi, bkr, bsr = b.srtp_keys()
+    assert pa == pb == SrtpProfile.AES_256_CM_HMAC_SHA1_80
+    assert len(aki) == 32                   # AES3 -> 256-bit master key
+    assert (aki, asi) == (bkr, bsr) and (akr, asr) == (bki, bsi)
+
+
+def test_negotiated_keys_drive_srtp_roundtrip_aes256():
+    """The negotiated AES-256 suite's exported keys must key working
+    SRTP tables (the provider -> table contract, same as SDES/DTLS)."""
+    from libjitsi_tpu.control.zrtp import CIPHER_AES1, CIPHER_AES3
+
+    a = ZrtpEndpoint(ssrc=1,
+                     algorithms={"cipher": (CIPHER_AES3, CIPHER_AES1)})
+    b = ZrtpEndpoint(ssrc=2)
+    run_zrtp(a, b)
+    prof, tx_k, tx_s, rx_k, rx_s = a.srtp_keys()
+    _, btx_k, btx_s, brx_k, brx_s = b.srtp_keys()
+    tx = SrtpStreamTable(capacity=1, profile=prof)
+    tx.add_stream(0, tx_k, tx_s)
+    rx = SrtpStreamTable(capacity=1, profile=prof)
+    rx.add_stream(0, brx_k, brx_s)
+    wire = tx.protect_rtp(rtp_header.build(
+        [b"negotiated-256"], [7], [0], [0xAB], [96], stream=[0]))
+    dec, ok = rx.unprotect_rtp(wire)
+    assert bool(ok.all())
+    assert dec.to_bytes(0)[12:] == b"negotiated-256"
+
+
+def test_dh3k_fallback_when_peer_lacks_ec25():
+    """A peer that only offers DH3k forces the 3072-bit MODP group —
+    the handshake still completes and both sides agree."""
+    from libjitsi_tpu.control.zrtp import KA_DH3K, KA_EC25
+
+    a = ZrtpEndpoint(ssrc=1)                       # default: EC25 first
+    b = ZrtpEndpoint(ssrc=2, algorithms={"ka": (KA_DH3K,)})
+    run_zrtp(a, b)
+    assert a.suite["ka"] == KA_DH3K == b.suite["ka"]
+    assert a.sas == b.sas
+    assert a.srtp_keys()[1] == b.srtp_keys()[3]
+
+
+def test_no_common_algorithm_refuses_commit():
+    """Disjoint cipher offers: initiate() must refuse loudly (no
+    silent fallback to a suite the peer never advertised)."""
+    import pytest
+
+    from libjitsi_tpu.control.zrtp import (CIPHER_AES1, CIPHER_AES3,
+                                           ZrtpProtocolError)
+
+    a = ZrtpEndpoint(ssrc=1, algorithms={"cipher": (CIPHER_AES3,)})
+    b = ZrtpEndpoint(ssrc=2, algorithms={"cipher": (CIPHER_AES1,)})
+    for p in a.hello_packets():
+        b.feed(p)
+    for p in b.hello_packets():
+        a.feed(p)
+    with pytest.raises(ZrtpProtocolError):
+        a.initiate()
+
+
+def test_commit_with_unoffered_algorithm_rejected():
+    """A Commit naming an algorithm the responder never advertised is
+    dropped and alerted (downgrade defense)."""
+    from libjitsi_tpu.control.zrtp import CIPHER_AES1, CIPHER_AES3
+
+    a = ZrtpEndpoint(ssrc=1)
+    b = ZrtpEndpoint(ssrc=2, algorithms={"cipher": (CIPHER_AES1,)})
+    for p in a.hello_packets():
+        b.feed(p)
+    for p in b.hello_packets():
+        a.feed(p)
+    commit = bytearray(a.initiate()[0])
+    # forge the cipher code in the Commit: 12B packet header + 12B
+    # message header + payload offset 48
+    commit[12 + 12 + 48:12 + 12 + 52] = CIPHER_AES3
+    replies = b.feed(_reseal(bytes(commit)))
+    assert replies == []
+    assert any("did not offer" in al or "MAC mismatch" in al
+               for al in b.alerts)
+
+
+def test_commit_contention_dh_vs_dh_different_ka_converges():
+    """Both sides commit DH mode with DIFFERENT KA picks (possible with
+    KA agility): §4.2's hvi tie-break must apply — exactly one side
+    backs down and the handshake completes (review r5: the old
+    KA-mismatch branch made both sides 'win' and deadlocked)."""
+    from libjitsi_tpu.control.zrtp import KA_DH3K, KA_EC25
+
+    a = ZrtpEndpoint(ssrc=1, algorithms={"ka": (KA_DH3K, KA_EC25)})
+    b = ZrtpEndpoint(ssrc=2, algorithms={"ka": (KA_EC25, KA_DH3K)})
+    for p in a.hello_packets():
+        b.feed(p)
+    for p in b.hello_packets():
+        a.feed(p)
+    # BOTH initiate: contention
+    wire = [(0, p) for p in a.initiate()] + [(1, p) for p in b.initiate()]
+    rounds = 0
+    while (not a.complete or not b.complete) and rounds < 30:
+        rounds += 1
+        nxt = []
+        for who, pkt in wire:
+            ep = b if who == 0 else a
+            nxt += [(1 - who, p) for p in ep.feed(pkt)]
+        wire = nxt
+    assert a.complete and b.complete, "contention deadlocked"
+    assert {a.role, b.role} == {"initiator", "responder"}
+    assert a.suite == b.suite and a.sas == b.sas
+    # winner's KA pick is in force on both sides
+    assert a.suite["ka"] in (KA_DH3K, KA_EC25)
