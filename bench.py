@@ -24,7 +24,14 @@ Section order is headline-first (the tunnel link degrades over process
 lifetime — see BASELINE.md): device microbenches, then crypto sweeps,
 then the tunnel-floored production/loop paths.
 
-Prints exactly one JSON line.
+Output protocol (round-5 rework — VERDICT r4 #1):
+- FULL results: BENCH_DETAIL.json on disk + one big stdout line;
+- FINAL stdout line: a COMPACT headline (value, vs_baseline, p99,
+  roofline verdict, section tally) sized for the driver's tail window;
+- every throughput figure carries an HBM-roofline annotation and is
+  CAPPED at the physically possible rate (median-of-passes banking; a
+  cross-check against the standalone AES core rate bounds the headline
+  too) — see _roofline/_aes_consistency_check.
 """
 
 from __future__ import annotations
@@ -43,13 +50,12 @@ enable_compile_cache(os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 
 N_STREAMS = 10_240
-# Launch size: throughput scales with batch because the round trip is
-# dispatch-dominated, not compute-bound (recorded runs: 2048 -> 39M,
-# 16384 -> 345M, 65536 -> ~1.1B pps pipelined ~= 0.26 TB/s of packet
-# payload, ~2x that in HBM read+write traffic) while sync p99 latency
-# stays flat (~0.2-0.3 ms across 2048..65536), so the big launch still
-# meets the 2 ms p99 budget with >8x headroom — p99 is measured at THIS
-# batch size.  131072+ was rejected: compile time blows up.
+# Launch size: 65536 amortizes per-launch dispatch overhead.  The batch
+# comments of rounds 2-4 cited 0.5-1.1B pps here; those numbers were
+# tunnel-acknowledgment fiction (block_until_ready does not wait on
+# this link — round-5 finding, see BASELINE.md).  Fetch-verified
+# execution on the real v5e is ~ms-scale per launch and measured
+# honestly below.
 BATCH = 65536
 WIDTH = 192          # capacity; 20 ms Opus packet ≈ 12B header + 160B payload
 PKT_LEN = 172
@@ -57,6 +63,79 @@ TAG_LEN = 10
 
 BUDGET_S = float(os.environ.get("LIBJITSI_TPU_BENCH_BUDGET_S", "440"))
 _T0 = time.monotonic()
+
+# Physics self-check (VERDICT r4 #1/weak-1: the r04 headline exceeded
+# the chip's HBM roofline 2.8x — tunnel-acknowledged launches harvested
+# by max() banking).  Every pps figure is recorded next to the implied
+# HBM traffic, and any estimator above the roofline is CAPPED to it and
+# flagged: a number the bench itself marks impossible must not become
+# the headline.  ~819 GB/s is TPU v5e; override for other chips.
+HBM_GBPS = float(os.environ.get("LIBJITSI_TPU_HBM_GBPS", "819"))
+
+
+_FLOOR = [None]
+
+
+def _checksum(fn):
+    """Wrap `fn` into a jitted twin returning ONE uint32 checksum scalar.
+
+    Round-5 finding (BASELINE.md): on this tunnel `block_until_ready`
+    does NOT wait for fresh launches — it returns in ~0.1 ms while the
+    execution queues remotely, which is how rounds 2-4 recorded
+    multi-billion-pps fiction.  Only fetching bytes forces completion;
+    reducing the outputs to a scalar keeps that forced transfer at 4
+    bytes, so timing `np.asarray(g(*args))` measures dispatch + real
+    execution + one scalar round trip (subtract `_fetch_floor()`).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def _sum_tree(out):
+        tot = jnp.uint32(0)
+        for leaf in jax.tree_util.tree_leaves(out):
+            if hasattr(leaf, "dtype"):
+                tot = tot + jnp.sum(leaf.astype(jnp.uint32))
+        return tot
+
+    return jax.jit(lambda *a: _sum_tree(fn(*a)))
+
+
+def _fetch_floor() -> float:
+    """Per-iteration cost of the 4-byte verification fetch itself
+    (dispatch RTT + scalar transfer), measured once on a trivial
+    program and subtracted from every fetch-verified timing."""
+    if _FLOOR[0] is None:
+        import jax
+        import jax.numpy as jnp
+
+        g = jax.jit(lambda x: jnp.sum(x))
+        x = jnp.arange(8, dtype=jnp.uint32)
+        _ = np.asarray(g(x))
+        samples = []
+        for _i in range(7):
+            t0 = time.perf_counter()
+            _ = np.asarray(g(x))
+            samples.append(time.perf_counter() - t0)
+        _FLOOR[0] = float(np.median(samples))
+        EXTRA["scalar_fetch_floor_ms"] = round(_FLOOR[0] * 1e3, 2)
+    return _FLOOR[0]
+
+
+def _roofline(key: str, pps: float, bytes_per_item: float,
+              traffic: str) -> float:
+    """Record `pps` under EXTRA[key] with its implied GB/s and the HBM
+    ceiling for this traffic model; return the roofline-capped value.
+    `traffic` documents the per-item byte model (auditable in the
+    detail record)."""
+    ceiling = HBM_GBPS * 1e9 / bytes_per_item
+    implied = pps * bytes_per_item / 1e9
+    rec = {"pps": round(pps, 1), "implied_gbps": round(implied, 1),
+           "bytes_per_item": round(bytes_per_item, 1),
+           "ceiling_pps": round(ceiling, 1), "traffic": traffic}
+    if pps > ceiling:
+        rec["roofline_capped"] = True
+    EXTRA.setdefault("roofline", {})[key] = rec
+    return min(pps, ceiling)
 
 
 def _elapsed() -> float:
@@ -85,13 +164,22 @@ _emitted = False
 
 
 def emit() -> None:
-    """Print the single JSON line exactly once (thread/signal safe).
+    """Emit results exactly once (thread/signal safe).
+
+    Protocol (VERDICT r4 #1: BENCH_r04 had rc=0 and numbers, but the
+    full dict overflowed the driver's tail window mid-line, so nothing
+    machine-parsed it):
+    - the FULL result dict is written to BENCH_DETAIL.json on disk and
+      printed as a non-final stdout line (best effort);
+    - the LAST stdout line is a COMPACT headline — value, vs_baseline,
+      p99, roofline verdict, section tally, detail pointer — small
+      enough that any sane tail window holds it whole.
 
     The emitted flag latches only after a successful serialization: the
     watchdog thread can race the main thread mutating EXTRA/SECTIONS
     (json.dumps then raises "dictionary changed size"), and a latched
     flag with no output would defeat the whole survivability contract —
-    so serialization retries, then degrades to a minimal headline line.
+    so serialization retries, then degrades to the compact line alone.
     """
     global _emitted
     import copy
@@ -103,20 +191,57 @@ def emit() -> None:
         if base and RESULT["value"]:
             RESULT["vs_baseline"] = round(RESULT["value"] / base, 3)
         EXTRA["elapsed_s"] = round(_elapsed(), 1)
-        payload = None
+        full = None
         for _ in range(3):
             try:
-                payload = json.dumps(copy.deepcopy(RESULT))
+                full = json.dumps(copy.deepcopy(RESULT))
                 break
             except Exception:
                 time.sleep(0.05)
-        if payload is None:   # degrade: headline only, but ONE line out
-            payload = json.dumps({
+        if full is not None:
+            try:
+                detail_path = os.environ.get(
+                    "LIBJITSI_TPU_BENCH_DETAIL") or os.path.join(
+                    os.path.dirname(os.path.abspath(__file__)),
+                    "BENCH_DETAIL.json")
+                with open(detail_path, "w") as f:
+                    f.write(full)
+            except Exception:
+                pass
+            print(full, flush=True)     # non-final: tail may clip it
+        try:
+            # build the compact line from the SERIALIZED snapshot (an
+            # immutable copy) — referencing the live EXTRA dicts here
+            # would reopen the mutation race the retry loop handles
+            ex = json.loads(full)["extra"] if full is not None else {}
+            sect = list(ex.get("sections", {}).values())
+            ok_n = sum(1 for v in sect if isinstance(v, dict)
+                       and v.get("status") == "ok")
+            compact = json.dumps({
                 "metric": RESULT["metric"], "value": RESULT["value"],
                 "unit": RESULT["unit"],
                 "vs_baseline": RESULT["vs_baseline"],
+                "extra": {
+                    "p99_batch_ms": ex.get("p99_batch_ms"),
+                    "estimators_pps": ex.get("estimators_pps"),
+                    "hbm_gbps_assumed": HBM_GBPS,
+                    "headline_roofline": ex.get("roofline", {}).get(
+                        "headline", {}),
+                    "consistency_vs_aes_core": ex.get(
+                        "consistency_vs_aes_core"),
+                    "sections_ok": ok_n, "sections_total": len(sect),
+                    "elapsed_s": ex.get("elapsed_s"),
+                    "detail": ("BENCH_DETAIL.json + penultimate stdout "
+                               "line"),
+                }})
+        except Exception:   # scalar-only degrade: ONE line out, always
+            compact = json.dumps({
+                "metric": RESULT["metric"],
+                "value": float(RESULT["value"]),
+                "unit": RESULT["unit"],
+                "vs_baseline": float(RESULT["vs_baseline"]),
                 "extra": {"degraded": "emit serialization raced"}})
-        print(payload, flush=True)
+        print(compact, flush=True)   # the FINAL line
         _emitted = True
 
 
@@ -183,6 +308,12 @@ def section(name: str, min_cost_s: float, box_s: float, fn):
 
 # -------------------------------------------------------------- sections --
 
+def _aes_core_name() -> str:
+    from libjitsi_tpu.kernels.aes import get_core
+
+    return get_core()
+
+
 def tpu_pps(deadline: float) -> None:
     import jax
     import jax.numpy as jnp
@@ -210,70 +341,58 @@ def tpu_pps(deadline: float) -> None:
 
     args = [jnp.asarray(a) for a in
             (tab_rk, tab_mid, stream, data, length, payload_off, iv, roc)]
-    out = step(*args)
-    jax.block_until_ready(out)          # compile
-    # The remote-TPU tunnel injects multi-x transport stalls (observed:
-    # a single 47 ms RPC stall in an otherwise 0.1 ms/iter pass) that are
-    # not chip throughput.  Three estimators, all reported:
-    #   sync best pass   — classic wall-clock over blocking iters;
-    #   min-latency      — BATCH / fastest single iteration (one clean
-    #                      round trip; still *includes* one tunnel RTT,
-    #                      so it underestimates the chip);
-    #   pipelined        — enqueue 50 independent steps, block once at
-    #                      the end: async dispatch overlaps transport
-    #                      with execution the way a real deployment runs.
-    # The headline value is the pipelined estimator (the one sustained
-    # measurement; the others are printed for methodology); p99 is
-    # reported for the best sync pass (chip tail) and pooled over every
-    # sample (stalls included) so the filtering is visible, not hidden.
-    iters = 20
-    best_sync, best_p99 = 0.0, float("inf")
-    min_lat = float("inf")
-    all_lat = []
-    for _ in range(5):
+    # FETCH-VERIFIED timing (round-5 methodology — see _checksum): the
+    # r2-r4 "sync/pipelined" loops measured dispatch acknowledgment,
+    # not execution, because block_until_ready does not wait on this
+    # tunnel.  Every sample below includes a forced 4-byte result
+    # fetch; the scalar-fetch floor is measured and subtracted.
+    g = _checksum(step)
+    _ = np.asarray(g(*args))            # compile + prime
+    floor = _fetch_floor()
+    lat = []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        _ = np.asarray(g(*args))
+        lat.append(time.perf_counter() - t0)
+        if time.monotonic() > deadline and len(lat) >= 3:
+            break
+    per_launch = max(float(np.median(lat)) - floor, 1e-9)
+    # sustained: enqueue k launches, fetch only the LAST checksum —
+    # the device executes in order, so the final scalar proves all k
+    # completed; this is the deployment overlap shape, now honest
+    k = 3 if per_launch > 0.3 else 25
+    sustained = []
+    for _ in range(2):
+        t0 = time.perf_counter()
+        s = None
+        for _i in range(k):
+            s = g(*args)
+        _ = np.asarray(s)
+        sustained.append(k * BATCH / max(
+            time.perf_counter() - t0 - floor, 1e-9))
         if time.monotonic() > deadline:
             break
-        lat = []
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            t1 = time.perf_counter()
-            out = step(*args)
-            jax.block_until_ready(out)
-            lat.append(time.perf_counter() - t1)
-            if time.monotonic() > deadline:
-                break
-        dt = time.perf_counter() - t0
-        all_lat.extend(lat)
-        min_lat = min(min_lat, min(lat))
-        pps = BATCH * len(lat) / dt
-        p99_ms = float(np.percentile(np.asarray(lat), 99) * 1e3)
-        if pps > best_sync:
-            best_sync, best_p99 = pps, p99_ms
-    best_pipelined = 0.0
-    for _ in range(3):
-        if time.monotonic() > deadline and best_pipelined:
-            break
-        t0 = time.perf_counter()
-        for _ in range(50):
-            out = step(*args)
-        jax.block_until_ready(out)
-        best_pipelined = max(best_pipelined,
-                             BATCH * 50 / (time.perf_counter() - t0))
-        # Headline the pipelined estimator: a genuinely sustained
-        # measurement (50 launches in flight), where min_latency
-        # extrapolates one best-case round trip and sync pays a full
-        # drain per launch.  Banked per pass: a later stall must not
-        # cost the already-measured headline.
-        RESULT["value"] = round(best_pipelined, 1)
-    estimators = {"sync_best_pass": best_sync, "pipelined": best_pipelined}
-    if np.isfinite(min_lat):
-        estimators["min_latency"] = BATCH / min_lat
-    if np.isfinite(best_p99):
-        EXTRA["p99_batch_ms"] = round(best_p99, 3)
-    if all_lat:
-        EXTRA["p99_ms_pooled_all_passes"] = round(
-            float(np.percentile(np.asarray(all_lat), 99) * 1e3), 3)
-    EXTRA["estimators_pps"] = {k: round(v, 1) for k, v in estimators.items()}
+    # Per-packet HBM traffic model for one protect launch: data in+out
+    # (2W) + round-key gather (11*16) + midstates (2*5*4) + iv (16) +
+    # roc/len/off/stream (4 each).  With honest timing the measured
+    # rate sits far BELOW this ceiling; the cap is a sanity backstop.
+    bytes_per_pkt = 2 * WIDTH + 11 * 16 + 2 * 5 * 4 + 16 + 4 * 4
+    traffic = (f"2*{WIDTH} data + 176 rk + 40 mid + 16 iv + 16 scalars"
+               f" per packet")
+    med_sustained = float(np.median(sustained)) if sustained else \
+        BATCH / per_launch
+    RESULT["value"] = round(
+        _roofline("headline", med_sustained, bytes_per_pkt, traffic), 1)
+    _roofline("sync_per_launch", BATCH / per_launch, bytes_per_pkt,
+              traffic)
+    EXTRA["p99_batch_ms"] = round(
+        (float(np.percentile(np.asarray(lat), 99)) - floor) * 1e3, 3)
+    EXTRA["on_device_launch_ms"] = round(per_launch * 1e3, 3)
+    EXTRA["estimators_pps"] = {
+        "sync_fetch_verified": round(BATCH / per_launch, 1),
+        "sustained_median": round(med_sustained, 1),
+        "sustained_passes": [round(v, 1) for v in sustained],
+        "aes_core_in_use": _aes_core_name()}
 
 
 def cpu_pps(deadline: float) -> None:
@@ -306,35 +425,22 @@ def cpu_pps(deadline: float) -> None:
     EXTRA["cpu_openssl_pps"] = round(done / (time.perf_counter() - t0), 1)
 
 
-def _time_fn(fn, args, deadline: float, iters: int = 8) -> float:
-    """Best per-iteration time across sync passes, single iterations and
-    a pipelined pass (see tpu_pps: tunnel stalls are not chip
-    throughput).  Deadline-aware: stops adding passes once the box is
-    spent (the first completed pass already yields a number)."""
-    import jax
-
-    out = fn(*args)
-    jax.block_until_ready(out)
-    best = float("inf")
-    for _ in range(3):
+def _time_fn(fn, args, deadline: float, iters: int = 4) -> float:
+    """Median FETCH-VERIFIED per-launch time, scalar-fetch floor
+    subtracted (round-5 methodology — block_until_ready does not wait
+    on this tunnel; see _checksum).  Deadline-aware: stops sampling
+    once the box is spent (the first sample already yields a number)."""
+    g = _checksum(fn)
+    _ = np.asarray(g(*args))            # compile + prime
+    floor = _fetch_floor()
+    samples = []
+    for _ in range(iters):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            t1 = time.perf_counter()
-            out = fn(*args)
-            jax.block_until_ready(out)
-            best = min(best, time.perf_counter() - t1)
-        best = min(best, (time.perf_counter() - t0) / iters)
-        if time.monotonic() > deadline:
-            return best
-    for _ in range(2):
-        t0 = time.perf_counter()
-        for _ in range(3 * iters):
-            out = fn(*args)
-        jax.block_until_ready(out)
-        best = min(best, (time.perf_counter() - t0) / (3 * iters))
-        if time.monotonic() > deadline:
+        _ = np.asarray(g(*args))
+        samples.append(time.perf_counter() - t0)
+        if time.monotonic() > deadline and samples:
             break
-    return best
+    return max(float(np.median(samples)) - floor, 1e-9)
 
 
 def aes_core_blocks_per_sec(deadline: float, b: int = 65536) -> None:
@@ -351,7 +457,8 @@ def aes_core_blocks_per_sec(deadline: float, b: int = 65536) -> None:
     from libjitsi_tpu.kernels.aes import aes_encrypt_table, \
         expand_keys_batch
     from libjitsi_tpu.kernels.aes_bitsliced import (
-        aes_encrypt_bitsliced, aes_encrypt_pallas_bitsliced)
+        aes_encrypt_bitsliced, aes_encrypt_bitsliced32,
+        aes_encrypt_pallas_bitsliced)
 
     rng = np.random.default_rng(21)
     rks = expand_keys_batch(rng.integers(0, 256, (b, 16), dtype=np.uint8))
@@ -362,25 +469,41 @@ def aes_core_blocks_per_sec(deadline: float, b: int = 65536) -> None:
     table = jax.jit(aes_encrypt_table)
     for name, fn in (("xla_table", table),
                      ("xla_bitsliced", aes_encrypt_bitsliced),
+                     ("xla_bitsliced32", aes_encrypt_bitsliced32),
                      ("pallas_bitsliced", aes_encrypt_pallas_bitsliced)):
         if time.monotonic() > deadline:
             out[name] = "skipped: budget"
             continue
         try:
-            o = fn(rksd, blkd)
-            jax.block_until_ready(o)
-            best = 0.0
-            for _ in range(3):
-                t0 = time.perf_counter()
-                for _ in range(30):
-                    o = fn(rksd, blkd)
-                jax.block_until_ready(o)
-                best = max(best, b * 30 / (time.perf_counter() - t0))
-                if time.monotonic() > deadline:
-                    break
-            out[name] = round(best, 1)
+            dt = _time_fn(fn, (rksd, blkd), deadline, iters=4)
+            # 176B round keys + 16B in + 16B out per block
+            out[name] = round(_roofline(f"aes_{name}", b / dt, 208,
+                                        "176 rk + 16 in + 16 out"), 1)
         except Exception as e:   # Mosaic lowering refusal, recorded
             out[name] = f"error: {type(e).__name__}"
+    _aes_consistency_check(out)
+
+
+def _aes_consistency_check(core: dict) -> None:
+    """Cross-estimator sanity (VERDICT r4 #1c): a 172B packet needs ~10
+    AES keystream blocks, so headline_pps * 10 cannot exceed the
+    (roofline-capped) standalone core rate by more than measurement
+    slack.  The r04 record failed exactly this check (implied 40B
+    blocks/s vs a 4.3B core); now it caps the headline instead of
+    shipping an impossible number."""
+    rates = [v for v in core.values() if isinstance(v, (int, float))]
+    if not rates or not RESULT["value"]:
+        return
+    blocks_per_pkt = -(-(PKT_LEN - 12) // 16)
+    allowed = max(rates) / blocks_per_pkt * 1.5
+    rec = {"blocks_per_pkt": blocks_per_pkt,
+           "core_rate_capped": round(max(rates), 1),
+           "allowed_headline_pps": round(allowed, 1), "ok": True}
+    if RESULT["value"] > allowed:
+        rec["ok"] = False
+        rec["headline_before_cap"] = RESULT["value"]
+        RESULT["value"] = round(allowed, 1)
+    EXTRA["consistency_vs_aes_core"] = rec
 
 
 def gcm_sweep(deadline: float) -> None:
@@ -407,7 +530,7 @@ def gcm_sweep(deadline: float) -> None:
     EXTRA["gcm_pps_grouped_by_batch"] = grouped
     EXTRA["gcm_pps_per_row_by_batch"] = per_row
 
-    for b in (4096, 16384, 65536):
+    for b in (16384, 65536):
         if time.monotonic() > deadline:
             grouped[str(b)] = "skipped: budget"
             continue
@@ -424,10 +547,15 @@ def gcm_sweep(deadline: float) -> None:
         args = [jnp.asarray(x) for x in (data, length, aad, rks, gms_g, iv,
                                          grid, inv)]
         dt = _time_fn(_ft.partial(G.gcm_protect_grouped, aad_const=12),
-                      args, deadline, iters=5)
-        grouped[str(b)] = round(b / dt, 1)
+                      args, deadline, iters=2)
+        # per pkt: 2W data + 176 rk + 12 iv + one 16KiB GHASH matrix
+        # per GROUP amortized over its rows
+        bpp = 2 * WIDTH + 176 + 12 + 16384 * grid.shape[0] / b
+        grouped[str(b)] = round(
+            _roofline(f"gcm_grouped_{b}", b / dt, bpp,
+                      "2W+rk+iv+gmat/group"), 1)
 
-    for b in (4096, 16384, 32768):
+    for b in (16384, 32768):
         if time.monotonic() > deadline:
             per_row[str(b)] = "skipped: budget"
             continue
@@ -438,14 +566,19 @@ def gcm_sweep(deadline: float) -> None:
         aad = np.full(b, 12, np.int32)
         iv = rng.integers(0, 256, (b, 12), dtype=np.uint8)
         args = [jnp.asarray(x) for x in (data, length, aad, rks, gms, iv)]
-        dt = _time_fn(G.gcm_protect, args, deadline, iters=5)
-        per_row[str(b)] = round(b / dt, 1)
+        dt = _time_fn(G.gcm_protect, args, deadline, iters=2)
+        per_row[str(b)] = round(
+            _roofline(f"gcm_per_row_{b}", b / dt,
+                      2 * WIDTH + 176 + 12 + 16384,
+                      "2W+rk+iv+16KiB gmat/row"), 1)
 
     # continuity keys (same configs as BENCH_r02/r03)
     if isinstance(grouped.get("65536"), (int, float)):
         EXTRA["gcm_pps"] = grouped["65536"]
     if isinstance(per_row.get("32768"), (int, float)):
         EXTRA["gcm_pps_per_row"] = per_row["32768"]
+    elif isinstance(per_row.get("16384"), (int, float)):
+        EXTRA["gcm_pps_per_row"] = per_row["16384"]
 
 
 def gcm_fanout(deadline: float, packets: int = 128, receivers: int = 512
@@ -464,8 +597,13 @@ def gcm_fanout(deadline: float, packets: int = 128, receivers: int = 512
     length = np.full(packets, PKT_LEN, np.int32)
     iv = rng.integers(0, 256, (receivers, packets, 12), dtype=np.uint8)
     args = [jnp.asarray(x) for x in (data, length, rks, gms, iv)]
-    dt = _time_fn(G.gcm_protect_fanout, args, deadline, iters=5)
-    EXTRA["gcm_fanout_rows_per_sec"] = round(packets * receivers / dt, 1)
+    dt = _time_fn(G.gcm_protect_fanout, args, deadline, iters=2)
+    rows = packets * receivers
+    # per out row: W write + W/G read + gmat/packets + rk/packets + iv
+    bpp = WIDTH + WIDTH / receivers + (16384 + 176) / packets + 12
+    EXTRA["gcm_fanout_rows_per_sec"] = round(
+        _roofline("gcm_fanout", rows / dt, bpp,
+                  "W out + amortized in/gmat/rk + iv"), 1)
 
 
 def mixer(deadline: float, n_participants: int = 256) -> None:
@@ -529,8 +667,11 @@ def fanout(deadline: float, packets: int = 128, receivers: int = 512
 
     args = [jnp.asarray(x) for x in
             (tab_rk, tab_mid, recv, data, length, off, iv, roc)]
-    dt = _time_fn(step, args, deadline)
-    EXTRA["sfu_fanout_rows_per_sec"] = round(rows / dt, 1)
+    dt = _time_fn(step, args, deadline, iters=2)
+    EXTRA["sfu_fanout_rows_per_sec"] = round(
+        _roofline("sfu_fanout", rows / dt,
+                  2 * WIDTH + 176 + 40 + 16 + 16,
+                  "2W data + rk + mid + iv + scalars"), 1)
 
 
 _TABLES: dict = {}
@@ -586,9 +727,9 @@ def _probe_child(n_streams: int = N_STREAMS) -> None:
     the shared helper and prints ONE json line of results on stdout."""
     from libjitsi_tpu.rtp import header as rtp_header
 
-    # self-bound under the parent's 150s kill cap: past it, stop
+    # self-bound under the parent's kill cap: past it, stop
     # measuring and print what exists (a killed child prints nothing)
-    deadline = time.monotonic() + 110
+    deadline = time.monotonic() + 70
     tx, rx, _ = _production_tables(n_streams)
     # single packet size on purpose: ONE size class = one compile pair
     rng = np.random.default_rng(77)
@@ -627,7 +768,8 @@ def _probe_child(n_streams: int = N_STREAMS) -> None:
 _CHILD = None     # live section subprocess; killed by _on_term/_watchdog
 
 
-def _run_in_child(fn_name: str, deadline: float, cap_s: float) -> None:
+def _run_in_child(fn_name: str, deadline: float, cap_s: float,
+                  env: "dict | None" = None) -> None:
     """Run a bench section in a SUBPROCESS with its own timeout and
     merge its one-line JSON stdout into EXTRA.
 
@@ -647,10 +789,14 @@ def _run_in_child(fn_name: str, deadline: float, cap_s: float) -> None:
     import sys
 
     budget = max(min(deadline - time.monotonic(), cap_s), 30)
+    child_env = None
+    if env:
+        child_env = dict(os.environ)
+        child_env.update(env)
     p = subprocess.Popen(
         [sys.executable, "-c", f"import bench; bench.{fn_name}()"],
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-        cwd=os.path.dirname(os.path.abspath(__file__)))
+        cwd=os.path.dirname(os.path.abspath(__file__)), env=child_env)
     _CHILD = p
     timed_out = False
     try:
@@ -663,11 +809,17 @@ def _run_in_child(fn_name: str, deadline: float, cap_s: float) -> None:
         _CHILD = None
     lines = [l for l in (out or "").splitlines() if l.strip()]
     payload = None
-    if lines:
+    # newest parseable line wins: a timeout-kill can clip the child's
+    # FINAL print mid-line, and discarding the earlier complete partial
+    # would lose already-measured numbers
+    for line in reversed(lines):
         try:
-            payload = json.loads(lines[-1])
+            cand = json.loads(line)
         except ValueError:
-            payload = None
+            continue
+        if isinstance(cand, dict):   # stray scalar/list prints are not
+            payload = cand           # results; keep scanning upward
+            break
     if payload is not None:
         EXTRA.update(payload)
         if timed_out or p.returncode != 0:
@@ -686,7 +838,7 @@ def table_roundtrip_probe(deadline: float) -> None:
     trip p99 at batch 512 over 10k installed streams, full host control
     plane per call; tunnel-caveated but measured.  Subprocess-isolated
     (see _run_in_child)."""
-    _run_in_child("_probe_child", deadline, 150)
+    _run_in_child("_probe_child", deadline, 85)
 
 
 def table_path(deadline: float) -> None:
@@ -705,7 +857,7 @@ def table_path(deadline: float) -> None:
     are reported alongside to keep the decomposition visible.  On local
     PCIe the same transfers are <1 ms.
     """
-    _run_in_child("_table_child", deadline, 180)
+    _run_in_child("_table_child", deadline, 70)
 
 
 def _table_child(n_streams: int = N_STREAMS, batch: int = 4096,
@@ -717,7 +869,7 @@ def _table_child(n_streams: int = N_STREAMS, batch: int = 4096,
     from libjitsi_tpu.core.rtp_math import chain_packet_indices
     from libjitsi_tpu.rtp import header as rtp_header
 
-    deadline = time.monotonic() + 140
+    deadline = time.monotonic() + 55
     out: dict = {}
     tx, rx, make_batches = _production_tables(n_streams)
     batches = make_batches(n_batches, 2000, batch)
@@ -809,6 +961,159 @@ def _table_child(n_streams: int = N_STREAMS, batch: int = 4096,
     print(json.dumps(out), flush=True)
 
 
+def mesh_plan(deadline: float, b: int = BATCH, n_dev: int = 8) -> None:
+    """Host routing plane of the sharded table (VERDICT r4 #3/#6): one
+    vectorized `_OwnerPlan` + chip-local row map + grouped-GCM grid
+    build at the headline batch size over 8 devices.  Pure host cost —
+    this is the per-batch overhead mesh mode adds BEFORE any device
+    work, the thing the r4 Python-loop plan left unmeasured."""
+    from libjitsi_tpu.mesh.table import (_OwnerPlan, local_rows,
+                                         mesh_gcm_grid)
+
+    rng = np.random.default_rng(31)
+    ids = rng.integers(0, N_STREAMS, b).astype(np.int64)
+    rows_per = N_STREAMS // n_dev
+    t_plan = t_grid = float("inf")
+    for _ in range(5):
+        t0 = time.perf_counter()
+        plan = _OwnerPlan(ids, N_STREAMS, rows_per, n_dev)
+        local = local_rows(plan, ids, N_STREAMS, rows_per, n_dev)
+        t1 = time.perf_counter()
+        mesh_gcm_grid(local)
+        t2 = time.perf_counter()
+        t_plan = min(t_plan, t1 - t0)
+        t_grid = min(t_grid, t2 - t1)
+        if time.monotonic() > deadline:
+            break
+    EXTRA["mesh_plan_ms"] = {
+        "batch": b, "n_dev": n_dev,
+        "owner_plan_ms": round(t_plan * 1e3, 3),
+        "gcm_grid_ms": round(t_grid * 1e3, 3),
+        "plan_pps_ceiling": round(b / t_plan, 1)}
+
+
+def mesh_seam(deadline: float) -> None:
+    """Sharded-table seam overhead on the REAL chip (VERDICT r4 #3):
+    `ShardedSrtpTable` on a ONE-device mesh vs the plain table — same
+    host control plane, same chip; the delta is the owner-plan /
+    shard_map / deferred-scatter seam.  Subprocess-isolated (fresh
+    shard_map compiles have stalled the tunnel before)."""
+    _run_in_child("_mesh_seam_child", deadline, 60)
+
+
+def _mesh_seam_child(n_streams: int = N_STREAMS, batch: int = 4096,
+                     iters: int = 3) -> None:
+    deadline = time.monotonic() + 45
+    import jax
+    from jax.sharding import Mesh
+
+    from libjitsi_tpu.mesh import ShardedSrtpTable
+    from libjitsi_tpu.rtp import header as rtp_header
+    from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+    rng = np.random.default_rng(9)
+    mks = rng.integers(0, 256, (n_streams, 16), dtype=np.uint8)
+    mss = rng.integers(0, 256, (n_streams, 14), dtype=np.uint8)
+    mesh1 = Mesh(np.array(jax.devices()[:1]), ("streams",))
+    out: dict = {}
+
+    def drive(table, key):
+        lat = []
+        for k in range(iters):
+            streams = rng.permutation(n_streams)[:batch]
+            b = rtp_header.build(
+                [b"\xcd" * 160] * batch,
+                [4000 + iters * int(key == "mesh1") + k] * batch,
+                [k * 960] * batch, (0x30000 + streams).tolist(),
+                [96] * batch, stream=streams.tolist())
+            t0 = time.perf_counter()
+            w = table.protect_rtp(b)
+            lat.append(time.perf_counter() - t0)
+            if time.monotonic() > deadline and len(lat) >= 2:
+                break
+        warm = lat[max(len(lat) // 3, 1):] or lat
+        out[f"mesh_seam_{key}_ms"] = round(
+            float(np.median(warm)) * 1e3, 3)
+
+    plain = SrtpStreamTable(capacity=n_streams)
+    plain.add_streams(np.arange(n_streams), mks, mss)
+    drive(plain, "plain")
+    print(json.dumps(out), flush=True)      # cumulative partial
+    sh = ShardedSrtpTable(n_streams, mesh1)
+    sh.add_streams(np.arange(n_streams), mks, mss)
+    drive(sh, "mesh1")
+    if out.get("mesh_seam_plain_ms"):
+        out["mesh_seam_overhead_ratio"] = round(
+            out["mesh_seam_mesh1_ms"] / out["mesh_seam_plain_ms"], 3)
+    print(json.dumps(out), flush=True)
+
+
+def mesh_cpu8(deadline: float) -> None:
+    """The sharded product path END-TO-END on the virtual 8-device CPU
+    mesh (the same geometry the driver's dryrun validates): sharded vs
+    plain `protect_rtp` per-batch time.  CPU numbers — the point is the
+    host-plane share and the seam scaling at 8 devices, not chip
+    throughput."""
+    _run_in_child("_mesh_cpu8_child", deadline, 55, env={
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                      " --xla_force_host_platform_device_count=8"
+                      ).strip()})
+
+
+def _mesh_cpu8_child(n_streams: int = N_STREAMS, batch: int = 1024,
+                     iters: int = 2) -> None:
+    # batch sized for the CPU backend's exec floor (~7 ms/packet-KB on
+    # this box): the section's value is the 8-device seam RATIO, not
+    # absolute CPU throughput — and batch must stay <= n_streams for
+    # the permutation below.  Self-bound sits UNDER the parent's 55s
+    # kill cap so the final print always happens.
+    deadline = time.monotonic() + 40
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from libjitsi_tpu.mesh import ShardedSrtpTable, make_media_mesh
+    from libjitsi_tpu.rtp import header as rtp_header
+    from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+    rng = np.random.default_rng(10)
+    mks = rng.integers(0, 256, (n_streams, 16), dtype=np.uint8)
+    mss = rng.integers(0, 256, (n_streams, 14), dtype=np.uint8)
+    mesh = make_media_mesh()
+    out: dict = {"mesh_cpu8_batch": batch,
+                 "mesh_cpu8_n_dev": int(mesh.devices.size)}
+
+    def drive(table, key):
+        lat = []
+        for k in range(iters):
+            streams = rng.permutation(n_streams)[:batch]
+            b = rtp_header.build(
+                [b"\xef" * 160] * batch,
+                [6000 + iters * int(key == "mesh8") + k] * batch,
+                [k * 960] * batch, (0x40000 + streams).tolist(),
+                [96] * batch, stream=streams.tolist())
+            t0 = time.perf_counter()
+            table.protect_rtp(b)
+            lat.append(time.perf_counter() - t0)
+            if time.monotonic() > deadline and len(lat) >= 2:
+                break
+        warm = lat[max(len(lat) // 3, 1):] or lat
+        out[f"mesh_cpu8_{key}_ms"] = round(
+            float(np.median(warm)) * 1e3, 3)
+
+    plain = SrtpStreamTable(capacity=n_streams)
+    plain.add_streams(np.arange(n_streams), mks, mss)
+    drive(plain, "plain")
+    print(json.dumps(out), flush=True)      # cumulative partial
+    sh = ShardedSrtpTable(n_streams, mesh)
+    sh.add_streams(np.arange(n_streams), mks, mss)
+    drive(sh, "mesh8")
+    if out.get("mesh_cpu8_plain_ms") and out.get("mesh_cpu8_mesh8_ms"):
+        out["mesh_cpu8_ratio_vs_plain"] = round(
+            out["mesh_cpu8_mesh8_ms"] / out["mesh_cpu8_plain_ms"], 3)
+    print(json.dumps(out), flush=True)
+
+
 def dense_tick(deadline: float, n_streams: int = 10_240) -> None:
     """Host cost of one decode-path tick at 10k streams: dense jitter
     insert+pop plus the batched GCC feed — the plane that used to be
@@ -877,14 +1182,14 @@ def loop_rtt(deadline: float) -> None:
     """End-to-end MediaLoop tick over REAL loopback UDP (SURVEY
     §3.2/§3.4's socket→chain→socket hot loop).  Subprocess-isolated
     (see _run_in_child)."""
-    _run_in_child("_loop_rtt_child", deadline, 120)
+    _run_in_child("_loop_rtt_child", deadline, 60)
 
 
 def loop_pipelined_gain(deadline: float) -> None:
     """SURVEY §7 step 4's dispatch/flush overlap seam, sync vs
     pipelined MediaLoop on the same echo workload.  Subprocess-isolated
     (see _run_in_child)."""
-    _run_in_child("_loop_gain_child", deadline, 150)
+    _run_in_child("_loop_gain_child", deadline, 70)
 
 
 def _loop_rtt_child(n_pkts: int = 256, cycles: int = 12) -> None:
@@ -899,7 +1204,7 @@ def _loop_rtt_child(n_pkts: int = 256, cycles: int = 12) -> None:
     """
     # self-bound comfortably inside the parent's kill cap: a killed
     # child prints nothing, a self-bounded one prints what it measured
-    deadline = time.monotonic() + 90
+    deadline = time.monotonic() + 45
     import libjitsi_tpu
     from libjitsi_tpu.io import UdpEngine
     from libjitsi_tpu.io.loop import MediaLoop
@@ -972,7 +1277,7 @@ def _loop_gain_child(n_pkts: int = 512, cycles: int = 12) -> None:
     serializing with it.  Same echo workload both ways."""
     # self-bound comfortably inside the parent's kill cap (see
     # _loop_rtt_child); one sync+pipelined pair is the minimum result
-    deadline = time.monotonic() + 110
+    deadline = time.monotonic() + 55
     import libjitsi_tpu
     from libjitsi_tpu.io import UdpEngine
     from libjitsi_tpu.io.loop import MediaLoop
@@ -1055,21 +1360,27 @@ def main():
         # several minutes of heavy sections), so the latency-sensitive
         # device microbenches run first and the host/production-path
         # sections (tunnel-floored anyway) run last.
-        section("tpu_pps", 20, 120, tpu_pps)
+        section("tpu_pps", 20, 200, tpu_pps)
         section("cpu_pps", 3, 20, cpu_pps)
         section("dense_tick", 3, 25, dense_tick)
-        section("aes_cores", 20, 150, aes_core_blocks_per_sec)
-        section("gcm_sweep", 25, 100, gcm_sweep)
-        section("table_roundtrip_probe", 30, 150, table_roundtrip_probe)
-        section("gcm_fanout", 10, 35, gcm_fanout)
-        section("fanout", 10, 35, fanout)
-        section("mixer", 8, 25, mixer)
-        section("bridge_mixes", 8, 25, bridge_mixes)
-        section("table_path", 40, 200, table_path)
-        # boxes exceed the children's self-bounds (90s/110s + startup):
+        section("mesh_plan", 2, 15, mesh_plan)
+        # quick device sections before the compile-heavy sweeps so a
+        # cold-cache run still records them (fetch-verified sampling
+        # made every section ~10x pricier; warm cache covers the rest)
+        section("mixer", 6, 20, mixer)
+        section("bridge_mixes", 6, 20, bridge_mixes)
+        section("fanout", 8, 30, fanout)
+        section("gcm_fanout", 8, 30, gcm_fanout)
+        section("aes_cores", 15, 90, aes_core_blocks_per_sec)
+        section("table_roundtrip_probe", 25, 90, table_roundtrip_probe)
+        section("gcm_sweep", 25, 90, gcm_sweep)
+        section("table_path", 25, 75, table_path)
+        section("mesh_seam", 20, 65, mesh_seam)
+        section("mesh_cpu8", 20, 60, mesh_cpu8)
+        # boxes exceed the children's self-bounds (60s/80s + startup):
         # a child must always outlive its own deadline to print
-        section("loop_rtt", 30, 130, loop_rtt)
-        section("loop_pipelined_gain", 40, 160, loop_pipelined_gain)
+        section("loop_rtt", 20, 65, loop_rtt)
+        section("loop_pipelined_gain", 25, 75, loop_pipelined_gain)
     finally:
         emit()
 

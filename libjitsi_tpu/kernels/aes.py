@@ -221,43 +221,66 @@ def aes_encrypt_table(round_keys, blocks):
 
 
 # Selectable encrypt core (the reference's `.srtp.crypto.Aes`
-# benchmark-and-pick idea at the kernel level): "table" (S-box gather,
-# the long-time default) or "bitsliced" (gather-free Boolean circuit,
-# kernels/aes_bitsliced.py — measured ~1.3x the table core's sustained
-# block rate on v5e).  The choice is read at TRACE time, so switch it
-# before the first jit of the consuming kernels (env
-# LIBJITSI_TPU_AES_CORE or set_core(); set_core clears jax caches so
-# later compiles pick the new core).
+# benchmark-and-pick idea at the kernel level): "table" (S-box gather)
+# or "bitsliced" (gather-free Boolean circuit,
+# kernels/aes_bitsliced.py).  Round-5 fetch-verified measurement on the
+# real v5e chip (prior rounds' timings were tunnel artifacts — see
+# BASELINE.md): bitsliced runs the 720k-block keystream load 8.6x
+# faster than the table core (~6.7M vs ~0.78M blocks/s), because the
+# per-byte S-box gathers that a CPU loves are the worst case for the
+# TPU's vector unit, while the Boolean circuit is pure lane-parallel
+# bit math.  Default: bitsliced on TPU backends, table on CPU (where
+# XLA:CPU's gather is cheap and the CPU test suite compiles the table
+# core fastest).  The choice is read at TRACE time, so switch before
+# the first jit of the consuming kernels (env LIBJITSI_TPU_AES_CORE or
+# set_core(); set_core clears jax caches so later compiles re-pick).
 import os as _os
 
-_CORE_NAME = _os.environ.get("LIBJITSI_TPU_AES_CORE", "table")
-if _CORE_NAME not in ("table", "bitsliced"):
+_CORES = ("table", "bitsliced", "bitsliced32")
+_CORE_NAME = _os.environ.get("LIBJITSI_TPU_AES_CORE")  # None = by backend
+if _CORE_NAME not in (None,) + _CORES:
     raise ValueError(
-        f"LIBJITSI_TPU_AES_CORE={_CORE_NAME!r}: must be 'table' or "
-        "'bitsliced' (a typo would otherwise silently run the default)")
+        f"LIBJITSI_TPU_AES_CORE={_CORE_NAME!r}: must be one of {_CORES} "
+        "(a typo would otherwise silently run the default)")
 
 
 def set_core(name: str) -> None:
     global _CORE_NAME
-    if name not in ("table", "bitsliced"):
-        raise ValueError("aes core must be 'table' or 'bitsliced'")
+    if name not in _CORES:
+        raise ValueError(f"aes core must be one of {_CORES}")
     if name != _CORE_NAME:
         _CORE_NAME = name
         jax.clear_caches()
 
 
 def get_core() -> str:
+    global _CORE_NAME
+    if _CORE_NAME is None:
+        # resolved lazily so importing this module never forces a
+        # backend init (conftest flips platforms before first use).
+        # TPU default: the bitsliced circuit — fetch-verified 8-37x the
+        # table core on v5e (the packed-word bitsliced32 measured at
+        # parity there, kept as a selectable provider for other chips);
+        # CPU keeps the table core.
+        _CORE_NAME = ("table" if jax.default_backend() == "cpu"
+                      else "bitsliced")
     return _CORE_NAME
 
 
 def aes_encrypt(round_keys, blocks):
     """Batched AES block encrypt via the selected core ([..., R, 16]
     keys, [..., 16] blocks; see `set_core`)."""
-    if _CORE_NAME == "bitsliced":
+    core = get_core()
+    if core == "bitsliced":
         from libjitsi_tpu.kernels.aes_bitsliced import \
             aes_encrypt_bitsliced_nd
 
         return aes_encrypt_bitsliced_nd(round_keys, blocks)
+    if core == "bitsliced32":
+        from libjitsi_tpu.kernels.aes_bitsliced import \
+            aes_encrypt_bitsliced32_nd
+
+        return aes_encrypt_bitsliced32_nd(round_keys, blocks)
     return aes_encrypt_table(round_keys, blocks)
 
 

@@ -86,13 +86,32 @@ def _signature(args) -> Tuple:
     return tuple(sig)
 
 
+def _force(out) -> None:
+    """Fetch-verified completion: on the axon tunnel
+    `jax.block_until_ready` returns before fresh launches execute
+    (round-5 finding, BASELINE.md), so provider timing must fetch
+    bytes.  One leaf suffices — competing providers return identical
+    shapes, so the (equal) transfer cost cancels in the comparison;
+    mesh `_LazyArray` leaves materialize through the same call."""
+    import numpy as _np
+
+    for leaf in jax.tree_util.tree_leaves(out):
+        if hasattr(leaf, "__array__"):
+            _np.asarray(leaf)
+            return
+    jax.block_until_ready(out)
+
+
 def _time_once(fn: Callable, args) -> Tuple[float, Any]:
     out = fn(*args)
-    jax.block_until_ready(out)          # compile + warm
+    _force(out)                         # compile + warm
     t0 = time.perf_counter()
     for _ in range(_BENCH_ITERS):
         out = fn(*args)
-    jax.block_until_ready(out)
+    # one fetch at the end: the device queue executes in order, so the
+    # last result's bytes prove all iterations completed — 5 executions
+    # amortize the single forced transfer
+    _force(out)
     return (time.perf_counter() - t0) / _BENCH_ITERS, out
 
 
@@ -141,7 +160,7 @@ def warmup(op: str, *args) -> str:
     o = _OPS[op]
     forced = _forced_provider(o)
     if forced is not None:
-        jax.block_until_ready(o.providers[forced](*args))
+        _force(o.providers[forced](*args))
         return forced
     sig = _signature(args)
     chosen = o.choice.get(sig)

@@ -141,6 +141,110 @@ def smoke_pallas_aes(platform: str) -> None:
           f"{platform}")
 
 
+def smoke_on_device_latency(platform: str, n_streams: int = 10_240
+                            ) -> None:
+    """ON-DEVICE time of the assembled table program (VERDICT r4 #5:
+    every host-side timing on this box embeds a ~100-500 ms tunnel
+    round trip, so the '<2 ms p99 added transform latency' north star
+    had no real-hardware measurement of the assembled path).
+
+    Method — DIFFERENTIAL chaining.  A first attempt chained launches
+    (output feeding the next input) and amortized one tunnel RTT over
+    the chain; the measured per-step time scaled LINEARLY with batch
+    bytes (~20 us/packet ~= 632 B/packet at ~32 MB/s), proving this
+    tunnel materializes every step's results back to the host and
+    re-ships the arguments — a chain step pays a full data round trip,
+    so chaining alone measures tunnel bandwidth, not the chip.  The
+    differential fix: time the SAME chain through a NULL program that
+    takes the identical argument list and only XORs the data (same
+    bytes moved per step, negligible compute), and subtract.  The
+    delta is the on-device crypto time per protect+unprotect round
+    trip, with both tunnel RTT and tunnel byte-motion cancelled.
+    chain x trials >= 100 sampled executions at batch 512.
+
+    Budgeted: a fresh 65536-row compile on a degraded tunnel has been
+    observed to stall for minutes, so each batch size only starts while
+    `LIBJITSI_TPU_SMOKE_LATENCY_BUDGET_S` (default 360 s) has room —
+    a partial record beats a smoke that never returns.
+    """
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from libjitsi_tpu.transform.srtp import kernel
+
+    budget = float(os.environ.get("LIBJITSI_TPU_SMOKE_LATENCY_BUDGET_S",
+                                  "360"))
+    t_start = time.monotonic()
+
+    rng = np.random.default_rng(17)
+    tab_rk = jnp.asarray(rng.integers(0, 256, (n_streams, 11, 16),
+                                      dtype=np.uint8))
+    tab_mid = jnp.asarray(rng.integers(0, 2**32, (n_streams, 2, 5),
+                                       dtype=np.uint64).astype(np.uint32))
+
+    @jax.jit
+    def rt(tab_rk, tab_mid, stream, data, length, off, iv, roc):
+        w, wl = kernel.srtp_protect(data, length, off, tab_rk[stream],
+                                    iv, tab_mid[stream], roc, 10, True,
+                                    payload_off_const=12)
+        d, _, _ = kernel.srtp_unprotect(w, wl, off, tab_rk[stream], iv,
+                                        tab_mid[stream], roc, 10, True,
+                                        payload_off_const=12)
+        return d
+
+    @jax.jit
+    def null(tab_rk, tab_mid, stream, data, length, off, iv, roc):
+        # identical argument list and output shape: the tunnel moves
+        # the same bytes per step, the device does ~no work
+        return data ^ jnp.uint8(1)
+
+    def run_chain(fn, args, chain):
+        d = args[3]
+        t0 = time.perf_counter()
+        for _ in range(chain):
+            d = fn(args[0], args[1], args[2], d, *args[4:])
+        jax.block_until_ready(d)
+        return (time.perf_counter() - t0) / chain
+
+    for batch, chain, trials in ((512, 40, 3), (65536, 8, 3)):
+        spent = time.monotonic() - t_start
+        if spent > budget * (0.25 if batch == 512 else 0.5):
+            print(f"[smoke] on-device latency batch={batch}: skipped "
+                  f"(latency budget {budget:.0f}s spent at "
+                  f"{spent:.0f}s)")
+            continue
+        args = (tab_rk, tab_mid,
+                jnp.asarray(rng.integers(0, n_streams, batch)
+                            .astype(np.int32)),
+                jnp.asarray(rng.integers(0, 256, (batch, 192),
+                                         dtype=np.uint8)),
+                jnp.asarray(np.full(batch, 172, np.int32)),
+                jnp.asarray(np.full(batch, 12, np.int32)),
+                jnp.asarray(rng.integers(0, 256, (batch, 16),
+                                         dtype=np.uint8)),
+                jnp.asarray(np.zeros(batch, np.uint32)))
+        jax.block_until_ready(rt(*args))        # compiles off the clock
+        jax.block_until_ready(null(*args))
+        crypto, base = [], []
+        for _ in range(trials):
+            crypto.append(run_chain(rt, args, chain))
+            base.append(run_chain(null, args, chain))
+            if time.monotonic() - t_start > budget:
+                break
+        dev_ms = (float(np.median(crypto)) - float(np.median(base))) \
+            * 1e3
+        print(f"[smoke] on-device protect+unprotect batch={batch}: "
+              f"{dev_ms:.3f} ms/round-trip differential "
+              f"({batch / max(dev_ms, 1e-6) * 1e3:.0f} pps implied; "
+              f"raw chain step {np.median(crypto) * 1e3:.1f} ms, null "
+              f"step {np.median(base) * 1e3:.1f} ms — the difference "
+              f"is chip time, the null step is tunnel byte-motion) "
+              f"over {len(crypto)}x{chain} executions; "
+              f"platform={platform}")
+
+
 def main() -> int:
     import jax
 
@@ -153,6 +257,8 @@ def main() -> int:
     smoke_srtp(platform)
     smoke_mixer(platform)
     smoke_pallas_aes(platform)
+    if os.environ.get("LIBJITSI_TPU_SMOKE_LATENCY", "1") != "0":
+        smoke_on_device_latency(platform)
     print("[smoke] PASS")
     return 0
 

@@ -75,3 +75,20 @@ def test_registry_lists_aes_providers():
 
     assert set(registry.providers("aes_encrypt")) >= {
         "xla_table", "xla_bitsliced", "pallas_bitsliced"}
+
+
+@pytest.mark.slow   # two fresh packed-circuit compiles (~1-2 min cold)
+def test_bitsliced32_packed_words_bit_exact():
+    """The packed-word provider (32 blocks per uint32 word, per-block
+    keys packed the same way) must match the table core bit for bit,
+    including the non-multiple-of-32 pad path and AES-256."""
+    rng = np.random.default_rng(9)
+    from libjitsi_tpu.kernels.aes_bitsliced import aes_encrypt_bitsliced32
+
+    for n, kl in ((33, 16), (64, 32)):
+        rks = aes.expand_keys_batch(
+            rng.integers(0, 256, (n, kl), dtype=np.uint8))
+        blocks = rng.integers(0, 256, (n, 16), dtype=np.uint8)
+        want = np.asarray(aes.aes_encrypt_table(rks, blocks))
+        got = np.asarray(aes_encrypt_bitsliced32(rks, blocks))
+        assert np.array_equal(got, want), (n, kl)

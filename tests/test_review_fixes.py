@@ -108,3 +108,42 @@ def test_unprotect_forged_oversize_ext_header_dropped():
     batch.stream[:] = 0
     dec, ok = rx.unprotect_rtp(batch)
     assert not np.asarray(ok).any()
+
+
+def test_bench_emit_final_line_is_compact_and_parseable(tmp_path):
+    """BENCH emit protocol (VERDICT r4 #1): the LAST stdout line must be
+    a compact JSON headline that survives a driver tail window, with
+    the full record on disk/penultimate line — and emit() must never
+    die even when serialization of the live dict races."""
+    import json
+    import subprocess
+    import sys
+
+    code = (
+        "import bench, json\n"
+        "bench.RESULT['value'] = 2.0e9\n"
+        "bench.EXTRA['estimators_pps'] = {'pipelined_median': 2.0e9}\n"
+        "bench.RESULT['value'] = round(bench._roofline("
+        "'headline', 2.0e9, 632.0, 'model'), 1)\n"
+        "bench._aes_consistency_check({'xla_table': 4.0e9})\n"
+        "bench.emit()\n")
+    import os
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ,
+               LIBJITSI_TPU_BENCH_DETAIL=str(tmp_path / "detail.json"))
+    res = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=120,
+                         cwd=repo, env=env)
+    assert res.returncode == 0, res.stderr[-500:]
+    lines = [l for l in res.stdout.splitlines() if l.strip()]
+    final = json.loads(lines[-1])            # last line parses
+    assert len(lines[-1]) < 2000             # sized for a tail window
+    assert final["metric"] == "srtp_protect_pps_at_10k_streams"
+    # roofline capped the impossible 2.0B to <= the HBM ceiling, then
+    # the AES-core cross-check bounded it further
+    assert final["value"] <= 819e9 / 632.0 + 1
+    assert final["extra"]["headline_roofline"]["roofline_capped"]
+    assert final["extra"]["consistency_vs_aes_core"]["ok"] is False
+    # full record parses too (penultimate line)
+    json.loads(lines[-2])
