@@ -657,8 +657,9 @@ def fanout(deadline: float, packets: int = 128, receivers: int = 512
     iv = rng.integers(0, 256, (rows, 16), dtype=np.uint8)
     roc = np.zeros(rows, np.uint32)
 
-    # same math as translator._fanout_protect, without buffer donation
-    # (donation would invalidate the timed args between iterations)
+    # same math as translator._fanout_protect (which since round 5
+    # takes the uniform-offset fast path for fan-outs), without buffer
+    # donation (donation would invalidate the timed args)
     @jax.jit
     def step(tab_rk, tab_mid, recv, data, length, off, iv, roc):
         return kernel.srtp_protect(data, length, off, tab_rk[recv], iv,

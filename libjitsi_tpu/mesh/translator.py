@@ -53,9 +53,13 @@ class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
         return self._rk, (self._gm if self._gcm else self._mid)
 
     def _cm_fanout_call(self, recv, data, length, payload_off, iv, idx):
+        from libjitsi_tpu.transform.srtp.context import _uniform_off
+
         roc = ((np.asarray(idx) >> 16) & 0xFFFFFFFF).astype(np.uint32)
         out, out_len = self._sharded_launch(
-            self._fanout_fn(), self._sharded_device(), recv,
+            self._fanout_fn(_uniform_off(payload_off,
+                                         np.asarray(data).shape[-1])),
+            self._sharded_device(), recv,
             [data, np.asarray(length, dtype=np.int32), payload_off, iv,
              roc])
         return out, out_len.astype(np.int32)
@@ -136,9 +140,9 @@ class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
         self._sh_fns[key] = fn
         return fn
 
-    def _fanout_fn(self):
+    def _fanout_fn(self, off_const=None):
         key = ("fanout", self.policy.auth_tag_len,
-               self.policy.cipher != Cipher.NULL)
+               self.policy.cipher != Cipher.NULL, off_const)
         fn = self._sh_fns.get(key)
         if fn is not None:
             return fn
@@ -148,7 +152,8 @@ class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
         def _run(tab_rk, tab_mid, local, data, length, off, iv, roc):
             out = kernel.srtp_protect(
                 data[0], length[0], off[0], tab_rk[local[0]], iv[0],
-                tab_mid[local[0]], roc[0], tag_len, encrypt)
+                tab_mid[local[0]], roc[0], tag_len, encrypt,
+                payload_off_const=off_const)
             return tuple(o[None] for o in out)
 
         row3 = P(self._axes, None, None)

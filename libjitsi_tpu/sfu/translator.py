@@ -37,13 +37,14 @@ from libjitsi_tpu.transform.srtp.kdf import derive_session_keys
 from libjitsi_tpu.transform.srtp.policy import Cipher, SrtpProfile
 
 
-@functools.partial(jax.jit, static_argnames=("tag_len", "encrypt"),
+@functools.partial(jax.jit,
+                   static_argnames=("tag_len", "encrypt", "off_const"),
                    donate_argnums=(3,))
 def _fanout_protect(tab_rk, tab_mid, recv, data, length, payload_off, iv,
-                    roc, tag_len: int, encrypt: bool):
+                    roc, tag_len: int, encrypt: bool, off_const=None):
     return kernel.srtp_protect(
         data, length, payload_off, tab_rk[recv], iv, tab_mid[recv], roc,
-        tag_len, encrypt)
+        tag_len, encrypt, payload_off_const=off_const)
 
 
 @functools.partial(jax.jit, static_argnames=("aad_const",), donate_argnums=(3,))
@@ -239,7 +240,14 @@ class RtpTranslator:
         """AES-CM fan-out device call — the mesh translator
         (mesh/translator.py) overrides exactly this seam, sharding the
         output rows by owning receiver chip; everything above (routing,
-        expansion, IVs) is shared verbatim."""
+        expansion, IVs) is shared verbatim.  Uniform payload offsets
+        (the fan-out common case: one sender's fixed header replicated
+        per leg) take the static-pad keystream alignment — a
+        fetch-verified ~1.2x win at 128x512 rows under the bitsliced
+        core (larger under the table core, where the offset gathers
+        compound with the S-box gathers)."""
+        from libjitsi_tpu.transform.srtp.context import _uniform_off
+
         tab_rk, tab_mid = self._device()
         return _fanout_protect(
             tab_rk, tab_mid, jnp.asarray(recv, dtype=jnp.int32),
@@ -247,7 +255,8 @@ class RtpTranslator:
             jnp.asarray(payload_off), jnp.asarray(iv),
             jnp.asarray((idx >> 16) & 0xFFFFFFFF, dtype=jnp.uint32),
             self.policy.auth_tag_len,
-            self.policy.cipher != Cipher.NULL)
+            self.policy.cipher != Cipher.NULL,
+            off_const=_uniform_off(payload_off, data.shape[-1]))
 
     # (see PendingTranslate at module scope)
 
