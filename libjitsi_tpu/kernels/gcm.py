@@ -18,6 +18,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from libjitsi_tpu.kernels.scatter import gather_span as _gather_span
+from libjitsi_tpu.kernels.scatter import scatter_bytes
 from libjitsi_tpu.kernels.aes import (aes_encrypt, ctr_crypt_offset,
                                       ctr_crypt_uniform)
 from libjitsi_tpu.kernels.ghash import ghash
@@ -134,17 +136,8 @@ def _inc32(block):
 
 
 def _scatter_tag(data, pos, tag):
-    col = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
-    pos = pos[:, None]
-    rel = jnp.clip(col - pos, 0, 15)
-    t = jnp.take_along_axis(tag, rel, axis=1)
-    return jnp.where((col >= pos) & (col < pos + TAG_LEN), t, data)
-
-
-def _gather_span(data, pos, n: int):
-    idx = pos[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
-    idx = jnp.clip(idx, 0, data.shape[1] - 1)
-    return jnp.take_along_axis(data, idx, axis=1)
+    # gather-free (kernels/scatter.py has the perf story)
+    return scatter_bytes(data, pos, tag, TAG_LEN)
 
 
 def _tag(round_keys, gmat, data, aad_len, ct_len, j0, width: int,

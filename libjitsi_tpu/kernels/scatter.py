@@ -1,0 +1,33 @@
+"""Per-row byte scatter/gather shared by the SRTP and GCM kernels.
+
+`scatter_bytes` writes a small per-row byte vector at a per-row column
+offset using UNROLLED broadcast compare+selects.  The obvious
+`take_along_axis(src, col - pos)` form is a per-element dynamic gather
+over the full [B, W] plane — fetch-verified at ~135 ms per scatter at
+65536x192 on a v5e, 3x the cost of the AES keystream it decorates —
+while n broadcast compares are plain vector ops.  `gather_span` keeps
+`take_along_axis` because its gather plane is only [B, n] (n <= 20).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def scatter_bytes(data, pos, src, n: int):
+    """Write src[:, :n] ([B, >=n] uint8) into data [B, W] at per-row
+    byte offset pos [B]; positions beyond W fall off the end (no-op),
+    matching the masked-gather form this replaces."""
+    col = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
+    pos = pos[:, None]
+    out = data
+    for k in range(n):
+        out = jnp.where(col == pos + k, src[:, k][:, None], out)
+    return out
+
+
+def gather_span(data, pos, n: int):
+    """Read n bytes at per-row byte offset `pos` -> [B, n] (clamped)."""
+    idx = pos[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
+    idx = jnp.clip(idx, 0, data.shape[1] - 1)
+    return jnp.take_along_axis(data, idx, axis=1)

@@ -21,32 +21,20 @@ import jax.numpy as jnp
 
 from libjitsi_tpu.kernels.aes import (ctr_crypt_offset, ctr_crypt_uniform,
                                       f8_crypt_offset, f8_crypt_uniform)
+from libjitsi_tpu.kernels.scatter import gather_span as _gather_span
+from libjitsi_tpu.kernels.scatter import scatter_bytes
 from libjitsi_tpu.kernels.sha1 import hmac_sha1
 
 
 def _scatter_word(data, pos, word):
-    """Write 4 bytes `word` [B, 4] at per-row byte offset `pos` [B]."""
-    col = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
-    pos = pos[:, None]
-    rel = jnp.clip(col - pos, 0, 3)
-    w = jnp.take_along_axis(word, rel, axis=1)
-    return jnp.where((col >= pos) & (col < pos + 4), w, data)
+    """Write 4 bytes `word` [B, 4] at per-row byte offset `pos` [B]
+    (gather-free — kernels/scatter.py has the perf story)."""
+    return scatter_bytes(data, pos, word, 4)
 
 
 def _scatter_tag(data, pos, tag, tag_len: int):
     """Write tag[:, :tag_len] at per-row byte offset `pos`."""
-    col = jnp.arange(data.shape[1], dtype=jnp.int32)[None, :]
-    pos = pos[:, None]
-    rel = jnp.clip(col - pos, 0, tag.shape[1] - 1)
-    t = jnp.take_along_axis(tag, rel, axis=1)
-    return jnp.where((col >= pos) & (col < pos + tag_len), t, data)
-
-
-def _gather_span(data, pos, n: int):
-    """Read n bytes at per-row byte offset `pos` -> [B, n]."""
-    idx = pos[:, None] + jnp.arange(n, dtype=jnp.int32)[None, :]
-    idx = jnp.clip(idx, 0, data.shape[1] - 1)
-    return jnp.take_along_axis(data, idx, axis=1)
+    return scatter_bytes(data, pos, tag, tag_len)
 
 
 def _auth_tags(data, mlen, extra_word, midstates):

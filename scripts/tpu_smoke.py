@@ -205,7 +205,11 @@ def smoke_on_device_latency(platform: str, n_streams: int = 10_240
         t0 = time.perf_counter()
         for _ in range(chain):
             d = fn(args[0], args[1], args[2], d, *args[4:])
-        jax.block_until_ready(d)
+        # BYTE FETCH, not block_until_ready: on this tunnel block can
+        # return before fresh launches execute (observed mid-process
+        # even after earlier fetches); one row's bytes force the whole
+        # dependency chain
+        np.asarray(d[0])
         return (time.perf_counter() - t0) / chain
 
     for batch, chain, trials in ((512, 40, 3), (65536, 8, 3)):
@@ -233,16 +237,28 @@ def smoke_on_device_latency(platform: str, n_streams: int = 10_240
             base.append(run_chain(null, args, chain))
             if time.monotonic() - t_start > budget:
                 break
-        dev_ms = (float(np.median(crypto)) - float(np.median(base))) \
-            * 1e3
-        print(f"[smoke] on-device protect+unprotect batch={batch}: "
-              f"{dev_ms:.3f} ms/round-trip differential "
-              f"({batch / max(dev_ms, 1e-6) * 1e3:.0f} pps implied; "
-              f"raw chain step {np.median(crypto) * 1e3:.1f} ms, null "
-              f"step {np.median(base) * 1e3:.1f} ms — the difference "
-              f"is chip time, the null step is tunnel byte-motion) "
-              f"over {len(crypto)}x{chain} executions; "
-              f"platform={platform}")
+        c_ms = float(np.median(crypto)) * 1e3
+        n_ms = float(np.median(base)) * 1e3
+        dev_ms = c_ms - n_ms
+        if dev_ms < 0.1 * n_ms:
+            # the crypto is smaller than the tunnel noise between the
+            # two chains: report the resolution bound, not a garbage
+            # subtraction
+            print(f"[smoke] on-device protect+unprotect batch={batch}: "
+                  f"below the differential's measurement floor "
+                  f"(crypto chain step {c_ms:.2f} ms vs null "
+                  f"{n_ms:.2f} ms -> on-device cost < ~{0.2 * n_ms:.2f} "
+                  f"ms/round-trip) over {len(crypto)}x{chain} "
+                  f"executions; platform={platform}")
+        else:
+            print(f"[smoke] on-device protect+unprotect batch={batch}: "
+                  f"{dev_ms:.3f} ms/round-trip differential "
+                  f"({batch / max(dev_ms, 1e-6) * 1e3:.0f} pps implied; "
+                  f"raw chain step {c_ms:.1f} ms, null step "
+                  f"{n_ms:.1f} ms — the difference is chip time, the "
+                  f"null step is tunnel byte-motion) over "
+                  f"{len(crypto)}x{chain} executions; "
+                  f"platform={platform}")
 
 
 def main() -> int:
