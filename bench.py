@@ -458,7 +458,7 @@ def aes_core_blocks_per_sec(deadline: float, b: int = 65536) -> None:
         expand_keys_batch
     from libjitsi_tpu.kernels.aes_bitsliced import (
         aes_encrypt_bitsliced, aes_encrypt_bitsliced32,
-        aes_encrypt_pallas_bitsliced)
+        aes_encrypt_bitsliced_tower, aes_encrypt_pallas_bitsliced)
 
     rng = np.random.default_rng(21)
     rks = expand_keys_batch(rng.integers(0, 256, (b, 16), dtype=np.uint8))
@@ -469,6 +469,7 @@ def aes_core_blocks_per_sec(deadline: float, b: int = 65536) -> None:
     table = jax.jit(aes_encrypt_table)
     for name, fn in (("xla_table", table),
                      ("xla_bitsliced", aes_encrypt_bitsliced),
+                     ("xla_bitsliced_tower", aes_encrypt_bitsliced_tower),
                      ("xla_bitsliced32", aes_encrypt_bitsliced32),
                      ("pallas_bitsliced", aes_encrypt_pallas_bitsliced)):
         if time.monotonic() > deadline:

@@ -236,7 +236,7 @@ def aes_encrypt_table(round_keys, blocks):
 # set_core(); set_core clears jax caches so later compiles re-pick).
 import os as _os
 
-_CORES = ("table", "bitsliced", "bitsliced32")
+_CORES = ("table", "bitsliced", "bitsliced_tower", "bitsliced32")
 _CORE_NAME = _os.environ.get("LIBJITSI_TPU_AES_CORE")  # None = by backend
 if _CORE_NAME not in (None,) + _CORES:
     raise ValueError(
@@ -258,12 +258,13 @@ def get_core() -> str:
     if _CORE_NAME is None:
         # resolved lazily so importing this module never forces a
         # backend init (conftest flips platforms before first use).
-        # TPU default: the bitsliced circuit — fetch-verified 8-37x the
-        # table core on v5e (the packed-word bitsliced32 measured at
-        # parity there, kept as a selectable provider for other chips);
+        # TPU default: the composite-field (tower) bitsliced circuit —
+        # fetch-verified fastest on v5e (~1.6x the addition-chain
+        # bitslice, which is itself 8-37x the gather table core; the
+        # packed-word bitsliced32 measured at parity with the chain).
         # CPU keeps the table core.
         _CORE_NAME = ("table" if jax.default_backend() == "cpu"
-                      else "bitsliced")
+                      else "bitsliced_tower")
     return _CORE_NAME
 
 
@@ -276,6 +277,11 @@ def aes_encrypt(round_keys, blocks):
             aes_encrypt_bitsliced_nd
 
         return aes_encrypt_bitsliced_nd(round_keys, blocks)
+    if core == "bitsliced_tower":
+        from libjitsi_tpu.kernels.aes_bitsliced import \
+            aes_encrypt_bitsliced_tower_nd
+
+        return aes_encrypt_bitsliced_tower_nd(round_keys, blocks)
     if core == "bitsliced32":
         from libjitsi_tpu.kernels.aes_bitsliced import \
             aes_encrypt_bitsliced32_nd

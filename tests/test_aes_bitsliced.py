@@ -66,6 +66,8 @@ def test_set_core_switches_srtp_protect_bit_identically():
     try:
         aes.set_core("bitsliced")
         assert protect() == want
+        aes.set_core("bitsliced_tower")   # the TPU production default
+        assert protect() == want
     finally:
         aes.set_core("table")
 
@@ -92,3 +94,35 @@ def test_bitsliced32_packed_words_bit_exact():
         want = np.asarray(aes.aes_encrypt_table(rks, blocks))
         got = np.asarray(aes_encrypt_bitsliced32(rks, blocks))
         assert np.array_equal(got, want), (n, kl)
+
+
+def test_bitsliced_tower_sbox_and_provider_bit_exact():
+    """The composite-field (GF((2^4)^2)) provider must match the table
+    core bit for bit — AES-128 and AES-256 (the tower parameters and
+    basis-change matrices are derived+asserted at import; this pins the
+    full cipher)."""
+    rng = np.random.default_rng(5)
+    from libjitsi_tpu.kernels.aes_bitsliced import \
+        aes_encrypt_bitsliced_tower
+
+    for n, kl in ((48, 16), (48, 32)):
+        rks = aes.expand_keys_batch(
+            rng.integers(0, 256, (n, kl), dtype=np.uint8))
+        blocks = rng.integers(0, 256, (n, 16), dtype=np.uint8)
+        want = np.asarray(aes.aes_encrypt_table(rks, blocks))
+        got = np.asarray(aes_encrypt_bitsliced_tower(rks, blocks))
+        assert np.array_equal(got, want), (n, kl)
+    # the _nd wrapper with BROADCAST keys — the exact shape the
+    # CTR/GCM call sites feed the TPU default dispatch
+    from libjitsi_tpu.kernels.aes_bitsliced import \
+        aes_encrypt_bitsliced_tower_nd
+
+    rks = aes.expand_keys_batch(
+        rng.integers(0, 256, (6, 16), dtype=np.uint8))
+    blocks = rng.integers(0, 256, (6, 3, 16), dtype=np.uint8)
+    rk_b = np.broadcast_to(rks[:, None], (6, 3, 11, 16))
+    want = np.asarray(aes.aes_encrypt_table(
+        rks[:, None].repeat(3, 1).reshape(-1, 11, 16),
+        blocks.reshape(-1, 16))).reshape(6, 3, 16)
+    got = np.asarray(aes_encrypt_bitsliced_tower_nd(rk_b, blocks))
+    assert np.array_equal(got, want)
