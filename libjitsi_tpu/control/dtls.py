@@ -18,11 +18,18 @@ import hashlib
 import os
 from typing import List, Optional, Tuple
 
-from cryptography import x509
-from cryptography.hazmat.bindings.openssl.binding import Binding
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.x509.oid import NameOID
+try:
+    from cryptography import x509
+    from cryptography.hazmat.bindings.openssl.binding import Binding
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.x509.oid import NameOID
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:
+    # gated dependency: the module must import without `cryptography`
+    # (demux + SDES-keyed bridges need none of it); DTLS handshakes
+    # raise at use time with a clear message instead
+    HAVE_CRYPTOGRAPHY = False
 import datetime
 
 from libjitsi_tpu.transform.srtp.policy import SrtpProfile
@@ -30,8 +37,22 @@ from libjitsi_tpu.utils.logging import get_logger
 
 _dtls_log = get_logger("control.dtls")
 
-_b = Binding()
-_lib, _ffi = _b.lib, _b.ffi
+_lib = _ffi = None
+
+
+def _openssl():
+    """Bind the OpenSSL FFI on first DTLS use (lazy so importing this
+    module — which every bridge does for `is_dtls` — never requires the
+    `cryptography` package to be installed)."""
+    global _lib, _ffi
+    if _lib is None:
+        if not HAVE_CRYPTOGRAPHY:
+            raise RuntimeError(
+                "DTLS-SRTP requires the 'cryptography' package; "
+                "SDES keying (add_participant) works without it")
+        b = Binding()
+        _lib, _ffi = b.lib, b.ffi
+    return _lib, _ffi
 
 # RFC 5764 §4.1.2 / OpenSSL srtp.h profile registry
 _PROFILE_BY_ID = {
@@ -58,6 +79,7 @@ def generate_certificate(cn: str = "libjitsi-tpu"
     Reference: DtlsControlImpl generates a per-instance self-signed
     certificate whose fingerprint goes into signaling.
     """
+    _openssl()
     key = ec.generate_private_key(ec.SECP256R1())
     name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, cn)])
     now = datetime.datetime.now(datetime.timezone.utc)
@@ -104,6 +126,7 @@ class DtlsSrtpEndpoint:
                  cookie_exchange: bool = False):
         if role not in ("client", "server"):
             raise ValueError("role must be client or server")
+        _openssl()
         self.role = role
         self.profiles = profiles or [
             SrtpProfile.AES_CM_128_HMAC_SHA1_80,

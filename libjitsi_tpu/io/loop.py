@@ -90,6 +90,12 @@ class MediaLoop:
         # Reference: DtlsPacketTransformer's pre-handshake queue.
         self._hold_mask = np.zeros(registry.capacity, dtype=bool)
         self._hold_q: Dict[int, "deque"] = {}
+        # supervisor-controlled inbound drop mask (stream quarantine /
+        # overload shedding, see service/supervisor.py): rows for masked
+        # streams are discarded before any state is touched
+        self.inbound_drop = np.zeros(registry.capacity, dtype=bool)
+        self.inbound_dropped = np.zeros(registry.capacity, dtype=np.int64)
+        self.inbound_dropped_total = 0
         self.ticks = 0
         self.rx_packets = 0
         self.tx_packets = 0
@@ -194,6 +200,16 @@ class MediaLoop:
             # rate-limited: an unknown-SSRC flood must not flood the log
             _log.warn("unknown_ssrc_drop", count=int((~known).sum()),
                       tick=self.ticks)
+        if self.inbound_drop.any():
+            # quarantined / shed streams are dropped BEFORE the address
+            # latch below, so a quarantined sender's packets can never
+            # redirect the row's return media mid-ban
+            shed = known & self.inbound_drop[
+                np.clip(sids, 0, len(self.inbound_drop) - 1)]
+            if shed.any():
+                np.add.at(self.inbound_dropped, sids[shed], 1)
+                self.inbound_dropped_total += int(shed.sum())
+                known &= ~shed
         self.addr_ip[sids[known]] = sip[known]
         self.addr_port[sids[known]] = sport[known]
 

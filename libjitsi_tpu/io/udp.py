@@ -110,6 +110,23 @@ class UdpEngine:
         self._sport = np.zeros(max_batch, dtype=np.uint16)
         self._ats = np.zeros(max_batch, dtype=np.int64)
 
+    @classmethod
+    def create_with_retry(cls, retries: int = 5, backoff_s: float = 0.05,
+                          sleep=None, **kwargs) -> "UdpEngine":
+        """Bind with bounded retry + exponential backoff.
+
+        The crash-restart path: a just-killed worker's socket can linger
+        briefly (or an init race holds the port), and the restarted
+        process must ride that out instead of dying — but boundedly, so
+        a genuinely-taken port still fails loudly."""
+        import time as _time
+
+        from libjitsi_tpu.utils.health import retrying
+
+        return retrying(lambda: cls(**kwargs), retries=retries,
+                        backoff_s=backoff_s,
+                        sleep=_time.sleep if sleep is None else sleep)
+
     def recv_batch(self, timeout_ms: int = 1
                    ) -> Tuple[PacketBatch, np.ndarray, np.ndarray]:
         """One batching window: up to max_batch datagrams.

@@ -122,6 +122,10 @@ class ConferenceBridge:
         self._tx_seq = np.zeros(capacity, dtype=np.int64)
         self._tx_ts = np.zeros(capacity, dtype=np.int64)
         self._tx_ssrc = np.zeros(capacity, dtype=np.int64)
+        # overload degradation (set by BridgeSupervisor): skip the
+        # non-essential tick work — speaker scoring, recorder events,
+        # egress level stamping — while media keeps flowing
+        self.degraded = False
         self.ticks = 0
 
     # ------------------------------------------------------- participants
@@ -276,8 +280,9 @@ class ConferenceBridge:
                     "dominant": -1}
         sids, _frames = self.bank.tick(now=self._now)
         out, levels = self.mixer.mix()
-        self.speaker.levels(levels)
-        self._update_egress_levels(levels)
+        if not self.degraded:
+            self.speaker.levels(levels)
+            self._update_egress_levels(levels)
         tx = self._send_mixes(out)
         self.ticks += 1
         return {"rx": rx, "mixed": len(sids), "tx": tx,

@@ -195,6 +195,9 @@ class SfuBridge:
         self._ssrc_of: Dict[int, int] = {}     # sid -> sender ssrc
         self.forwarded = 0
         self.retransmitted = 0
+        # overload degradation (set by BridgeSupervisor): suppress the
+        # RTCP feedback fan-out while media forwarding keeps flowing
+        self.degraded = False
         # receive-side GCC over each sender->bridge leg: fed per tick
         # from the abs-send-time ext + (kernel, when enabled) arrival
         # stamps; one transport row per sender sid.  Reference:
@@ -656,6 +659,10 @@ class SfuBridge:
         with the sender leg's keys.  Call periodically (the reference's
         RecurringRunnable cadence); also drains the accumulation so a
         long-lived conference does not grow state unboundedly."""
+        if self.degraded:
+            # overload: RTCP reports are the first work shed (senders
+            # coast on their last estimates; media is untouched)
+            return 0
         now = time.time() if now is None else now
         sent = 0
         # periodic GCC tick: every fed sender leg's estimate advances

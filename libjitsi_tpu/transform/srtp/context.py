@@ -258,6 +258,15 @@ class SrtpStreamTable:
         self.rtcp_tx_index = np.full(s, -1, dtype=np.int64)
         self.rtcp_rx_max = np.full(s, -1, dtype=np.int64)
         self.rtcp_rx_mask = np.zeros(s, dtype=np.uint64)
+        # per-stream receive-failure accounting (RTP + RTCP combined):
+        # the supervisor's quarantine detector reads per-tick deltas of
+        # these to isolate an SSRC storming garbage (service/supervisor).
+        # Size-class bucket padding can double-count an auth failure
+        # (padding rows duplicate real rows and also fail auth) — fine
+        # for a rate threshold; replay_reject counts only window-based
+        # rejects, never the in-batch dedup kills padding produces.
+        self.auth_fail = np.zeros(s, dtype=np.int64)
+        self.replay_reject = np.zeros(s, dtype=np.int64)
         # key-derivation-rate re-keying (reference:
         # BaseSRTPCryptoContext.keyDerivationRate): master material is
         # retained for kdr>0 streams and session keys are re-derived when
@@ -324,6 +333,8 @@ class SrtpStreamTable:
         self.rtcp_tx_index[sid] = -1
         self.rtcp_rx_max[sid] = -1
         self.rtcp_rx_mask[sid] = 0
+        self.auth_fail[sid] = 0
+        self.replay_reject[sid] = 0
         self.kdr[sid] = kdr
         self._epoch_rtp[sid] = 0
         self._epoch_rtcp[sid] = 0
@@ -399,6 +410,8 @@ class SrtpStreamTable:
         self.rtcp_tx_index[sids] = -1
         self.rtcp_rx_max[sids] = -1
         self.rtcp_rx_mask[sids] = 0
+        self.auth_fail[sids] = 0
+        self.replay_reject[sids] = 0
         self.kdr[sids] = kdr_arr
         self._epoch_rtp[sids] = 0
         self._epoch_rtcp[sids] = 0
@@ -605,6 +618,8 @@ class SrtpStreamTable:
             self._rk_f8_rtcp[sid] = 0
         self._masters.pop(sid, None)
         self.kdr[sid] = 0
+        self.auth_fail[sid] = 0
+        self.replay_reject[sid] = 0
         self._dev = None
 
     def _device(self):
@@ -973,7 +988,11 @@ class SrtpStreamTable:
             iv = self._cm_iv(self._salt_rtp[stream], hdr.ssrc, idx)
             data, mlen, auth_ok = self._cm_rtp_unprotect_call(
                 stream, batch, hdr, iv, v, length)
-        ok = valid & not_replayed & np.asarray(auth_ok)
+        auth_ok = np.asarray(auth_ok)
+        srow = np.clip(stream, 0, self.capacity - 1)
+        np.add.at(self.auth_fail, srow, valid & not_replayed & ~auth_ok)
+        np.add.at(self.replay_reject, srow, valid & ~not_replayed)
+        ok = valid & not_replayed & auth_ok
         # in-batch duplicate indices: keep the first *authenticated*
         # occurrence (a forged front-runner fails auth and must not block
         # the genuine copy later in the batch)
@@ -1167,7 +1186,11 @@ class SrtpStreamTable:
             iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
             data, mlen, auth_ok, _e, _idx = self._rtcp_unprotect_call(
                 stream, batch, iv, length, p.cipher != Cipher.NULL)
-        ok = valid & not_replayed & np.asarray(auth_ok)
+        auth_ok = np.asarray(auth_ok)
+        srow = np.clip(stream, 0, self.capacity - 1)
+        np.add.at(self.auth_fail, srow, valid & not_replayed & ~auth_ok)
+        np.add.at(self.replay_reject, srow, valid & ~not_replayed)
+        ok = valid & not_replayed & auth_ok
         ok &= ~replay.dedup_first(stream, index, ok)
         replay.update(self.rtcp_rx_max, self.rtcp_rx_mask, stream, index, ok)
 
@@ -1224,6 +1247,8 @@ class SrtpStreamTable:
             "rtcp_tx_index": self.rtcp_tx_index.copy(),
             "rtcp_rx_max": self.rtcp_rx_max.copy(),
             "rtcp_rx_mask": self.rtcp_rx_mask.copy(),
+            "auth_fail": self.auth_fail.copy(),
+            "replay_reject": self.replay_reject.copy(),
         }
         if self._gcm:
             snap["gm_rtp"] = self._gm_rtp.copy()
@@ -1260,6 +1285,9 @@ class SrtpStreamTable:
         self.rtcp_tx_index = snap["rtcp_tx_index"].copy()
         self.rtcp_rx_max = snap["rtcp_rx_max"].copy()
         self.rtcp_rx_mask = snap["rtcp_rx_mask"].copy()
+        if "auth_fail" in snap:      # older snapshots lack the counters
+            self.auth_fail = snap["auth_fail"].copy()
+            self.replay_reject = snap["replay_reject"].copy()
         if self._gcm:
             self._gm_rtp = snap["gm_rtp"].copy()
             self._gm_rtcp = snap["gm_rtcp"].copy()

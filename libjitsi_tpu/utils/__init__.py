@@ -1,2 +1,5 @@
 from libjitsi_tpu.utils.metrics import MetricsRegistry  # noqa: F401
-from libjitsi_tpu.utils.faults import FaultInjectionEngine  # noqa: F401
+from libjitsi_tpu.utils.faults import (  # noqa: F401
+    FaultInjectionEngine, GilbertElliott)
+from libjitsi_tpu.utils.health import (  # noqa: F401
+    ExponentialBackoff, SlidingWindowCounter, Watchdog, retrying)

@@ -2,12 +2,29 @@
 fault-injection framework; our test strategy requires loss/jitter/
 reorder/duplicate injection as a chain engine).
 
-Deterministic per-seed, so failing runs replay exactly.
+Deterministic per-seed, so failing runs replay exactly.  Note the RNG
+is consumed batch-by-batch: the same packets partitioned into different
+batches draw different fates — chaos tests that need IDENTICAL faulted
+bytes across two runs must fault a pre-generated wire stream offline
+and feed the same bytes to both (see tests/test_chaos_recovery.py).
+
+Two loss processes compose:
+- independent per-packet `loss` (classic Bernoulli), and
+- `burst` — a Gilbert–Elliott two-state Markov channel (good/bad with
+  per-state loss rates), the standard model for the CORRELATED loss
+  bursts real networks show, which independent loss cannot reproduce
+  (a jitter buffer that survives 5% random loss can still die to the
+  same 5% arriving as 10-packet bursts).
+
+The engine applies on both directions: `reverse_transform` simulates
+the network on receive, and (with `tx=True`) `transform` on send —
+install it AFTER SrtpTransformEngine in the chain list so both paths
+see ciphertext, exactly like a lossy wire.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -15,64 +32,166 @@ from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.transform.engine import PacketTransformer, TransformEngine
 
 
+class GilbertElliott:
+    """Two-state Markov loss channel (Gilbert–Elliott).
+
+    State GOOD drops with `loss_good` (usually 0), state BAD with
+    `loss_bad` (usually 1).  Transitions per packet: GOOD->BAD with
+    `p_gb`, BAD->GOOD with `p_bg`; mean burst length = 1/p_bg, long-run
+    loss rate ≈ p_gb/(p_gb+p_bg) · loss_bad (for loss_good=0).
+
+    Vectorized by sojourn segments: instead of stepping the chain per
+    packet, the time spent in each state is drawn geometrically and a
+    whole segment's losses are filled with one vector op.  State (and a
+    partially-consumed sojourn) persists across batches, so bursts span
+    batch boundaries like they span ticks on a real wire.
+    """
+
+    GOOD, BAD = 0, 1
+
+    def __init__(self, p_gb: float, p_bg: float, loss_bad: float = 1.0,
+                 loss_good: float = 0.0):
+        if not (0.0 <= p_gb <= 1.0 and 0.0 <= p_bg <= 1.0):
+            raise ValueError("transition probabilities must be in [0, 1]")
+        self.p_gb = p_gb
+        self.p_bg = p_bg
+        self.loss_bad = loss_bad
+        self.loss_good = loss_good
+        self.state = self.GOOD
+        self._left = 0          # packets remaining in current sojourn
+        self._absorbing = False  # sojourn came from a 0-probability exit
+
+    def losses(self, n: int, rng: np.random.Generator) -> np.ndarray:
+        """Drop mask for the next `n` packets, advancing the chain."""
+        out = np.empty(n, dtype=bool)
+        i = 0
+        while i < n:
+            if self._left == 0:
+                p_exit = self.p_gb if self.state == self.GOOD else self.p_bg
+                if p_exit <= 0.0:        # absorbing: never leaves
+                    self._left = n - i
+                    self._absorbing = True
+                else:
+                    self._left = int(rng.geometric(p_exit))
+                    self._absorbing = False
+            seg = min(self._left, n - i)
+            p = self.loss_good if self.state == self.GOOD else self.loss_bad
+            if p <= 0.0:
+                out[i:i + seg] = False
+            elif p >= 1.0:
+                out[i:i + seg] = True
+            else:
+                out[i:i + seg] = rng.random(seg) < p
+            self._left -= seg
+            i += seg
+            if self._left == 0 and not self._absorbing:
+                self.state ^= 1
+        return out
+
+
 class FaultInjectionEngine(TransformEngine):
     """Drops / duplicates / reorders / corrupts rows of each batch.
 
-    Installed like any other engine (usually first in the receive
-    chain, simulating the network).  Rates are per-packet
-    probabilities; reordering shuffles a window at the batch level.
+    Installed like any other engine (after SRTP in the list, so it runs
+    first on receive and last on send — the network simulator sits on
+    the wire side of the crypto).  Rates are per-packet probabilities;
+    reordering shuffles a window at the batch level; `burst` adds a
+    Gilbert–Elliott correlated-loss channel (independent chains per
+    direction — a real path's two directions fade independently).
+    `tx=True` also faults the send path (counters split per direction).
     """
 
     def __init__(self, loss: float = 0.0, duplicate: float = 0.0,
                  corrupt: float = 0.0, reorder: float = 0.0,
-                 seed: int = 0):
+                 seed: int = 0,
+                 burst: Optional[Tuple[float, ...]] = None,
+                 tx: bool = False):
         self.loss = loss
         self.duplicate = duplicate
         self.corrupt = corrupt
         self.reorder = reorder
+        self.tx = tx
         self.rng = np.random.default_rng(seed)
+        self._ge_rx = GilbertElliott(*burst) if burst else None
+        self._ge_tx = GilbertElliott(*burst) if burst and tx else None
         self.dropped = 0
         self.duplicated = 0
         self.corrupted = 0
+        self.tx_dropped = 0
+        self.tx_duplicated = 0
+        self.tx_corrupted = 0
         eng = self
 
         class _T(PacketTransformer):
             def reverse_transform(self, batch, mask=None):
-                n = batch.batch_size
-                keep = np.ones(n, bool) if mask is None else mask.copy()
-                if n == 0:
-                    return batch, keep
-                r = eng.rng
-                data = batch.data.copy()
-                length = np.asarray(batch.length).copy()
-                stream = np.asarray(batch.stream).copy()
+                return eng._apply(batch, mask, eng._ge_rx, "")
 
-                drop = r.random(n) < eng.loss
-                eng.dropped += int(drop.sum())
-                keep &= ~drop
-
-                cor = (r.random(n) < eng.corrupt) & keep
-                for i in np.nonzero(cor)[0]:
-                    if length[i] > 0:
-                        data[i, r.integers(0, length[i])] ^= 0xFF
-                eng.corrupted += int(cor.sum())
-
-                order = np.arange(n)
-                if eng.reorder > 0 and n > 1:
-                    swaps = np.nonzero(r.random(n - 1) < eng.reorder)[0]
-                    for i in swaps:
-                        order[i], order[i + 1] = order[i + 1], order[i]
-
-                dup_rows = np.nonzero((r.random(n) < eng.duplicate)
-                                      & keep)[0]
-                eng.duplicated += len(dup_rows)
-                if len(dup_rows):
-                    order = np.concatenate([order, dup_rows])
-
-                out = PacketBatch(data[order], length[order], stream[order])
-                return out, keep[order]
+            def transform(self, batch, mask=None):
+                if not eng.tx:
+                    n = batch.batch_size
+                    return batch, (np.ones(n, bool) if mask is None
+                                   else mask)
+                return eng._apply(batch, mask, eng._ge_tx, "tx_")
 
         self._rtp = _T()
+
+    def _apply(self, batch: PacketBatch, mask, ge, prefix: str):
+        n = batch.batch_size
+        keep = np.ones(n, bool) if mask is None else mask.copy()
+        if n == 0:
+            return batch, keep
+        r = self.rng
+        data = batch.data.copy()
+        length = np.asarray(batch.length).copy()
+        stream = np.asarray(batch.stream).copy()
+
+        drop = r.random(n) < self.loss
+        if ge is not None:
+            drop |= ge.losses(n, r)
+        self._bump(prefix + "dropped", int((drop & keep).sum()))
+        keep &= ~drop
+
+        cor = (r.random(n) < self.corrupt) & keep
+        rows = np.nonzero(cor & (length > 0))[0]
+        if len(rows):
+            # one flipped byte per corrupted packet, position uniform in
+            # the packet — vectorized (Generator.integers broadcasts the
+            # per-row exclusive upper bound)
+            cols = r.integers(0, length[rows])
+            data[rows, cols] ^= 0xFF
+        self._bump(prefix + "corrupted", len(rows))
+
+        order = np.arange(n)
+        if self.reorder > 0 and n > 1:
+            swaps = np.nonzero(r.random(n - 1) < self.reorder)[0]
+            for i in swaps:
+                order[i], order[i + 1] = order[i + 1], order[i]
+
+        dup_rows = np.nonzero((r.random(n) < self.duplicate) & keep)[0]
+        self._bump(prefix + "duplicated", len(dup_rows))
+        if len(dup_rows):
+            order = np.concatenate([order, dup_rows])
+
+        out = PacketBatch(data[order], length[order], stream[order])
+        return out, keep[order]
+
+    def _bump(self, counter: str, by: int) -> None:
+        setattr(self, counter, getattr(self, counter) + by)
+
+    def register_metrics(self, registry, prefix: str = "fault") -> None:
+        """Expose the per-direction fault counters on a MetricsRegistry
+        (Prometheus counters, rendered by `registry.render()`)."""
+        for name, help_ in (
+                ("dropped", "packets dropped by injected loss (rx)"),
+                ("corrupted", "packets bit-flipped (rx)"),
+                ("duplicated", "packets duplicated (rx)"),
+                ("tx_dropped", "packets dropped by injected loss (tx)"),
+                ("tx_corrupted", "packets bit-flipped (tx)"),
+                ("tx_duplicated", "packets duplicated (tx)")):
+            registry.register_scalar(
+                f"{prefix}_{name}",
+                (lambda n=name: getattr(self, n)),
+                help_=help_, kind="counter")
 
     @property
     def rtp_transformer(self):

@@ -52,17 +52,19 @@ class MetricsRegistry:
 
     def __init__(self, namespace: str = "libjitsi_tpu"):
         self.ns = namespace
-        self._arrays: Dict[str, Tuple[np.ndarray, str, str]] = {}
-        self._scalars: Dict[str, Tuple[Callable[[], float], str]] = {}
+        self._arrays: Dict[str, Tuple[np.ndarray, str, str, str]] = {}
+        self._scalars: Dict[str, Tuple[Callable[[], float], str, str]] = {}
         self.timings: Dict[str, TimingRing] = {}
 
     def register_array(self, name: str, arr: np.ndarray, by: str = "stream",
-                       help_: str = "") -> None:
-        self._arrays[name] = (arr, by, help_)
+                       help_: str = "", kind: str = "gauge") -> None:
+        """`kind` is the Prometheus metric type for the # TYPE line —
+        "gauge" (default) or "counter" for monotonic totals."""
+        self._arrays[name] = (arr, by, help_, kind)
 
     def register_scalar(self, name: str, fn: Callable[[], float],
-                        help_: str = "") -> None:
-        self._scalars[name] = (fn, help_)
+                        help_: str = "", kind: str = "gauge") -> None:
+        self._scalars[name] = (fn, help_, kind)
 
     def timing(self, name: str) -> TimingRing:
         if name not in self.timings:
@@ -73,20 +75,20 @@ class MetricsRegistry:
         """Prometheus text format.  `active` masks which rows of the
         per-stream arrays are exported (10k idle rows would be noise)."""
         out: List[str] = []
-        for name, (arr, by, help_) in self._arrays.items():
+        for name, (arr, by, help_, kind) in self._arrays.items():
             full = f"{self.ns}_{name}"
             if help_:
                 out.append(f"# HELP {full} {help_}")
-            out.append(f"# TYPE {full} gauge")
+            out.append(f"# TYPE {full} {kind}")
             rows = np.nonzero(active)[0] if active is not None \
                 else range(len(arr))
             for i in rows:
                 out.append(f'{full}{{{by}="{i}"}} {arr[i]}')
-        for name, (fn, help_) in self._scalars.items():
+        for name, (fn, help_, kind) in self._scalars.items():
             full = f"{self.ns}_{name}"
             if help_:
                 out.append(f"# HELP {full} {help_}")
-            out.append(f"# TYPE {full} gauge")
+            out.append(f"# TYPE {full} {kind}")
             out.append(f"{full} {fn()}")
         for name, ring in self.timings.items():
             for q, label in ((50, "p50"), (99, "p99")):

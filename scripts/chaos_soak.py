@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""Chaos soak: a live conference under sustained in-chain fault
+injection (loss / corruption / reorder / duplication / Gilbert–Elliott
+bursts), killed mid-run and recovered from its checkpoint, with an
+invariant report at the end.
+
+Unlike tests/test_chaos_recovery.py (offline-faulted wire, bit-exact
+accept-set comparison), this drives the REAL FaultInjectionEngine
+inside the bridge's transform chain for minutes at a time — the
+long-soak complement to the deterministic acceptance test.  The pytest
+twin (tests/test_chaos_soak.py, marked slow) runs a short
+configuration of the same loop.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/chaos_soak.py --ticks 200 \
+        --loss 0.05 --corrupt 0.03 --reorder 0.1 --burst 0.02,0.25
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import libjitsi_tpu  # noqa: E402
+from libjitsi_tpu.core.packet import PacketBatch  # noqa: E402
+from libjitsi_tpu.io import UdpEngine  # noqa: E402
+from libjitsi_tpu.rtp import header as rtp_header  # noqa: E402
+from libjitsi_tpu.service.bridge import ConferenceBridge  # noqa: E402
+from libjitsi_tpu.service.pump import g711_codec  # noqa: E402
+from libjitsi_tpu.service.supervisor import (  # noqa: E402
+    BridgeSupervisor, SupervisorConfig)
+from libjitsi_tpu.transform.engine import TransformEngineChain  # noqa: E402
+from libjitsi_tpu.transform.srtp import SrtpStreamTable  # noqa: E402
+from libjitsi_tpu.utils.faults import FaultInjectionEngine  # noqa: E402
+from libjitsi_tpu.utils.metrics import MetricsRegistry  # noqa: E402
+
+
+class _Leg:
+    """One SRTP participant speaking a tone over loopback UDP."""
+
+    def __init__(self, ssrc, freq, bridge_port):
+        self.ssrc, self.freq, self.bridge_port = ssrc, freq, bridge_port
+        self.codec = g711_codec()
+        self.rx_key = (bytes([ssrc]) * 16, bytes([ssrc + 1]) * 14)
+        self.tx_key = (bytes([ssrc + 2]) * 16, bytes([ssrc + 3]) * 14)
+        self.protect = SrtpStreamTable(capacity=1)
+        self.protect.add_stream(0, *self.rx_key)
+        self.engine = UdpEngine(port=0, max_batch=64)
+        self.seq = 100
+        self.t = 0
+        self.sent = 0
+        self.last_wire = None       # kept for the replay probe
+
+    def send_frame(self):
+        n = np.arange(160)
+        pcm = (8000 * np.sin(2 * np.pi * self.freq *
+                             (self.t + n) / 8000)).astype(np.int16)
+        self.t += 160
+        b = rtp_header.build([self.codec.encode(pcm)], [self.seq],
+                             [self.t], [self.ssrc], [0], stream=[0])
+        self.seq += 1
+        prot = self.protect.protect_rtp(b)
+        self.last_wire = prot.to_bytes(0)
+        self.engine.send_batch(prot, "127.0.0.1", self.bridge_port)
+        self.sent += 1
+
+    def drain(self):
+        back, _, _ = self.engine.recv_batch(timeout_ms=0)
+        return back.batch_size
+
+    def close(self):
+        self.engine.close()
+
+
+def _install_faults(bridge, faults):
+    """Splice the fault engine onto the wire side of the chain (last in
+    the list = first on receive, after SRTP on send)."""
+    bridge.chain = TransformEngineChain(
+        bridge.chain.engines + [faults],
+        names=bridge.chain.names + [type(faults).__name__]
+        if getattr(bridge.chain, "names", None) else None)
+    bridge.loop.chain = bridge.chain
+
+
+def run_soak(ticks=120, participants=3, loss=0.05, corrupt=0.03,
+             reorder=0.1, duplicate=0.02, burst=(0.02, 0.25),
+             kill_frac=0.5, seed=0, ckpt_path=None, verbose=True):
+    """Run the soak; returns the invariant report dict (all `ok_*`
+    entries must be True)."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    own_ckpt = ckpt_path is None
+    if own_ckpt:
+        fd, ckpt_path = tempfile.mkstemp(suffix=".ckpt")
+        os.close(fd)
+    metrics = MetricsRegistry()
+    scfg = SupervisorConfig(deadline_ms=1000.0,
+                            quarantine_auth_threshold=1 << 30,
+                            quarantine_replay_threshold=1 << 30,
+                            checkpoint_every=25, checkpoint_path=ckpt_path)
+
+    def build(restore_snap_path=None):
+        if restore_snap_path is None:
+            bridge = ConferenceBridge(cfg, port=0, capacity=16,
+                                      recv_window_ms=0)
+            sup = BridgeSupervisor(bridge, scfg, metrics=metrics)
+        else:
+            sup = BridgeSupervisor.recover(
+                cfg, restore_snap_path, ConferenceBridge, port=0,
+                supervisor_config=scfg, metrics=metrics,
+                recv_window_ms=0)
+            bridge = sup.bridge
+        faults = FaultInjectionEngine(loss=loss, corrupt=corrupt,
+                                      reorder=reorder,
+                                      duplicate=duplicate, seed=seed,
+                                      burst=burst, tx=True)
+        _install_faults(bridge, faults)
+        faults.register_metrics(metrics)
+        return bridge, sup, faults
+
+    bridge, sup, faults = build()
+    legs = [_Leg(0x30 + 0x10 * i, 300.0 * (i + 1), bridge.port)
+            for i in range(participants)]
+    for leg in legs:
+        bridge.add_participant(leg.ssrc, leg.rx_key, leg.tx_key)
+
+    kill_at = int(ticks * kill_frac)
+    decoded_at_kill = None
+    # decoded_frames is a per-process ReceiveBank stat (the jitter
+    # bank inside is what the checkpoint carries), so the restored
+    # bridge counts from zero — baseline it right after the rebuild
+    decoded_restore_base = None
+    stalled = False
+    now = 1000.0
+    fault_dropped = 0
+    t0 = time.perf_counter()
+    for t in range(ticks):
+        if t == kill_at:
+            sup.save_checkpoint()
+            decoded_at_kill = bridge.bank.decoded_frames.copy()
+            fault_dropped += faults.dropped + faults.tx_dropped
+            bridge.close()                      # the crash
+            bridge, sup, faults = build(restore_snap_path=ckpt_path)
+            decoded_restore_base = bridge.bank.decoded_frames.copy()
+            for leg in legs:
+                leg.bridge_port = bridge.port
+        for leg in legs:
+            leg.send_frame()
+        for _ in range(20):
+            if sup.tick(now=now)["rx"]:
+                break
+        sup.tick(now=now + 0.001)
+        for leg in legs:
+            leg.drain()
+        stalled = stalled or sup.watchdog.state == "stalled"
+        now += 0.020
+
+    decoded_end = bridge.bank.decoded_frames.copy()
+    fault_dropped += faults.dropped + faults.tx_dropped
+
+    # replay probe: pre-kill wire must bounce off the restored window
+    replay_before = int(np.sum(bridge.rx_table.replay_reject))
+    probe = legs[0].last_wire
+    legs[0].engine.send_batch(PacketBatch.from_payloads([probe]),
+                              "127.0.0.1", bridge.port)
+    for _ in range(20):
+        if sup.tick(now=now)["rx"]:
+            break
+        time.sleep(0.001)
+    replay_after = int(np.sum(bridge.rx_table.replay_reject))
+
+    sids = list(range(participants))
+    report = {
+        "ticks": ticks,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "sent": sum(leg.sent for leg in legs),
+        "decoded_per_leg": [int(x) for x in decoded_end[sids]],
+        "fault_dropped": int(fault_dropped),
+        "srtp_auth_fail": [int(x) for x in bridge.rx_table.auth_fail[sids]],
+        "checkpoints_written": sup.checkpoints_written,
+        "watchdog": sup.health(),
+        # ---- invariants
+        "ok_survived": True,                    # we got here
+        "ok_not_stalled": not stalled,
+        "ok_media_flowed_before_kill": bool(
+            (decoded_at_kill[sids] > 0).all()),
+        "ok_media_continued_after_restore": bool(
+            (decoded_end[sids] > decoded_restore_base[sids]).all()),
+        "ok_replay_rejected": replay_after > replay_before,
+        "ok_faults_injected": fault_dropped > 0,
+    }
+    for leg in legs:
+        leg.close()
+    bridge.close()
+    if own_ckpt and os.path.exists(ckpt_path):
+        os.unlink(ckpt_path)
+    if verbose:
+        print("---- chaos soak report ----")
+        for k, v in report.items():
+            print(f"{k:36s} {v}")
+        print("---- metrics ----")
+        print(metrics.render())
+    return report
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--ticks", type=int, default=120)
+    ap.add_argument("--participants", type=int, default=3)
+    ap.add_argument("--loss", type=float, default=0.05)
+    ap.add_argument("--corrupt", type=float, default=0.03)
+    ap.add_argument("--reorder", type=float, default=0.1)
+    ap.add_argument("--duplicate", type=float, default=0.02)
+    ap.add_argument("--burst", type=str, default="0.02,0.25",
+                    help="Gilbert–Elliott p_gb,p_bg ('' disables)")
+    ap.add_argument("--kill-frac", type=float, default=0.5,
+                    help="fraction of the run at which to crash+recover")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--ckpt", type=str, default=None)
+    args = ap.parse_args()
+    burst = (tuple(float(x) for x in args.burst.split(","))
+             if args.burst else None)
+    report = run_soak(ticks=args.ticks, participants=args.participants,
+                      loss=args.loss, corrupt=args.corrupt,
+                      reorder=args.reorder, duplicate=args.duplicate,
+                      burst=burst, kill_frac=args.kill_frac,
+                      seed=args.seed, ckpt_path=args.ckpt)
+    failed = [k for k, v in report.items()
+              if k.startswith("ok_") and not v]
+    if failed:
+        print(f"INVARIANT FAILURES: {failed}", file=sys.stderr)
+        return 1
+    print("all invariants held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,34 @@
+"""Slow twin of scripts/chaos_soak.py: the same live-fault soak loop
+(in-chain loss/corrupt/reorder/duplicate + Gilbert–Elliott bursts,
+mid-run kill + checkpoint recovery) in a short configuration, asserting
+every invariant in the report."""
+
+import importlib.util
+import os
+
+import pytest
+
+_SCRIPT = os.path.join(os.path.dirname(__file__), os.pardir,
+                       "scripts", "chaos_soak.py")
+
+
+def _load_soak():
+    spec = importlib.util.spec_from_file_location("chaos_soak", _SCRIPT)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.slow
+def test_chaos_soak_invariants(tmp_path):
+    soak = _load_soak()
+    report = soak.run_soak(ticks=50, participants=3, loss=0.08,
+                           corrupt=0.05, reorder=0.1, duplicate=0.03,
+                           burst=(0.03, 0.3), kill_frac=0.5, seed=7,
+                           ckpt_path=str(tmp_path / "soak.ckpt"),
+                           verbose=False)
+    failed = {k: v for k, v in report.items()
+              if k.startswith("ok_") and not v}
+    assert not failed, (failed, report)
+    assert report["fault_dropped"] > 0
+    assert report["checkpoints_written"] >= 1

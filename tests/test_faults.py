@@ -1,0 +1,128 @@
+"""FaultInjectionEngine unit tests: Gilbert–Elliott burst statistics,
+the vectorized single-byte corrupt, the send-side (tx) path, per-seed
+determinism, and the Prometheus counter export.  Pure numpy — no
+device, no sockets (the SRTP-composed fault tests live in
+test_utils.py and are marked slow)."""
+
+import numpy as np
+
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.utils.faults import FaultInjectionEngine, GilbertElliott
+from libjitsi_tpu.utils.metrics import MetricsRegistry
+
+
+def _batch(n, fill=0x42, cap=64, length=32):
+    data = np.full((n, cap), fill, dtype=np.uint8)
+    return PacketBatch(data, np.full(n, length, dtype=np.int32),
+                       np.arange(n, dtype=np.int32))
+
+
+# ------------------------------------------------------ Gilbert–Elliott
+
+def test_ge_long_run_loss_rate_and_burstiness():
+    rng = np.random.default_rng(7)
+    ge = GilbertElliott(p_gb=0.02, p_bg=0.25)       # ~7.4% loss, 4-pkt bursts
+    drops = np.concatenate([ge.losses(1000, rng) for _ in range(50)])
+    rate = drops.mean()
+    assert 0.04 < rate < 0.12, rate
+    # burstiness: mean run length of consecutive losses ~ 1/p_bg = 4,
+    # far above the ~1.07 an independent Bernoulli of the same rate gives
+    edges = np.diff(drops.astype(np.int8))
+    starts = (edges == 1).sum() + int(drops[0])
+    mean_burst = drops.sum() / max(starts, 1)
+    assert mean_burst > 2.0, mean_burst
+
+
+def test_ge_state_persists_across_batches():
+    rng = np.random.default_rng(0)
+    ge = GilbertElliott(p_gb=1.0, p_bg=0.0)     # enters BAD, never leaves
+    assert not ge.losses(1, rng)[0]             # first packet still GOOD
+    assert ge.losses(5, rng).all()              # absorbed in BAD
+    assert ge.losses(5, rng).all()              # ... across batches too
+
+
+def test_ge_validates_probabilities():
+    import pytest
+    with pytest.raises(ValueError):
+        GilbertElliott(p_gb=1.5, p_bg=0.1)
+
+
+# ------------------------------------------------------------- corrupt
+
+def test_corrupt_flips_exactly_one_byte_per_row():
+    eng = FaultInjectionEngine(corrupt=1.0, seed=3)
+    b = _batch(40)
+    out, ok = eng.rtp_transformer.reverse_transform(b)
+    assert ok.all() and eng.corrupted == 40
+    diff = (out.data != 0x42).sum(axis=1)
+    assert (diff == 1).all(), "each corrupted packet flips ONE byte"
+    cols = np.nonzero(out.data != 0x42)[1]
+    assert (cols < np.asarray(out.length)).all(), \
+        "corruption landed past the packet length"
+
+
+def test_zero_length_rows_are_never_corrupted():
+    eng = FaultInjectionEngine(corrupt=1.0, seed=3)
+    data = np.zeros((4, 16), dtype=np.uint8)
+    b = PacketBatch(data, np.zeros(4, dtype=np.int32),
+                    np.zeros(4, dtype=np.int32))
+    out, ok = eng.rtp_transformer.reverse_transform(b)
+    assert ok.all() and (out.data == 0).all()
+
+
+# ------------------------------------------------------------- tx path
+
+def test_tx_disabled_send_path_is_identity():
+    eng = FaultInjectionEngine(loss=1.0, seed=1)     # rx drops everything
+    b = _batch(8)
+    out, ok = eng.rtp_transformer.transform(b)
+    assert ok.all() and out is b and eng.tx_dropped == 0
+
+
+def test_tx_enabled_faults_send_path_with_separate_counters():
+    eng = FaultInjectionEngine(loss=0.5, seed=1, tx=True)
+    b = _batch(200)
+    _, ok_tx = eng.rtp_transformer.transform(b)
+    assert 0 < eng.tx_dropped < 200 and eng.dropped == 0
+    assert int((~ok_tx).sum()) == eng.tx_dropped
+    _, ok_rx = eng.rtp_transformer.reverse_transform(b)
+    assert eng.dropped == int((~ok_rx).sum()) > 0
+
+
+def test_burst_loss_composes_with_bernoulli():
+    eng = FaultInjectionEngine(loss=0.0, seed=5,
+                               burst=(0.05, 0.2))
+    total = 0
+    for _ in range(20):
+        _, ok = eng.rtp_transformer.reverse_transform(_batch(100))
+        total += int((~ok).sum())
+    assert eng.dropped == total > 0
+
+
+def test_same_seed_same_fates():
+    outs = []
+    for _ in range(2):
+        eng = FaultInjectionEngine(loss=0.3, corrupt=0.3, duplicate=0.2,
+                                   reorder=0.2, seed=11, burst=(0.1, 0.3))
+        b = _batch(64)
+        out, ok = eng.rtp_transformer.reverse_transform(b)
+        outs.append((out.data.copy(), np.asarray(out.length).copy(),
+                     ok.copy()))
+    assert np.array_equal(outs[0][0], outs[1][0])
+    assert np.array_equal(outs[0][1], outs[1][1])
+    assert np.array_equal(outs[0][2], outs[1][2])
+
+
+# ------------------------------------------------------------- metrics
+
+def test_fault_counters_render_as_prometheus_counters():
+    eng = FaultInjectionEngine(loss=1.0, seed=2, tx=True)
+    eng.rtp_transformer.reverse_transform(_batch(5))
+    eng.rtp_transformer.transform(_batch(3))
+    reg = MetricsRegistry()
+    eng.register_metrics(reg)
+    txt = reg.render()
+    assert "# TYPE libjitsi_tpu_fault_dropped counter" in txt
+    assert "libjitsi_tpu_fault_dropped 5" in txt
+    assert "libjitsi_tpu_fault_tx_dropped 3" in txt
+    assert "# HELP libjitsi_tpu_fault_tx_corrupted" in txt

@@ -1,0 +1,278 @@
+"""BridgeSupervisor unit tests: watchdog state machine, the overload
+escalation ladder (recv window -> degraded -> shedding) and its
+recovery, sliding-window quarantine with exponential-backoff
+re-admission, checkpoint file versioning, and the health primitives.
+
+All against a dummy bridge — no sockets, no device; the e2e proofs
+live in tests/test_chaos_recovery.py.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.service.supervisor import (BridgeSupervisor, CKPT_MAGIC,
+                                             CKPT_VERSION, SupervisorConfig)
+from libjitsi_tpu.utils.health import (ExponentialBackoff, HEALTHY,
+                                       OVERLOADED, STALLED,
+                                       SlidingWindowCounter, Watchdog,
+                                       retrying)
+from libjitsi_tpu.utils.metrics import MetricsRegistry
+
+CAP = 8
+
+
+class DummyLoop:
+    def __init__(self):
+        self.registry = types.SimpleNamespace(capacity=CAP)
+        self.recv_window_ms = 1
+        self.inbound_drop = np.zeros(CAP, dtype=bool)
+        self.inbound_dropped = np.zeros(CAP, dtype=np.int64)
+        self.inbound_dropped_total = 0
+
+
+class DummyBridge:
+    def __init__(self):
+        self.loop = DummyLoop()
+        self.degraded = False
+        self._ssrc_of = {0: 100, 1: 101, 2: 102, 3: 103}
+        self.rx_table = types.SimpleNamespace(
+            auth_fail=np.zeros(CAP, dtype=np.int64),
+            replay_reject=np.zeros(CAP, dtype=np.int64))
+        self.speaker = types.SimpleNamespace(dominant=0)
+        self.ticked = 0
+
+    def tick(self, now=None):
+        self.ticked += 1
+        return {"rx": 0}
+
+
+class FakeClock:
+    """Scripted tick durations: each supervisor tick reads the clock
+    twice (t0/t1); the second read advances by the next duration."""
+
+    def __init__(self, durations):
+        self.durations = list(durations)
+        self.t = 0.0
+        self.half = False
+
+    def __call__(self):
+        if self.half:
+            self.t += self.durations.pop(0) if self.durations else 0.0
+        self.half = not self.half
+        return self.t
+
+
+def _sup(durations, **cfg_kwargs):
+    cfg = SupervisorConfig(deadline_ms=10.0, **cfg_kwargs)
+    bridge = DummyBridge()
+    return BridgeSupervisor(bridge, cfg,
+                            clock=FakeClock(durations)), bridge
+
+
+# ------------------------------------------------------------- watchdog
+
+def test_watchdog_states_and_counters():
+    wd = Watchdog(0.010, overload_after=3, stall_after=5)
+    assert wd.state == HEALTHY
+    for _ in range(2):
+        assert wd.observe(0.020)
+    assert wd.state == HEALTHY and wd.consecutive == 2
+    wd.observe(0.020)
+    assert wd.state == OVERLOADED
+    for _ in range(2):
+        wd.observe(0.020)
+    assert wd.state == STALLED and wd.overruns == 5
+    assert not wd.observe(0.001)          # one good tick clears the run
+    assert wd.state == HEALTHY and wd.consecutive == 0
+    assert wd.max_consecutive == 5 and wd.worst_s == 0.020
+
+
+def test_supervisor_passes_result_through_and_counts():
+    sup, bridge = _sup([0.001] * 3)
+    assert sup.tick() == {"rx": 0}
+    sup.tick(now=1.0)
+    assert bridge.ticked == 2 and sup.ticks == 2
+    assert sup.health()["state"] == HEALTHY
+
+
+# -------------------------------------------------------------- ladder
+
+def test_escalation_ladder_then_full_recovery():
+    # 12 overrun ticks (escalate every 2), then 30 good ones
+    sup, bridge = _sup([0.05] * 12 + [0.001] * 30,
+                       overload_after=2, overload_exit=3, shed_step=2)
+    for _ in range(12):
+        sup.tick()
+    # rung 1: batching window zeroed; rung 2: degraded; rung 3+: shed
+    assert bridge.loop.recv_window_ms == 0
+    assert bridge.degraded
+    assert sup.level >= 3 and len(sup._shed) > 0
+    assert bridge.loop.inbound_drop[sorted(sup._shed_set)].all()
+    # dominant speaker (sid 0) is never shed
+    assert 0 not in sup._shed_set
+    for _ in range(30):
+        sup.tick()
+    assert sup.level == 0
+    assert not bridge.degraded
+    assert bridge.loop.recv_window_ms == 1          # restored
+    assert not sup._shed and not bridge.loop.inbound_drop.any()
+
+
+def test_shed_is_deterministic_and_priority_ordered():
+    cfg = SupervisorConfig(deadline_ms=10.0, overload_after=1,
+                           shed_step=2)
+    bridge = DummyBridge()
+    sup = BridgeSupervisor(bridge, cfg, priorities={1: 5, 2: 0, 3: 0},
+                           clock=FakeClock([0.05] * 3))
+    sup.tick()          # level 1
+    sup.tick()          # level 2
+    sup.tick()          # level 3: shed 2
+    # lowest priority first, then highest sid: 3 then 2 (1 has prio 5,
+    # 0 is the dominant speaker)
+    assert sup._shed == [3, 2]
+
+
+# ---------------------------------------------------------- quarantine
+
+def test_quarantine_convicts_releases_and_backs_off():
+    cfg = SupervisorConfig(deadline_ms=1000.0, quarantine_window=5,
+                           quarantine_auth_threshold=10,
+                           quarantine_backoff_ticks=4,
+                           quarantine_backoff_cap=8)
+    bridge = DummyBridge()
+    sup = BridgeSupervisor(bridge, cfg)
+    for _ in range(3):
+        bridge.rx_table.auth_fail[2] += 4
+        sup.tick(now=0.0)
+    assert 2 in sup._quarantined and bridge.loop.inbound_drop[2]
+    assert sup.quarantine_total == 1
+    first_release = sup._quarantined[2]
+    assert first_release - sup.ticks <= 4
+    # other streams untouched
+    assert not bridge.loop.inbound_drop[[0, 1, 3]].any()
+    while sup.ticks < first_release:
+        sup.tick(now=0.0)
+    assert 2 not in sup._quarantined and not bridge.loop.inbound_drop[2]
+    # relapse: second conviction's ban is exponentially longer
+    for _ in range(3):
+        bridge.rx_table.auth_fail[2] += 4
+        sup.tick(now=0.0)
+    assert 2 in sup._quarantined
+    assert sup._quarantined[2] - sup.ticks >= 7      # 4 * 2 (minus 1 tick)
+    assert sup.quarantine_total == 2
+
+
+def test_quarantine_threshold_is_windowed_not_lifetime():
+    cfg = SupervisorConfig(deadline_ms=1000.0, quarantine_window=3,
+                           quarantine_auth_threshold=10)
+    bridge = DummyBridge()
+    sup = BridgeSupervisor(bridge, cfg)
+    # 2 failures/tick forever: lifetime total crosses 10 but any
+    # 3-tick window holds only 6 — never quarantined
+    for _ in range(20):
+        bridge.rx_table.auth_fail[1] += 2
+        sup.tick(now=0.0)
+    assert 1 not in sup._quarantined
+
+
+# ------------------------------------------------------------- metrics
+
+def test_supervisor_metrics_render():
+    reg = MetricsRegistry()
+    cfg = SupervisorConfig(deadline_ms=10.0, overload_after=1,
+                           quarantine_window=5,
+                           quarantine_auth_threshold=5)
+    bridge = DummyBridge()
+    sup = BridgeSupervisor(bridge, cfg, metrics=reg,
+                           clock=FakeClock([0.05] * 4))
+    bridge.rx_table.auth_fail[3] += 6
+    for _ in range(4):
+        sup.tick()
+    txt = reg.render()
+    assert "# TYPE libjitsi_tpu_supervisor_ticks_overrun counter" in txt
+    assert "libjitsi_tpu_supervisor_ticks_overrun 4" in txt
+    assert "libjitsi_tpu_supervisor_watchdog_state 1" in txt
+    assert "libjitsi_tpu_supervisor_streams_quarantined 1" in txt
+    assert "libjitsi_tpu_supervisor_quarantine_total 1" in txt
+    assert 'libjitsi_tpu_srtp_auth_fail{stream="3"} 6' in txt
+    assert "# TYPE libjitsi_tpu_srtp_auth_fail counter" in txt
+
+
+# ----------------------------------------------------------- checkpoint
+
+def test_checkpoint_rejects_garbage_and_wrong_version(tmp_path):
+    bad = tmp_path / "bad.ckpt"
+    bad.write_bytes(b"not a checkpoint")
+    with pytest.raises(Exception):
+        BridgeSupervisor.load_checkpoint(str(bad))
+
+    import pickle
+    wrong = tmp_path / "wrong.ckpt"
+    wrong.write_bytes(pickle.dumps({"magic": "other", "version": 1}))
+    with pytest.raises(ValueError, match="not a libjitsi_tpu"):
+        BridgeSupervisor.load_checkpoint(str(wrong))
+    futur = tmp_path / "future.ckpt"
+    futur.write_bytes(pickle.dumps(
+        {"magic": CKPT_MAGIC, "version": CKPT_VERSION + 1}))
+    with pytest.raises(ValueError, match="version"):
+        BridgeSupervisor.load_checkpoint(str(futur))
+
+
+def test_periodic_checkpoint_cadence(tmp_path):
+    path = str(tmp_path / "bridge.ckpt")
+
+    class SnapBridge(DummyBridge):
+        def snapshot(self):
+            return {"hello": 1}
+
+    cfg = SupervisorConfig(deadline_ms=1000.0, checkpoint_every=3,
+                           checkpoint_path=path)
+    sup = BridgeSupervisor(SnapBridge(), cfg)
+    for _ in range(7):
+        sup.tick(now=0.0)
+    assert sup.checkpoints_written == 2
+    blob = BridgeSupervisor.load_checkpoint(path)
+    assert blob["snap"] == {"hello": 1}
+    assert blob["ticks"] == 6 and blob["bridge"] == "SnapBridge"
+
+
+# ------------------------------------------------------ health helpers
+
+def test_sliding_window_counter_expires_old_ticks():
+    win = SlidingWindowCounter(4, window=3)
+    win.push(np.array([5, 0, 0, 0]))
+    win.push(np.array([0, 2, 0, 0]))
+    assert list(win.sums()) == [5, 2, 0, 0]
+    win.push(np.zeros(4, dtype=np.int64))
+    win.push(np.zeros(4, dtype=np.int64))     # row with the 5 rotates out
+    assert list(win.sums()) == [0, 2, 0, 0]
+    win.reset_rows([1])
+    assert list(win.sums()) == [0, 0, 0, 0]
+
+
+def test_exponential_backoff_caps():
+    bo = ExponentialBackoff(4, factor=2.0, cap=10)
+    assert [bo.delay(a) for a in range(4)] == [4, 8, 10, 10]
+
+
+def test_retrying_bounded_and_sleeps_backoff():
+    slept = []
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(98, "in use")
+        return "bound"
+
+    assert retrying(flaky, retries=5, backoff_s=0.01,
+                    sleep=slept.append) == "bound"
+    assert calls["n"] == 3 and slept == [0.01, 0.02]
+
+    def always():
+        raise OSError(98, "in use")
+
+    with pytest.raises(OSError):
+        retrying(always, retries=3, backoff_s=0.01, sleep=slept.append)
