@@ -30,6 +30,11 @@ class SendSideBandwidthEstimation:
         self.remb_cap: Optional[float] = None
         self._last_decrease_ms = -1e18
         self._last_loss_ms = -1e18
+        # smoothed reported loss: the BWE loss signal consumed by the
+        # adaptive FEC sender (sfu/recovery.py) — same RR stream that
+        # drives the loss-based rate moves below
+        self.loss_estimate = 0.0
+        self.last_fraction_lost = 0
         # delay-based estimator over TCC feedback (send times are ours,
         # arrival deltas are the remote's)
         self._delay = RemoteBitrateEstimator(min_bitrate_bps,
@@ -42,6 +47,8 @@ class SendSideBandwidthEstimation:
         """Loss-based update from an RTCP RR (reference:
         SendSideBandwidthEstimation.updateReceiverBlock)."""
         loss = fraction_lost_255 / 255.0
+        self.last_fraction_lost = int(fraction_lost_255) & 0xFF
+        self.loss_estimate += 0.3 * (loss - self.loss_estimate)
         if loss < self.LOW_LOSS:
             # 8% per second, compounded by elapsed time
             dt = min(max(now_ms - self._last_loss_ms, 0.0), 1000.0) \

@@ -40,8 +40,16 @@ class JitterBuffer:
         self._released = False
         self._jitter_s = 0.0
         self._last_transit: Optional[float] = None
+        self._bad_seq: Optional[int] = None
         self.lost = 0
         self.late_dropped = 0
+        self.resets = 0
+
+    #: beyond this mod-2^16 backward distance a packet is no longer a
+    #: plausible reorder — it is either ancient or (indistinguishably,
+    #: since seq_delta folds at +/-32768) a huge forward jump from a
+    #: sender reset.  RFC 3550's MAX_MISORDER.
+    MAX_MISORDER = 100
 
     @property
     def target_delay(self) -> float:
@@ -51,11 +59,32 @@ class JitterBuffer:
     def insert(self, seq: int, rtp_ts: int, payload: bytes,
                now: float) -> None:
         seq &= 0xFFFF
-        if self._next_seq is not None and seq_delta(seq, self._next_seq) < 0:
-            if self._released:
-                self.late_dropped += 1  # already released past this seq
-                return
-            self._next_seq = seq  # window not started: move start back
+        if self._next_seq is not None:
+            d = int(seq_delta(seq, self._next_seq))
+            if -self.MAX_MISORDER <= d < 0:
+                if self._released:
+                    self.late_dropped += 1  # released past this seq
+                    return
+                self._next_seq = seq  # window not started: move start back
+            elif d < 0:
+                # Too far back to be a reorder.  seq_delta cannot tell a
+                # very-late packet from a forward jump > 32768 (sender
+                # reset / seq randomization); before this branch existed
+                # a reset read as "late" forever and the stream stalled
+                # permanently.  RFC 3550 resync: drop the first
+                # out-of-range packet but remember its successor; a
+                # second consecutive one confirms the new seq space.
+                if seq == self._bad_seq:
+                    self.resets += 1
+                    self._buf.clear()
+                    self._next_seq = seq
+                    self._bad_seq = None
+                else:
+                    self._bad_seq = (seq + 1) & 0xFFFF
+                    self.late_dropped += 1
+                    return
+            else:
+                self._bad_seq = None
         transit = now - rtp_ts / self.clock_rate
         if self._last_transit is not None:
             d = abs(transit - self._last_transit)
@@ -89,8 +118,15 @@ class JitterBuffer:
             if now - oldest.arrival <= self.target_delay + \
                     self.frame_ms / 1000.0:
                 return None
-            self.lost += 1
-            self._next_seq = (self._next_seq + 1) & 0xFFFF
+            # Jump straight to the nearest buffered seq (mod-2^16).
+            # Every buffered entry is ahead of _next_seq (insert either
+            # moves the window back or drops/resyncs), so the smallest
+            # forward delta IS the loss run — stepping one seq at a
+            # time both miscounts across 65535->0 and costs O(gap).
+            d, s = min((int(seq_delta(e.seq, self._next_seq)), e.seq)
+                       for e in self._buf.values())
+            self.lost += d
+            self._next_seq = s
         return None
 
     def __len__(self) -> int:

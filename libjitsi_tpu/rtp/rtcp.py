@@ -367,8 +367,19 @@ def build_bye(b: Bye) -> bytes:
 
 
 def build_nack(n: Nack) -> bytes:
-    """Encode lost seqs as PID/BLP pairs (reference: NACKPacket)."""
+    """Encode lost seqs as PID/BLP pairs (reference: NACKPacket).
+
+    Wrap-aware: the PID/BLP packing walks the seqs in *circular* order,
+    anchored just after the largest mod-2^16 gap.  A loss run across
+    65535->0 — numerically [0, 65534, 65535] — packs as one pair
+    (PID=65534, BLP covering 65535 and 0) instead of two, and the PIDs
+    come out in the order the packets were actually sent.
+    """
     seqs = sorted(set(s & 0xFFFF for s in n.lost_seqs))
+    if len(seqs) > 1:
+        gaps = [(seqs[i] - seqs[i - 1]) & 0xFFFF for i in range(len(seqs))]
+        k = gaps.index(max(gaps))         # i=0 wraps to seqs[-1]
+        seqs = seqs[k:] + seqs[:k]
     fci = b""
     i = 0
     while i < len(seqs):

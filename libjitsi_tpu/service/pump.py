@@ -21,7 +21,7 @@ without threads — a server drives thousands of pumps from one loop.
 from __future__ import annotations
 
 import dataclasses
-from typing import Callable, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -222,7 +222,8 @@ class ReceivePump:
     `codec.decode(b"")` handling."""
 
     def __init__(self, stream, codec: FrameCodec,
-                 sink=None, mixer=None, mixer_sid: Optional[int] = None):
+                 sink=None, mixer=None, mixer_sid: Optional[int] = None,
+                 plc: bool = True):
         from libjitsi_tpu.rtp.jitter_buffer import JitterBuffer
 
         self.stream = stream
@@ -230,6 +231,12 @@ class ReceivePump:
         self.sink = sink
         self.mixer = mixer
         self.mixer_sid = mixer_sid
+        # packet-loss concealment: an underrun asks the codec for a
+        # concealment frame (`decode(b"")` — Opus synthesizes one;
+        # codecs without PLC raise or return empty and we fall back to
+        # silence).  The last rung of the NACK->RTX->FEC->PLC ladder.
+        self.plc = plc
+        self.plc_frames = 0
         # ptime is fully determined by the codec (frame_samples at
         # sample_rate); the jitter clock is the RTP media clock, i.e.
         # ts_step RTP units per ptime
@@ -268,7 +275,19 @@ class ReceivePump:
         payload = self.jb.pop(now)
         if payload is None:
             self.lost_frames += 1
-            pcm = np.zeros(self.codec.frame_samples, dtype=np.int16)
+            pcm = None
+            if self.plc and self.decoded_frames > 0:
+                # only conceal mid-stream: before the first decode there
+                # is nothing to extrapolate, silence IS correct
+                try:
+                    pcm = np.asarray(self.codec.decode(b""),
+                                     dtype=np.int16)
+                except (ValueError, RuntimeError, TypeError):
+                    pcm = None
+            if pcm is None or len(pcm) == 0:
+                pcm = np.zeros(self.codec.frame_samples, dtype=np.int16)
+            else:
+                self.plc_frames += 1
         else:
             try:
                 pcm = np.asarray(self.codec.decode(payload),
@@ -310,7 +329,8 @@ class ReceiveBank:
     G711_ULAW, G711_ALAW, STATEFUL = 0, 1, 2
 
     def __init__(self, capacity: int, mixer=None, payload_cap: int = 256,
-                 depth: int = 16, mixer_rate: Optional[int] = None):
+                 depth: int = 16, mixer_rate: Optional[int] = None,
+                 plc: bool = False, plc_max_run: int = 3):
         from libjitsi_tpu.rtp.dense_jitter import DenseJitterBank
 
         self.capacity = capacity
@@ -335,6 +355,16 @@ class ReceiveBank:
         # feeding a truncated frame to a stateful decoder corrupts its
         # state); size payload_cap for the codec/bitrate in use
         self.oversize_dropped = np.zeros(capacity, dtype=np.int64)
+        # packet-loss concealment (opt-in; the ladder's last rung):
+        # an underrun mid-stream repeats the row's last decoded frame
+        # with 6 dB decay per repeat, for at most `plc_max_run` frames
+        # in a row — repeat-with-decay is the codec-agnostic fallback
+        # (G.711 Appendix I posture); silence resumes past the run cap
+        self.plc = plc
+        self.plc_max_run = plc_max_run
+        self.plc_frames = np.zeros(capacity, dtype=np.int64)
+        self._plc_run = np.zeros(capacity, dtype=np.int32)
+        self._last_pcm: Dict[int, np.ndarray] = {}
 
     def add_stream(self, sid: int, codec: FrameCodec) -> None:
         if self.mixer is not None and \
@@ -372,11 +402,15 @@ class ReceiveBank:
         self.decoded_frames[sid] = 0
         self.lost_frames[sid] = 0
         self.decode_errors[sid] = 0
+        self.plc_frames[sid] = 0
+        self._plc_run[sid] = 0
+        self._last_pcm.pop(sid, None)
 
     def remove_stream(self, sid: int) -> None:
         self._kind[sid] = -1
         self._decode.pop(sid, None)
         self.jb.reset_streams([sid])
+        self._last_pcm.pop(sid, None)
 
     # ------------------------------------------------------------- intake
     def push_decrypted(self, batch, ok, now: Optional[float] = None
@@ -428,7 +462,8 @@ class ReceiveBank:
         now = _time.time() if now is None else now
         ready, pays, plens = self.jb.pop_all(now)
         installed = self._kind >= 0
-        self.lost_frames[installed & ~ready] += 1
+        lost = installed & ~ready
+        self.lost_frames[lost] += 1
         out_sids: List[int] = []
         out_pcm: List[np.ndarray] = []
         mix_deposits: List[Tuple[np.ndarray, np.ndarray]] = []
@@ -465,6 +500,25 @@ class ReceiveBank:
                 s_pcm.append(pcm)
             except (ValueError, RuntimeError):
                 self.decode_errors[sid] += 1
+        if self.plc:
+            # per-row work only on LOST rows of an opted-in bank — the
+            # vectorized decode path above stays loop-free
+            self._plc_run[ready] = 0
+            for rows, pcm in mix_deposits:
+                for i, sid in enumerate(rows.tolist()):
+                    self._last_pcm[sid] = pcm[i]
+            for i, sid in enumerate(s_sids):
+                self._last_pcm[sid] = s_pcm[i]
+            for sid in np.nonzero(lost)[0].tolist():
+                last = self._last_pcm.get(sid)
+                if last is None or self._plc_run[sid] >= self.plc_max_run:
+                    continue          # nothing to extrapolate / run over
+                self._plc_run[sid] += 1
+                decay = 0.5 ** int(self._plc_run[sid])
+                pcm = (last.astype(np.float32) * decay).astype(np.int16)
+                self.plc_frames[sid] += 1
+                s_sids.append(sid)
+                s_pcm.append(pcm)
         out_sids.extend(s_sids)
         out_pcm.extend(s_pcm)
         if self.mixer is not None:

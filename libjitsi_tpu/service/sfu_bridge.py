@@ -33,6 +33,7 @@ from libjitsi_tpu.rtp import rtcp
 from libjitsi_tpu.service.media_stream import StreamRegistry
 from libjitsi_tpu.sfu import PacketCache, RtpTranslator
 from libjitsi_tpu.sfu import rtx as rtx_mod
+from libjitsi_tpu.sfu.recovery import RecoveryConfig, RecoveryController
 from libjitsi_tpu.sfu.rtcp_termination import RtcpTermination
 from libjitsi_tpu.sfu.simulcast import SimulcastForwarder
 from libjitsi_tpu.transform.header_ext import AbsSendTimeEngine
@@ -152,7 +153,8 @@ class SfuBridge:
                  kernel_timestamps: bool = False,
                  abs_send_time_ext_id: int = 3,
                  pipelined: bool = False,
-                 mesh=None):
+                 mesh=None,
+                 recovery_config: Optional[RecoveryConfig] = None):
         self.capacity = capacity
         self.profile = profile
         self.ast_ext_id = abs_send_time_ext_id
@@ -184,6 +186,12 @@ class SfuBridge:
                                             profile=profile)
         self.cache = PacketCache()
         self.rtcp_term = RtcpTermination(bridge_ssrc=0x5F0BFF)
+        # end-to-end loss recovery (sfu/recovery.py): uplink gap
+        # detection -> upstream NACKs, budgeted NACK service, adaptive
+        # FEC on egress legs, and the supervisor's shed-FEC-first /
+        # shrink-RTX-second escalation rungs.  Transient (like the
+        # caches): a restored bridge re-learns loss state from traffic.
+        self.recovery = RecoveryController(recovery_config)
         self.loop = MediaLoop(
             UdpEngine(port=port, max_batch=4 * capacity,
                       kernel_timestamps=kernel_timestamps),
@@ -473,9 +481,15 @@ class SfuBridge:
             if rtx_row is None:
                 return False
             key = (sid << 32) | track.out_ssrc
-            copies = track.precache.lookup_nack(key, nack.lost_seqs)
+            copies, missing = track.precache.lookup_nack(
+                key, nack.lost_seqs, return_missing=True)
+            self.recovery.rtx_cache_miss += len(missing)
             if not copies:
                 return True          # ours, but aged out of the cache
+            if not self.recovery.allow_rtx(
+                    sum(len(c) for c in copies), self._now):
+                return True          # over the retransmission budget
+            self.recovery.rtx_requests_served += len(copies)
             b = PacketBatch.from_payloads(copies,
                                           stream=[rtx_row] * len(copies))
             out = rtx_mod.encapsulate_batch(b, track.rtx_ssrc,
@@ -519,7 +533,11 @@ class SfuBridge:
         sub = PacketBatch(dec.data[rows],
                           np.asarray(dec.length)[rows],
                           dec.stream[rows])
-        self._feed_bwe(sub, rows)
+        hdr = rtp_header.parse(sub)
+        # uplink loss detection: gaps in each sender's seq space queue
+        # upstream NACKs (drained toward the sender by emit_feedback)
+        self.recovery.observe_rx(hdr.ssrc, hdr.seq, self._now)
+        self._feed_bwe(sub, rows, hdr=hdr)
         # stamp the bridge's own abs-send-time before the fan-out so
         # every receiver leg can run receive-side GCC on its downlink
         sub, _ = self._ast.rtp_transformer.transform(sub)
@@ -576,21 +594,39 @@ class SfuBridge:
         # fan-out, and two senders' seq ranges must never collide in
         # one leg's cache
         hdr = rtp_header.parse(wire)
+        copies = [wire.to_bytes(i) for i in range(wire.batch_size)]
         self.cache.insert_batch(
             (recv.astype(np.int64) << 32) | hdr.ssrc.astype(np.int64),
-            hdr.seq,
-            [wire.to_bytes(i) for i in range(wire.batch_size)],
-            now=self._now)
+            hdr.seq, copies, now=self._now)
         sent = self.loop.engine.send_batch(
             wire, self.loop.addr_ip[recv], self.loop.addr_port[recv])
         self.forwarded += sent
+        # adaptive FEC over the PROTECTED per-leg copies: XOR of SRTP
+        # ciphertexts is opaque, and a recovered packet still passes the
+        # receiver's normal SRTP auth — FEC adds redundancy, never an
+        # injection surface.  One FEC stream per (leg, sender ssrc).
+        if self.recovery.fec_active():
+            fec_out, fec_addr = [], []
+            for j, pkt in enumerate(copies):
+                fec = self.recovery.fec_protect(int(recv[j]),
+                                                int(hdr.ssrc[j]), pkt)
+                if fec is not None:
+                    fec_out.append(fec)
+                    fec_addr.append(int(recv[j]))
+            if fec_out:
+                fa = np.asarray(fec_addr, dtype=np.int64)
+                self.loop.engine.send_batch(
+                    PacketBatch.from_payloads(fec_out),
+                    self.loop.addr_ip[fa], self.loop.addr_port[fa])
 
-    def _feed_bwe(self, sub: PacketBatch, rows: np.ndarray) -> None:
+    def _feed_bwe(self, sub: PacketBatch, rows: np.ndarray,
+                  hdr=None) -> None:
         """Drive the bridge's receive-side GCC from the senders'
         abs-send-time stamps.  Arrival times prefer the engine's kernel
         rx stamps (row-aligned via MediaLoop.last_rtp_arrival_ns);
         without them, the tick's host clock."""
-        hdr = rtp_header.parse(sub)
+        if hdr is None:
+            hdr = rtp_header.parse(sub)
         off, dlen, found = rtp_ext.find_one_byte_ext(sub, hdr,
                                                      self.ast_ext_id)
         f = np.nonzero(found & (dlen == 3))[0]
@@ -640,16 +676,28 @@ class SfuBridge:
                     # receiver's downlink estimate drives its simulcast
                     # layer selection
                     self._recv_bw[sid] = float(p.bitrate_bps)
+                elif isinstance(p, (rtcp.ReceiverReport,
+                                    rtcp.SenderReport)):
+                    # reported downlink loss drives the FEC ratio
+                    for rb in p.reports:
+                        self.recovery.on_receiver_report(
+                            rb.fraction_lost)
 
     def _serve_nack(self, sid: int, nack: "rtcp.Nack") -> None:
         key = (sid << 32) | (nack.media_ssrc & 0xFFFFFFFF)
-        copies = self.cache.lookup_nack(key, nack.lost_seqs)
+        copies, missing = self.cache.lookup_nack(key, nack.lost_seqs,
+                                                 return_missing=True)
+        self.recovery.rtx_cache_miss += len(missing)
         if not copies:
             return
+        if not self.recovery.allow_rtx(sum(len(c) for c in copies),
+                                       self._now):
+            return      # over the retransmission-bandwidth budget
         out = PacketBatch.from_payloads(copies)
         sent = self.loop.engine.send_batch(
             out, self.loop.addr_ip[sid], self.loop.addr_port[sid])
         self.retransmitted += sent
+        self.recovery.rtx_requests_served += len(copies)
         _log.debug("nack_served", sid=sid, lost=len(nack.lost_seqs),
                    sent=sent)
 
@@ -669,6 +717,12 @@ class SfuBridge:
         # (AIMD increase in normal state, beta-cut on overuse)
         if self._bwe_fed.any():
             self.bwe.update_estimate(now * 1000.0)
+        # bridge-detected uplink losses (budgeted, held off, deduped by
+        # the NackScheduler) merge into the same termination window as
+        # receiver-relayed NACKs
+        for ssrc, seqs in self.recovery.collect_upstream_nacks(
+                now).items():
+            self.rtcp_term.queue_nack(ssrc, seqs)
         if self._video:
             self._select_video_layers()
         for sid, ssrc in list(self._ssrc_of.items()):

@@ -16,9 +16,13 @@ recovery are in scope (SURVEY §5 robustness gap).  One
    level 1 shrinks the recv batching window to 0 (poll, don't wait),
    level 2 sets `bridge.degraded` (skips speaker scoring / egress level
    stamping / RTCP report generation — work whose absence degrades UX,
-   not correctness), level 3+ sheds the lowest-priority streams
-   deterministically.  Recovery walks the same ladder back down once
-   ticks meet the deadline again, restoring shed streams LIFO.
+   not correctness).  On a bridge with a loss-recovery controller
+   (`bridge.recovery`, sfu/recovery.py) two more rungs precede stream
+   loss: level 3 sheds FEC redundancy, level 4 shrinks the
+   retransmission budget; only then (level 5+, or 3+ without a
+   controller) are the lowest-priority streams shed deterministically.
+   Recovery walks the same ladder back down once ticks meet the
+   deadline again, restoring shed streams LIFO.
 
 3. **Stream quarantine** — per-stream sliding windows over the SRTP
    auth-failure and replay-rejection counters.  A stream exceeding the
@@ -152,6 +156,7 @@ class BridgeSupervisor:
 
     def _escalate(self) -> None:
         self.level += 1
+        rec = getattr(self.bridge, "recovery", None)
         if self.level == 1:
             # stop waiting for packets: the batching window is latency
             # the tick can't afford while behind
@@ -160,15 +165,29 @@ class BridgeSupervisor:
                 self.loop.recv_window_ms = 0
         elif self.level == 2:
             self.bridge.degraded = True
+        elif rec is not None and self.level == 3:
+            # loss-recovery coupling: FEC overhead is the first
+            # bandwidth/CPU to go — redundancy sheds before media
+            rec.shed_fec(True)
+        elif rec is not None and self.level == 4:
+            # then the retransmission budget shrinks...
+            rec.throttle_rtx(True)
         else:
+            # ...and only then are whole streams dropped
             self._shed_streams(self.cfg.shed_step)
 
     def _deescalate(self) -> None:
-        if self.level >= 3 and self._shed:
+        rec = getattr(self.bridge, "recovery", None)
+        shed_floor = 5 if rec is not None else 3
+        if self.level >= shed_floor and self._shed:
             for _ in range(min(self.cfg.shed_step, len(self._shed))):
                 sid = self._shed.pop()
                 self._shed_set.discard(sid)
             self._sync_drop_mask()
+        elif rec is not None and self.level == 4:
+            rec.throttle_rtx(False)
+        elif rec is not None and self.level == 3:
+            rec.shed_fec(False)
         elif self.level == 2:
             self.bridge.degraded = False
         elif self.level == 1 and self._saved_window is not None:
@@ -341,6 +360,15 @@ class BridgeSupervisor:
             registry.register_array(
                 "srtp_replay_reject", table.replay_reject,
                 help_="SRTP replay-window rejections", kind="counter")
+        rec = getattr(self.bridge, "recovery", None)
+        if rec is not None:
+            rec.register_metrics(registry)
+        bank = getattr(self.bridge, "bank", None)
+        if bank is not None and hasattr(bank, "plc_frames"):
+            registry.register_array(
+                "plc_frames", bank.plc_frames,
+                help_="frames concealed by packet-loss concealment",
+                kind="counter")
 
     def health(self) -> dict:
         """Liveness summary for probes / logs."""

@@ -48,13 +48,36 @@ class PacketCache:
         e = self._store.get((int(ssrc), seq & 0xFFFF))
         return e[1] if e is not None else None
 
-    def lookup_nack(self, ssrc: int, lost_seqs: Sequence[int]) -> List[bytes]:
-        """Packets available for retransmission out of a NACK's list."""
-        out = []
-        for s in lost_seqs:
+    def lookup_nack(self, ssrc: int, lost_seqs: Sequence[int],
+                    return_missing: bool = False):
+        """Packets available for retransmission out of a NACK's list.
+
+        Deduplicates and serves in *circular* seq order: a NACK whose
+        list straddles 65535->0 parses (sorted numerically) as e.g.
+        [0, 1, 65534, 65535] — a plain sort would retransmit the wrap
+        side first and re-scramble the very packets the receiver is
+        trying to repair.  The serve order is anchored just after the
+        largest mod-2^16 gap between the requested seqs, which is
+        where the circular sequence "starts".
+
+        With `return_missing=True` returns `(packets, missing_seqs)` so
+        the caller can count cache misses.
+        """
+        ss = sorted({int(s) & 0xFFFF for s in lost_seqs})
+        if len(ss) > 1:
+            gaps = [(ss[i] - ss[i - 1]) & 0xFFFF for i in range(len(ss))]
+            k = gaps.index(max(gaps))     # i=0 wraps to ss[-1]
+            ss = ss[k:] + ss[:k]
+        out: List[bytes] = []
+        missing: List[int] = []
+        for s in ss:
             p = self.get(ssrc, s)
             if p is not None:
                 out.append(p)
+            else:
+                missing.append(s)
+        if return_missing:
+            return out, missing
         return out
 
     def _evict(self, now: float) -> None:

@@ -52,6 +52,15 @@ class RtcpTermination:
             elif isinstance(p, (rtcp.Pli, rtcp.Fir)):
                 self._pli_pending.add(p.media_ssrc)
 
+    def queue_nack(self, media_ssrc: int, seqs) -> None:
+        """Queue bridge-originated lost seqs (the RecoveryController's
+        uplink gap detection) for the next feedback round toward the
+        sender.  Merges with receiver-relayed NACKs — the aggregation
+        window dedups either source."""
+        if seqs:
+            self._nacks.setdefault(media_ssrc & 0xFFFFFFFF, set()).update(
+                int(s) & 0xFFFF for s in seqs)
+
     # ------------------------------------------------------------- output
     def make_sender_feedback(self, media_ssrc: int,
                              now: Optional[float] = None,

@@ -66,6 +66,27 @@ class MetricsRegistry:
                         help_: str = "", kind: str = "gauge") -> None:
         self._scalars[name] = (fn, help_, kind)
 
+    def register_counters(self, obj, names, prefix: str = "",
+                          kind: str = "counter") -> None:
+        """Register monotonic int attributes of `obj` as counters.
+
+        `names` is an iterable of attribute names, or of
+        (attribute, help) pairs.  Each becomes a scalar
+        `{prefix}_{attr}` reading the attribute live — the idiom for
+        the recovery ladder's Python-side counters (`nacks_sent`,
+        `rtx_cache_miss`, ...), which are plain ints rather than the
+        data path's dense arrays.
+        """
+        for entry in names:
+            if isinstance(entry, str):
+                attr, help_ = entry, ""
+            else:
+                attr, help_ = entry
+            name = f"{prefix}_{attr}" if prefix else attr
+            self.register_scalar(
+                name, (lambda o=obj, a=attr: getattr(o, a)),
+                help_=help_, kind=kind)
+
     def timing(self, name: str) -> TimingRing:
         if name not in self.timings:
             self.timings[name] = TimingRing()

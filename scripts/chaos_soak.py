@@ -91,9 +91,16 @@ def _install_faults(bridge, faults):
 
 def run_soak(ticks=120, participants=3, loss=0.05, corrupt=0.03,
              reorder=0.1, duplicate=0.02, burst=(0.02, 0.25),
-             kill_frac=0.5, seed=0, ckpt_path=None, verbose=True):
+             kill_frac=0.5, seed=0, ckpt_path=None, verbose=True,
+             plc=True, residual_bound=0.5):
     """Run the soak; returns the invariant report dict (all `ok_*`
-    entries must be True)."""
+    entries must be True).
+
+    Loss-recovery invariant: with PLC enabled, the fraction of lost
+    frames left UNCONCEALED must stay under `residual_bound` — under
+    Gilbert-Elliott burst loss the concealment ladder (repeat-with-
+    decay, capped run length) has to absorb the short bursts even
+    though it cannot absorb the long ones."""
     libjitsi_tpu.stop()
     libjitsi_tpu.init()
     cfg = libjitsi_tpu.configuration_service()
@@ -110,13 +117,13 @@ def run_soak(ticks=120, participants=3, loss=0.05, corrupt=0.03,
     def build(restore_snap_path=None):
         if restore_snap_path is None:
             bridge = ConferenceBridge(cfg, port=0, capacity=16,
-                                      recv_window_ms=0)
+                                      recv_window_ms=0, plc=plc)
             sup = BridgeSupervisor(bridge, scfg, metrics=metrics)
         else:
             sup = BridgeSupervisor.recover(
                 cfg, restore_snap_path, ConferenceBridge, port=0,
                 supervisor_config=scfg, metrics=metrics,
-                recv_window_ms=0)
+                recv_window_ms=0, plc=plc)
             bridge = sup.bridge
         faults = FaultInjectionEngine(loss=loss, corrupt=corrupt,
                                       reorder=reorder,
@@ -134,6 +141,8 @@ def run_soak(ticks=120, participants=3, loss=0.05, corrupt=0.03,
 
     kill_at = int(ticks * kill_frac)
     decoded_at_kill = None
+    lost_pre_kill = 0
+    plc_pre_kill = 0
     # decoded_frames is a per-process ReceiveBank stat (the jitter
     # bank inside is what the checkpoint carries), so the restored
     # bridge counts from zero — baseline it right after the rebuild
@@ -146,6 +155,8 @@ def run_soak(ticks=120, participants=3, loss=0.05, corrupt=0.03,
         if t == kill_at:
             sup.save_checkpoint()
             decoded_at_kill = bridge.bank.decoded_frames.copy()
+            lost_pre_kill = int(bridge.bank.lost_frames.sum())
+            plc_pre_kill = int(bridge.bank.plc_frames.sum())
             fault_dropped += faults.dropped + faults.tx_dropped
             bridge.close()                      # the crash
             bridge, sup, faults = build(restore_snap_path=ckpt_path)
@@ -178,6 +189,14 @@ def run_soak(ticks=120, participants=3, loss=0.05, corrupt=0.03,
     replay_after = int(np.sum(bridge.rx_table.replay_reject))
 
     sids = list(range(participants))
+    # --- loss-recovery accounting (both bridge lives): a lost frame
+    # the PLC concealed is recovered UX-wise; what remains unconcealed
+    # is the residual the recovery ladder failed to absorb
+    lost_total = lost_pre_kill + int(bridge.bank.lost_frames.sum())
+    plc_total = plc_pre_kill + int(bridge.bank.plc_frames.sum())
+    residual = ((lost_total - plc_total) / lost_total
+                if lost_total else 0.0)
+    any_loss = loss > 0 or corrupt > 0 or burst is not None
     report = {
         "ticks": ticks,
         "wall_s": round(time.perf_counter() - t0, 3),
@@ -196,6 +215,13 @@ def run_soak(ticks=120, participants=3, loss=0.05, corrupt=0.03,
             (decoded_end[sids] > decoded_restore_base[sids]).all()),
         "ok_replay_rejected": replay_after > replay_before,
         "ok_faults_injected": fault_dropped > 0,
+        "lost_frames": lost_total,
+        "plc_frames": plc_total,
+        "residual_loss_ratio": round(residual, 4),
+        "ok_plc_engaged": (not plc) or (not any_loss)
+        or plc_total > 0,
+        "ok_residual_loss_bounded": (not plc) or (not any_loss)
+        or residual <= residual_bound,
     }
     for leg in legs:
         leg.close()
@@ -225,6 +251,10 @@ def main():
                     help="fraction of the run at which to crash+recover")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--ckpt", type=str, default=None)
+    ap.add_argument("--no-plc", action="store_true",
+                    help="disable packet-loss concealment in the bank")
+    ap.add_argument("--residual-bound", type=float, default=0.5,
+                    help="max unconcealed fraction of lost frames")
     args = ap.parse_args()
     burst = (tuple(float(x) for x in args.burst.split(","))
              if args.burst else None)
@@ -232,7 +262,9 @@ def main():
                       loss=args.loss, corrupt=args.corrupt,
                       reorder=args.reorder, duplicate=args.duplicate,
                       burst=burst, kill_frac=args.kill_frac,
-                      seed=args.seed, ckpt_path=args.ckpt)
+                      seed=args.seed, ckpt_path=args.ckpt,
+                      plc=not args.no_plc,
+                      residual_bound=args.residual_bound)
     failed = [k for k, v in report.items()
               if k.startswith("ok_") and not v]
     if failed:
