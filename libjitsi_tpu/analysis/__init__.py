@@ -1,0 +1,20 @@
+"""jitlint — repo-native static analysis for the failure classes this
+codebase has actually hit (see ISSUE 3 / README "Static analysis").
+
+Four rules, one shared AST visitor core, a per-file parallel driver,
+`# jitlint: disable=<rule>` pragmas and a committed baseline for
+grandfathered findings:
+
+- ``hotpath-purity``  — host syncs / tracer-dependent Python control
+  flow / shape-unstable ops inside ``@jax.jit`` functions.
+- ``secret-taint``    — secret-dependent branches and Python-level
+  table indexing in ``kernels/`` and ``transform/srtp/``.
+- ``rtp-mod16``       — raw arithmetic/comparison on 16-bit RTP
+  seq/roc values outside ``core/rtp_math.py`` helpers.
+- ``drift``           — counters incremented but never registered with
+  ``MetricsRegistry`` (and dangling registrations), and
+  ``ArraySnapshotMixin`` array state missing from ``_SNAP_FIELDS``.
+"""
+
+from libjitsi_tpu.analysis.core import Finding, FileContext  # noqa: F401
+from libjitsi_tpu.analysis.driver import run_lint            # noqa: F401

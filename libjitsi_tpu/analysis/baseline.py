@@ -1,0 +1,55 @@
+"""Committed baseline for grandfathered findings.
+
+Every entry carries a one-line justification — the gate enforces zero
+NEW findings, while documented pre-existing ones (e.g. the table AES
+core's by-design gathers) stay visible in the file instead of silently
+pragma'd away.  Keys are content-based (`Finding.content_key`), so
+unrelated edits that shift line numbers do not invalidate the baseline;
+editing the flagged line itself DOES (the finding re-fires and must be
+re-justified or fixed — that is the point).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Tuple
+
+from libjitsi_tpu.analysis.core import Finding
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__), "baseline.json")
+
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> Dict[str, str]:
+    """{content_key: justification}; missing file = empty baseline."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as fh:
+        data = json.load(fh)
+    entries = data["entries"] if isinstance(data, dict) else data
+    return {e["key"]: e.get("why", "") for e in entries}
+
+
+def save_baseline(findings: List[Finding], path: str = DEFAULT_BASELINE,
+                  why: str = "grandfathered at baseline creation") -> None:
+    entries = [{"key": f.content_key, "why": why,
+                "rule": f.rule, "path": f.path, "line": f.line,
+                "snippet": f.snippet}
+               for f in sorted(findings,
+                               key=lambda f: (f.path, f.line, f.rule))]
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump({"entries": entries}, fh, indent=1, sort_keys=False)
+        fh.write("\n")
+
+
+def split_by_baseline(findings: List[Finding],
+                      baseline: Dict[str, str]
+                      ) -> Tuple[List[Finding], List[Finding], List[str]]:
+    """(new, grandfathered, stale_keys).  Stale keys are baseline
+    entries whose finding no longer fires — kept visible so the
+    baseline shrinks as code heals instead of accreting forever."""
+    fired = {f.content_key for f in findings}
+    new = [f for f in findings if f.content_key not in baseline]
+    old = [f for f in findings if f.content_key in baseline]
+    stale = sorted(k for k in baseline if k not in fired)
+    return new, old, stale

@@ -1,0 +1,24 @@
+"""Checker registry.  Per-file checkers run in the parallel driver;
+global checkers run once over the whole parsed index (cross-file
+facts: metric registrations vs counter definitions)."""
+
+from libjitsi_tpu.analysis.checkers.drift import (check_snapshot_drift,
+                                                  check_metrics_drift)
+from libjitsi_tpu.analysis.checkers.hotpath import check_hotpath_purity
+from libjitsi_tpu.analysis.checkers.rtpmod16 import check_rtp_mod16
+from libjitsi_tpu.analysis.checkers.secrets import check_secret_taint
+
+#: checker(ctx) -> [Finding]
+PER_FILE_CHECKERS = (
+    check_hotpath_purity,
+    check_secret_taint,
+    check_rtp_mod16,
+    check_snapshot_drift,
+)
+
+#: checker({relpath: ctx}) -> [Finding]
+GLOBAL_CHECKERS = (
+    check_metrics_drift,
+)
+
+RULES = ("hotpath-purity", "secret-taint", "rtp-mod16", "drift")

@@ -1,0 +1,231 @@
+"""drift: metrics/checkpoint coverage drift.
+
+Two halves, one rule name:
+
+**Metrics drift** (global, cross-file): a class that exports SOME of
+its counters through ``MetricsRegistry`` but silently grew another
+counter nobody registered is invisible in production — the exact
+failure the recovery-ladder counters guard against.  We collect every
+attribute name mentioned in ``register_counters(obj, [...])`` lists
+and every ``lambda: obj.attr`` body inside ``register_scalar`` calls;
+then for each class whose counters are *partially* covered we flag the
+uncovered counter attributes.  Vice versa, a registered attribute that
+no class ever defines is a typo that renders as a permanent ``0``
+metric — also flagged.  Classes with NO registered counters are out of
+scope (internal helpers have no exporter contract).
+
+**Snapshot drift** (per-file): subclasses of ``ArraySnapshotMixin``
+must list every mutable array field in ``_SNAP_FIELDS`` (or carry it
+via the scalar hooks) — a field missing from the snapshot restores
+stale zeros after a crash-recover, the bug class
+``test_checkpoint_roundtrip`` hunts one class at a time.  We flag
+array-valued ``self.X = np.zeros/...`` fields of mixin subclasses
+missing from both ``_SNAP_FIELDS`` and the scalar-hook sources, and
+``_SNAP_FIELDS`` entries with no matching array assignment.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from libjitsi_tpu.analysis.core import (FileContext, Finding,
+                                        call_func_name, node_name)
+
+RULE = "drift"
+
+COUNTER_NAME_RE = re.compile(
+    r"(_count|_counts|_frames|_errors|_dropped|_drops|_sent|_served|"
+    r"_miss|_misses|_recovered|_rejects|_rejected|_fail|_fails|"
+    r"_abandoned|_suppressed|_late|_switches|_restarts|_evicted|"
+    r"_expired|_total)$|^(dropped|lost|forwarded|switches|recovered)")
+
+ARRAY_CTORS = {"zeros", "full", "empty", "ones", "array", "tile",
+               "arange", "copy"}
+
+
+# ------------------------------------------------------------ snapshot half
+
+def check_snapshot_drift(ctx: FileContext) -> List[Finding]:
+    findings: List[Optional[Finding]] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        bases = {node_name(b) for b in node.bases}
+        if "ArraySnapshotMixin" not in bases:
+            continue
+        findings.extend(_check_snapshot_class(ctx, node))
+    return [f for f in findings if f is not None]
+
+
+def _check_snapshot_class(ctx: FileContext, cls: ast.ClassDef
+                          ) -> List[Optional[Finding]]:
+    snap_fields: Set[str] = set()
+    snap_fields_node: Optional[ast.AST] = None
+    scalar_hook_names: Set[str] = set()
+    array_fields: Dict[str, ast.AST] = {}
+
+    for item in cls.body:
+        if isinstance(item, ast.Assign):
+            for tgt in item.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == "_SNAP_FIELDS":
+                    snap_fields_node = item
+                    for n in ast.walk(item.value):
+                        if isinstance(n, ast.Constant) and \
+                                isinstance(n.value, str):
+                            snap_fields.add(n.value)
+        elif isinstance(item, ast.FunctionDef):
+            if item.name in ("_snap_scalars", "_restore_kwargs",
+                             "snapshot", "restore"):
+                for n in ast.walk(item):
+                    if isinstance(n, ast.Constant) and \
+                            isinstance(n.value, str):
+                        scalar_hook_names.add(n.value)
+                    name = node_name(n)
+                    if name:
+                        scalar_hook_names.add(name)
+            if item.name == "__init__":
+                for n in ast.walk(item):
+                    if isinstance(n, ast.Assign) and \
+                            _is_array_ctor(n.value):
+                        for tgt in n.targets:
+                            if isinstance(tgt, ast.Attribute) and \
+                                    isinstance(tgt.value, ast.Name) and \
+                                    tgt.value.id == "self":
+                                array_fields[tgt.attr] = n
+
+    out: List[Optional[Finding]] = []
+    for field, node in sorted(array_fields.items()):
+        if field not in snap_fields and field not in scalar_hook_names:
+            out.append(ctx.finding(
+                RULE, node,
+                f"array field `{field}` of ArraySnapshotMixin subclass "
+                f"`{cls.name}` is missing from _SNAP_FIELDS (restores "
+                "as stale zeros after crash-recover)"))
+    for field in sorted(snap_fields):
+        if field not in array_fields:
+            out.append(ctx.finding(
+                RULE, snap_fields_node or cls,
+                f"_SNAP_FIELDS entry `{field}` of `{cls.name}` has no "
+                "matching array assignment in __init__ (snapshot() "
+                "will AttributeError or copy a non-array)"))
+    return out
+
+
+def _is_array_ctor(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr in ARRAY_CTORS and \
+                node_name(fn.value) in ("np", "numpy", "jnp"):
+            return True
+        # x.copy() / np.asarray(...).astype(...)
+        if isinstance(fn, ast.Attribute) and fn.attr in ("copy", "astype"):
+            return _is_array_ctor(fn.value) or True
+    return False
+
+
+# ------------------------------------------------------------- metrics half
+
+def _registered_attrs(ctx: FileContext) -> Set[str]:
+    """Attribute names exported through MetricsRegistry in this file."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = call_func_name(node)
+        if fname == "register_counters" and len(node.args) >= 2:
+            for n in ast.walk(node.args[1]):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    # pairs are (attr, help): help texts contain spaces,
+                    # attribute names never do
+                    if " " not in n.value:
+                        out.add(n.value)
+        elif fname in ("register_scalar", "register_array"):
+            # the reading closure names the attribute: lambda: self.x
+            for n in ast.walk(node):
+                if isinstance(n, ast.Lambda):
+                    for leaf in ast.walk(n.body):
+                        if isinstance(leaf, ast.Attribute):
+                            out.add(leaf.attr)
+                elif isinstance(n, ast.Attribute):
+                    out.add(n.attr)
+    return out
+
+
+def _class_counters(ctx: FileContext) -> List[Tuple[str, str, ast.AST,
+                                                    Set[str]]]:
+    """(class, file, node, counter-attrs) for every class that both
+    initializes integer counters and increments them."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        zeroed: Dict[str, ast.AST] = {}
+        bumped: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Assign) and \
+                    isinstance(n.value, ast.Constant) and \
+                    n.value.value == 0 and \
+                    not isinstance(n.value.value, bool):
+                for tgt in n.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        zeroed[tgt.attr] = n
+            elif isinstance(n, ast.AugAssign) and \
+                    isinstance(n.op, ast.Add) and \
+                    isinstance(n.target, ast.Attribute) and \
+                    isinstance(n.target.value, ast.Name) and \
+                    n.target.value.id == "self":
+                bumped.add(n.target.attr)
+        counters = {a for a in zeroed if a in bumped
+                    and COUNTER_NAME_RE.search(a)}
+        if counters:
+            out.append((node.name, ctx.relpath, node, counters))
+    return out
+
+
+def check_metrics_drift(index: Dict[str, FileContext]) -> List[Finding]:
+    registered: Set[str] = set()
+    for ctx in index.values():
+        registered |= _registered_attrs(ctx)
+
+    findings: List[Optional[Finding]] = []
+    all_counter_attrs: Set[str] = set()
+    all_attr_names: Set[str] = set()
+    for ctx in index.values():
+        for n in ast.walk(ctx.tree):
+            if isinstance(n, ast.Attribute):
+                all_attr_names.add(n.attr)
+        for cls_name, _rel, node, counters in _class_counters(ctx):
+            all_counter_attrs |= counters
+            covered = counters & registered
+            missing = counters - registered
+            if covered and missing:
+                for attr in sorted(missing):
+                    findings.append(ctx.finding(
+                        RULE, node,
+                        f"counter `{cls_name}.{attr}` is incremented "
+                        "but never registered with MetricsRegistry "
+                        "while sibling counters "
+                        f"({', '.join(sorted(covered)[:3])}) are — "
+                        "invisible in production"))
+
+    # vice versa: registered attribute names that exist nowhere
+    for ctx in index.values():
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call) and
+                    call_func_name(node) == "register_counters" and
+                    len(node.args) >= 2):
+                continue
+            for n in ast.walk(node.args[1]):
+                if isinstance(n, ast.Constant) and \
+                        isinstance(n.value, str) and " " not in n.value \
+                        and n.value not in all_attr_names:
+                    findings.append(ctx.finding(
+                        RULE, n,
+                        f"register_counters names `{n.value}` but no "
+                        "class defines that attribute (typo -> "
+                        "AttributeError at scrape time)"))
+    return [f for f in findings if f is not None]

@@ -1,0 +1,194 @@
+"""hotpath-purity: host syncs and tracer-dependent Python control flow
+inside jitted functions.
+
+One `.item()` (or `int()` on a traced array) inside a `@jax.jit` body
+re-introduces a ~100 ms device->host sync per batch — the exact
+regression class the scalar-fetch-floor work in bench.py measures.  A
+Python `if`/`while` on a tracer either crashes at trace time (caught by
+tests only if that branch is exercised) or, worse, silently bakes one
+side into the compiled program.  `np.asarray` on a traced value forces
+materialization.  Data-dependent-shape ops (`nonzero`/`unique` without
+`size=`) retrace or fail on TPU.
+
+Jit scopes found:
+- decorators: ``@jax.jit``, ``@jit``, ``@functools.partial(jax.jit,
+  static_argnames=(...))``, ``@partial(jit, ...)``
+- call-wrapped local functions: ``jax.jit(fn)`` / ``jax.jit(
+  jax.shard_map(fn, ...))`` where ``fn`` is a def in the same module.
+
+`static_argnames` parameters are exempt from taint (they are Python
+values at trace time); `x is None` tests are pytree-structure checks
+and legal.  `lax.cond`/`jnp.where`/`lax.select` are calls, not Python
+branches, and never fire.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from libjitsi_tpu.analysis.core import (FileContext, Finding, call_func_name,
+                                        is_none_check, names_in, node_name,
+                                        propagate_taint, tainted_leaves)
+
+RULE = "hotpath-purity"
+
+#: methods that synchronously pull device data to the host
+SYNC_METHODS = {"item", "tolist", "to_py", "block_until_ready"}
+#: shape-unstable calls that retrace or fail under jit without size=
+SHAPE_UNSTABLE = {"nonzero", "unique", "flatnonzero", "argwhere", "where"}
+HOST_CASTS = {"int", "float", "bool", "complex"}
+HOST_ARRAY = {"asarray", "array"}   # flagged when the module is numpy's
+
+
+def _decorator_jit_info(dec: ast.AST) -> Optional[Set[str]]:
+    """Returns static_argnames when `dec` marks a jit function, else None."""
+    name = node_name(dec) if not isinstance(dec, ast.Call) else None
+    if name in {"jit"}:
+        return set()
+    if isinstance(dec, ast.Attribute) and dec.attr == "jit":
+        return set()
+    if isinstance(dec, ast.Call):
+        fn = call_func_name(dec)
+        if fn == "jit":
+            return _static_argnames(dec)
+        if fn == "partial" and dec.args:
+            inner = node_name(dec.args[0])
+            if inner == "jit":
+                return _static_argnames(dec)
+    return None
+
+
+def _static_argnames(call: ast.Call) -> Set[str]:
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnames", "static_argnums"):
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    out.add(n.value)
+    return out
+
+
+def _call_wrapped_jit_names(tree: ast.AST) -> Set[str]:
+    """Function names passed (possibly nested) into a jax.jit(...) call."""
+    wrapped: Set[str] = set()
+
+    def collect(node: ast.AST) -> None:
+        if isinstance(node, ast.Name):
+            wrapped.add(node.id)
+        elif isinstance(node, ast.Call):
+            for a in node.args:
+                collect(a)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and call_func_name(node) == "jit":
+            for a in node.args:
+                collect(a)
+    return wrapped
+
+
+def _function_params(fn: ast.FunctionDef) -> List[str]:
+    a = fn.args
+    params = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+    if a.vararg:
+        params.append(a.vararg.arg)
+    if a.kwarg:
+        params.append(a.kwarg.arg)
+    return params
+
+
+def check_hotpath_purity(ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    wrapped = _call_wrapped_jit_names(ctx.tree)
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        static: Optional[Set[str]] = None
+        for dec in node.decorator_list:
+            info = _decorator_jit_info(dec)
+            if info is not None:
+                static = info
+                break
+        if static is None and node.name in wrapped:
+            static = set()
+        if static is None:
+            continue
+        findings.extend(_check_jit_body(ctx, node, static))
+    return [f for f in findings if f is not None]
+
+
+def _check_jit_body(ctx: FileContext, fn: ast.FunctionDef,
+                    static: Set[str]) -> List[Optional[Finding]]:
+    tainted = set(_function_params(fn)) - static - {"self", "cls"}
+    tainted = propagate_taint(fn.body, tainted)
+    out: List[Optional[Finding]] = []
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            fname = call_func_name(node)
+            # host syncs: x.item(), x.tolist(), ...
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in SYNC_METHODS \
+                    and names_in(node.func.value) & tainted:
+                out.append(ctx.finding(
+                    RULE, node,
+                    f"`.{node.func.attr}()` on a traced value inside "
+                    f"jitted `{fn.name}` forces a device->host sync"))
+            # int()/float()/bool() on traced values
+            elif fname in HOST_CASTS and node.args and \
+                    tainted_leaves(node.args[0], tainted):
+                out.append(ctx.finding(
+                    RULE, node,
+                    f"`{fname}()` on a traced value inside jitted "
+                    f"`{fn.name}` forces a device->host sync (use "
+                    "lax/jnp ops or hoist to the caller)"))
+            # np.asarray / np.array on traced values
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in HOST_ARRAY \
+                    and node_name(node.func.value) in ("np", "numpy") \
+                    and node.args and tainted_leaves(node.args[0], tainted):
+                out.append(ctx.finding(
+                    RULE, node,
+                    f"`np.{node.func.attr}` on a traced value inside "
+                    f"jitted `{fn.name}` materializes on the host; use "
+                    "jnp"))
+            # shape-unstable ops without a static size
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in SHAPE_UNSTABLE \
+                    and node_name(node.func.value) in ("jnp", "np", "numpy",
+                                                       "lax", "jax"):
+                kwargs = {kw.arg for kw in node.keywords}
+                # one-arg jnp.where is shape-unstable; 3-arg is select
+                if node.func.attr == "where" and len(node.args) != 1:
+                    continue
+                if "size" not in kwargs and \
+                        names_in(node) & tainted:
+                    out.append(ctx.finding(
+                        RULE, node,
+                        f"`{node.func.attr}` without `size=` inside "
+                        f"jitted `{fn.name}` has a data-dependent "
+                        "output shape (retrace storm / trace error)"))
+        elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            test = node.test
+            if is_none_check(test):
+                continue
+            leaves = tainted_leaves(test, tainted)
+            if leaves:
+                kind = {ast.If: "if", ast.While: "while",
+                        ast.IfExp: "conditional expression"}[type(node)]
+                name = node_name(leaves[0])
+                out.append(ctx.finding(
+                    RULE, node,
+                    f"Python `{kind}` on tracer-derived `{name}` inside "
+                    f"jitted `{fn.name}` (use lax.cond/jnp.where; "
+                    "Python control flow bakes one branch into the "
+                    "trace)"))
+        elif isinstance(node, ast.Assert):
+            if tainted_leaves(node.test, tainted):
+                out.append(ctx.finding(
+                    RULE, node,
+                    f"`assert` on a traced value inside jitted "
+                    f"`{fn.name}` (trace-time no-op or host sync; use "
+                    "checkify or move to the caller)"))
+    return out

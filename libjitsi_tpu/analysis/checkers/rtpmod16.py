@@ -1,0 +1,197 @@
+"""rtp-mod16: raw arithmetic/comparison on 16-bit RTP sequence numbers
+outside ``core/rtp_math.py``.
+
+PR 2's seq-wrap fixes (jitter buffer bulk gap-skip, PacketCache
+``lookup_nack`` rotation, ``rtcp.build_nack`` PID/BLP packing) all came
+from the same bug class: ``a - b`` or ``a < b`` on values that live on
+the mod-2^16 circle.  The discipline that prevents it is
+``core/rtp_math.py`` (`seq_delta`/`is_newer_seq`/`as_seq`) or explicit
+masking at the use site.  This checker flags, on any name that looks
+seq/roc-like:
+
+- ``+``/``-``/``*`` whose result is not masked (``& 0xFFFF``/``% ...``)
+  in the same expression and not already inside an rtp_math helper call;
+- ``<``/``<=``/``>``/``>=`` against anything but an integer literal
+  (literal compares are sentinel/bounds checks — ``seq >= 0``);
+- ``min()``/``max()`` over seq values (wrap-unsafe ordering);
+- slices and ``range()``/``arange()`` spans with seq bounds
+  (wrap-unsafe seq-range walks).
+
+Names with an ``ext``/``unwrapped``/``index`` token are 64-bit extended
+counters (`SeqNumUnwrapper` output, RFC 3711 packet indices) where raw
+arithmetic is the POINT — they are exempt, and renaming a variable to
+say what it is (`..._ext`) is the documented fix for counters that
+never touch the wire.  Equality compares are wrap-safe and exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from libjitsi_tpu.analysis.core import (FileContext, Finding, call_func_name,
+                                        int_const, node_name)
+
+RULE = "rtp-mod16"
+
+SEQ_TOKENS = {"seq", "seqs", "seqno", "seqnum", "roc", "rollover"}
+#: tokens marking a 64-bit extended/unwrapped counter — raw math is fine
+EXT_TOKENS = {"ext", "extended", "unwrapped", "uts", "index", "indices",
+              "idx"}
+#: tokens marking a value that is ABOUT seqs but not on the mod-2^16
+#: circle: container/window sizes, signed deltas, masks
+META_TOKENS = {"window", "cap", "limit", "budget", "map", "mask", "mod",
+               "delta", "deltas", "width", "depth", "count", "gap",
+               "gaps", "span"}
+#: rtp_math helpers (and wrap-aware wrappers) whose argument expressions
+#: are safe: they mask/fold internally
+SAFE_CALLS = {"seq_delta", "is_newer_seq", "is_older_seq", "as_seq",
+              "as_ts", "estimate_packet_index", "chain_packet_indices",
+              "update_index_state", "unwrap", "segment_ranks"}
+WRAP_SAFE_BINOPS = (ast.BitAnd, ast.BitOr, ast.BitXor, ast.Mod,
+                    ast.RShift, ast.LShift, ast.FloorDiv)
+
+
+def is_seq_name(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    tokens = set(re.split(r"[_\d]+", name.lower())) - {""}
+    if tokens & (EXT_TOKENS | META_TOKENS):
+        return False
+    if tokens & SEQ_TOKENS:
+        return True
+    # twseq/wireseq-style compounds
+    return any(t.endswith("seq") for t in tokens)
+
+
+def _seq_operand(node: ast.AST) -> Optional[str]:
+    """Seq-ish identifier at the top of an operand expression (through
+    unary ops, int() casts and plain subscripts like seqs[i])."""
+    if isinstance(node, ast.UnaryOp):
+        return _seq_operand(node.operand)
+    if isinstance(node, ast.Call) and call_func_name(node) == "int" \
+            and node.args:
+        return _seq_operand(node.args[0])
+    if isinstance(node, ast.Subscript):
+        return _seq_operand(node.value)
+    name = node_name(node)
+    return name if is_seq_name(name) else None
+
+
+def _masked_or_safe(node: ast.AST) -> bool:
+    """True when an ancestor within the same expression masks the value
+    (``& 0xFFFF``, ``% MOD``, shifts) or hands it to an rtp_math
+    helper."""
+    cur = node
+    parent = getattr(cur, "_jl_parent", None)
+    while parent is not None:
+        if isinstance(parent, ast.BinOp) and \
+                isinstance(parent.op, WRAP_SAFE_BINOPS):
+            return True
+        if isinstance(parent, ast.Call):
+            fname = call_func_name(parent)
+            if fname in SAFE_CALLS:
+                return True
+        if isinstance(parent, ast.stmt):
+            return False
+        cur, parent = parent, getattr(parent, "_jl_parent", None)
+    return False
+
+
+def _in_safe_call(node: ast.AST) -> bool:
+    parent = getattr(node, "_jl_parent", None)
+    while parent is not None and not isinstance(parent, ast.stmt):
+        if isinstance(parent, ast.Call) and \
+                call_func_name(parent) in SAFE_CALLS:
+            return True
+        parent = getattr(parent, "_jl_parent", None)
+    return False
+
+
+def check_rtp_mod16(ctx: FileContext) -> List[Finding]:
+    if ctx.relpath.endswith("core/rtp_math.py"):
+        return []
+    findings: List[Optional[Finding]] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.BinOp) and \
+                isinstance(node.op, (ast.Add, ast.Sub, ast.Mult)):
+            name = _seq_operand(node.left) or _seq_operand(node.right)
+            if name and not _masked_or_safe(node):
+                op = {ast.Add: "+", ast.Sub: "-",
+                      ast.Mult: "*"}[type(node.op)]
+                findings.append(ctx.finding(
+                    RULE, node,
+                    f"raw `{op}` on seq-like `{name}` without a wrap "
+                    "mask (use core.rtp_math.seq_delta/as_seq or mask "
+                    "with & 0xFFFF in the same expression)"))
+        elif isinstance(node, ast.AugAssign) and \
+                isinstance(node.op, (ast.Add, ast.Sub, ast.Mult)):
+            name = node_name(node.target)
+            if is_seq_name(name) and _seq_operand(node.target):
+                findings.append(ctx.finding(
+                    RULE, node,
+                    f"unmasked in-place arithmetic on seq-like "
+                    f"`{name}` (wraps past 2^16; use "
+                    "`x = (x + n) & 0xFFFF` or rename to `..._ext` if "
+                    "it is a 64-bit extended counter)"))
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 and \
+                isinstance(node.ops[0], (ast.Lt, ast.LtE, ast.Gt, ast.GtE)):
+            left, right = node.left, node.comparators[0]
+            name = _seq_operand(left) or _seq_operand(right)
+            if name and not _in_safe_call(node) \
+                    and int_const(left) is None \
+                    and int_const(right) is None \
+                    and not _masked_expr(left) and not _masked_expr(right):
+                findings.append(ctx.finding(
+                    RULE, node,
+                    f"raw ordering compare on seq-like `{name}` "
+                    "(misorders across the 2^16 wrap; use "
+                    "core.rtp_math.is_newer_seq/seq_delta)"))
+        elif isinstance(node, ast.Call):
+            fname = call_func_name(node)
+            if fname in ("min", "max") and len(node.args) >= 2:
+                for a in node.args:
+                    name = _seq_operand(a)
+                    if name:
+                        findings.append(ctx.finding(
+                            RULE, node,
+                            f"`{fname}()` over seq-like `{name}` is "
+                            "wrap-unsafe ordering (compare via "
+                            "seq_delta on an anchor instead)"))
+                        break
+            elif fname in ("range", "arange") and len(node.args) >= 2:
+                for a in node.args[:2]:
+                    name = _seq_operand(a)
+                    if name and not _masked_or_safe(node):
+                        findings.append(ctx.finding(
+                            RULE, node,
+                            f"seq-range walk `{fname}({name}, ...)` is "
+                            "wrap-unsafe (iterate a seq_delta-derived "
+                            "count and mask each step)"))
+                        break
+        elif isinstance(node, ast.Subscript) and \
+                isinstance(node.slice, ast.Slice):
+            sl = node.slice
+            for bound in (sl.lower, sl.upper):
+                if bound is None:
+                    continue
+                name = _seq_operand(bound)
+                if name and not _masked_expr(bound):
+                    findings.append(ctx.finding(
+                        RULE, node,
+                        f"slicing by seq-like `{name}` is wrap-unsafe "
+                        "(a wrapped range selects the complement; "
+                        "derive lengths via seq_delta)"))
+                    break
+    return [f for f in findings if f is not None]
+
+
+def _masked_expr(node: ast.AST) -> bool:
+    """The operand expression itself already folds into wire space."""
+    if isinstance(node, ast.BinOp) and \
+            isinstance(node.op, WRAP_SAFE_BINOPS):
+        return True
+    if isinstance(node, ast.Call) and call_func_name(node) in SAFE_CALLS:
+        return True
+    return False

@@ -1,0 +1,116 @@
+"""secret-taint: constant-time discipline in ``kernels/`` and
+``transform/srtp/``.
+
+The bitsliced-AES work (`kernels/aes_bitsliced.py`) exists because
+secret-indexed table lookups and secret-dependent branches leak timing.
+This checker taints names that look like key material (key, keystream,
+salt, round keys, auth tags, HMAC midstates, digests) plus anything
+assigned from them, then flags:
+
+- Python ``if``/``while``/ternary/``assert`` whose condition reads a
+  tainted value (secret-dependent branch; early returns ride on this);
+- subscripts whose INDEX is tainted (``SBOX[key_byte]`` — the classic
+  cache-timing leak; slicing a secret value itself is fine);
+- ``==``/``!=`` on tainted values used as a branch condition
+  (short-circuiting byte compare of auth tags).
+
+Structure checks stay legal: ``len(key) == 16``, ``key.shape``,
+``key is None``.  Vectorized verdicts (``ok = tags == expected`` used
+in ``np.where``) do not branch and do not fire.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from libjitsi_tpu.analysis.core import (FileContext, Finding, is_none_check,
+                                        node_name, propagate_taint,
+                                        tainted_leaves)
+
+RULE = "secret-taint"
+
+#: package-relative path prefixes under constant-time discipline
+SCOPE_PREFIXES = ("kernels/", "transform/srtp/")
+
+SECRET_TOKENS = {"key", "keys", "keystream", "secret", "salt", "rk",
+                 "mid", "tag", "tags", "digest", "mac", "hmac", "auth",
+                 "priv", "dhpart", "srtp_key", "ikm", "okm", "keymat"}
+#: metadata suffix tokens that make a name *about* a secret, not secret
+EXEMPT_TOKENS = {"len", "size", "lens", "sizes", "idx", "index", "off",
+                 "offset", "offsets", "count", "name", "names", "id",
+                 "kind", "width", "cap", "shape", "fmt", "label"}
+
+
+def is_secret_name(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    tokens = set(re.split(r"[_\d]+", name.lower())) - {""}
+    return bool(tokens & SECRET_TOKENS) and not tokens & EXEMPT_TOKENS
+
+
+def _scope_ok(relpath: str) -> bool:
+    # package-root-relative ("libjitsi_tpu/kernels/..." or "kernels/...")
+    p = relpath.split("libjitsi_tpu/")[-1]
+    return any(p.startswith(pre) for pre in SCOPE_PREFIXES)
+
+
+def check_secret_taint(ctx: FileContext) -> List[Finding]:
+    if not _scope_ok(ctx.relpath):
+        return []
+    findings: List[Optional[Finding]] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_check_fn(ctx, node))
+    return [f for f in findings if f is not None]
+
+
+def _check_fn(ctx: FileContext, fn: ast.FunctionDef
+              ) -> List[Optional[Finding]]:
+    args = fn.args
+    params = [p.arg for p in args.posonlyargs + args.args + args.kwonlyargs]
+    tainted = {p for p in params if is_secret_name(p)}
+    # names born secret inside the body (key = derive(...), etc.);
+    # method attributes (`d.keys()`) are call targets, not values
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Attribute):
+            parent = getattr(node, "_jl_parent", None)
+            if isinstance(parent, ast.Call) and parent.func is node:
+                continue
+        name = node_name(node)
+        if is_secret_name(name):
+            tainted.add(name)
+    tainted = propagate_taint(fn.body, tainted)
+    out: List[Optional[Finding]] = []
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            test = node.test
+            if is_none_check(test):
+                continue
+            leaves = tainted_leaves(test, tainted)
+            if leaves:
+                name = node_name(leaves[0])
+                out.append(ctx.finding(
+                    RULE, node,
+                    f"secret-dependent branch on `{name}` in "
+                    f"`{fn.name}` (timing leak; compute both sides and "
+                    "select, or hoist the secret out of control flow)"))
+        elif isinstance(node, ast.Assert):
+            if tainted_leaves(node.test, tainted):
+                out.append(ctx.finding(
+                    RULE, node,
+                    f"assert on secret data in `{fn.name}` (timing "
+                    "leak + aborts differ by secret value)"))
+        elif isinstance(node, ast.Subscript):
+            idx = node.slice
+            leaves = tainted_leaves(idx, tainted)
+            if leaves:
+                name = node_name(leaves[0])
+                out.append(ctx.finding(
+                    RULE, node,
+                    f"secret-indexed lookup via `{name}` in "
+                    f"`{fn.name}` (data-cache timing leak; bitslice or "
+                    "mask the whole table)"))
+    return out

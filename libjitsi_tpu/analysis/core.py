@@ -1,0 +1,279 @@
+"""Shared visitor core for jitlint checkers.
+
+Everything a checker needs from one file lives in a `FileContext`:
+parsed AST (with parent links), raw lines, pragma tables, and the
+`finding()` constructor that fills in location/snippet/symbol.  The
+taint helpers (`assigned_names`, `names_in`, `under_shape_access`) are
+the common dataflow vocabulary of the hotpath and secret checkers —
+both run the same one-pass forward propagation over statement lists.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import re
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+PRAGMA_RE = re.compile(r"#\s*jitlint:\s*disable=([a-z0-9_,\-]+|all)")
+PRAGMA_FILE_RE = re.compile(r"#\s*jitlint:\s*disable-file=([a-z0-9_,\-]+|all)")
+
+#: attribute/function accesses through which a traced or secret value
+#: yields only STATIC (shape/dtype) information — never data
+SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "nbytes"}
+SHAPE_CALLS = {"len", "isinstance", "hasattr", "getattr", "type", "id"}
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str              # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    snippet: str           # stripped source of the flagged line
+    symbol: str            # enclosing qualname, "" at module level
+    occurrence: int = 0    # nth identical finding in this symbol
+
+    @property
+    def content_key(self) -> str:
+        """Line-number-independent identity used by the baseline: the
+        same logical finding keeps its key across unrelated edits that
+        shift line numbers."""
+        h = hashlib.sha1(
+            " ".join(self.snippet.split()).encode()).hexdigest()[:12]
+        return (f"{self.rule}:{self.path}:{self.symbol}:"
+                f"{h}:{self.occurrence}")
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet, "symbol": self.symbol,
+                "key": self.content_key}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"[{self.rule}] {self.message}\n    {self.snippet}")
+
+
+def _parse_pragmas(lines: List[str]) -> Tuple[Dict[int, Set[str]], Set[str]]:
+    per_line: Dict[int, Set[str]] = {}
+    whole_file: Set[str] = set()
+    for i, text in enumerate(lines, start=1):
+        m = PRAGMA_FILE_RE.search(text)
+        if m:
+            whole_file |= set(m.group(1).split(","))
+            continue
+        m = PRAGMA_RE.search(text)
+        if m:
+            per_line[i] = set(m.group(1).split(","))
+    return per_line, whole_file
+
+
+class FileContext:
+    """One parsed source file plus the lookup tables checkers share."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath.replace("\\", "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        for parent in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(parent):
+                child._jl_parent = parent  # type: ignore[attr-defined]
+        self.line_pragmas, self.file_pragmas = _parse_pragmas(self.lines)
+        # enclosing def/class intervals for scope-level pragmas and
+        # finding symbols: (start, end, qualname, def_line)
+        self._scopes: List[Tuple[int, int, str, int]] = []
+        self._collect_scopes(self.tree, prefix="")
+
+    def _collect_scopes(self, node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                qual = f"{prefix}{child.name}"
+                self._scopes.append(
+                    (child.lineno, child.end_lineno or child.lineno,
+                     qual, child.lineno))
+                self._collect_scopes(child, prefix=qual + ".")
+            else:
+                self._collect_scopes(child, prefix=prefix)
+
+    def symbol_at(self, line: int) -> str:
+        best = ""
+        best_span = None
+        for start, end, qual, _ in self._scopes:
+            if start <= line <= end:
+                span = end - start
+                if best_span is None or span <= best_span:
+                    best, best_span = qual, span
+        return best
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if {"all", rule} & self.file_pragmas:
+            return True
+        for probe in self._pragma_lines(line):
+            rules = self.line_pragmas.get(probe)
+            if rules and {"all", rule} & rules:
+                return True
+        return False
+
+    def _pragma_lines(self, line: int) -> Iterable[int]:
+        """Lines whose pragma governs `line`: the line itself, the line
+        above it, and every enclosing def/class header line."""
+        yield line
+        yield line - 1
+        for start, end, _, def_line in self._scopes:
+            if start <= line <= end:
+                yield def_line
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Optional[Finding]:
+        line = getattr(node, "lineno", 1)
+        col = getattr(node, "col_offset", 0)
+        if self.suppressed(rule, line):
+            return None
+        snippet = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        return Finding(rule=rule, path=self.relpath, line=line, col=col,
+                       message=message, snippet=snippet,
+                       symbol=self.symbol_at(line))
+
+
+# --------------------------------------------------------- taint helpers
+
+def node_name(node: ast.AST) -> Optional[str]:
+    """The identifier a Name/Attribute leaf refers to (`self.x` -> "x")."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+def names_in(node: ast.AST) -> Set[str]:
+    """All Name ids and Attribute attrs mentioned under `node`."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        name = node_name(n)
+        if name is not None:
+            out.add(name)
+    return out
+
+
+def under_shape_access(leaf: ast.AST) -> bool:
+    """True when `leaf` only contributes static information: it is read
+    through .shape/.dtype/len()/… — the accesses jit and constant-time
+    code may branch on freely."""
+    node = leaf
+    parent = getattr(node, "_jl_parent", None)
+    while parent is not None:
+        if isinstance(parent, ast.Attribute) and parent.attr in SHAPE_ATTRS:
+            return True
+        if isinstance(parent, ast.Call):
+            fn = parent.func
+            if isinstance(fn, ast.Name) and fn.id in SHAPE_CALLS \
+                    and node in parent.args:
+                return True
+            # x.dtype == ..., jnp.shape(x): treated by the Attribute arm
+        if isinstance(parent, (ast.stmt,)):
+            return False
+        node, parent = parent, getattr(parent, "_jl_parent", None)
+    return False
+
+
+def is_none_check(test: ast.AST) -> bool:
+    """`x is None` / `x is not None` — pytree-structure checks, legal in
+    jit code and secret-independent."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 \
+            and isinstance(test.ops[0], (ast.Is, ast.IsNot)):
+        return True
+    if isinstance(test, ast.BoolOp):
+        return all(is_none_check(v) for v in test.values)
+    return False
+
+
+def tainted_leaves(node: ast.AST, tainted: Set[str]) -> List[ast.AST]:
+    """Name/Attribute leaves under `node` whose identifier is tainted
+    and which are NOT read through a shape-only access."""
+    hits: List[ast.AST] = []
+    for n in ast.walk(node):
+        name = node_name(n)
+        if name in tainted and not under_shape_access(n):
+            hits.append(n)
+    return hits
+
+
+#: names that must never carry taint — receivers, builtins, and module
+#: aliases; tainting `self` or `int` poisons every later expression
+NEVER_TAINT = {"self", "cls", "int", "float", "bool", "len", "bytes",
+               "bytearray", "range", "enumerate", "zip", "min", "max",
+               "sum", "abs", "np", "numpy", "jnp", "jax", "lax", "os",
+               "functools", "struct", "isinstance", "type", "print"}
+
+
+def _target_value_names(tgt: ast.AST) -> Set[str]:
+    """Names that RECEIVE a value in an assignment target.  A subscript
+    index or attribute chain does not receive the value — walking the
+    whole target (the naive approach) taints loop indices and `self`
+    and poisons everything downstream."""
+    if isinstance(tgt, ast.Name):
+        return {tgt.id}
+    if isinstance(tgt, ast.Attribute):
+        return {tgt.attr}
+    if isinstance(tgt, ast.Subscript):
+        return _target_value_names(tgt.value)
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        out: Set[str] = set()
+        for el in tgt.elts:
+            out |= _target_value_names(el)
+        return out
+    if isinstance(tgt, ast.Starred):
+        return _target_value_names(tgt.value)
+    return set()
+
+
+def propagate_taint(body: List[ast.stmt], tainted: Set[str]) -> Set[str]:
+    """One forward pass: any assignment whose RHS *reads data from* a
+    tainted name (not just its shape/dtype) taints the value-receiving
+    names of its targets.  Conservative and loop-free on purpose —
+    checkers re-run it per function, and a single pass matches how
+    straight-line kernel code is written."""
+    tainted = set(tainted) - NEVER_TAINT
+
+    def rhs_tainted(value: ast.AST) -> bool:
+        return bool(tainted_leaves(value, tainted))
+
+    def add(names: Set[str]) -> None:
+        tainted.update(names - NEVER_TAINT)
+
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Assign) and rhs_tainted(node.value):
+                for tgt in node.targets:
+                    add(_target_value_names(tgt))
+            elif isinstance(node, ast.AugAssign) and \
+                    rhs_tainted(node.value):
+                add(_target_value_names(node.target))
+            elif isinstance(node, ast.AnnAssign) and \
+                    node.value is not None and rhs_tainted(node.value):
+                add(_target_value_names(node.target))
+            elif isinstance(node, ast.For) and rhs_tainted(node.iter):
+                add(_target_value_names(node.target))
+    return tainted
+
+
+def call_func_name(call: ast.Call) -> Optional[str]:
+    """Last path component of a call target: `a.b.f(x)` -> "f"."""
+    return node_name(call.func)
+
+
+def int_const(node: ast.AST) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = int_const(node.operand)
+        return -v if v is not None else None
+    return None

@@ -1,0 +1,150 @@
+"""Per-file parallel lint driver + human/JSON rendering.
+
+`run_lint(paths)` discovers ``.py`` files, parses and runs the per-file
+checkers across a thread pool (one task per file — parse plus four
+visitors is microseconds per file, the pool exists so a cold cache of
+~200 files clears the tier-1 <10 s gate with headroom to grow), then
+runs the cross-file checkers on the assembled index, assigns
+occurrence indices, and applies the committed baseline.
+
+Exit-code contract (scripts/lint.py): 0 clean, 1 findings, 2 internal
+error — an unparseable file is an internal error, not a finding, so a
+syntax-broken tree fails loudly rather than linting clean.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import json
+import os
+from collections import defaultdict
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from libjitsi_tpu.analysis import baseline as baseline_mod
+from libjitsi_tpu.analysis.checkers import (GLOBAL_CHECKERS,
+                                            PER_FILE_CHECKERS)
+from libjitsi_tpu.analysis.core import FileContext, Finding
+
+SKIP_DIRS = {"__pycache__", ".git", "build", "dist"}
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: List[Finding]          # new (unbaselined) findings
+    grandfathered: List[Finding]
+    stale_baseline: List[str]
+    files_checked: int
+    errors: List[str]                # internal errors (parse failures)
+
+    @property
+    def exit_code(self) -> int:
+        if self.errors:
+            return 2
+        return 1 if self.findings else 0
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "files_checked": self.files_checked,
+            "findings": [f.to_dict() for f in self.findings],
+            "grandfathered": [f.to_dict() for f in self.grandfathered],
+            "stale_baseline": self.stale_baseline,
+            "errors": self.errors,
+            "exit_code": self.exit_code,
+        }, indent=1)
+
+    def render_human(self) -> str:
+        out: List[str] = []
+        for f in sorted(self.findings, key=lambda f: (f.path, f.line)):
+            out.append(f.render())
+        if self.stale_baseline:
+            out.append(f"note: {len(self.stale_baseline)} stale baseline "
+                       "entr(y/ies) no longer fire — prune with "
+                       "`scripts/lint.py --prune-baseline`:")
+            out.extend(f"  {k}" for k in self.stale_baseline)
+        for e in self.errors:
+            out.append(f"internal error: {e}")
+        out.append(
+            f"jitlint: {len(self.findings)} new finding(s), "
+            f"{len(self.grandfathered)} baselined, "
+            f"{self.files_checked} files checked")
+        return "\n".join(out)
+
+
+def discover_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
+    """[(abspath, relpath)] for every .py under `paths` (files pass
+    through directly)."""
+    out: List[Tuple[str, str]] = []
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            out.append((p, os.path.basename(p)))
+            continue
+        root_parent = os.path.dirname(p)
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = [d for d in sorted(dirnames)
+                           if d not in SKIP_DIRS]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    full = os.path.join(dirpath, fn)
+                    out.append((full, os.path.relpath(full, root_parent)))
+    return out
+
+
+def _lint_one(path: str, relpath: str
+              ) -> Tuple[Optional[FileContext], List[Finding],
+                         Optional[str]]:
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            source = fh.read()
+        ctx = FileContext(path, relpath, source)
+    except (SyntaxError, UnicodeDecodeError, OSError) as exc:
+        return None, [], f"{relpath}: {exc}"
+    findings: List[Finding] = []
+    for checker in PER_FILE_CHECKERS:
+        findings.extend(checker(ctx))
+    return ctx, findings, None
+
+
+def _assign_occurrences(findings: List[Finding]) -> None:
+    """Identical (rule, path, symbol, snippet) findings get stable
+    ordinal suffixes in line order so each can be baselined
+    independently."""
+    groups = defaultdict(list)
+    for f in findings:
+        f.occurrence = 0
+        groups[f.content_key].append(f)
+    for group in groups.values():
+        for i, f in enumerate(sorted(group, key=lambda f: (f.line, f.col))):
+            f.occurrence = i
+
+
+def run_lint(paths: Sequence[str],
+             baseline_path: Optional[str] = None,
+             jobs: Optional[int] = None) -> LintResult:
+    files = discover_files(paths)
+    index: Dict[str, FileContext] = {}
+    findings: List[Finding] = []
+    errors: List[str] = []
+
+    workers = jobs or min(32, (os.cpu_count() or 4))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
+        for ctx, file_findings, err in ex.map(
+                lambda pr: _lint_one(*pr), files):
+            if err is not None:
+                errors.append(err)
+                continue
+            assert ctx is not None
+            index[ctx.relpath] = ctx
+            findings.extend(file_findings)
+
+    for checker in GLOBAL_CHECKERS:
+        findings.extend(checker(index))
+
+    _assign_occurrences(findings)
+    base = baseline_mod.load_baseline(
+        baseline_path or baseline_mod.DEFAULT_BASELINE)
+    new, old, stale = baseline_mod.split_by_baseline(findings, base)
+    return LintResult(findings=new, grandfathered=old,
+                      stale_baseline=stale, files_checked=len(index),
+                      errors=errors)

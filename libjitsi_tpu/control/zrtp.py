@@ -444,7 +444,11 @@ class ZrtpEndpoint:
 
     # ----------------------------------------------------------- transport
     def _send(self, msg: bytes) -> bytes:
-        self._seq += 1
+        # 16-bit wire field (RFC 6189 §5 sequence number): wrap at the
+        # increment, not at serialization — a random initial seq near
+        # 65535 otherwise grows past 2^16 within one handshake retry
+        # storm and desyncs any receiver tracking the raw counter
+        self._seq = (self._seq + 1) & 0xFFFF
         return _wrap(msg, self._seq, self.ssrc)
 
     def hello_packets(self) -> List[bytes]:

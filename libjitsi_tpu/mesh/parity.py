@@ -44,7 +44,7 @@ def assert_table_parity(mesh, capacity: int, batch_size: int,
         streams = r.integers(0, capacity, batch_size)
         pls = [bytes([seq0 & 0xFF]) * 40 for _ in range(batch_size)]
         return rtp_header.build(
-            pls, [seq0 + i for i in range(batch_size)],
+            pls, [(seq0 + i) & 0xFFFF for i in range(batch_size)],
             [0] * batch_size, (0x7000 + streams).tolist(),
             [96] * batch_size, stream=streams.tolist())
 

@@ -379,6 +379,7 @@ def build_nack(n: Nack) -> bytes:
     if len(seqs) > 1:
         gaps = [(seqs[i] - seqs[i - 1]) & 0xFFFF for i in range(len(seqs))]
         k = gaps.index(max(gaps))         # i=0 wraps to seqs[-1]
+        # list rotation (concat, not arithmetic) # jitlint: disable=rtp-mod16
         seqs = seqs[k:] + seqs[:k]
     fci = b""
     i = 0

@@ -248,6 +248,17 @@ class ReceivePump:
         self.lost_frames = 0
         self.decode_errors = 0
 
+    def register_metrics(self, registry, prefix: str = "rx_pump") -> None:
+        """Export the pump's decode/loss counters (drift rule: every
+        counter a class increments is either registered or doesn't
+        exist — an unregistered counter is invisible in production)."""
+        registry.register_counters(self, (
+            ("decoded_frames", "frames decoded from the jitter buffer"),
+            ("lost_frames", "jitter-buffer underruns (pre-PLC)"),
+            ("decode_errors", "authenticated but undecodable payloads"),
+            ("plc_frames", "underruns concealed by the codec PLC"),
+        ), prefix=prefix)
+
     def push(self, datagrams: List[bytes],
              now: Optional[float] = None) -> int:
         """Receive-chain + jitter-buffer insert; returns accepted count."""

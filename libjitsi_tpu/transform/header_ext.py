@@ -26,6 +26,7 @@ from typing import Callable, Optional
 import numpy as np
 
 from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.core.rtp_math import seq_delta
 from libjitsi_tpu.rtp import ext as rtp_ext
 from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.transform.engine import PacketTransformer, TransformEngine
@@ -76,7 +77,10 @@ class TransportCCEngine(_RtpOnlyEngine):
     def __init__(self, ext_id: int, clock: Callable[[], float] = time.time):
         self.ext_id = ext_id
         self.clock = clock
-        self.next_seq = 0
+        # 64-bit EXTENDED counter (the `_ext` suffix is the rtp-mod16
+        # naming contract for unwrapped counters): only the 16-bit fold
+        # `& 0xFFFF` at stamp time touches the wire
+        self.next_seq_ext = 0
         self.sent_seq = np.full(self.HISTORY, -1, dtype=np.int64)
         self.sent_time = np.zeros(self.HISTORY, dtype=np.float64)
         eng = self
@@ -90,8 +94,8 @@ class TransportCCEngine(_RtpOnlyEngine):
                 # masked rows (padding, dropped upstream) must not consume
                 # transport-wide seqs: a gap reads as loss at the receiver
                 seqs = np.zeros(n, dtype=np.int64)
-                seqs[live] = eng.next_seq + np.arange(k, dtype=np.int64)
-                eng.next_seq += k
+                seqs[live] = eng.next_seq_ext + np.arange(k, dtype=np.int64)
+                eng.next_seq_ext += k
                 now = eng.clock()
                 slot = seqs[live] % eng.HISTORY
                 eng.sent_seq[slot] = seqs[live]
@@ -109,13 +113,10 @@ class TransportCCEngine(_RtpOnlyEngine):
     def lookup_send_time(self, twseq: int) -> Optional[float]:
         """twseq is the 16-bit wire value (TCC feedback); unwrap it
         against the full counter before the slot lookup."""
-        base = self.next_seq - 1
+        base = self.next_seq_ext - 1
         if base < 0:
             return None
-        diff = (twseq - base) & 0xFFFF
-        if diff >= 0x8000:
-            diff -= 0x10000
-        ext = base + diff
+        ext = base + int(seq_delta(twseq, base & 0xFFFF))
         if ext < 0:
             return None
         slot = ext % self.HISTORY

@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""jitlint CLI — the tier-1 static-analysis gate.
+
+Usage:
+    python scripts/lint.py libjitsi_tpu              # human output
+    python scripts/lint.py --json libjitsi_tpu       # machine output
+    python scripts/lint.py --update-baseline ...     # grandfather all
+    python scripts/lint.py --prune-baseline ...      # drop stale keys
+
+Exit codes: 0 clean (no unbaselined findings), 1 findings, 2 internal
+error (unparseable file, bad arguments, crash).  The gate in
+scripts/tier1.sh treats nonzero as failure.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("paths", nargs="+", help="files or package dirs")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: the committed "
+                         "libjitsi_tpu/analysis/baseline.json)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="write ALL current findings to the baseline "
+                         "(each entry still needs a one-line `why` — "
+                         "edit the file) and exit 0")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries that no longer fire")
+    ap.add_argument("--jobs", type=int, default=None)
+    args = ap.parse_args(argv)
+
+    from libjitsi_tpu.analysis import baseline as baseline_mod
+    from libjitsi_tpu.analysis.driver import run_lint
+
+    t0 = time.perf_counter()
+    try:
+        result = run_lint(args.paths, baseline_path=args.baseline,
+                          jobs=args.jobs)
+    except Exception as exc:  # noqa: BLE001 — contract: crash = exit 2
+        print(f"jitlint internal error: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return 2
+    elapsed = time.perf_counter() - t0
+
+    bpath = args.baseline or baseline_mod.DEFAULT_BASELINE
+    if args.update_baseline:
+        all_f = result.findings + result.grandfathered
+        baseline_mod.save_baseline(all_f, bpath)
+        print(f"baseline: wrote {len(all_f)} entries to {bpath} "
+              "(fill in each entry's `why`)")
+        return 0
+    if args.prune_baseline:
+        base = baseline_mod.load_baseline(bpath)
+        keep = [f for f in result.grandfathered]
+        kept = {f.content_key: base[f.content_key] for f in keep}
+        with open(bpath, "w", encoding="utf-8") as fh:
+            json.dump({"entries": [
+                {"key": k, "why": why} for k, why in sorted(kept.items())
+            ]}, fh, indent=1)
+            fh.write("\n")
+        print(f"baseline: kept {len(kept)}, "
+              f"pruned {len(result.stale_baseline)} stale entries")
+        return 0
+
+    if args.as_json:
+        print(result.to_json())
+    else:
+        print(result.render_human())
+        print(f"jitlint: {result.files_checked} files in {elapsed:.2f}s")
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -2,5 +2,14 @@
 # Tier-1 verify — the ROADMAP.md command, verbatim, runnable from
 # anywhere in the checkout.  Prints DOTS_PASSED=<n> and exits with
 # pytest's status.
+#
+# The jitlint gate runs FIRST and is hard: any new static-analysis
+# finding (hotpath-purity, secret-taint, rtp-mod16, drift) fails the
+# tier before a single test runs.  Grandfathered findings live in
+# libjitsi_tpu/analysis/baseline.json; see README "Static analysis".
 cd "$(dirname "$0")/.."
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+echo "== jitlint gate =="
+python scripts/lint.py libjitsi_tpu || { echo "TIER1 FAIL: jitlint gate"; exit 1; }
+echo "== core test tier =="
+t0=$SECONDS
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); echo "TIER1_WALL_SECONDS=$((SECONDS - t0))"; exit $rc

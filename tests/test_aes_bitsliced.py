@@ -96,6 +96,7 @@ def test_bitsliced32_packed_words_bit_exact():
         assert np.array_equal(got, want), (n, kl)
 
 
+@pytest.mark.slow   # full tower-cipher compile, AES-128 + AES-256
 def test_bitsliced_tower_sbox_and_provider_bit_exact():
     """The composite-field (GF((2^4)^2)) provider must match the table
     core bit for bit — AES-128 and AES-256 (the tower parameters and
@@ -126,3 +127,21 @@ def test_bitsliced_tower_sbox_and_provider_bit_exact():
         blocks.reshape(-1, 16))).reshape(6, 3, 16)
     got = np.asarray(aes_encrypt_bitsliced_tower_nd(rk_b, blocks))
     assert np.array_equal(got, want)
+
+
+def test_tower_sbox_circuit_matches_table_fast():
+    """Fast twin of the tower provider test: the composite-field S-box
+    circuit over all 256 inputs, evaluated in plain numpy (no jit, no
+    full-cipher compile).  The slow twin pins the assembled cipher."""
+    from libjitsi_tpu.kernels.aes import _SBOX
+    from libjitsi_tpu.kernels.aes_bitsliced import (_sbox_bits,
+                                                    _sbox_bits_tower)
+
+    xs = np.arange(256, dtype=np.uint8)
+    bits = [((xs >> p) & 1).astype(np.uint8) for p in range(8)]
+    for impl in (_sbox_bits, _sbox_bits_tower):
+        out = impl(bits)
+        got = np.zeros(256, dtype=np.uint16)
+        for p in range(8):
+            got |= out[p].astype(np.uint16) << p
+        assert np.array_equal(got.astype(np.uint8), _SBOX), impl.__name__

@@ -128,7 +128,7 @@ def test_tcc_mask_skips_state_for_masked_rows():
     batch = _mixed_batch(4, 0)
     mask = np.array([True, False, True, True])
     chain.rtp_transformer.transform(batch, mask)
-    assert eng.next_seq == 3                 # masked row consumed no seq
+    assert eng.next_seq_ext == 3                 # masked row consumed no seq
 
 
 def test_empty_batch_protect_unprotect():

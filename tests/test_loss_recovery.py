@@ -540,6 +540,8 @@ def _run_recovery_e2e(rounds, per_round, seed=7):
         dropped=dropped, missing=missing, blackhole=blackhole)
 
 
+@pytest.mark.slow   # cold SRTP-path compiles dominate (~40s); the fast
+# twin below keeps every ladder rung covered in the core tier
 def test_e2e_recovery_ladder_under_burst_loss():
     r = _run_recovery_e2e(rounds=30, per_round=8)
     # loss actually happened, and the ladder actually ran
@@ -567,6 +569,23 @@ def test_e2e_recovery_ladder_under_burst_loss():
                  "recv_recovery_fec_recovered", "recv_recovery_plc_frames"):
         assert f"# TYPE libjitsi_tpu_{name} counter" in txt, name
         assert f"libjitsi_tpu_{name} " in txt, name
+
+
+def test_e2e_recovery_ladder_fast_twin():
+    """Fast twin of the burst-loss ladder e2e: 10 rounds instead of 30,
+    same wiring — every rung (NACK, RTX, FEC, deadline PLC) must still
+    fire.  FEC-ratio adaptation needs the longer run and stays in the
+    slow twin."""
+    r = _run_recovery_e2e(rounds=10, per_round=6)
+    assert r.dropped > 0
+    assert r.rr.nacks.nacks_sent > 0
+    assert r.sfu.recovery.rtx_requests_served > 0
+    assert r.rr.fec_recovered > 0
+    assert r.rr.plc_frames > 0
+    assert r.rr.nacks.pending_count() == 0
+    residual = len(r.missing) - r.rr.plc_frames
+    assert residual <= 0.01 * r.sent, \
+        f"residual {residual}/{r.sent} (missing {len(r.missing)})"
 
 
 def test_e2e_upstream_nack_from_bridge_gap_detection():

@@ -16,7 +16,7 @@ from libjitsi_tpu.transform.header_ext import TransportCCEngine
 def test_tcc_lookup_survives_16bit_wrap():
     """Feedback carries 16-bit seqs; lookup must unwrap past 65535."""
     eng = TransportCCEngine(ext_id=5, clock=lambda: 3.0)
-    eng.next_seq = 70_000  # counter already past one wrap
+    eng.next_seq_ext = 70_000  # counter already past one wrap
     b = rtp_header.build([b"x"], [1], [0], [9], [96], stream=[0])
     eng.rtp_transformer.transform(b)  # sends ext seq 70000
     assert eng.lookup_send_time(70_000 & 0xFFFF) == 3.0
