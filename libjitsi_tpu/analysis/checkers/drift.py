@@ -12,7 +12,11 @@ then for each class whose counters are *partially* covered we flag the
 uncovered counter attributes.  Vice versa, a registered attribute that
 no class ever defines is a typo that renders as a permanent ``0``
 metric — also flagged.  Classes with NO registered counters are out of
-scope (internal helpers have no exporter contract).
+scope (internal helpers have no exporter contract).  The same pass
+covers ``Histogram``s: a class that constructs one and feeds it with
+``observe``/``observe_array`` must hand it to the registry somewhere
+(``register_histogram`` or the ``registry.histogram`` factory), else
+the distribution is recorded but unscrapeable.
 
 **Snapshot drift** (per-file): subclasses of ``ArraySnapshotMixin``
 must list every mutable array field in ``_SNAP_FIELDS`` (or carry it
@@ -153,6 +157,62 @@ def _registered_attrs(ctx: FileContext) -> Set[str]:
     return out
 
 
+def _registered_hist_attrs(ctx: FileContext) -> Set[str]:
+    """Histogram attribute names that reach the exporter in this file:
+    mentioned inside a ``register_histogram(...)`` call, or assigned
+    from the ``registry.histogram(...)`` factory (which registers on
+    creation, so the factory form has no drift window)."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and \
+                call_func_name(node) == "register_histogram":
+            for n in ast.walk(node):
+                if isinstance(n, ast.Attribute):
+                    out.add(n.attr)
+        elif isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                call_func_name(node.value) == "histogram":
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute):
+                    out.add(tgt.attr)
+                elif isinstance(tgt, ast.Name):
+                    out.add(tgt.id)
+    return out
+
+
+def _class_histograms(ctx: FileContext
+                      ) -> List[Tuple[str, ast.AST, Set[str], Set[str]]]:
+    """(class, node, ctor-assigned hist attrs, observed hist attrs) for
+    every class that constructs a bare ``Histogram(...)``.  Anchoring on
+    the constructor assignment keeps `.observe` calls on non-histogram
+    objects (Watchdog.observe, LossTracker.observe) out of scope."""
+    out = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        created: Set[str] = set()
+        observed: Set[str] = set()
+        for n in ast.walk(node):
+            if isinstance(n, ast.Assign) and \
+                    isinstance(n.value, ast.Call) and \
+                    call_func_name(n.value) == "Histogram":
+                for tgt in n.targets:
+                    if isinstance(tgt, ast.Attribute) and \
+                            isinstance(tgt.value, ast.Name) and \
+                            tgt.value.id == "self":
+                        created.add(tgt.attr)
+            elif isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in ("observe", "observe_array") and \
+                    isinstance(n.func.value, ast.Attribute) and \
+                    isinstance(n.func.value.value, ast.Name) and \
+                    n.func.value.value.id == "self":
+                observed.add(n.func.value.attr)
+        if created:
+            out.append((node.name, node, created, observed))
+    return out
+
+
 def _class_counters(ctx: FileContext) -> List[Tuple[str, str, ast.AST,
                                                     Set[str]]]:
     """(class, file, node, counter-attrs) for every class that both
@@ -211,6 +271,21 @@ def check_metrics_drift(index: Dict[str, FileContext]) -> List[Finding]:
                         "while sibling counters "
                         f"({', '.join(sorted(covered)[:3])}) are — "
                         "invisible in production"))
+
+    # histogram half: a Histogram constructed and fed but never handed
+    # to the registry records distributions nobody can scrape
+    hist_registered: Set[str] = set()
+    for ctx in index.values():
+        hist_registered |= _registered_hist_attrs(ctx)
+    for ctx in index.values():
+        for cls_name, node, created, observed in _class_histograms(ctx):
+            for attr in sorted((created & observed) - hist_registered):
+                findings.append(ctx.finding(
+                    RULE, node,
+                    f"histogram `{cls_name}.{attr}` is observed but "
+                    "never registered with MetricsRegistry (use "
+                    "register_histogram or the registry.histogram "
+                    "factory) — invisible in production"))
 
     # vice versa: registered attribute names that exist nowhere
     for ctx in index.values():

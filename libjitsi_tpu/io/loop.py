@@ -26,10 +26,15 @@ from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.io.pcap import PcapWriter
 from libjitsi_tpu.io.udp import UdpEngine
 from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.utils.flight import FlightRecorder
 from libjitsi_tpu.utils.logging import get_logger
 from libjitsi_tpu.utils.metrics import MetricsRegistry
+from libjitsi_tpu.utils.tracing import PipelineTracer
 
 _log = get_logger("io.loop")
+
+#: wire datagram sizes: 64B keepalives up to jumbo-ish video bursts
+PACKET_SIZE_BUCKETS = (64, 128, 256, 512, 768, 1024, 1280, 1500)
 
 
 def _is_rtcp(data: np.ndarray, length: np.ndarray) -> np.ndarray:
@@ -57,7 +62,9 @@ class MediaLoop:
                  pcap_tap: Optional[PcapWriter] = None,
                  metrics: Optional[MetricsRegistry] = None,
                  recv_window_ms: int = 1,
-                 pipelined: bool = False):
+                 pipelined: bool = False,
+                 tracer: Optional[PipelineTracer] = None,
+                 flight: Optional[FlightRecorder] = None):
         self.engine = engine
         self.registry = registry
         self.chain = chain
@@ -80,6 +87,17 @@ class MediaLoop:
         self.on_dtls = on_dtls
         self.pcap = pcap_tap
         self.metrics = metrics or MetricsRegistry()
+        # stage spans (ingress/reverse_chain/forward_chain/egress) feed
+        # per-stage rings + the supervisor's per-tick budget ledger;
+        # bridges share this tracer so their stages land in one ledger
+        self.tracer = tracer if tracer is not None else \
+            PipelineTracer(self.metrics)
+        # optional flight recorder: per-stream header samples + drop
+        # events for post-mortems (attached by the supervisor)
+        self.flight = flight
+        self.pkt_size_hist = self.metrics.histogram(
+            "packet_size_bytes", PACKET_SIZE_BUCKETS,
+            help_="received datagram sizes")
         self.recv_window_ms = recv_window_ms
         # learned (ip, port) per stream row (latched from last packet)
         self.addr_ip = np.zeros(registry.capacity, dtype=np.uint32)
@@ -138,13 +156,18 @@ class MediaLoop:
         # re-established below only when this tick carries RTP rows; a
         # stale previous-tick value must never masquerade as fresh
         self.last_rtp_arrival_ns = None
-        if self.use_kernel_ts:
-            batch, sip, sport, ats = self.engine.recv_batch_ts(
-                self.recv_window_ms)
-        else:
-            batch, sip, sport = self.engine.recv_batch(self.recv_window_ms)
-            ats = None
+        with self.tracer.span("ingress"):
+            if self.use_kernel_ts:
+                batch, sip, sport, ats = self.engine.recv_batch_ts(
+                    self.recv_window_ms)
+            else:
+                batch, sip, sport = self.engine.recv_batch(
+                    self.recv_window_ms)
+                ats = None
         n = batch.batch_size
+        if n:
+            self.pkt_size_hist.observe_array(
+                np.asarray(batch.length)[:n])
         self.ticks += 1
         # the recv window just elapsed: anything dispatched last tick
         # has had a full socket-wait of device time — flush it now
@@ -228,11 +251,20 @@ class MediaLoop:
         if len(rtcp_rows) and self._hold_q:
             rtcp_rows = rtcp_rows[~self._hold_mask[sids[rtcp_rows]]]
 
-        with self.metrics.timing("reverse_chain"):
+        with self.tracer.span("reverse_chain"):
             if len(rtp_rows):
                 rtp = PacketBatch(sub.data[rtp_rows],
                                   np.asarray(sub.length)[rtp_rows],
                                   sub.stream[rtp_rows])
+                if self.flight is not None:
+                    # sample RTP headers (seq at bytes 2..3) into the
+                    # per-stream flight rings — vectorized field pulls,
+                    # bounded rows per stream inside record_headers
+                    d = rtp.data
+                    seqs = ((d[:, 2].astype(np.int64) << 8) | d[:, 3])
+                    self.flight.record_headers(
+                        rtp.stream, seqs, np.asarray(rtp.length),
+                        tick=self.ticks)
                 self.last_rtp_arrival_ns = (
                     ats[rtp_rows] if ats is not None else None)
                 if self.chain is not None:
@@ -270,7 +302,7 @@ class MediaLoop:
         stream row's latched address."""
         if batch.batch_size == 0:
             return 0
-        with self.metrics.timing("forward_chain"):
+        with self.tracer.span("forward_chain"):
             if self.chain is not None:
                 batch, ok = self.chain.rtp_transformer.transform(batch)
             else:
@@ -282,8 +314,9 @@ class MediaLoop:
                           np.asarray(batch.length)[rows],
                           batch.stream[rows])
         sids = np.clip(out.stream, 0, self.registry.capacity - 1)
-        sent = self.engine.send_batch(out, self.addr_ip[sids],
-                                      self.addr_port[sids])
+        with self.tracer.span("egress"):
+            sent = self.engine.send_batch(out, self.addr_ip[sids],
+                                          self.addr_port[sids])
         self.tx_packets += sent
         return sent
 
@@ -295,7 +328,7 @@ class MediaLoop:
             return 0
         if self.chain is None:
             return self.send_media(batch)       # nothing to overlap
-        with self.metrics.timing("forward_dispatch"):
+        with self.tracer.span("forward_chain"):
             pending, mask = self.chain.rtp_transformer.transform_async(
                 batch)
         self._inflight.append((pending, mask))
@@ -305,17 +338,19 @@ class MediaLoop:
         """Materialize + transmit every in-flight dispatched batch."""
         sent = 0
         inflight, self._inflight = self._inflight, []
-        for pending, mask in inflight:
-            out = pending.result()
-            rows = np.nonzero(mask)[0]
-            if len(rows) == 0:
-                continue
-            sub = PacketBatch(out.data[rows],
-                              np.asarray(out.length)[rows],
-                              out.stream[rows])
-            sids = np.clip(sub.stream, 0, self.registry.capacity - 1)
-            sent += self.engine.send_batch(sub, self.addr_ip[sids],
-                                           self.addr_port[sids])
+        with self.tracer.span("egress"):
+            for pending, mask in inflight:
+                out = pending.result()
+                rows = np.nonzero(mask)[0]
+                if len(rows) == 0:
+                    continue
+                sub = PacketBatch(out.data[rows],
+                                  np.asarray(out.length)[rows],
+                                  out.stream[rows])
+                sids = np.clip(sub.stream, 0,
+                               self.registry.capacity - 1)
+                sent += self.engine.send_batch(sub, self.addr_ip[sids],
+                                               self.addr_port[sids])
         self.tx_packets += sent
         return sent
 

@@ -1,4 +1,5 @@
 from libjitsi_tpu.service.bridge import ConferenceBridge  # noqa: F401
 from libjitsi_tpu.service.sfu_bridge import SfuBridge  # noqa: F401
+from libjitsi_tpu.service.obs_server import ObservabilityServer  # noqa: F401
 from libjitsi_tpu.service.supervisor import (  # noqa: F401
     BridgeSupervisor, SupervisorConfig)

@@ -130,6 +130,9 @@ class ConferenceBridge:
         # non-essential tick work — speaker scoring, recorder events,
         # egress level stamping — while media keeps flowing
         self.degraded = False
+        # flight recorder slot (attached by BridgeSupervisor; shared
+        # with self.loop for packet-header sampling)
+        self.flight = None
         self.ticks = 0
 
     # ------------------------------------------------------- participants
@@ -220,6 +223,11 @@ class ConferenceBridge:
         self.bank = ReceiveBank(self.capacity, mixer=self.mixer,
                                 payload_cap=max(256, frame_samples),
                                 mixer_rate=rate, plc=self._plc)
+        # the bank is born AFTER any supervisor registered its metrics
+        # (first join builds it), so it exports itself on the loop's
+        # registry; name-keyed registration makes a restore's rebuilt
+        # bank overwrite the old closures rather than duplicate them
+        self.bank.register_metrics(self.loop.metrics)
 
     def add_participant_dtls(self, ssrc: int,
                              codec: Optional[FrameCodec] = None,
@@ -282,11 +290,13 @@ class ConferenceBridge:
             return {"rx": rx, "mixed": 0, "tx": 0,
                     "levels": np.zeros(0, dtype=np.uint8),
                     "dominant": -1}
-        sids, _frames = self.bank.tick(now=self._now)
-        out, levels = self.mixer.mix()
-        if not self.degraded:
-            self.speaker.levels(levels)
-            self._update_egress_levels(levels)
+        with self.loop.tracer.span("decode"):
+            sids, _frames = self.bank.tick(now=self._now)
+        with self.loop.tracer.span("mixer"):
+            out, levels = self.mixer.mix()
+            if not self.degraded:
+                self.speaker.levels(levels)
+                self._update_egress_levels(levels)
         tx = self._send_mixes(out)
         self.ticks += 1
         return {"rx": rx, "mixed": len(sids), "tx": tx,

@@ -1,0 +1,152 @@
+"""ObservabilityServer: stdlib-http surface for the metrics plane.
+
+A threaded `http.server` (no framework, no new deps) serving:
+
+  /metrics              Prometheus text exposition (registry render)
+  /healthz              supervisor health JSON; 503 when stalled
+  /debug/streams/<sid>  flight-recorder dump for one stream
+  /debug/postmortems    supervisor's bounded post-mortem list
+
+The server binds an ephemeral port by default (`port=0`; read `.port`
+after `start()`), runs on a daemon thread, and never touches the data
+path — `/metrics` renders from the same dense arrays the tick already
+maintains, so a scrape costs one string build, not a lock on the loop.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from libjitsi_tpu.utils.logging import get_logger
+
+_log = get_logger("service.obs")
+
+CONTENT_TYPE_METRICS = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def _jsonable(obj):
+    """json.dumps default= hook: numpy scalars/arrays -> python."""
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    return str(obj)
+
+
+class ObservabilityServer:
+    """Serve /metrics, /healthz and flight-recorder debug dumps."""
+
+    def __init__(self, metrics=None, supervisor=None, flight=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.metrics = metrics
+        self.supervisor = supervisor
+        # explicit flight wins; else follow the supervisor's recorder
+        self._flight = flight
+        self.host = host
+        self.port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def flight(self):
+        if self._flight is not None:
+            return self._flight
+        return getattr(self.supervisor, "flight", None)
+
+    # ---------------------------------------------------------- handlers
+    def _metrics_text(self) -> str:
+        if self.metrics is None:
+            return "\n"
+        return self.metrics.render()
+
+    def _health(self) -> dict:
+        if self.supervisor is None:
+            return {"ok": True, "state": "unknown"}
+        h = dict(self.supervisor.health())
+        h["ok"] = h.get("state") != "stalled"
+        return h
+
+    def _route(self, path: str):
+        """-> (status, content_type, body_bytes)"""
+        if path == "/metrics":
+            return (200, CONTENT_TYPE_METRICS,
+                    self._metrics_text().encode("utf-8"))
+        if path == "/healthz":
+            h = self._health()
+            code = 200 if h.get("ok") else 503
+            return (code, "application/json",
+                    json.dumps(h, default=_jsonable).encode("utf-8"))
+        if path.startswith("/debug/streams/"):
+            flight = self.flight
+            sid_s = path[len("/debug/streams/"):]
+            if flight is None or not sid_s.lstrip("-").isdigit():
+                return (404, "application/json", b'{"error": "no such '
+                        b'stream or no flight recorder"}')
+            body = json.dumps(flight.dump(int(sid_s)),
+                              default=_jsonable)
+            return (200, "application/json", body.encode("utf-8"))
+        if path == "/debug/streams":
+            flight = self.flight
+            streams = flight.streams() if flight is not None else []
+            return (200, "application/json",
+                    json.dumps({"streams": streams}).encode("utf-8"))
+        if path == "/debug/postmortems":
+            pms = list(getattr(self.supervisor, "postmortems", ()))
+            return (200, "application/json",
+                    json.dumps(pms, default=_jsonable).encode("utf-8"))
+        return (404, "application/json", b'{"error": "not found"}')
+
+    # ----------------------------------------------------------- control
+    def start(self) -> "ObservabilityServer":
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):          # noqa: N802 (stdlib API name)
+                path = self.path.split("?", 1)[0]
+                try:
+                    status, ctype, body = outer._route(path)
+                except Exception as exc:   # render must never kill scrape
+                    status, ctype = 500, "application/json"
+                    body = json.dumps(
+                        {"error": repr(exc)}).encode("utf-8")
+                self.send_response(status)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                _log.debug("http", line=(fmt % args))
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port),
+                                          _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="obs-server", daemon=True)
+        self._thread.start()
+        _log.info("obs_server_started", host=self.host, port=self.port)
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+
+    def __enter__(self) -> "ObservabilityServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()

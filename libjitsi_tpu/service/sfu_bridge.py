@@ -192,6 +192,9 @@ class SfuBridge:
         # shrink-RTX-second escalation rungs.  Transient (like the
         # caches): a restored bridge re-learns loss state from traffic.
         self.recovery = RecoveryController(recovery_config)
+        # flight recorder slot (attached by BridgeSupervisor; shared
+        # with self.loop and self.recovery)
+        self.flight = None
         self.loop = MediaLoop(
             UdpEngine(port=port, max_batch=4 * capacity,
                       kernel_timestamps=kernel_timestamps),
@@ -237,11 +240,16 @@ class SfuBridge:
 
     # ---------------------------------------------------------- endpoints
     def add_endpoint(self, ssrc: int, rx_key: Tuple[bytes, bytes],
-                     tx_key: Tuple[bytes, bytes]) -> int:
+                     tx_key: Tuple[bytes, bytes],
+                     name: Optional[str] = None) -> int:
         if ssrc in self._ssrc_of.values():
             raise ValueError(f"ssrc {ssrc:#x} already joined")
         self._quiesce_fanout()
         sid = self.registry.alloc(self)
+        if name is not None:
+            # SDES-style display name: hostile input, escaped at
+            # metric render time (never trusted raw)
+            self.loop.metrics.set_stream_name(sid, name)
         self.rx_table.add_stream(sid, *rx_key)
         self.tx_table.add_stream(sid, *tx_key)
         self.translator.add_receiver(sid, *tx_key)
@@ -336,6 +344,7 @@ class SfuBridge:
                     self.registry.release(row)
         self.loop.addr_ip[sid] = 0
         self.loop.addr_port[sid] = 0
+        self.loop.metrics.set_stream_name(sid, None)
         self.registry.release(sid)
         self._rebuild_routes()
         _log.info("endpoint_leave", sid=sid)
@@ -498,9 +507,15 @@ class SfuBridge:
             track.rtx_seq[sid] = (track.rtx_seq[sid]
                                   + out.batch_size) & 0xFFFF
             wire = self.tx_table.protect_rtp(out)
-            sent = self.loop.engine.send_batch(
-                wire, self.loop.addr_ip[sid], self.loop.addr_port[sid])
+            with self.loop.tracer.span("egress"):
+                sent = self.loop.engine.send_batch(
+                    wire, self.loop.addr_ip[sid],
+                    self.loop.addr_port[sid])
             self.retransmitted += sent
+            if self.flight is not None:
+                self.flight.record("rtx_served", sid=sid,
+                                   ssrc=int(track.out_ssrc),
+                                   n=len(copies), rtx=True)
             _log.debug("video_nack_rtx", sid=sid, sent=sent)
             return True
         return False
@@ -536,7 +551,8 @@ class SfuBridge:
         hdr = rtp_header.parse(sub)
         # uplink loss detection: gaps in each sender's seq space queue
         # upstream NACKs (drained toward the sender by emit_feedback)
-        self.recovery.observe_rx(hdr.ssrc, hdr.seq, self._now)
+        with self.loop.tracer.span("recovery"):
+            self.recovery.observe_rx(hdr.ssrc, hdr.seq, self._now)
         self._feed_bwe(sub, rows, hdr=hdr)
         # stamp the bridge's own abs-send-time before the fan-out so
         # every receiver leg can run receive-side GCC on its downlink
@@ -554,10 +570,13 @@ class SfuBridge:
                                   sub.stream[keep])
                 idx_sel = idx_sel[keep]
         if self.pipelined:
-            self._pending_fanout.append(
-                self.translator.translate_async(sub, idx_sel))
+            with self.loop.tracer.span("forward_chain"):
+                self._pending_fanout.append(
+                    self.translator.translate_async(sub, idx_sel))
             return None
-        self._emit_fanout(*self.translator.translate(sub, idx_sel))
+        with self.loop.tracer.span("forward_chain"):
+            wire, recv = self.translator.translate(sub, idx_sel)
+        self._emit_fanout(wire, recv)
         return None
 
     def _quiesce_fanout(self) -> None:
@@ -598,8 +617,9 @@ class SfuBridge:
         self.cache.insert_batch(
             (recv.astype(np.int64) << 32) | hdr.ssrc.astype(np.int64),
             hdr.seq, copies, now=self._now)
-        sent = self.loop.engine.send_batch(
-            wire, self.loop.addr_ip[recv], self.loop.addr_port[recv])
+        with self.loop.tracer.span("egress"):
+            sent = self.loop.engine.send_batch(
+                wire, self.loop.addr_ip[recv], self.loop.addr_port[recv])
         self.forwarded += sent
         # adaptive FEC over the PROTECTED per-leg copies: XOR of SRTP
         # ciphertexts is opaque, and a recovered packet still passes the
@@ -615,9 +635,15 @@ class SfuBridge:
                     fec_addr.append(int(recv[j]))
             if fec_out:
                 fa = np.asarray(fec_addr, dtype=np.int64)
-                self.loop.engine.send_batch(
-                    PacketBatch.from_payloads(fec_out),
-                    self.loop.addr_ip[fa], self.loop.addr_port[fa])
+                with self.loop.tracer.span("egress"):
+                    self.loop.engine.send_batch(
+                        PacketBatch.from_payloads(fec_out),
+                        self.loop.addr_ip[fa], self.loop.addr_port[fa])
+                if self.flight is not None:
+                    for fsid in set(fec_addr):
+                        self.flight.record(
+                            "fec_sent", sid=fsid,
+                            n=fec_addr.count(fsid))
 
     def _feed_bwe(self, sub: PacketBatch, rows: np.ndarray,
                   hdr=None) -> None:
@@ -688,16 +714,24 @@ class SfuBridge:
         copies, missing = self.cache.lookup_nack(key, nack.lost_seqs,
                                                  return_missing=True)
         self.recovery.rtx_cache_miss += len(missing)
+        if missing and self.flight is not None:
+            self.flight.record("rtx_cache_miss", sid=sid,
+                               ssrc=int(nack.media_ssrc),
+                               n=len(missing))
         if not copies:
             return
         if not self.recovery.allow_rtx(sum(len(c) for c in copies),
                                        self._now):
             return      # over the retransmission-bandwidth budget
         out = PacketBatch.from_payloads(copies)
-        sent = self.loop.engine.send_batch(
-            out, self.loop.addr_ip[sid], self.loop.addr_port[sid])
+        with self.loop.tracer.span("egress"):
+            sent = self.loop.engine.send_batch(
+                out, self.loop.addr_ip[sid], self.loop.addr_port[sid])
         self.retransmitted += sent
         self.recovery.rtx_requests_served += len(copies)
+        if self.flight is not None:
+            self.flight.record("rtx_served", sid=sid,
+                               ssrc=int(nack.media_ssrc), n=len(copies))
         _log.debug("nack_served", sid=sid, lost=len(nack.lost_seqs),
                    sent=sent)
 
@@ -720,8 +754,9 @@ class SfuBridge:
         # bridge-detected uplink losses (budgeted, held off, deduped by
         # the NackScheduler) merge into the same termination window as
         # receiver-relayed NACKs
-        for ssrc, seqs in self.recovery.collect_upstream_nacks(
-                now).items():
+        with self.loop.tracer.span("recovery"):
+            upstream = self.recovery.collect_upstream_nacks(now)
+        for ssrc, seqs in upstream.items():
             self.rtcp_term.queue_nack(ssrc, seqs)
         if self._video:
             self._select_video_layers()

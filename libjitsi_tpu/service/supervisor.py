@@ -43,13 +43,16 @@ from __future__ import annotations
 import os
 import pickle
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from libjitsi_tpu.utils.flight import FlightRecorder
 from libjitsi_tpu.utils.health import (ExponentialBackoff, SlidingWindowCounter,
                                        Watchdog, retrying, state_code)
+from libjitsi_tpu.utils.tracing import PipelineTracer
 
 CKPT_MAGIC = "ljt-ckpt"
 CKPT_VERSION = 1
@@ -88,12 +91,24 @@ class BridgeSupervisor:
 
     def __init__(self, bridge, config: Optional[SupervisorConfig] = None,
                  metrics=None, priorities: Optional[Dict[int, int]] = None,
-                 clock: Callable[[], float] = time.perf_counter):
+                 clock: Callable[[], float] = time.perf_counter,
+                 flight: Optional[FlightRecorder] = None):
         self.bridge = bridge
         self.cfg = config or SupervisorConfig()
         self.loop = getattr(bridge, "loop", bridge)
         self.clock = clock
         self.priorities = priorities or {}
+        # flight recorder: every destructive action below (quarantine,
+        # shed, recover) dumps a post-mortem naming its trigger
+        self.flight = flight if flight is not None else FlightRecorder()
+        self.postmortems: deque = deque(maxlen=32)
+        self._attach_flight()
+        # stage-budget ledger drained from the loop's PipelineTracer
+        # each tick: overload events name the dominant stage instead of
+        # just "the tick was slow"
+        self.tracer: Optional[PipelineTracer] = getattr(
+            self.loop, "tracer", None)
+        self.last_ledger: Dict[str, float] = {}
         cap = self.loop.registry.capacity
         self.watchdog = Watchdog(self.cfg.deadline_ms / 1000.0,
                                  overload_after=self.cfg.overload_after,
@@ -127,6 +142,15 @@ class BridgeSupervisor:
         if metrics is not None:
             self.register_metrics(metrics)
 
+    def _attach_flight(self) -> None:
+        """Hand the recorder to every pipeline piece that can feed it
+        (loop header samples, recovery-ladder actions, bridge events).
+        Only objects that declare a `flight` slot participate."""
+        for obj in (self.loop, self.bridge,
+                    getattr(self.bridge, "recovery", None)):
+            if obj is not None and hasattr(obj, "flight"):
+                obj.flight = self.flight
+
     # ------------------------------------------------------------- tick
 
     def tick(self, now: Optional[float] = None):
@@ -134,6 +158,8 @@ class BridgeSupervisor:
         result = (self.bridge.tick(now=now) if now is not None
                   else self.bridge.tick())
         over = self.watchdog.observe(self.clock() - t0)
+        if self.tracer is not None:
+            self.last_ledger = self.tracer.take_ledger()
         self.ticks += 1
         self._update_quarantine()
         if over:
@@ -157,6 +183,14 @@ class BridgeSupervisor:
     def _escalate(self) -> None:
         self.level += 1
         rec = getattr(self.bridge, "recovery", None)
+        # budget attribution: the ladder acts on WHERE the tick budget
+        # went, not just that it overran — the dominant stage rides on
+        # every escalation event for the post-mortem
+        stage, stage_s = PipelineTracer.dominant(self.last_ledger)
+        self.flight.record(
+            "ladder_escalate", tick=self.ticks, level=self.level,
+            worst_s=self.watchdog.worst_s,
+            stage=stage or "unknown", stage_s=stage_s)
         if self.level == 1:
             # stop waiting for packets: the batching window is latency
             # the tick can't afford while behind
@@ -178,11 +212,15 @@ class BridgeSupervisor:
 
     def _deescalate(self) -> None:
         rec = getattr(self.bridge, "recovery", None)
+        self.flight.record("ladder_deescalate", tick=self.ticks,
+                           level=self.level - 1)
         shed_floor = 5 if rec is not None else 3
         if self.level >= shed_floor and self._shed:
             for _ in range(min(self.cfg.shed_step, len(self._shed))):
                 sid = self._shed.pop()
                 self._shed_set.discard(sid)
+                self.flight.record("shed_restore", sid=sid,
+                                   tick=self.ticks)
             self._sync_drop_mask()
         elif rec is not None and self.level == 4:
             rec.throttle_rtx(False)
@@ -215,9 +253,18 @@ class BridgeSupervisor:
                  if s not in self._shed_set and s not in self._quarantined
                  and s != dominant]
         cands.sort(key=lambda s: (self.priorities.get(s, 0), -s))
+        stage, stage_s = PipelineTracer.dominant(self.last_ledger)
         for sid in cands[:k]:
             self._shed.append(sid)
             self._shed_set.add(sid)
+            ev = self.flight.record(
+                "shed", sid=sid, tick=self.ticks, level=self.level,
+                priority=self.priorities.get(sid, 0),
+                stage=stage or "unknown", stage_s=stage_s)
+            self.postmortems.append({
+                "trigger": "overload_shed", "sid": sid,
+                "tick": self.ticks, "event": ev,
+                "dump": self.flight.dump(sid)})
         if cands[:k]:
             self._sync_drop_mask()
 
@@ -230,10 +277,20 @@ class BridgeSupervisor:
         cap = len(self._last_auth)
         auth = np.asarray(table.auth_fail[:cap])
         replay = np.asarray(table.replay_reject[:cap])
-        self._auth_win.push(auth - self._last_auth)
-        self._replay_win.push(replay - self._last_replay)
+        d_auth = auth - self._last_auth
+        d_replay = replay - self._last_replay
+        self._auth_win.push(d_auth)
+        self._replay_win.push(d_replay)
         self._last_auth[:] = auth
         self._last_replay[:] = replay
+        # per-stream failure deltas feed the flight ring: when a
+        # conviction lands, the dump shows the storm that caused it
+        for sid in np.nonzero(d_auth > 0)[0]:
+            self.flight.record("srtp_auth_fail", sid=int(sid),
+                               tick=self.ticks, n=int(d_auth[sid]))
+        for sid in np.nonzero(d_replay > 0)[0]:
+            self.flight.record("srtp_replay_reject", sid=int(sid),
+                               tick=self.ticks, n=int(d_replay[sid]))
 
         changed = False
         for sid in [s for s, until in self._quarantined.items()
@@ -241,6 +298,8 @@ class BridgeSupervisor:
             del self._quarantined[sid]
             self._auth_win.reset_rows([sid])
             self._replay_win.reset_rows([sid])
+            self.flight.record("quarantine_release", sid=sid,
+                               tick=self.ticks)
             changed = True
 
         auth_sum = self._auth_win.sums()
@@ -256,6 +315,18 @@ class BridgeSupervisor:
                 self._ban.delay(strikes))
             self._q_strikes[sid] = strikes + 1
             self.quarantine_total += 1
+            reason = ("auth_storm"
+                      if auth_sum[sid] >= self.cfg.quarantine_auth_threshold
+                      else "replay_storm")
+            ev = self.flight.record(
+                "quarantine", sid=sid, tick=self.ticks, reason=reason,
+                auth_window=int(auth_sum[sid]),
+                replay_window=int(replay_sum[sid]),
+                until=self._quarantined[sid], strikes=strikes + 1)
+            self.postmortems.append({
+                "trigger": "quarantine", "sid": sid,
+                "tick": self.ticks, "event": ev,
+                "dump": self.flight.dump(sid)})
             self._auth_win.reset_rows([sid])
             self._replay_win.reset_rows([sid])
             changed = True
@@ -286,6 +357,8 @@ class BridgeSupervisor:
             pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
         os.replace(tmp, path)
         self.checkpoints_written += 1
+        self.flight.record("checkpoint_saved", tick=self.ticks,
+                           path=path)
         return path
 
     @staticmethod
@@ -317,6 +390,13 @@ class BridgeSupervisor:
             retries=retries, backoff_s=backoff_s, sleep=sleep)
         sup = cls(bridge, config=supervisor_config, metrics=metrics)
         sup.ticks = blob["ticks"]
+        # crash-restart is a destructive action like any other: it
+        # leaves a post-mortem naming the checkpoint it rose from
+        ev = sup.flight.record("recovered", tick=sup.ticks, path=path,
+                               bridge=blob["bridge"])
+        sup.postmortems.append({
+            "trigger": "checkpoint_recover", "tick": sup.ticks,
+            "event": ev, "dump": sup.flight.dump_all()})
         return sup
 
     # --------------------------------------------------- observability
@@ -349,16 +429,21 @@ class BridgeSupervisor:
             lambda: self.loop.inbound_dropped_total,
             help_="packets dropped by shed/quarantine masks",
             kind="counter")
+        # per-stream arrays are registered as CALLABLES resolving
+        # through self.bridge/self.loop at render time: a checkpoint
+        # restore that rebinds rx_table (or the whole bridge) must not
+        # leave the exporter reading the pre-restore arrays
         registry.register_array(
-            "inbound_dropped", self.loop.inbound_dropped,
+            "inbound_dropped", lambda: self.loop.inbound_dropped,
             help_="per-stream packets dropped at ingress", kind="counter")
         table = getattr(self.bridge, "rx_table", None)
         if table is not None and hasattr(table, "auth_fail"):
             registry.register_array(
-                "srtp_auth_fail", table.auth_fail,
+                "srtp_auth_fail", lambda: self.bridge.rx_table.auth_fail,
                 help_="SRTP authentication failures", kind="counter")
             registry.register_array(
-                "srtp_replay_reject", table.replay_reject,
+                "srtp_replay_reject",
+                lambda: self.bridge.rx_table.replay_reject,
                 help_="SRTP replay-window rejections", kind="counter")
         rec = getattr(self.bridge, "recovery", None)
         if rec is not None:
@@ -366,13 +451,17 @@ class BridgeSupervisor:
         bank = getattr(self.bridge, "bank", None)
         if bank is not None and hasattr(bank, "plc_frames"):
             registry.register_array(
-                "plc_frames", bank.plc_frames,
+                "plc_frames", lambda: self.bridge.bank.plc_frames,
                 help_="frames concealed by packet-loss concealment",
                 kind="counter")
+            if hasattr(bank, "register_metrics"):
+                bank.register_metrics(registry)
 
     def health(self) -> dict:
         """Liveness summary for probes / logs."""
         return {"state": self.watchdog.state, "level": self.level,
                 "shed": sorted(self._shed_set),
                 "quarantined": sorted(self._quarantined),
-                "ticks": self.ticks, "overruns": self.watchdog.overruns}
+                "ticks": self.ticks, "overruns": self.watchdog.overruns,
+                "last_ledger": dict(self.last_ledger),
+                "postmortems": len(self.postmortems)}

@@ -311,6 +311,14 @@ class RecoveryController:
         self.rtx_budget_dropped = 0
         self.fec_shed = False
         self.rtx_throttled = False
+        # optional flight recorder (attached by BridgeSupervisor):
+        # ladder transitions and NACK/RTX actions leave forensic events
+        self.flight = None
+
+    def _rec(self, kind: str, sid: Optional[int] = None,
+             **fields) -> None:
+        if self.flight is not None:
+            self.flight.record(kind, sid=sid, **fields)
 
     # ------------------------------------------------------------ uplink
     def observe_rx(self, ssrcs, seqs, now: float) -> None:
@@ -326,11 +334,15 @@ class RecoveryController:
             losses, advanced = tr.observe(int(seq))
             if losses:
                 self.nacks.on_losses(ssrc, losses, now)
+                self._rec("nack_queued", ssrc=ssrc, n=len(losses))
             elif not advanced:
                 self.nacks.on_arrival(ssrc, int(seq))
 
     def collect_upstream_nacks(self, now: float) -> Dict[int, List[int]]:
         nacks, _expired = self.nacks.collect(now)
+        if nacks:
+            self._rec("nack_upstream", streams=len(nacks),
+                      seqs=sum(len(v) for v in nacks.values()))
         return nacks
 
     # ---------------------------------------------------------- downlink
@@ -347,6 +359,7 @@ class RecoveryController:
         if self.rtx_bucket.allow(nbytes, now):
             return True
         self.rtx_budget_dropped += 1
+        self._rec("rtx_budget_drop", nbytes=int(nbytes))
         return False
 
     def fec_active(self) -> bool:
@@ -374,6 +387,7 @@ class RecoveryController:
         """Escalation rung: FEC overhead is the first bandwidth shed."""
         self.fec_shed = shed
         self.fec.set_shed(shed)
+        self._rec("fec_shed", shed=bool(shed))
         _log.info("recovery_fec_shed", shed=shed)
 
     def throttle_rtx(self, throttled: bool) -> None:
@@ -382,6 +396,7 @@ class RecoveryController:
         self.rtx_throttled = throttled
         self.rtx_bucket.set_scale(
             self.cfg.rtx_throttle_scale if throttled else 1.0)
+        self._rec("rtx_throttle", throttled=bool(throttled))
         _log.info("recovery_rtx_throttle", throttled=throttled)
 
     # --------------------------------------------------- observability

@@ -1,4 +1,8 @@
-from libjitsi_tpu.utils.metrics import MetricsRegistry  # noqa: F401
+from libjitsi_tpu.utils.metrics import (  # noqa: F401
+    Histogram, MetricsRegistry, TimingRing, exponential_buckets,
+    validate_exposition)
+from libjitsi_tpu.utils.tracing import PipelineTracer  # noqa: F401
+from libjitsi_tpu.utils.flight import FlightRecorder  # noqa: F401
 from libjitsi_tpu.utils.faults import (  # noqa: F401
     FaultInjectionEngine, GilbertElliott)
 from libjitsi_tpu.utils.health import (  # noqa: F401

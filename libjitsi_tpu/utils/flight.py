@@ -1,0 +1,118 @@
+"""Per-stream flight recorder: bounded rings of structured events.
+
+Every destructive runtime action (quarantine, shed, crash-recover)
+used to leave nothing behind but a log line; the flight recorder keeps
+the last-N structured events per stream (auth failures, NACK/RTX/FEC
+actions, packet-header samples) plus a global ring (ladder
+transitions, checkpoints), so the supervisor can dump a post-mortem
+naming the triggering event *at the moment it acts*.
+
+Events are plain dicts — JSON-serializable by construction — with a
+monotone global sequence number so a merged timeline across streams
+can be reconstructed from any dump.  Rings are bounded deques; the
+recorder is O(1) per event and safe to leave attached in production.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional
+
+import numpy as np
+
+
+def _plain(value: Any) -> Any:
+    """numpy scalars/arrays -> python, so events stay JSON-ready no
+    matter what the (dense-array-driven) call sites pass in."""
+    if isinstance(value, np.integer):
+        return int(value)
+    if isinstance(value, np.floating):
+        return float(value)
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    return value
+
+#: schema: every event carries seq (global monotone), t (monotonic
+#: clock), kind, and optionally sid/tick; remaining keys are
+#: kind-specific (see README "Observability" for the catalogue).
+EVENT_BASE_KEYS = ("seq", "t", "kind", "sid", "tick")
+
+
+class FlightRecorder:
+    """Bounded per-stream + global event rings."""
+
+    def __init__(self, per_stream: int = 64, global_events: int = 256,
+                 max_headers: int = 16,
+                 clock=time.monotonic):
+        self.per_stream = int(per_stream)
+        self.max_headers = int(max_headers)
+        self._clock = clock
+        self._seq_ext = 0  # monotone 64-bit event counter, not an RTP seq
+        self._streams: Dict[int, Deque[dict]] = {}
+        self._global: Deque[dict] = deque(maxlen=int(global_events))
+        self.events_recorded = 0
+
+    # ------------------------------------------------------------ record
+    def record(self, kind: str, sid: Optional[int] = None,
+               tick: Optional[int] = None, **fields: Any) -> dict:
+        """Append one event; routed to the stream ring when `sid` is
+        given, to the global ring otherwise."""
+        self._seq_ext += 1
+        self.events_recorded += 1
+        ev = {"seq": self._seq_ext, "t": self._clock(), "kind": str(kind)}
+        if sid is not None:
+            ev["sid"] = int(sid)
+        if tick is not None:
+            ev["tick"] = int(tick)
+        ev.update({k: _plain(v) for k, v in fields.items()})
+        if sid is None:
+            self._global.append(ev)
+        else:
+            ring = self._streams.get(int(sid))
+            if ring is None:
+                ring = self._streams[int(sid)] = deque(
+                    maxlen=self.per_stream)
+            ring.append(ev)
+        return ev
+
+    def record_headers(self, sids, seqs, lengths,
+                       tick: Optional[int] = None) -> None:
+        """Sample the tick's RTP headers into per-stream rings as one
+        compact `hdr` event per stream (bounded at `max_headers` rows
+        per stream per tick — this is a flight recorder, not a pcap)."""
+        per: Dict[int, List[List[int]]] = {}
+        for sid, seq, ln in zip(sids, seqs, lengths):
+            rows = per.setdefault(int(sid), [])
+            if len(rows) < self.max_headers:
+                rows.append([int(seq), int(ln)])
+        for sid, rows in per.items():
+            self.record("hdr", sid=sid, tick=tick, n=len(rows),
+                        headers=rows)
+
+    # -------------------------------------------------------------- dump
+    def dump(self, sid: int) -> dict:
+        """Post-mortem for one stream: its event ring plus the recent
+        global ring (ladder context) as JSON-ready dicts."""
+        return {
+            "sid": int(sid),
+            "events": [dict(e) for e in self._streams.get(int(sid), ())],
+            "global": [dict(e) for e in self._global],
+        }
+
+    def dump_all(self) -> dict:
+        return {
+            "streams": {int(s): [dict(e) for e in ring]
+                        for s, ring in self._streams.items()},
+            "global": [dict(e) for e in self._global],
+        }
+
+    def streams(self) -> List[int]:
+        return sorted(self._streams)
+
+    def clear(self, sid: Optional[int] = None) -> None:
+        if sid is None:
+            self._streams.clear()
+            self._global.clear()
+        else:
+            self._streams.pop(int(sid), None)

@@ -6,60 +6,199 @@ framework need one.  Metrics stay what the framework already has —
 dense arrays across streams — and the exporter renders them on demand;
 there is no per-increment overhead beyond the array ops the data path
 does anyway.  A timing ring buffer gives per-batch device latency
-percentiles (the p99 the north-star metric tracks).
+percentiles (the p99 the north-star metric tracks), exposed as a
+Prometheus `summary`; distribution metrics (packet sizes, jitter,
+decode delay) are fixed-bucket `Histogram`s filled with one
+`np.searchsorted` per batch.
+
+`validate_exposition` is a pure-python parser of the text format used
+by tests and `scripts/obs_smoke.py` as the runtime twin of the jitlint
+`drift` checker: every family typed exactly once, histogram buckets
+cumulative with `le="+Inf"` == `_count`, label values escaped.
 """
 
 from __future__ import annotations
 
+import math
 import time
-from typing import Callable, Dict, List, Optional, Tuple
+from typing import (Callable, Dict, Iterable, List, Optional, Sequence,
+                    Tuple, Union)
 
 import numpy as np
 
+ArraySource = Union[np.ndarray, Callable[[], np.ndarray]]
+
+
+def escape_label_value(value: object) -> str:
+    """Escape a label value per the Prometheus text exposition format:
+    backslash, double-quote and newline must be escaped so a hostile
+    SDES stream name cannot break out of the label and corrupt (or
+    forge) the rest of the scrape."""
+    return (str(value).replace("\\", "\\\\").replace("\n", "\\n")
+            .replace('"', '\\"'))
+
+
+def escape_help(text: str) -> str:
+    """# HELP text: escape backslash and newline (quotes are legal)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(value: float) -> str:
+    """Float sample value without exponent noise ('0.001', not '1e-03'
+    for bucket bounds; samples keep %g compactness)."""
+    return f"{float(value):.6g}"
+
+
+def _fmt_le(upper: float) -> str:
+    if math.isinf(upper):
+        return "+Inf"
+    f = float(upper)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+class SpanTimer:
+    """Per-entry timer token: holds its own t0, so overlapping and
+    nested timers over the same ring never clobber each other (the
+    reentrancy bug of storing t0 on the shared ring)."""
+
+    __slots__ = ("_ring", "_t0", "seconds")
+
+    def __init__(self, ring: "TimingRing"):
+        self._ring = ring
+        self._t0 = time.perf_counter()
+        self.seconds: Optional[float] = None
+
+    def stop(self) -> float:
+        if self.seconds is None:           # idempotent
+            self.seconds = time.perf_counter() - self._t0
+            self._ring.record(self.seconds)
+        return self.seconds
+
+    def __enter__(self) -> "SpanTimer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
 
 class TimingRing:
-    """Fixed-size ring of durations (seconds) -> percentiles."""
+    """Fixed-size ring of durations (seconds) -> percentiles.
+
+    Rendered as a Prometheus `summary`: quantile samples from the ring
+    window plus lifetime `_sum`/`_count`.  As a context manager it
+    keeps a LIFO stack of start times, so `with ring:` nests correctly;
+    `span()` hands out an independent `SpanTimer` token for overlapping
+    (non-LIFO) measurement."""
 
     def __init__(self, size: int = 4096):
         self._buf = np.zeros(size, dtype=np.float64)
         self._n = 0
         self._i = 0
+        self._stack: List[SpanTimer] = []
+        self.sum = 0.0
+        self.count = 0
 
     def record(self, seconds: float) -> None:
         self._buf[self._i] = seconds
         self._i = (self._i + 1) % len(self._buf)
         self._n = min(self._n + 1, len(self._buf))
+        self.sum += seconds
+        self.count += 1
 
     def percentile(self, q: float) -> float:
         if self._n == 0:
             return 0.0
         return float(np.percentile(self._buf[: self._n], q))
 
-    def __enter__(self):
-        self._t0 = time.perf_counter()
+    def span(self) -> SpanTimer:
+        return SpanTimer(self)
+
+    def __enter__(self) -> "TimingRing":
+        self._stack.append(SpanTimer(self))
         return self
 
-    def __exit__(self, *exc):
-        self.record(time.perf_counter() - self._t0)
+    def __exit__(self, *exc) -> None:
+        self._stack.pop().stop()
+
+
+def exponential_buckets(start: float, factor: float, count: int
+                        ) -> List[float]:
+    """`count` bucket upper bounds starting at `start`, each `factor`
+    times the previous (the +Inf bucket is implicit)."""
+    return [start * factor ** i for i in range(count)]
+
+
+class Histogram:
+    """Array-backed fixed-bucket histogram with vectorized fill.
+
+    `observe_array` buckets a whole dense array with one
+    `np.searchsorted` + `np.bincount` — the idiom for per-batch packet
+    sizes / per-stream jitter where a Python loop per sample would eat
+    the tick budget.  Bucket upper bounds are inclusive (`le`
+    semantics); counts are kept per-bucket and rendered cumulative."""
+
+    def __init__(self, buckets: Sequence[float]):
+        if len(buckets) == 0:
+            raise ValueError("histogram needs at least one finite bucket")
+        uppers = np.asarray(sorted(float(b) for b in buckets),
+                            dtype=np.float64)
+        if not np.isfinite(uppers).all():
+            raise ValueError("bucket bounds must be finite; +Inf is "
+                             "implicit")
+        self.uppers = uppers
+        # one slot per finite bucket + the +Inf overflow slot
+        self.bucket_counts = np.zeros(len(uppers) + 1, dtype=np.int64)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.observe_array(np.asarray([value], dtype=np.float64))
+
+    def observe_array(self, values: np.ndarray) -> None:
+        v = np.asarray(values, dtype=np.float64).ravel()
+        if v.size == 0:
+            return
+        # first bucket whose (inclusive) upper bound >= value
+        idx = np.searchsorted(self.uppers, v, side="left")
+        self.bucket_counts += np.bincount(
+            idx, minlength=len(self.bucket_counts))
+        self.sum += float(v.sum())
+        self.count += int(v.size)
+
+    def cumulative(self) -> np.ndarray:
+        """Cumulative counts, one per finite bucket plus +Inf (last
+        element always equals `count`)."""
+        return np.cumsum(self.bucket_counts)
 
 
 class MetricsRegistry:
     """Array-backed gauges/counters with Prometheus text rendering.
 
-    register("rtp_rx_packets", stats.rx_packets, by="stream") exposes a
-    whole per-stream array; scalar callables work for totals.
-    """
+    register_array("rtp_rx_packets", stats.rx_packets, by="stream")
+    exposes a whole per-stream array; a zero-arg callable source
+    (``lambda: self.table.rx_packets``) re-resolves on every render, so
+    a checkpoint restore that rebinds the array never leaves the
+    exporter reporting pre-restore values.  Scalar callables work for
+    totals; `histogram()` / `register_histogram()` expose `Histogram`s;
+    timing rings render as summaries."""
 
     def __init__(self, namespace: str = "libjitsi_tpu"):
         self.ns = namespace
-        self._arrays: Dict[str, Tuple[np.ndarray, str, str, str]] = {}
+        self._arrays: Dict[str, Tuple[ArraySource, str, str, str]] = {}
         self._scalars: Dict[str, Tuple[Callable[[], float], str, str]] = {}
+        self._hists: Dict[str, Tuple[Histogram, str]] = {}
         self.timings: Dict[str, TimingRing] = {}
+        # per-row display names for `by="stream"` arrays (SDES CNAMEs);
+        # values are hostile input and are escaped at render time
+        self.stream_names: Dict[int, str] = {}
 
-    def register_array(self, name: str, arr: np.ndarray, by: str = "stream",
-                       help_: str = "", kind: str = "gauge") -> None:
-        """`kind` is the Prometheus metric type for the # TYPE line —
-        "gauge" (default) or "counter" for monotonic totals."""
+    def register_array(self, name: str, arr: ArraySource,
+                       by: str = "stream", help_: str = "",
+                       kind: str = "gauge") -> None:
+        """`arr` is an ndarray or a zero-arg callable returning one
+        (callables survive checkpoint-restore rebinds).  `kind` is the
+        Prometheus metric type for the # TYPE line — "gauge" (default)
+        or "counter" for monotonic totals."""
         self._arrays[name] = (arr, by, help_, kind)
 
     def register_scalar(self, name: str, fn: Callable[[], float],
@@ -87,6 +226,27 @@ class MetricsRegistry:
                 name, (lambda o=obj, a=attr: getattr(o, a)),
                 help_=help_, kind=kind)
 
+    def register_histogram(self, name: str, hist: Histogram,
+                           help_: str = "") -> None:
+        self._hists[name] = (hist, help_)
+
+    def histogram(self, name: str, buckets: Sequence[float],
+                  help_: str = "") -> Histogram:
+        """Create-or-get a registered histogram (factory form: the
+        returned object is already exported, so there is no
+        observed-but-never-registered drift window)."""
+        if name not in self._hists:
+            self._hists[name] = (Histogram(buckets), help_)
+        return self._hists[name][0]
+
+    def set_stream_name(self, sid: int, name: Optional[str]) -> None:
+        """Attach a display name (e.g. SDES CNAME) to a stream row;
+        None clears.  Escaped on render — hostile names are expected."""
+        if name is None:
+            self.stream_names.pop(int(sid), None)
+        else:
+            self.stream_names[int(sid)] = str(name)
+
     def timing(self, name: str) -> TimingRing:
         if name not in self.timings:
             self.timings[name] = TimingRing()
@@ -96,24 +256,227 @@ class MetricsRegistry:
         """Prometheus text format.  `active` masks which rows of the
         per-stream arrays are exported (10k idle rows would be noise)."""
         out: List[str] = []
-        for name, (arr, by, help_, kind) in self._arrays.items():
+        for name, (src, by, help_, kind) in self._arrays.items():
+            arr = src() if callable(src) else src
             full = f"{self.ns}_{name}"
             if help_:
-                out.append(f"# HELP {full} {help_}")
+                out.append(f"# HELP {full} {escape_help(help_)}")
             out.append(f"# TYPE {full} {kind}")
             rows = np.nonzero(active)[0] if active is not None \
                 else range(len(arr))
             for i in rows:
-                out.append(f'{full}{{{by}="{i}"}} {arr[i]}')
+                labels = f'{by}="{int(i)}"'
+                sname = self.stream_names.get(int(i)) \
+                    if by == "stream" else None
+                if sname is not None:
+                    labels += f',name="{escape_label_value(sname)}"'
+                out.append(f"{full}{{{labels}}} {arr[i]}")
         for name, (fn, help_, kind) in self._scalars.items():
             full = f"{self.ns}_{name}"
             if help_:
-                out.append(f"# HELP {full} {help_}")
+                out.append(f"# HELP {full} {escape_help(help_)}")
             out.append(f"# TYPE {full} {kind}")
             out.append(f"{full} {fn()}")
+        for name, (hist, help_) in self._hists.items():
+            full = f"{self.ns}_{name}"
+            if help_:
+                out.append(f"# HELP {full} {escape_help(help_)}")
+            out.append(f"# TYPE {full} histogram")
+            cum = hist.cumulative()
+            for upper, c in zip(hist.uppers, cum[:-1]):
+                out.append(f'{full}_bucket{{le="{_fmt_le(upper)}"}} '
+                           f"{int(c)}")
+            out.append(f'{full}_bucket{{le="+Inf"}} {hist.count}')
+            out.append(f"{full}_sum {_fmt(hist.sum)}")
+            out.append(f"{full}_count {hist.count}")
         for name, ring in self.timings.items():
-            for q, label in ((50, "p50"), (99, "p99")):
-                out.append(
-                    f'{self.ns}_{name}_seconds{{quantile="{label}"}} '
-                    f"{ring.percentile(q):.6g}")
+            full = f"{self.ns}_{name}_seconds"
+            out.append(f"# TYPE {full} summary")
+            for q, label in ((50, "0.5"), (99, "0.99")):
+                out.append(f'{full}{{quantile="{label}"}} '
+                           f"{_fmt(ring.percentile(q))}")
+            out.append(f"{full}_sum {_fmt(ring.sum)}")
+            out.append(f"{full}_count {ring.count}")
         return "\n".join(out) + "\n"
+
+
+# ------------------------------------------------- exposition validation
+
+_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _parse_labels(block: str) -> Optional[Dict[str, str]]:
+    """Parse `a="b",c="d"` honoring \\\\ \\n \\" escapes; None on a
+    malformed block."""
+    labels: Dict[str, str] = {}
+    i, n = 0, len(block)
+    while i < n:
+        j = block.find("=", i)
+        if j < 0:
+            return None
+        key = block[i:j].strip()
+        if not key or block[j + 1: j + 2] != '"':
+            return None
+        i = j + 2
+        val: List[str] = []
+        while i < n:
+            ch = block[i]
+            if ch == "\\":
+                if i + 1 >= n:
+                    return None
+                esc = block[i + 1]
+                val.append({"n": "\n", "\\": "\\", '"': '"'}.get(esc))
+                if val[-1] is None:
+                    return None
+                i += 2
+            elif ch == '"':
+                break
+            elif ch == "\n":
+                return None
+            else:
+                val.append(ch)
+                i += 1
+        if i >= n or block[i] != '"':
+            return None
+        labels[key] = "".join(val)
+        i += 1
+        if i < n and block[i] == ",":
+            i += 1
+    return labels
+
+
+def parse_exposition(text: str) -> Tuple[
+        Dict[str, str], List[Tuple[str, Dict[str, str], float]],
+        List[str]]:
+    """Parse Prometheus text format -> (types, samples, errors).
+    types maps family name -> metric type; samples are
+    (sample_name, labels, value)."""
+    types: Dict[str, str] = {}
+    samples: List[Tuple[str, Dict[str, str], float]] = []
+    errors: List[str] = []
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split(None, 3)
+            if len(parts) < 4:
+                errors.append(f"line {lineno}: malformed TYPE line")
+                continue
+            fam, mtype = parts[2], parts[3].strip()
+            if mtype not in ("counter", "gauge", "histogram", "summary",
+                            "untyped"):
+                errors.append(f"line {lineno}: unknown type "
+                              f"`{mtype}` for {fam}")
+            if fam in types:
+                errors.append(f"line {lineno}: duplicate TYPE for {fam}")
+            types[fam] = mtype
+            continue
+        if line.startswith("#"):
+            continue                        # HELP / comments
+        # sample: name{labels} value
+        name, labels, rest = line, {}, ""
+        brace = line.find("{")
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                errors.append(f"line {lineno}: unbalanced braces")
+                continue
+            name = line[:brace]
+            parsed = _parse_labels(line[brace + 1: close])
+            if parsed is None:
+                errors.append(f"line {lineno}: malformed labels in "
+                              f"`{line}`")
+                continue
+            labels = parsed
+            rest = line[close + 1:]
+        else:
+            parts = line.split(None, 1)
+            if len(parts) != 2:
+                errors.append(f"line {lineno}: malformed sample `{line}`")
+                continue
+            name, rest = parts
+        try:
+            value = float(rest.strip().split()[0])
+        except (ValueError, IndexError):
+            errors.append(f"line {lineno}: unparseable value in `{line}`")
+            continue
+        samples.append((name, labels, value))
+    return types, samples, errors
+
+
+def _family_of(sample_name: str, types: Dict[str, str]) -> Optional[str]:
+    if sample_name in types:
+        return sample_name
+    for suf in _SUFFIXES:
+        if sample_name.endswith(suf):
+            base = sample_name[: -len(suf)]
+            if base in types and types[base] in ("histogram", "summary"):
+                return base
+    return None
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Return a list of format violations (empty == valid): every
+    sample family typed exactly once, histogram buckets cumulative
+    with `le="+Inf"` == `_count` and a `_sum`, summaries with numeric
+    quantile labels plus `_sum`/`_count`."""
+    types, samples, errors = parse_exposition(text)
+    by_family: Dict[str, List[Tuple[str, Dict[str, str], float]]] = {}
+    for name, labels, value in samples:
+        fam = _family_of(name, types)
+        if fam is None:
+            errors.append(f"sample `{name}` has no # TYPE line")
+            continue
+        by_family.setdefault(fam, []).append((name, labels, value))
+
+    for fam, mtype in types.items():
+        fam_samples = by_family.get(fam, [])
+        if mtype == "histogram":
+            buckets = [(s[1].get("le"), s[2]) for s in fam_samples
+                       if s[0] == fam + "_bucket"]
+            counts = [s[2] for s in fam_samples if s[0] == fam + "_count"]
+            sums = [s for s in fam_samples if s[0] == fam + "_sum"]
+            if not buckets:
+                errors.append(f"histogram {fam}: no _bucket samples")
+                continue
+            les = []
+            for le, _v in buckets:
+                if le is None:
+                    errors.append(f"histogram {fam}: bucket missing le")
+                    continue
+                les.append(math.inf if le == "+Inf" else float(le))
+            if les != sorted(les):
+                errors.append(f"histogram {fam}: buckets not in "
+                              "ascending le order")
+            vals = [v for _le, v in buckets]
+            if any(b > a for a, b in zip(vals[1:], vals)):
+                errors.append(f"histogram {fam}: bucket counts not "
+                              "cumulative")
+            if not les or not math.isinf(les[-1]):
+                errors.append(f'histogram {fam}: missing le="+Inf" '
+                              "bucket")
+            if not counts:
+                errors.append(f"histogram {fam}: missing _count")
+            elif les and math.isinf(les[-1]) and vals[-1] != counts[0]:
+                errors.append(f'histogram {fam}: le="+Inf" bucket '
+                              f"({vals[-1]:g}) != _count ({counts[0]:g})")
+            if not sums:
+                errors.append(f"histogram {fam}: missing _sum")
+        elif mtype == "summary":
+            quantiles = [s for s in fam_samples if s[0] == fam]
+            for _name, labels, _v in quantiles:
+                q = labels.get("quantile")
+                try:
+                    qf = float(q)
+                except (TypeError, ValueError):
+                    errors.append(f"summary {fam}: non-numeric quantile "
+                                  f"label {q!r}")
+                    continue
+                if not 0.0 <= qf <= 1.0:
+                    errors.append(f"summary {fam}: quantile {qf} "
+                                  "outside [0, 1]")
+            if not any(s[0] == fam + "_sum" for s in fam_samples):
+                errors.append(f"summary {fam}: missing _sum")
+            if not any(s[0] == fam + "_count" for s in fam_samples):
+                errors.append(f"summary {fam}: missing _count")
+    return errors

@@ -1,0 +1,115 @@
+"""Pipeline stage tracing: nested timed spans per tick stage.
+
+The supervisor's overload ladder used to see one number — bridge.tick
+wall time — so "we're over budget" never said *where* the budget went
+(ingress? reverse chain? the mixer?).  `PipelineTracer` wraps each
+stage of a tick (ingress batch → reverse transform chain →
+SFU/recovery → mixer → forward chain → egress) in a span that feeds
+three sinks at once:
+
+  1. a per-stage `TimingRing` in the `MetricsRegistry` (rendered as a
+     Prometheus summary, `stage_<name>_seconds{quantile=...}`), so
+     /metrics carries p50/p99 per stage;
+  2. a per-tick **budget ledger** (stage -> seconds this tick) the
+     supervisor drains with `take_ledger()` and uses to attribute an
+     overrun to its dominant stage in flight-recorder events;
+  3. an optional `jax.profiler.TraceAnnotation`, so when a Perfetto
+     trace is captured (utils/profiling.trace) the host-side stage
+     spans line up with the TPU timeline on the same clock.
+
+Spans are `SpanTimer` tokens — each holds its own t0 — so nesting
+(recovery inside reverse_chain) and overlapping (pipelined dispatch)
+both record correctly.  Nested spans accumulate into the ledger
+independently: the ledger is per-stage *inclusive* time, and callers
+that want exclusive attribution compare parent vs child entries.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+from libjitsi_tpu.utils.metrics import MetricsRegistry, SpanTimer
+
+try:                                    # annotation sink is optional:
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except Exception:                       # pragma: no cover - jax present
+    _TraceAnnotation = None
+
+#: canonical stage names (a tracer accepts any string; these are the
+#: ones the acceptance scrape asserts on)
+STAGES = ("ingress", "reverse_chain", "recovery", "decode", "mixer",
+          "forward_chain", "egress")
+
+
+class _StageSpan:
+    """Context manager for one stage entry; independent token per
+    entry, safe to nest and overlap."""
+
+    __slots__ = ("_tracer", "stage", "_timer", "_ann")
+
+    def __init__(self, tracer: "PipelineTracer", stage: str):
+        self._tracer = tracer
+        self.stage = stage
+        self._timer: Optional[SpanTimer] = None
+        self._ann = None
+
+    def __enter__(self) -> "_StageSpan":
+        t = self._tracer
+        if t.annotate:
+            self._ann = _TraceAnnotation(f"{t.prefix}:{self.stage}")
+            self._ann.__enter__()
+        self._timer = t.metrics.timing(
+            f"{t.prefix}_{self.stage}").span()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        seconds = self._timer.stop()
+        if self._ann is not None:
+            self._ann.__exit__(*exc if exc else (None, None, None))
+            self._ann = None
+        led = self._tracer._ledger
+        led[self.stage] = led.get(self.stage, 0.0) + seconds
+
+
+class PipelineTracer:
+    """Per-stage span timing + per-tick budget ledger.
+
+    One tracer per media loop / bridge; share it across the pieces of
+    one pipeline (loop + SFU + mixer) so their stages land in the same
+    ledger.  `annotate=True` (default) also emits
+    jax.profiler.TraceAnnotation spans when jax is importable — they
+    are no-ops unless a profiler trace is active.
+    """
+
+    def __init__(self, metrics: Optional[MetricsRegistry] = None,
+                 prefix: str = "stage", annotate: bool = True):
+        self.metrics = metrics if metrics is not None else \
+            MetricsRegistry()
+        self.prefix = prefix
+        self.annotate = bool(annotate) and _TraceAnnotation is not None
+        self._ledger: Dict[str, float] = {}
+        self.last_ledger: Dict[str, float] = {}
+
+    def span(self, stage: str) -> _StageSpan:
+        return _StageSpan(self, stage)
+
+    def ledger(self) -> Dict[str, float]:
+        """The accumulating (not-yet-taken) ledger, read-only view."""
+        return dict(self._ledger)
+
+    def take_ledger(self) -> Dict[str, float]:
+        """Drain and return this tick's stage->seconds ledger; the
+        supervisor calls this once per bridge tick.  Also retained as
+        `last_ledger` for health()/debug surfaces."""
+        led, self._ledger = self._ledger, {}
+        self.last_ledger = led
+        return led
+
+    @staticmethod
+    def dominant(ledger: Dict[str, float]
+                 ) -> Tuple[Optional[str], float]:
+        """(stage, seconds) of the ledger's costliest stage."""
+        if not ledger:
+            return None, 0.0
+        stage = max(ledger, key=ledger.get)
+        return stage, ledger[stage]

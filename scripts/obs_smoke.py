@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Observability-plane smoke: a real SfuBridge over loopback UDP with a
+supervisor and an ObservabilityServer attached, scraped over HTTP.
+
+Drives media + a NACK through the bridge for N ticks, then asserts:
+
+- /metrics parses under the exposition validator with ZERO errors;
+- the five pipeline-stage summaries (ingress, reverse_chain, recovery,
+  forward_chain, egress) are present with p50/p99 quantiles;
+- real histogram families expose cumulative buckets ending in +Inf;
+- a hostile SDES stream name round-trips escaped, not raw;
+- /healthz reports ok and /debug/streams serves a flight dump.
+
+Prints OBS_SMOKE_OK on success; any failure raises (exit != 0).
+Tier-1 runs this after the jitlint gate (scripts/tier1.sh).
+"""
+
+import argparse
+import json
+import sys
+import urllib.request
+
+sys.path.insert(0, ".")
+
+HOSTILE_NAME = 'evil "name\nwith\\slashes'
+STAGES = ("ingress", "reverse_chain", "recovery", "forward_chain",
+          "egress")
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def run(ticks: int = 40) -> None:
+    import libjitsi_tpu
+    from libjitsi_tpu.service.obs_server import ObservabilityServer
+    from libjitsi_tpu.service.sfu_bridge import SfuBridge
+    from libjitsi_tpu.service.supervisor import (BridgeSupervisor,
+                                                 SupervisorConfig)
+    from libjitsi_tpu.utils.metrics import validate_exposition
+
+    sys.path.insert(0, "tests")
+    from test_sfu_bridge import _Endpoint
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    sfu = SfuBridge(libjitsi_tpu.configuration_service(), port=0,
+                    capacity=8, recv_window_ms=0)
+    sup = BridgeSupervisor(sfu, SupervisorConfig(deadline_ms=1000.0),
+                           metrics=sfu.loop.metrics)
+    srv = ObservabilityServer(metrics=sfu.loop.metrics,
+                              supervisor=sup).start()
+    try:
+        eps = [_Endpoint(0x200 + 9 * k, sfu.port) for k in range(3)]
+        names = [HOSTILE_NAME, "alice", None]
+        for e, name in zip(eps, names):
+            sfu.add_endpoint(e.ssrc, e.rx_key, e.tx_key, name=name)
+        for e in eps:
+            for other in eps:
+                if other is not e:
+                    e.expect_sender(other.ssrc)
+
+        now = 100.0
+        for t in range(ticks):
+            if t % 4 == 0:
+                for e in eps:
+                    e.send_media()
+            sup.tick(now=now)
+            now += 0.02
+            for e in eps:
+                e.drain()
+        assert sfu.forwarded > 0, "no media forwarded"
+        # exercise the RTX path (egress span + rtx_served flight event)
+        eps[0].send_nack(eps[1].ssrc, [501])
+        for _ in range(10):
+            sup.tick(now=now)
+        sfu.emit_feedback(now=now)
+
+        code, text = _get(srv.port, "/metrics")
+        assert code == 200, f"/metrics -> {code}"
+        errors = validate_exposition(text)
+        assert not errors, "exposition invalid:\n" + "\n".join(errors)
+        ns = sfu.loop.metrics.ns
+        for stage in STAGES:
+            fam = f"{ns}_stage_{stage}_seconds"
+            assert f"# TYPE {fam} summary" in text, f"missing {fam}"
+            for q in ('quantile="0.5"', 'quantile="0.99"'):
+                assert f"{fam}{{{q}}}" in text, f"missing {fam}{{{q}}}"
+        assert f"# TYPE {ns}_packet_size_bytes histogram" in text
+        assert f'{ns}_packet_size_bytes_bucket{{le="+Inf"}}' in text
+        assert HOSTILE_NAME not in text, "raw hostile name leaked"
+        assert 'evil \\"name\\nwith\\\\slashes' in text, \
+            "escaped stream name missing"
+
+        code, body = _get(srv.port, "/healthz")
+        health = json.loads(body)
+        assert code == 200 and health["ok"], f"unhealthy: {health}"
+
+        code, body = _get(srv.port, "/debug/streams")
+        sids = json.loads(body)["streams"]
+        assert sids, "flight recorder saw no streams"
+        code, body = _get(srv.port, "/debug/streams/%d" % sids[0])
+        dump = json.loads(body)
+        assert code == 200 and dump["events"], "empty flight dump"
+        kinds = {e["kind"] for e in dump["events"]}
+        assert "hdr" in kinds, f"no header samples in dump: {kinds}"
+    finally:
+        srv.stop()
+        sfu.close()
+        libjitsi_tpu.stop()
+    print("OBS_SMOKE_OK")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ticks", type=int, default=40)
+    args = ap.parse_args()
+    run(ticks=args.ticks)
+
+
+if __name__ == "__main__":
+    main()

@@ -170,6 +170,14 @@ def test_kill_and_resume_is_bit_exact_under_loss_and_corruption(tmp_path):
     # packets with exactly the same decrypted bytes
     assert accepted_b == accepted_a
 
+    # the crash-restart left a post-mortem naming the checkpoint it
+    # rose from (a destructive action like any other)
+    pm = next(p for p in sup_b.postmortems
+              if p["trigger"] == "checkpoint_recover")
+    assert pm["event"]["kind"] == "recovered"
+    assert pm["event"]["path"] == ckpt
+    assert pm["event"]["bridge"] == "ConferenceBridge"
+
     # replayed pre-checkpoint wire must bounce off the restored replay
     # window (find a surviving, uncorrupted pre-kill packet and resend
     # its exact bytes)
@@ -245,6 +253,13 @@ def test_quarantine_isolates_auth_storm_then_readmits():
     assert int(bridge.bank.decoded_frames[sid1]) >= 4, \
         "innocent participant was disturbed by the quarantine"
     assert int(bridge.loop.inbound_dropped[sid0]) > 0
+    # the conviction dumped a post-mortem: trigger named, and the
+    # stream ring shows the auth storm that caused it
+    pm = next(p for p in sup.postmortems if p["trigger"] == "quarantine")
+    assert pm["sid"] == sid0
+    assert pm["event"]["reason"] == "auth_storm"
+    assert any(e["kind"] == "srtp_auth_fail"
+               for e in pm["dump"]["events"])
 
     # phase 2: the storm stops; the ban expires after the backoff
     for _ in range(10):

@@ -419,6 +419,62 @@ def test_drift_metrics_dangling_registration_fires():
     assert any("hits_cuont" in f.message for f in found)
 
 
+def test_drift_histogram_observed_but_never_registered_fires():
+    """A Histogram constructed and fed but never handed to the
+    registry records distributions nobody can scrape."""
+    src = """
+    from libjitsi_tpu.utils.metrics import Histogram
+
+    class Bank:
+        def __init__(self):
+            self.jitter_hist = Histogram((0.01, 0.1))
+
+        def tick(self, vals):
+            self.jitter_hist.observe_array(vals)
+    """
+    ctx = ctx_of(src)
+    found = check_metrics_drift({ctx.relpath: ctx})
+    assert len(found) == 1
+    assert "jitter_hist" in found[0].message
+    assert "never registered" in found[0].message
+
+
+def test_drift_histogram_registered_forms_are_clean():
+    """Both registration idioms clear the check — an explicit
+    register_histogram (even in ANOTHER file) and the
+    registry.histogram factory, which registers on creation.  An
+    `.observe()` on a non-histogram attr (Watchdog-style) is out of
+    scope entirely."""
+    src = """
+    from libjitsi_tpu.utils.metrics import Histogram
+
+    class Bank:
+        def __init__(self):
+            self.jitter_hist = Histogram((0.01, 0.1))
+
+        def tick(self, vals):
+            self.jitter_hist.observe_array(vals)
+    """
+    reg = """
+    def wire(bank, registry):
+        registry.register_histogram("jitter", bank.jitter_hist)
+    """
+    factory = """
+    class Loop:
+        def __init__(self, registry):
+            self.size_hist = registry.histogram("sizes", (64, 1500))
+            self.watchdog = object()
+
+        def tick(self, lens):
+            self.size_hist.observe_array(lens)
+            self.watchdog.observe(0.1)
+    """
+    c1, c2 = ctx_of(src), ctx_of(reg, "libjitsi_tpu/other.py")
+    assert check_metrics_drift({c1.relpath: c1, c2.relpath: c2}) == []
+    c3 = ctx_of(factory, "libjitsi_tpu/loop.py")
+    assert check_metrics_drift({c3.relpath: c3}) == []
+
+
 # ------------------------------------------------- pragmas and baseline
 
 def test_line_pragma_suppresses():

@@ -1,0 +1,350 @@
+"""Observability plane: Histogram bucket semantics, the exposition
+validator, TimingRing reentrancy, label escaping, callable array
+sources (stale-array regression), PipelineTracer ledgers, the flight
+recorder, and the HTTP server — plus a slow soak twin of
+scripts/obs_smoke.py.
+"""
+
+import json
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.service.obs_server import ObservabilityServer
+from libjitsi_tpu.service.supervisor import (BridgeSupervisor,
+                                             SupervisorConfig)
+from libjitsi_tpu.utils.flight import FlightRecorder
+from libjitsi_tpu.utils.metrics import (Histogram, MetricsRegistry,
+                                        TimingRing, escape_label_value,
+                                        exponential_buckets,
+                                        validate_exposition)
+from libjitsi_tpu.utils.tracing import PipelineTracer
+
+
+# ------------------------------------------------------------ histogram
+
+def test_histogram_bucket_boundaries_are_inclusive():
+    h = Histogram((1.0, 2.0, 5.0))
+    for v in (0.5, 1.0, 1.5, 2.0, 5.0, 99.0):
+        h.observe(v)
+    # le semantics: 1.0 lands in the le="1" bucket, 5.0 in le="5"
+    assert h.bucket_counts.tolist() == [2, 2, 1, 1]
+    assert h.cumulative().tolist() == [2, 4, 5, 6]
+    assert h.count == 6
+    assert h.sum == pytest.approx(0.5 + 1.0 + 1.5 + 2.0 + 5.0 + 99.0)
+
+
+def test_histogram_vectorized_fill_matches_scalar_loop():
+    rng = np.random.default_rng(7)
+    vals = rng.exponential(0.05, size=2000)
+    buckets = exponential_buckets(0.001, 2.0, 10)
+    ha, hb = Histogram(buckets), Histogram(buckets)
+    ha.observe_array(vals)
+    for v in vals:
+        hb.observe(float(v))
+    assert ha.bucket_counts.tolist() == hb.bucket_counts.tolist()
+    assert ha.count == hb.count == 2000
+    assert ha.sum == pytest.approx(hb.sum)
+
+
+def test_histogram_rejects_empty_and_infinite_buckets():
+    with pytest.raises(ValueError):
+        Histogram(())
+    with pytest.raises(ValueError):
+        Histogram((1.0, float("inf")))
+
+
+def test_histogram_render_is_cumulative_with_inf_bucket():
+    m = MetricsRegistry()
+    h = m.histogram("pkt_bytes", (100, 200), help_="sizes")
+    h.observe_array(np.array([50.0, 150.0, 150.0, 999.0]))
+    text = m.render()
+    assert "# TYPE libjitsi_tpu_pkt_bytes histogram" in text
+    assert 'libjitsi_tpu_pkt_bytes_bucket{le="100"} 1' in text
+    assert 'libjitsi_tpu_pkt_bytes_bucket{le="200"} 3' in text
+    assert 'libjitsi_tpu_pkt_bytes_bucket{le="+Inf"} 4' in text
+    assert "libjitsi_tpu_pkt_bytes_count 4" in text
+    assert validate_exposition(text) == []
+
+
+def test_registry_histogram_factory_is_create_or_get():
+    m = MetricsRegistry()
+    a = m.histogram("x", (1, 2))
+    b = m.histogram("x", (5, 6))          # existing wins; buckets kept
+    assert a is b
+    assert a.uppers.tolist() == [1.0, 2.0]
+
+
+# ------------------------------------------------------------ validator
+
+def test_validator_accepts_full_registry_render():
+    m = MetricsRegistry()
+    m.register_array("rx", np.array([1, 2, 3]), help_="per stream",
+                     kind="counter")
+    m.register_scalar("up", lambda: 1)
+    m.histogram("sizes", (10, 100)).observe_array(
+        np.array([5.0, 50.0, 500.0]))
+    ring = m.timing("stage_ingress")
+    for v in (0.001, 0.002, 0.003):
+        ring.record(v)
+    assert validate_exposition(m.render()) == []
+
+
+@pytest.mark.parametrize("text,needle", [
+    # buckets must be cumulative
+    ('# TYPE h histogram\nh_bucket{le="1"} 5\nh_bucket{le="2"} 3\n'
+     'h_bucket{le="+Inf"} 5\nh_sum 1\nh_count 5\n', "cumulative"),
+    # +Inf bucket required
+    ('# TYPE h histogram\nh_bucket{le="1"} 2\nh_sum 1\nh_count 2\n',
+     '+Inf'),
+    # +Inf must equal _count
+    ('# TYPE h histogram\nh_bucket{le="1"} 1\nh_bucket{le="+Inf"} 2\n'
+     'h_sum 1\nh_count 3\n', "_count"),
+    # _sum required
+    ('# TYPE h histogram\nh_bucket{le="1"} 1\nh_bucket{le="+Inf"} 1\n'
+     'h_count 1\n', "_sum"),
+    # every family typed exactly once
+    ('# TYPE g gauge\n# TYPE g gauge\ng 1\n', "duplicate"),
+    # samples without a TYPE line
+    ('untyped_metric 4\n', "no # TYPE"),
+    # summary quantiles must be numeric in [0, 1]
+    ('# TYPE s summary\ns{quantile="p99"} 1\ns_sum 1\ns_count 1\n',
+     "quantile"),
+])
+def test_validator_rejects_seeded_breakage(text, needle):
+    errors = validate_exposition(text)
+    assert errors and any(needle in e for e in errors), errors
+
+
+# ------------------------------------------------- timing-ring reentrancy
+
+def test_timing_ring_nested_with_blocks_record_both():
+    ring = TimingRing()
+    with ring:
+        with ring:                       # reentrant: inner must not
+            pass                         # clobber the outer's t0
+    assert ring.count == 2
+    durations = ring._buf[:2]
+    assert durations[1] >= durations[0]  # outer (recorded 2nd) >= inner
+
+
+def test_timing_ring_overlapping_span_tokens():
+    ring = TimingRing()
+    a = ring.span()
+    b = ring.span()                      # overlapping, non-LIFO
+    a.stop()
+    b.stop()
+    assert ring.count == 2
+    assert a.stop() == a.seconds         # idempotent stop
+
+
+# -------------------------------------------------------------- escaping
+
+def test_hostile_label_values_are_escaped():
+    hostile = 'pwn" } 1\nfake_metric{x="y'
+    esc = escape_label_value(hostile)
+    assert "\n" not in esc and '"' not in esc.replace('\\"', "")
+    m = MetricsRegistry()
+    m.register_array("rx", np.array([7]), by="stream")
+    m.set_stream_name(0, hostile)
+    text = m.render()
+    assert hostile not in text
+    assert validate_exposition(text) == []
+    # the escaped value round-trips through the parser
+    from libjitsi_tpu.utils.metrics import parse_exposition
+    _types, samples, errors = parse_exposition(text)
+    assert not errors
+    byname = {n: lab for n, lab, _v in samples}
+    assert byname["libjitsi_tpu_rx"]["name"] == hostile
+
+
+def test_hostile_help_text_is_escaped():
+    m = MetricsRegistry()
+    m.register_scalar("up", lambda: 1,
+                      help_="line1\nline2 \\ backslash")
+    text = m.render()
+    assert "# HELP libjitsi_tpu_up line1\\nline2 \\\\ backslash" in text
+    assert validate_exposition(text) == []
+
+
+# ------------------------------------- callable sources (stale arrays)
+
+CAP = 8
+
+
+class _DummyLoop:
+    def __init__(self):
+        self.registry = types.SimpleNamespace(capacity=CAP)
+        self.recv_window_ms = 1
+        self.inbound_drop = np.zeros(CAP, dtype=bool)
+        self.inbound_dropped = np.zeros(CAP, dtype=np.int64)
+        self.inbound_dropped_total = 0
+
+
+class _DummyBridge:
+    def __init__(self):
+        self.loop = _DummyLoop()
+        self.degraded = False
+        self._ssrc_of = {}
+        self.rx_table = types.SimpleNamespace(
+            auth_fail=np.zeros(CAP, dtype=np.int64),
+            replay_reject=np.zeros(CAP, dtype=np.int64))
+        self.speaker = types.SimpleNamespace(dominant=0)
+
+    def tick(self, now=None):
+        return {"rx": 0}
+
+
+def test_register_array_accepts_callable_source():
+    m = MetricsRegistry()
+    holder = {"arr": np.array([1, 2])}
+    m.register_array("live", lambda: holder["arr"], kind="counter")
+    assert 'libjitsi_tpu_live{stream="0"} 1' in m.render()
+    holder["arr"] = np.array([9, 9])     # rebind, not mutate
+    assert 'libjitsi_tpu_live{stream="0"} 9' in m.render()
+
+
+def test_supervisor_scrape_survives_table_rebind():
+    """Chaos-style kill/restore regression: the exporter must follow
+    the supervisor's CURRENT bridge objects, not the arrays captured at
+    registration time (the stale-array bug)."""
+    reg = MetricsRegistry()
+    bridge = _DummyBridge()
+    sup = BridgeSupervisor(bridge, SupervisorConfig(deadline_ms=1000.0),
+                           metrics=reg)
+    bridge.rx_table.auth_fail[3] = 2
+    assert 'libjitsi_tpu_srtp_auth_fail{stream="3"} 2' in reg.render()
+    # "restore": a whole new table object, as recover() produces
+    bridge.rx_table = types.SimpleNamespace(
+        auth_fail=np.zeros(CAP, dtype=np.int64),
+        replay_reject=np.zeros(CAP, dtype=np.int64))
+    bridge.rx_table.auth_fail[3] = 41
+    text = reg.render()
+    assert 'libjitsi_tpu_srtp_auth_fail{stream="3"} 41' in text, \
+        "exporter kept reading the pre-restore array"
+    assert sup is not None
+
+
+# --------------------------------------------------------------- tracer
+
+def test_tracer_feeds_rings_and_ledger():
+    m = MetricsRegistry()
+    tr = PipelineTracer(m, annotate=False)
+    with tr.span("ingress"):
+        with tr.span("recovery"):        # nested spans both record
+            pass
+    assert m.timings["stage_ingress"].count == 1
+    assert m.timings["stage_recovery"].count == 1
+    led = tr.take_ledger()
+    assert set(led) == {"ingress", "recovery"}
+    assert led["ingress"] >= led["recovery"] >= 0.0
+    assert tr.ledger() == {}             # drained
+    assert tr.last_ledger == led
+    stage, secs = PipelineTracer.dominant(led)
+    assert stage == "ingress" and secs == led["ingress"]
+    assert PipelineTracer.dominant({}) == (None, 0.0)
+
+
+# ------------------------------------------------------ flight recorder
+
+def test_flight_recorder_rings_are_bounded_and_ordered():
+    fr = FlightRecorder(per_stream=4, global_events=3)
+    for i in range(10):
+        fr.record("x", sid=1, tick=i)
+        fr.record("g", tick=i)
+    d = fr.dump(1)
+    assert len(d["events"]) == 4
+    assert [e["tick"] for e in d["events"]] == [6, 7, 8, 9]
+    assert len(d["global"]) == 3
+    seqs = [e["seq"] for e in d["events"]]
+    assert seqs == sorted(seqs)          # merged-timeline ordering
+    assert fr.events_recorded == 20
+    assert fr.streams() == [1]
+    fr.clear(1)
+    assert fr.dump(1)["events"] == []
+
+
+def test_flight_recorder_header_sampling_is_capped():
+    fr = FlightRecorder(max_headers=3)
+    sids = [5] * 10 + [6]
+    seqs = list(range(100, 110)) + [777]
+    lens = [60] * 11
+    fr.record_headers(sids, seqs, lens, tick=2)
+    ev5 = fr.dump(5)["events"][0]
+    assert ev5["kind"] == "hdr" and ev5["n"] == 3
+    assert ev5["headers"] == [[100, 60], [101, 60], [102, 60]]
+    assert fr.dump(6)["events"][0]["headers"] == [[777, 60]]
+
+
+def test_flight_dump_is_json_serializable():
+    fr = FlightRecorder()
+    fr.record("q", sid=np.int64(3), tick=np.int32(1),
+              n=np.int64(5))
+    json.dumps(fr.dump(3))               # plain dicts by construction
+
+
+# ------------------------------------------------------------ http server
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read().decode("utf-8")
+
+
+def test_obs_server_serves_metrics_health_and_debug():
+    m = MetricsRegistry()
+    m.register_scalar("up", lambda: 1)
+    fr = FlightRecorder()
+    fr.record("hdr", sid=4, tick=0, n=1, headers=[[10, 60]])
+    sup = types.SimpleNamespace(
+        health=lambda: {"state": "healthy"}, flight=fr, postmortems=[])
+    with ObservabilityServer(metrics=m, supervisor=sup) as srv:
+        code, text = _get(srv.port, "/metrics")
+        assert code == 200 and "libjitsi_tpu_up 1" in text
+        assert validate_exposition(text) == []
+        code, body = _get(srv.port, "/healthz")
+        assert code == 200 and json.loads(body)["ok"]
+        code, body = _get(srv.port, "/debug/streams")
+        assert json.loads(body)["streams"] == [4]
+        code, body = _get(srv.port, "/debug/streams/4")
+        assert code == 200
+        assert json.loads(body)["events"][0]["kind"] == "hdr"
+        code, body = _get(srv.port, "/debug/postmortems")
+        assert code == 200 and json.loads(body) == []
+
+
+def test_obs_server_healthz_503_when_stalled_and_404s():
+    sup = types.SimpleNamespace(
+        health=lambda: {"state": "stalled"}, flight=None,
+        postmortems=[])
+    with ObservabilityServer(supervisor=sup) as srv:
+        try:
+            code, body = _get(srv.port, "/healthz")
+        except urllib.error.HTTPError as e:
+            code, body = e.code, e.read().decode("utf-8")
+        assert code == 503 and not json.loads(body)["ok"]
+        try:
+            code, _ = _get(srv.port, "/debug/streams/abc")
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 404
+        try:
+            code, _ = _get(srv.port, "/nope")
+        except urllib.error.HTTPError as e:
+            code = e.code
+        assert code == 404
+
+
+# ------------------------------------------------------------- soak twin
+
+@pytest.mark.slow
+def test_obs_smoke_soak():
+    """The tier-1 smoke with 5x the ticks: histograms keep their
+    invariants and the validator stays clean under sustained load."""
+    import sys
+    sys.path.insert(0, "scripts")
+    import obs_smoke
+
+    obs_smoke.run(ticks=200)

@@ -112,12 +112,28 @@ def test_escalation_ladder_then_full_recovery():
     assert bridge.loop.inbound_drop[sorted(sup._shed_set)].all()
     # dominant speaker (sid 0) is never shed
     assert 0 not in sup._shed_set
+    # every shed produced a retrievable post-mortem naming its trigger
+    pms = [p for p in sup.postmortems if p["trigger"] == "overload_shed"]
+    assert {p["sid"] for p in pms} == set(sup._shed_set)
+    for p in pms:
+        assert p["event"]["kind"] == "shed"
+        assert any(e["kind"] == "shed" and e["sid"] == p["sid"]
+                   for e in p["dump"]["events"])
+        # the global ring in the dump shows the ladder walking up
+        assert any(e["kind"] == "ladder_escalate"
+                   for e in p["dump"]["global"])
     for _ in range(30):
         sup.tick()
     assert sup.level == 0
     assert not bridge.degraded
     assert bridge.loop.recv_window_ms == 1          # restored
     assert not sup._shed and not bridge.loop.inbound_drop.any()
+    # recovery left its own trail: de-escalations + per-sid restores
+    glob = {e["kind"] for e in sup.flight.dump_all()["global"]}
+    assert "ladder_deescalate" in glob
+    for sid in {p["sid"] for p in pms}:
+        kinds = [e["kind"] for e in sup.flight.dump(sid)["events"]]
+        assert "shed_restore" in kinds
 
 
 def test_shed_is_deterministic_and_priority_ordered():
@@ -148,6 +164,11 @@ def test_quarantine_convicts_releases_and_backs_off():
         sup.tick(now=0.0)
     assert 2 in sup._quarantined and bridge.loop.inbound_drop[2]
     assert sup.quarantine_total == 1
+    # the conviction dumped a post-mortem whose ring shows the storm
+    pm = next(p for p in sup.postmortems if p["trigger"] == "quarantine")
+    assert pm["sid"] == 2 and pm["event"]["reason"] == "auth_storm"
+    assert any(e["kind"] == "srtp_auth_fail"
+               for e in pm["dump"]["events"])
     first_release = sup._quarantined[2]
     assert first_release - sup.ticks <= 4
     # other streams untouched
@@ -155,6 +176,8 @@ def test_quarantine_convicts_releases_and_backs_off():
     while sup.ticks < first_release:
         sup.tick(now=0.0)
     assert 2 not in sup._quarantined and not bridge.loop.inbound_drop[2]
+    assert any(e["kind"] == "quarantine_release"
+               for e in sup.flight.dump(2)["events"])
     # relapse: second conviction's ban is exponentially longer
     for _ in range(3):
         bridge.rx_table.auth_fail[2] += 4

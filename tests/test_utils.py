@@ -34,7 +34,10 @@ def test_timing_ring_percentiles():
         ring.record(v)
     assert ring.percentile(50) == 0.001
     assert ring.percentile(99) >= 0.05
-    assert 'quantile="p99"' in m.render()
+    render = m.render()
+    # Prometheus summary form: numeric quantile labels + _sum/_count
+    assert 'quantile="0.99"' in render
+    assert "libjitsi_tpu_srtp_batch_seconds_count 100" in render
 
 
 @pytest.mark.slow
