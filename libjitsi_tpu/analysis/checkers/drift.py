@@ -16,7 +16,15 @@ scope (internal helpers have no exporter contract).  The same pass
 covers ``Histogram``s: a class that constructs one and feeds it with
 ``observe``/``observe_array`` must hand it to the registry somewhere
 (``register_histogram`` or the ``registry.histogram`` factory), else
-the distribution is recorded but unscrapeable.
+the distribution is recorded but unscrapeable.  Two more cross-file
+facts ride the same index: an ``SloSpec`` whose ``metric`` /
+``bad_metric`` / ``total_metric`` names a family no registration ever
+defines burns against a permanently-absent signal (the engine reads
+``None`` forever and the SLO can never fire), and a histogram created
+with ``exemplars=True`` whose ``observe``/``observe_same`` calls never
+pass ``exemplar=`` ships empty exemplar slots in every OpenMetrics
+scrape — both are silent-at-runtime wiring bugs, which is exactly what
+a static gate is for.
 
 **Snapshot drift** (per-file): subclasses of ``ArraySnapshotMixin``
 must list every mutable array field in ``_SNAP_FIELDS`` (or carry it
@@ -246,6 +254,111 @@ def _class_counters(ctx: FileContext) -> List[Tuple[str, str, ast.AST,
     return out
 
 
+#: SloSpec kwargs that reference metric-family names
+SLO_REF_KWARGS = ("metric", "bad_metric", "total_metric")
+
+
+def _registered_metric_names(ctx: FileContext
+                             ) -> Tuple[Set[str], Set[str]]:
+    """(exact family names, name suffixes) this file hands to the
+    registry.  Exact names come from constant first args
+    (register_scalar/array/multi/histogram + the ``registry.histogram``
+    factory); suffixes come from ``register_counters`` attribute lists
+    (full name = ``{prefix}_{attr}`` with a call-site prefix) and from
+    f-string names whose constant tail survives prefix
+    parameterization (``f"{prefix}_fec_k"`` -> ``fec_k``)."""
+    exact: Set[str] = set()
+    suffixes: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = call_func_name(node)
+        if fname in ("register_scalar", "register_array",
+                     "register_multi", "register_histogram",
+                     "histogram") and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str):
+                exact.add(arg.value)
+            elif isinstance(arg, ast.JoinedStr) and arg.values:
+                tail = arg.values[-1]
+                if isinstance(tail, ast.Constant) and \
+                        isinstance(tail.value, str):
+                    suffixes.add(tail.value.lstrip("_"))
+        elif fname == "register_counters" and len(node.args) >= 2:
+            for n in ast.walk(node.args[1]):
+                if isinstance(n, ast.Constant) and \
+                        isinstance(n.value, str) and " " not in n.value:
+                    suffixes.add(n.value)
+    return exact, suffixes
+
+
+def _slo_metric_refs(ctx: FileContext
+                     ) -> List[Tuple[str, str, ast.AST]]:
+    """(slo name, referenced family name, node) for every constant
+    metric kwarg of an ``SloSpec(...)`` construction."""
+    out: List[Tuple[str, str, ast.AST]] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and
+                call_func_name(node) == "SloSpec"):
+            continue
+        slo_name = ""
+        if node.args and isinstance(node.args[0], ast.Constant):
+            slo_name = str(node.args[0].value)
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant):
+                slo_name = str(kw.value.value)
+        for kw in node.keywords:
+            if kw.arg in SLO_REF_KWARGS and \
+                    isinstance(kw.value, ast.Constant) and \
+                    isinstance(kw.value.value, str) and kw.value.value:
+                out.append((slo_name, kw.value.value, kw.value))
+    return out
+
+
+def _exemplar_hists(ctx: FileContext) -> List[Tuple[str, ast.AST]]:
+    """(attr/name, node) assigned from a histogram constructor called
+    with a literal ``exemplars=True``."""
+    out: List[Tuple[str, ast.AST]] = []
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Assign) and
+                isinstance(node.value, ast.Call) and
+                call_func_name(node.value) in ("histogram",
+                                               "Histogram")):
+            continue
+        if not any(kw.arg == "exemplars" and
+                   isinstance(kw.value, ast.Constant) and
+                   kw.value.value is True
+                   for kw in node.value.keywords):
+            continue
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Attribute):
+                out.append((tgt.attr, node))
+            elif isinstance(tgt, ast.Name):
+                out.append((tgt.id, node))
+    return out
+
+
+def _exemplar_observed(ctx: FileContext) -> Set[str]:
+    """attr/local names whose observe/observe_same/observe_array call
+    passes an ``exemplar=`` keyword."""
+    out: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Call) and
+                isinstance(node.func, ast.Attribute) and
+                node.func.attr in ("observe", "observe_same",
+                                   "observe_array")):
+            continue
+        if not any(kw.arg == "exemplar" for kw in node.keywords):
+            continue
+        holder = node.func.value
+        if isinstance(holder, ast.Attribute):
+            out.add(holder.attr)
+        elif isinstance(holder, ast.Name):
+            out.add(holder.id)
+    return out
+
+
 def check_metrics_drift(index: Dict[str, FileContext]) -> List[Finding]:
     registered: Set[str] = set()
     for ctx in index.values():
@@ -286,6 +399,46 @@ def check_metrics_drift(index: Dict[str, FileContext]) -> List[Finding]:
                     "never registered with MetricsRegistry (use "
                     "register_histogram or the registry.histogram "
                     "factory) — invisible in production"))
+
+    # SLO half: a spec naming a family no registration defines burns
+    # against a permanently-missing signal
+    metric_exact: Set[str] = set()
+    metric_suffixes: Set[str] = set()
+    for ctx in index.values():
+        exact, sufs = _registered_metric_names(ctx)
+        metric_exact |= exact
+        metric_suffixes |= sufs
+
+    def _family_known(ref: str) -> bool:
+        if ref in metric_exact:
+            return True
+        return any(ref == s or ref.endswith("_" + s)
+                   for s in metric_suffixes)
+
+    for ctx in index.values():
+        for slo_name, ref, node in _slo_metric_refs(ctx):
+            if not _family_known(ref):
+                findings.append(ctx.finding(
+                    RULE, node,
+                    f"SloSpec `{slo_name}` references metric `{ref}` "
+                    "that no MetricsRegistry registration defines — "
+                    "the burn-rate engine reads an absent family "
+                    "forever and this SLO can never fire"))
+
+    # exemplar half: an exemplars=True histogram nobody ever feeds an
+    # exemplar ships empty exemplar slots in every OpenMetrics scrape
+    exemplar_fed: Set[str] = set()
+    for ctx in index.values():
+        exemplar_fed |= _exemplar_observed(ctx)
+    for ctx in index.values():
+        for attr, node in _exemplar_hists(ctx):
+            if attr not in exemplar_fed:
+                findings.append(ctx.finding(
+                    RULE, node,
+                    f"histogram `{attr}` is created with "
+                    "exemplars=True but no observe call ever passes "
+                    "exemplar= — its exemplar slots stay empty in "
+                    "every OpenMetrics scrape"))
 
     # vice versa: registered attribute names that exist nowhere
     for ctx in index.values():

@@ -36,6 +36,10 @@ _log = get_logger("io.loop")
 #: wire datagram sizes: 64B keepalives up to jumbo-ish video bursts
 PACKET_SIZE_BUCKETS = (64, 128, 256, 512, 768, 1024, 1280, 1500)
 
+#: end-to-end packet journey (ingress arrival -> egress send), seconds;
+#: 0.02 is the default tick/ptime budget the journey_p99 SLO keys on
+JOURNEY_BUCKETS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1)
+
 
 def _is_rtcp(data: np.ndarray, length: np.ndarray) -> np.ndarray:
     """RFC 5761 demux: full second byte in [192, 223] marks RTCP on a
@@ -98,6 +102,17 @@ class MediaLoop:
         self.pkt_size_hist = self.metrics.histogram(
             "packet_size_bytes", PACKET_SIZE_BUCKETS,
             help_="received datagram sizes")
+        # journey tracing: every ingress batch is stamped with a
+        # monotonic trace id + arrival time; egress observes the
+        # end-to-end latency with an OpenMetrics exemplar carrying the
+        # trace id, so a tail-latency bucket links straight to the
+        # FlightRecorder `hdr` events recorded under the same trace
+        self.journey_hist = self.metrics.histogram(
+            "packet_journey_seconds", JOURNEY_BUCKETS,
+            help_="ingress-arrival to egress-send packet latency",
+            exemplars=True)
+        self.trace_id = 0
+        self._trace_t0: Optional[float] = None
         self.recv_window_ms = recv_window_ms
         # learned (ip, port) per stream row (latched from last packet)
         self.addr_ip = np.zeros(registry.capacity, dtype=np.uint32)
@@ -164,6 +179,11 @@ class MediaLoop:
                 batch, sip, sport = self.engine.recv_batch(
                     self.recv_window_ms)
                 ats = None
+        # arrival stamp: the batching window just closed — everything
+        # this tick sends is measured against this instant (per-batch
+        # journey; rows within one batch share the stamp)
+        self.trace_id += 1
+        self._trace_t0 = time.perf_counter()
         n = batch.batch_size
         if n:
             self.pkt_size_hist.observe_array(
@@ -264,7 +284,7 @@ class MediaLoop:
                     seqs = ((d[:, 2].astype(np.int64) << 8) | d[:, 3])
                     self.flight.record_headers(
                         rtp.stream, seqs, np.asarray(rtp.length),
-                        tick=self.ticks)
+                        tick=self.ticks, trace=self.trace_id)
                 self.last_rtp_arrival_ns = (
                     ats[rtp_rows] if ats is not None else None)
                 if self.chain is not None:
@@ -296,6 +316,34 @@ class MediaLoop:
                 self.on_rtcp(rb, okc)
         return n
 
+    # ----------------------------------------------------------- journey
+    def journey_origin(self) -> Tuple[int, Optional[float]]:
+        """The current tick's (trace_id, arrival_t0) — captured at
+        dispatch time by pipelined senders whose bytes flush on a later
+        tick, so the observed journey includes the pipelining delay."""
+        return self.trace_id, self._trace_t0
+
+    def note_journey(self, n: int, sids=None) -> Optional[float]:
+        return self.note_journey_at(self.journey_origin(), n, sids=sids)
+
+    def note_journey_at(self, origin: Tuple[int, Optional[float]],
+                        n: int, sids=None) -> Optional[float]:
+        """Observe `n` packets leaving now against an ingress origin.
+        A journey that overflows the top histogram bucket marks the
+        shipped streams priority in the flight recorder, so the next
+        header sample keeps their burst tail (adaptive hdr sampling)."""
+        trace, t0 = origin
+        if n <= 0 or t0 is None:
+            return None
+        dt = time.perf_counter() - t0
+        tail = self.journey_hist.observe_same(
+            dt, int(n), exemplar={"trace_id": str(trace)})
+        if tail and self.flight is not None and sids is not None:
+            for sid in set(int(s) for s in np.asarray(sids).ravel()):
+                if sid >= 0:
+                    self.flight.mark_priority(sid)
+        return dt
+
     # -------------------------------------------------------------- send
     def send_media(self, batch: PacketBatch) -> int:
         """Protect (forward chain) + send a batch; rows route to each
@@ -317,6 +365,7 @@ class MediaLoop:
         with self.tracer.span("egress"):
             sent = self.engine.send_batch(out, self.addr_ip[sids],
                                           self.addr_port[sids])
+            self.note_journey(sent, sids=out.stream)
         self.tx_packets += sent
         return sent
 
@@ -331,7 +380,7 @@ class MediaLoop:
         with self.tracer.span("forward_chain"):
             pending, mask = self.chain.rtp_transformer.transform_async(
                 batch)
-        self._inflight.append((pending, mask))
+        self._inflight.append((pending, mask, self.journey_origin()))
         return batch.batch_size
 
     def flush_sends(self) -> int:
@@ -339,7 +388,7 @@ class MediaLoop:
         sent = 0
         inflight, self._inflight = self._inflight, []
         with self.tracer.span("egress"):
-            for pending, mask in inflight:
+            for pending, mask, origin in inflight:
                 out = pending.result()
                 rows = np.nonzero(mask)[0]
                 if len(rows) == 0:
@@ -349,8 +398,12 @@ class MediaLoop:
                                   out.stream[rows])
                 sids = np.clip(sub.stream, 0,
                                self.registry.capacity - 1)
-                sent += self.engine.send_batch(sub, self.addr_ip[sids],
-                                               self.addr_port[sids])
+                k = self.engine.send_batch(sub, self.addr_ip[sids],
+                                           self.addr_port[sids])
+                # journey measured from the DISPATCH tick's arrival:
+                # the pipelining window is real latency the packet paid
+                self.note_journey_at(origin, k, sids=sub.stream)
+                sent += k
         self.tx_packets += sent
         return sent
 

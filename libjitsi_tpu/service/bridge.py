@@ -288,6 +288,7 @@ class ConferenceBridge:
             self._dtls.tick()
         if self.bank is None:         # no participants yet
             return {"rx": rx, "mixed": 0, "tx": 0,
+                    "trace": self.loop.trace_id,
                     "levels": np.zeros(0, dtype=np.uint8),
                     "dominant": -1}
         with self.loop.tracer.span("decode"):
@@ -299,7 +300,10 @@ class ConferenceBridge:
                 self._update_egress_levels(levels)
         tx = self._send_mixes(out)
         self.ticks += 1
+        # trace is the tick's journey id: grep it in flight `hdr`
+        # events and in packet_journey_seconds exemplars
         return {"rx": rx, "mixed": len(sids), "tx": tx,
+                "trace": self.loop.trace_id,
                 "levels": levels, "dominant": self.speaker.dominant}
 
     def _speaker_changed(self, sid: int) -> None:

@@ -2,8 +2,15 @@
 
 A threaded `http.server` (no framework, no new deps) serving:
 
-  /metrics              Prometheus text exposition (registry render)
+  /metrics              Prometheus text exposition (registry render);
+                        negotiates OpenMetrics via the Accept header —
+                        an OpenMetrics scrape gets exemplars on
+                        histogram buckets (trace ids linking tail
+                        latency to flight-recorder `hdr` events) and
+                        the `# EOF` terminator
   /healthz              supervisor health JSON; 503 when stalled
+  /debug/slo            SloEngine status: per-SLO burn rates over the
+                        four windows, states, thresholds
   /debug/streams/<sid>  flight-recorder dump for one stream
   /debug/postmortems    supervisor's bounded post-mortem list
 
@@ -23,10 +30,12 @@ from typing import Optional
 import numpy as np
 
 from libjitsi_tpu.utils.logging import get_logger
+from libjitsi_tpu.utils.metrics import (CONTENT_TYPE_OPENMETRICS,
+                                        CONTENT_TYPE_PROM)
 
 _log = get_logger("service.obs")
 
-CONTENT_TYPE_METRICS = "text/plain; version=0.0.4; charset=utf-8"
+CONTENT_TYPE_METRICS = CONTENT_TYPE_PROM
 
 
 def _jsonable(obj):
@@ -44,11 +53,13 @@ class ObservabilityServer:
     """Serve /metrics, /healthz and flight-recorder debug dumps."""
 
     def __init__(self, metrics=None, supervisor=None, flight=None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 slo=None, host: str = "127.0.0.1", port: int = 0):
         self.metrics = metrics
         self.supervisor = supervisor
         # explicit flight wins; else follow the supervisor's recorder
         self._flight = flight
+        # explicit slo engine wins; else follow the supervisor's
+        self._slo = slo
         self.host = host
         self.port = int(port)
         self._httpd: Optional[ThreadingHTTPServer] = None
@@ -60,11 +71,17 @@ class ObservabilityServer:
             return self._flight
         return getattr(self.supervisor, "flight", None)
 
+    @property
+    def slo(self):
+        if self._slo is not None:
+            return self._slo
+        return getattr(self.supervisor, "slo", None)
+
     # ---------------------------------------------------------- handlers
-    def _metrics_text(self) -> str:
+    def _metrics_text(self, openmetrics: bool = False) -> str:
         if self.metrics is None:
-            return "\n"
-        return self.metrics.render()
+            return "# EOF\n" if openmetrics else "\n"
+        return self.metrics.render(openmetrics=openmetrics)
 
     def _health(self) -> dict:
         if self.supervisor is None:
@@ -73,11 +90,25 @@ class ObservabilityServer:
         h["ok"] = h.get("state") != "stalled"
         return h
 
-    def _route(self, path: str):
+    def _route(self, path: str, accept: str = ""):
         """-> (status, content_type, body_bytes)"""
         if path == "/metrics":
-            return (200, CONTENT_TYPE_METRICS,
-                    self._metrics_text().encode("utf-8"))
+            # content negotiation the way Prometheus does it: the
+            # scraper opts into OpenMetrics explicitly; default stays
+            # the 0.0.4 text format (exemplar-free)
+            om = "application/openmetrics-text" in (accept or "")
+            ctype = CONTENT_TYPE_OPENMETRICS if om \
+                else CONTENT_TYPE_METRICS
+            return (200, ctype,
+                    self._metrics_text(openmetrics=om).encode("utf-8"))
+        if path == "/debug/slo":
+            slo = self.slo
+            if slo is None:
+                return (404, "application/json",
+                        b'{"error": "no slo engine attached"}')
+            return (200, "application/json",
+                    json.dumps(slo.status(),
+                               default=_jsonable).encode("utf-8"))
         if path == "/healthz":
             h = self._health()
             code = 200 if h.get("ok") else 503
@@ -110,8 +141,9 @@ class ObservabilityServer:
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):          # noqa: N802 (stdlib API name)
                 path = self.path.split("?", 1)[0]
+                accept = self.headers.get("Accept", "")
                 try:
-                    status, ctype, body = outer._route(path)
+                    status, ctype, body = outer._route(path, accept)
                 except Exception as exc:   # render must never kill scrape
                     status, ctype = 500, "application/json"
                     body = json.dumps(

@@ -258,6 +258,13 @@ class ReceivePump:
             ("decode_errors", "authenticated but undecodable payloads"),
             ("plc_frames", "underruns concealed by the codec PLC"),
         ), prefix=prefix)
+        registry.register_scalar(
+            f"{prefix}_jb_lost", lambda: self.jb.lost,
+            help_="seqs the jitter buffer declared lost", kind="counter")
+        registry.register_scalar(
+            f"{prefix}_jb_late_dropped", lambda: self.jb.late_dropped,
+            help_="arrivals already released past (too late to play)",
+            kind="counter")
 
     def push(self, datagrams: List[bytes],
              now: Optional[float] = None) -> int:

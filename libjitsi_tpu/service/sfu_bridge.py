@@ -192,6 +192,10 @@ class SfuBridge:
         # shrink-RTX-second escalation rungs.  Transient (like the
         # caches): a restored bridge re-learns loss state from traffic.
         self.recovery = RecoveryController(recovery_config)
+        # resolve uplink SSRCs back to leg sids so nack_queued events
+        # land in the stream's flight ring (and mark it priority for
+        # tail-biased header sampling)
+        self.recovery.sid_of = self._sid_of_ssrc
         # flight recorder slot (attached by BridgeSupervisor; shared
         # with self.loop and self.recovery)
         self.flight = None
@@ -349,6 +353,19 @@ class SfuBridge:
         self._rebuild_routes()
         _log.info("endpoint_leave", sid=sid)
 
+    def _sid_of_ssrc(self, ssrc: int) -> Optional[int]:
+        """Reverse of `_ssrc_of` (recovery's sid resolver): uplink
+        media SSRC -> sender leg sid, video layers included."""
+        ssrc = int(ssrc) & 0xFFFFFFFF
+        for sid, s in self._ssrc_of.items():
+            if s == ssrc:
+                return sid
+        for lsid, track in self._video.items():
+            li = track.layer_sids.index(lsid)
+            if track.layer_ssrcs[li] == ssrc:
+                return track.sender_sid
+        return None
+
     # --------------------------------------------------------------- video
     def add_video_track(self, sender_sid: int, layer_ssrcs,
                         layer_bps, rtx_pt: int = 97) -> "_VideoTrack":
@@ -460,8 +477,10 @@ class SfuBridge:
         wb = PacketBatch.from_payloads(out_payloads, stream=out_rows)
         wire = self.tx_table.protect_rtp(wb)
         addr = np.asarray(out_addr, dtype=np.int64)
-        sent = self.loop.engine.send_batch(
-            wire, self.loop.addr_ip[addr], self.loop.addr_port[addr])
+        with self.loop.tracer.span("egress"):
+            sent = self.loop.engine.send_batch(
+                wire, self.loop.addr_ip[addr], self.loop.addr_port[addr])
+            self.loop.note_journey(sent, sids=addr)
         self.forwarded += sent
 
     def _select_video_layers(self) -> None:
@@ -511,6 +530,8 @@ class SfuBridge:
                 sent = self.loop.engine.send_batch(
                     wire, self.loop.addr_ip[sid],
                     self.loop.addr_port[sid])
+                # NACK-arrival -> RTX-egress is this tick's journey
+                self.loop.note_journey(sent, sids=[sid])
             self.retransmitted += sent
             if self.flight is not None:
                 self.flight.record("rtx_served", sid=sid,
@@ -571,8 +592,12 @@ class SfuBridge:
                 idx_sel = idx_sel[keep]
         if self.pipelined:
             with self.loop.tracer.span("forward_chain"):
+                # dispatch carries its ingress origin: the flush lands
+                # on a LATER tick, and the journey must charge the
+                # pipelining delay to the tick the packets arrived on
                 self._pending_fanout.append(
-                    self.translator.translate_async(sub, idx_sel))
+                    (self.translator.translate_async(sub, idx_sel),
+                     self.loop.journey_origin()))
             return None
         with self.loop.tracer.span("forward_chain"):
             wire, recv = self.translator.translate(sub, idx_sel)
@@ -592,10 +617,11 @@ class SfuBridge:
 
     def _flush_fanout(self) -> None:
         pending, self._pending_fanout = self._pending_fanout, []
-        for pend in pending:
-            self._emit_fanout(*pend.result())
+        for pend, origin in pending:
+            self._emit_fanout(*pend.result(), origin=origin)
 
-    def _emit_fanout(self, wire: PacketBatch, recv: np.ndarray) -> None:
+    def _emit_fanout(self, wire: PacketBatch, recv: np.ndarray,
+                     origin=None) -> None:
         if wire.batch_size == 0:
             return
         # a just-joined leg has no latched address yet: sending to
@@ -620,6 +646,9 @@ class SfuBridge:
         with self.loop.tracer.span("egress"):
             sent = self.loop.engine.send_batch(
                 wire, self.loop.addr_ip[recv], self.loop.addr_port[recv])
+            self.loop.note_journey_at(
+                origin if origin is not None
+                else self.loop.journey_origin(), sent, sids=recv)
         self.forwarded += sent
         # adaptive FEC over the PROTECTED per-leg copies: XOR of SRTP
         # ciphertexts is opaque, and a recovered packet still passes the
@@ -727,6 +756,7 @@ class SfuBridge:
         with self.loop.tracer.span("egress"):
             sent = self.loop.engine.send_batch(
                 out, self.loop.addr_ip[sid], self.loop.addr_port[sid])
+            self.loop.note_journey(sent, sids=[sid])
         self.retransmitted += sent
         self.recovery.rtx_requests_served += len(copies)
         if self.flight is not None:

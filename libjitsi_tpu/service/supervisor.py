@@ -74,6 +74,11 @@ class SupervisorConfig:
     stall_after: int = 25            # consecutive overruns -> STALLED
     overload_exit: int = 5           # consecutive good ticks -> de-escalate
     shed_step: int = 4               # streams shed per level-3+ escalation
+    # stage attribution: when one stage owns at least this share of the
+    # tick's budget ledger, escalation jumps to the rung that targets
+    # that stage (forward_chain -> shed FEC, ingress -> shrink the recv
+    # window) instead of walking the wall-time ladder in order
+    stage_share_threshold: float = 0.6
     quarantine_window: int = 50      # ticks of history per stream
     quarantine_auth_threshold: int = 20
     quarantine_replay_threshold: int = 200
@@ -92,7 +97,8 @@ class BridgeSupervisor:
     def __init__(self, bridge, config: Optional[SupervisorConfig] = None,
                  metrics=None, priorities: Optional[Dict[int, int]] = None,
                  clock: Callable[[], float] = time.perf_counter,
-                 flight: Optional[FlightRecorder] = None):
+                 flight: Optional[FlightRecorder] = None,
+                 slo=None):
         self.bridge = bridge
         self.cfg = config or SupervisorConfig()
         self.loop = getattr(bridge, "loop", bridge)
@@ -102,6 +108,12 @@ class BridgeSupervisor:
         # shed, recover) dumps a post-mortem naming its trigger
         self.flight = flight if flight is not None else FlightRecorder()
         self.postmortems: deque = deque(maxlen=32)
+        # optional SloEngine (utils/slo.py): ticked here so its windows
+        # advance on the same cadence as the watchdog, and its worst
+        # state rides on every ladder_escalate event
+        self.slo = slo
+        if slo is not None and getattr(slo, "flight", None) is None:
+            slo.flight = self.flight
         self._attach_flight()
         # stage-budget ledger drained from the loop's PipelineTracer
         # each tick: overload events name the dominant stage instead of
@@ -130,6 +142,7 @@ class BridgeSupervisor:
         self._ban = ExponentialBackoff(self.cfg.quarantine_backoff_ticks,
                                        cap=self.cfg.quarantine_backoff_cap)
         self.level = 0               # current escalation-ladder rung
+        self._rungs: List[str] = []  # actions taken, LIFO unwind order
         self._good = 0               # consecutive on-deadline ticks
         self._shed: List[int] = []   # shed sids, LIFO restore order
         self._shed_set: set = set()
@@ -161,6 +174,8 @@ class BridgeSupervisor:
         if self.tracer is not None:
             self.last_ledger = self.tracer.take_ledger()
         self.ticks += 1
+        if self.slo is not None:
+            self.slo.on_tick()
         self._update_quarantine()
         if over:
             self._good = 0
@@ -180,55 +195,100 @@ class BridgeSupervisor:
 
     # ------------------------------------------- overload escalation
 
-    def _escalate(self) -> None:
-        self.level += 1
+    #: wall-time rung order (the PR-2 ladder); recovery-only rungs are
+    #: skipped on bridges without a controller, and `shed_streams`
+    #: repeats once every named rung is held
+    LADDER = ("recv_window", "degrade", "shed_fec", "throttle_rtx")
+
+    def _slo_state(self) -> str:
+        return self.slo.state() if self.slo is not None else "none"
+
+    def _pick_rung(self, stage: Optional[str], share: float,
+                   rec) -> str:
+        """Stage-attributed rung choice: when one stage owns the tick
+        budget, act on THAT stage — shed FEC only when forward_chain
+        dominates, shrink the recv window only when ingress does.  No
+        dominant stage (or its rung already held) falls back to the
+        wall-time ladder order."""
+        taken = set(self._rungs)
+        if share >= self.cfg.stage_share_threshold:
+            if (stage == "forward_chain" and rec is not None
+                    and "shed_fec" not in taken):
+                return "shed_fec"
+            if stage == "ingress" and "recv_window" not in taken:
+                return "recv_window"
+        for rung in self.LADDER:
+            if rung in ("shed_fec", "throttle_rtx") and rec is None:
+                continue
+            if rung not in taken:
+                return rung
+        return "shed_streams"
+
+    def _apply_rung(self, rung: str) -> None:
         rec = getattr(self.bridge, "recovery", None)
-        # budget attribution: the ladder acts on WHERE the tick budget
-        # went, not just that it overran — the dominant stage rides on
-        # every escalation event for the post-mortem
-        stage, stage_s = PipelineTracer.dominant(self.last_ledger)
-        self.flight.record(
-            "ladder_escalate", tick=self.ticks, level=self.level,
-            worst_s=self.watchdog.worst_s,
-            stage=stage or "unknown", stage_s=stage_s)
-        if self.level == 1:
+        if rung == "recv_window":
             # stop waiting for packets: the batching window is latency
             # the tick can't afford while behind
-            self._saved_window = getattr(self.loop, "recv_window_ms", None)
+            self._saved_window = getattr(self.loop, "recv_window_ms",
+                                         None)
             if self._saved_window is not None:
                 self.loop.recv_window_ms = 0
-        elif self.level == 2:
+        elif rung == "degrade":
             self.bridge.degraded = True
-        elif rec is not None and self.level == 3:
+        elif rung == "shed_fec":
             # loss-recovery coupling: FEC overhead is the first
             # bandwidth/CPU to go — redundancy sheds before media
             rec.shed_fec(True)
-        elif rec is not None and self.level == 4:
+        elif rung == "throttle_rtx":
             # then the retransmission budget shrinks...
             rec.throttle_rtx(True)
         else:
             # ...and only then are whole streams dropped
             self._shed_streams(self.cfg.shed_step)
 
-    def _deescalate(self) -> None:
+    def _escalate(self) -> None:
+        self.level += 1
         rec = getattr(self.bridge, "recovery", None)
+        # budget attribution: the ladder acts on WHERE the tick budget
+        # went, not just that it overran — the dominant stage, its
+        # ledger share, the chosen rung, and the SLO state ride on
+        # every escalation event for the post-mortem
+        stage, stage_s = PipelineTracer.dominant(self.last_ledger)
+        total = sum(self.last_ledger.values())
+        share = (stage_s / total) if total > 0 else 0.0
+        rung = self._pick_rung(stage, share, rec)
+        self.flight.record(
+            "ladder_escalate", tick=self.ticks, level=self.level,
+            worst_s=self.watchdog.worst_s,
+            stage=stage or "unknown", stage_s=stage_s,
+            stage_share=round(share, 4), rung=rung,
+            slo_state=self._slo_state())
+        self._apply_rung(rung)
+        self._rungs.append(rung)
+
+    def _deescalate(self) -> None:
+        """Pop the most recent rung and reverse it — LIFO, so whatever
+        order stage attribution escalated in, recovery unwinds it."""
+        rec = getattr(self.bridge, "recovery", None)
+        rung = self._rungs.pop() if self._rungs else "shed_streams"
         self.flight.record("ladder_deescalate", tick=self.ticks,
-                           level=self.level - 1)
-        shed_floor = 5 if rec is not None else 3
-        if self.level >= shed_floor and self._shed:
-            for _ in range(min(self.cfg.shed_step, len(self._shed))):
-                sid = self._shed.pop()
-                self._shed_set.discard(sid)
-                self.flight.record("shed_restore", sid=sid,
-                                   tick=self.ticks)
-            self._sync_drop_mask()
-        elif rec is not None and self.level == 4:
+                           level=self.level - 1, rung=rung)
+        if rung == "shed_streams":
+            if self._shed:
+                for _ in range(min(self.cfg.shed_step,
+                                   len(self._shed))):
+                    sid = self._shed.pop()
+                    self._shed_set.discard(sid)
+                    self.flight.record("shed_restore", sid=sid,
+                                       tick=self.ticks)
+                self._sync_drop_mask()
+        elif rung == "throttle_rtx" and rec is not None:
             rec.throttle_rtx(False)
-        elif rec is not None and self.level == 3:
+        elif rung == "shed_fec" and rec is not None:
             rec.shed_fec(False)
-        elif self.level == 2:
+        elif rung == "degrade":
             self.bridge.degraded = False
-        elif self.level == 1 and self._saved_window is not None:
+        elif rung == "recv_window" and self._saved_window is not None:
             self.loop.recv_window_ms = self._saved_window
             self._saved_window = None
         self.level -= 1
@@ -445,9 +505,41 @@ class BridgeSupervisor:
                 "srtp_replay_reject",
                 lambda: self.bridge.rx_table.replay_reject,
                 help_="SRTP replay-window rejections", kind="counter")
+        if hasattr(self.bridge, "forwarded"):
+            # denominator of the residual-loss SLO: packets the bridge
+            # actually forwarded downstream
+            registry.register_scalar(
+                "bridge_forwarded", lambda: self.bridge.forwarded,
+                help_="packets forwarded to receivers", kind="counter")
+        if hasattr(self.bridge, "_video"):
+            # simulcast/SVC forwarders are per-receiver objects; export
+            # the fleet-wide sums (drift rule: every bumped counter is
+            # scraped somewhere)
+            def _fwds():
+                return [f for t in set(self.bridge._video.values())
+                        for f in t.fwd.values()]
+            registry.register_scalar(
+                "video_layer_switches",
+                lambda: sum(f.switches for f in _fwds()),
+                help_="simulcast/SVC layer switches across receivers",
+                kind="counter")
+            registry.register_scalar(
+                "video_svc_dropped",
+                lambda: sum(f.dropped for f in _fwds()
+                            if hasattr(f, "dropped")),
+                help_="SVC packets dropped by layer projection",
+                kind="counter")
+            registry.register_scalar(
+                "video_svc_late_dropped",
+                lambda: sum(f.late_dropped for f in _fwds()
+                            if hasattr(f, "late_dropped")),
+                help_="late SVC packets with no renumber hole left",
+                kind="counter")
         rec = getattr(self.bridge, "recovery", None)
         if rec is not None:
             rec.register_metrics(registry)
+        if self.slo is not None:
+            self.slo.register_metrics(registry)
         bank = getattr(self.bridge, "bank", None)
         if bank is not None and hasattr(bank, "plc_frames"):
             registry.register_array(
@@ -460,8 +552,10 @@ class BridgeSupervisor:
     def health(self) -> dict:
         """Liveness summary for probes / logs."""
         return {"state": self.watchdog.state, "level": self.level,
+                "rungs": list(self._rungs),
                 "shed": sorted(self._shed_set),
                 "quarantined": sorted(self._quarantined),
                 "ticks": self.ticks, "overruns": self.watchdog.overruns,
                 "last_ledger": dict(self.last_ledger),
+                "slo_state": self._slo_state(),
                 "postmortems": len(self.postmortems)}

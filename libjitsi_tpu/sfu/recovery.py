@@ -314,6 +314,10 @@ class RecoveryController:
         # optional flight recorder (attached by BridgeSupervisor):
         # ladder transitions and NACK/RTX actions leave forensic events
         self.flight = None
+        # optional ssrc -> leg sid resolver (attached by SfuBridge):
+        # with it, nack_queued events land in the stream's own ring and
+        # mark the stream priority for tail-biased header sampling
+        self.sid_of = None
 
     def _rec(self, kind: str, sid: Optional[int] = None,
              **fields) -> None:
@@ -334,7 +338,10 @@ class RecoveryController:
             losses, advanced = tr.observe(int(seq))
             if losses:
                 self.nacks.on_losses(ssrc, losses, now)
-                self._rec("nack_queued", ssrc=ssrc, n=len(losses))
+                sid = self.sid_of(ssrc) if self.sid_of is not None \
+                    else None
+                self._rec("nack_queued", sid=sid, ssrc=ssrc,
+                          n=len(losses))
             elif not advanced:
                 self.nacks.on_arrival(ssrc, int(seq))
 

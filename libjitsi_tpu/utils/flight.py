@@ -38,6 +38,14 @@ def _plain(value: Any) -> Any:
 #: kind-specific (see README "Observability" for the catalogue).
 EVENT_BASE_KEYS = ("seq", "t", "kind", "sid", "tick")
 
+#: events that mark their stream PRIORITY for the next header sample:
+#: a stream that just triggered the NACK/RTX/FEC machinery is exactly
+#: the one whose packet tail we want on record (journey-tail overflow
+#: marks via `mark_priority` directly, from MediaLoop.note_journey)
+PRIORITY_KINDS = frozenset((
+    "nack_queued", "rtx_served", "rtx_cache_miss", "fec_sent",
+    "rtx_budget_drop"))
+
 
 class FlightRecorder:
     """Bounded per-stream + global event rings."""
@@ -51,7 +59,15 @@ class FlightRecorder:
         self._seq_ext = 0  # monotone 64-bit event counter, not an RTP seq
         self._streams: Dict[int, Deque[dict]] = {}
         self._global: Deque[dict] = deque(maxlen=int(global_events))
+        # streams whose next header sample keeps the burst TAIL instead
+        # of a spread: marked by PRIORITY_KINDS events and by journey
+        # observations that overflow the top latency bucket; each mark
+        # is consumed by the next record_headers for that stream
+        self._priority: set = set()
         self.events_recorded = 0
+
+    def mark_priority(self, sid: int) -> None:
+        self._priority.add(int(sid))
 
     # ------------------------------------------------------------ record
     def record(self, kind: str, sid: Optional[int] = None,
@@ -69,6 +85,8 @@ class FlightRecorder:
         if sid is None:
             self._global.append(ev)
         else:
+            if kind in PRIORITY_KINDS:
+                self._priority.add(int(sid))
             ring = self._streams.get(int(sid))
             if ring is None:
                 ring = self._streams[int(sid)] = deque(
@@ -76,19 +94,47 @@ class FlightRecorder:
             ring.append(ev)
         return ev
 
+    @staticmethod
+    def _spread(n_rows: int, k: int) -> List[int]:
+        """k row indices spread evenly over [0, n_rows), always
+        including the last row — a deterministic stride reservoir, so a
+        1k-packet burst keeps its tail on record instead of only its
+        first 16 packets."""
+        if n_rows <= k:
+            return list(range(n_rows))
+        idx = np.linspace(0, n_rows - 1, num=k)
+        return sorted({int(round(i)) for i in idx} | {n_rows - 1})
+
     def record_headers(self, sids, seqs, lengths,
-                       tick: Optional[int] = None) -> None:
+                       tick: Optional[int] = None,
+                       trace: Optional[int] = None) -> None:
         """Sample the tick's RTP headers into per-stream rings as one
         compact `hdr` event per stream (bounded at `max_headers` rows
-        per stream per tick — this is a flight recorder, not a pcap)."""
+        per stream per tick — this is a flight recorder, not a pcap).
+
+        Sampling is tail-biased: streams marked priority (they just
+        triggered NACK/RTX/FEC, or their last journey overflowed the
+        top latency bucket) keep the LAST `max_headers` rows of the
+        burst; everyone else gets a deterministic stride reservoir that
+        always includes the burst's final row.  `trace` links the event
+        to the tick's journey exemplar."""
         per: Dict[int, List[List[int]]] = {}
         for sid, seq, ln in zip(sids, seqs, lengths):
-            rows = per.setdefault(int(sid), [])
-            if len(rows) < self.max_headers:
-                rows.append([int(seq), int(ln)])
+            per.setdefault(int(sid), []).append([int(seq), int(ln)])
         for sid, rows in per.items():
-            self.record("hdr", sid=sid, tick=tick, n=len(rows),
-                        headers=rows)
+            if sid in self._priority:
+                self._priority.discard(sid)
+                sample = rows[-self.max_headers:]
+                mode = "tail"
+            else:
+                sample = [rows[i]
+                          for i in self._spread(len(rows),
+                                                self.max_headers)]
+                mode = "spread"
+            extra = {} if trace is None else {"trace": int(trace)}
+            self.record("hdr", sid=sid, tick=tick, n=len(sample),
+                        total=len(rows), mode=mode, headers=sample,
+                        **extra)
 
     # -------------------------------------------------------------- dump
     def dump(self, sid: int) -> dict:

@@ -15,6 +15,14 @@ decode delay) are fixed-bucket `Histogram`s filled with one
 by tests and `scripts/obs_smoke.py` as the runtime twin of the jitlint
 `drift` checker: every family typed exactly once, histogram buckets
 cumulative with `le="+Inf"` == `_count`, label values escaped.
+
+Histograms can carry **OpenMetrics exemplars**: one slot per bucket
+holding the label set of a recent observation that landed there (the
+journey tracer stores the packet trace id, linking a tail-latency
+bucket straight to the matching FlightRecorder entries).  Exemplars
+are rendered only when the scraper negotiated the OpenMetrics content
+type (`render(openmetrics=True)`), which also appends the mandatory
+`# EOF` terminator; the plain Prometheus 0.0.4 rendering is unchanged.
 """
 
 from __future__ import annotations
@@ -27,6 +35,18 @@ from typing import (Callable, Dict, Iterable, List, Optional, Sequence,
 import numpy as np
 
 ArraySource = Union[np.ndarray, Callable[[], np.ndarray]]
+#: zero-arg callable yielding (labels, value) rows for one family —
+#: the shape of `register_multi` sources (e.g. burn-rate gauges keyed
+#: by slo + window)
+MultiSource = Callable[[], Iterable[Tuple[Dict[str, str], float]]]
+
+CONTENT_TYPE_PROM = "text/plain; version=0.0.4; charset=utf-8"
+CONTENT_TYPE_OPENMETRICS = (
+    "application/openmetrics-text; version=1.0.0; charset=utf-8")
+
+#: OpenMetrics spec: the combined length of an exemplar's label names
+#: and values MUST NOT exceed 128 UTF-8 characters
+EXEMPLAR_RUNES_MAX = 128
 
 
 def escape_label_value(value: object) -> str:
@@ -135,9 +155,15 @@ class Histogram:
     `np.searchsorted` + `np.bincount` — the idiom for per-batch packet
     sizes / per-stream jitter where a Python loop per sample would eat
     the tick budget.  Bucket upper bounds are inclusive (`le`
-    semantics); counts are kept per-bucket and rendered cumulative."""
+    semantics); counts are kept per-bucket and rendered cumulative.
 
-    def __init__(self, buckets: Sequence[float]):
+    With `exemplars=True` the histogram keeps one exemplar slot per
+    bucket (+Inf included): `observe(value, exemplar={...})` stores
+    the label set alongside the observed value, and the registry
+    renders it after the matching `_bucket` line on OpenMetrics
+    scrapes only."""
+
+    def __init__(self, buckets: Sequence[float], exemplars: bool = False):
         if len(buckets) == 0:
             raise ValueError("histogram needs at least one finite bucket")
         uppers = np.asarray(sorted(float(b) for b in buckets),
@@ -150,9 +176,31 @@ class Histogram:
         self.bucket_counts = np.zeros(len(uppers) + 1, dtype=np.int64)
         self.sum = 0.0
         self.count = 0
+        # last-exemplar-wins per bucket slot: (labels, observed value)
+        self.exemplars: Optional[
+            List[Optional[Tuple[Dict[str, str], float]]]] = (
+                [None] * (len(uppers) + 1) if exemplars else None)
 
-    def observe(self, value: float) -> None:
-        self.observe_array(np.asarray([value], dtype=np.float64))
+    def observe(self, value: float,
+                exemplar: Optional[Dict[str, str]] = None) -> bool:
+        return self.observe_same(value, 1, exemplar=exemplar)
+
+    def observe_same(self, value: float, n: int,
+                     exemplar: Optional[Dict[str, str]] = None) -> bool:
+        """Observe `value` `n` times (one egress batch = n packets with
+        one shared journey latency) in O(1); returns True when the
+        value overflowed into the top (+Inf) bucket — the signal the
+        adaptive flight sampler keys tail bias from."""
+        if n <= 0:
+            return False
+        v = float(value)
+        idx = int(np.searchsorted(self.uppers, v, side="left"))
+        self.bucket_counts[idx] += int(n)
+        self.sum += v * int(n)
+        self.count += int(n)
+        if exemplar is not None and self.exemplars is not None:
+            self.exemplars[idx] = (dict(exemplar), v)
+        return idx >= len(self.uppers)
 
     def observe_array(self, values: np.ndarray) -> None:
         v = np.asarray(values, dtype=np.float64).ravel()
@@ -187,6 +235,7 @@ class MetricsRegistry:
         self._arrays: Dict[str, Tuple[ArraySource, str, str, str]] = {}
         self._scalars: Dict[str, Tuple[Callable[[], float], str, str]] = {}
         self._hists: Dict[str, Tuple[Histogram, str]] = {}
+        self._multi: Dict[str, Tuple[MultiSource, str, str]] = {}
         self.timings: Dict[str, TimingRing] = {}
         # per-row display names for `by="stream"` arrays (SDES CNAMEs);
         # values are hostile input and are escaped at render time
@@ -226,18 +275,66 @@ class MetricsRegistry:
                 name, (lambda o=obj, a=attr: getattr(o, a)),
                 help_=help_, kind=kind)
 
+    def register_multi(self, name: str, fn: MultiSource,
+                       help_: str = "", kind: str = "gauge") -> None:
+        """One family, many labeled samples: `fn` returns (labels,
+        value) rows resolved at render time — the shape of the SLO
+        engine's `slo_burn_rate{slo=...,window=...}` gauges."""
+        self._multi[name] = (fn, help_, kind)
+
     def register_histogram(self, name: str, hist: Histogram,
                            help_: str = "") -> None:
         self._hists[name] = (hist, help_)
 
     def histogram(self, name: str, buckets: Sequence[float],
-                  help_: str = "") -> Histogram:
+                  help_: str = "", exemplars: bool = False) -> Histogram:
         """Create-or-get a registered histogram (factory form: the
         returned object is already exported, so there is no
         observed-but-never-registered drift window)."""
         if name not in self._hists:
-            self._hists[name] = (Histogram(buckets), help_)
+            self._hists[name] = (Histogram(buckets, exemplars=exemplars),
+                                 help_)
         return self._hists[name][0]
+
+    def get_histogram(self, name: str) -> Optional[Histogram]:
+        entry = self._hists.get(name)
+        return entry[0] if entry is not None else None
+
+    def sample_total(self, name: str) -> float:
+        """Current scalar total of a registered family, whatever its
+        shape: scalars read live, per-stream arrays sum across rows,
+        histograms report their observation count.  The SLO engine's
+        single read API — SloSpecs name families, not objects."""
+        if name in self._scalars:
+            return float(self._scalars[name][0]())
+        if name in self._hists:
+            return float(self._hists[name][0].count)
+        if name in self._arrays:
+            src = self._arrays[name][0]
+            arr = src() if callable(src) else src
+            return float(np.asarray(arr).sum())
+        raise KeyError(f"no registered metric family `{name}`")
+
+    def has_metric(self, name: str) -> bool:
+        return (name in self._scalars or name in self._hists
+                or name in self._arrays or name in self._multi)
+
+    def families(self) -> List[Tuple[str, str]]:
+        """(full_name, kind) of every registered family — the source of
+        truth `scripts/gen_dashboards.py` generates recording rules
+        from, so rule exprs can never drift from registered names."""
+        fams: List[Tuple[str, str]] = []
+        for name, (_src, _by, _help, kind) in self._arrays.items():
+            fams.append((f"{self.ns}_{name}", kind))
+        for name, (_fn, _help, kind) in self._scalars.items():
+            fams.append((f"{self.ns}_{name}", kind))
+        for name in self._hists:
+            fams.append((f"{self.ns}_{name}", "histogram"))
+        for name, (_fn, _help, kind) in self._multi.items():
+            fams.append((f"{self.ns}_{name}", kind))
+        for name in self.timings:
+            fams.append((f"{self.ns}_{name}_seconds", "summary"))
+        return sorted(fams)
 
     def set_stream_name(self, sid: int, name: Optional[str]) -> None:
         """Attach a display name (e.g. SDES CNAME) to a stream row;
@@ -252,9 +349,20 @@ class MetricsRegistry:
             self.timings[name] = TimingRing()
         return self.timings[name]
 
-    def render(self, active: Optional[np.ndarray] = None) -> str:
+    @staticmethod
+    def _fmt_exemplar(labels: Dict[str, str], value: float) -> str:
+        """OpenMetrics exemplar suffix: ` # {labels} value`."""
+        block = ",".join(f'{k}="{escape_label_value(v)}"'
+                         for k, v in labels.items())
+        return f" # {{{block}}} {_fmt(value)}"
+
+    def render(self, active: Optional[np.ndarray] = None,
+               openmetrics: bool = False) -> str:
         """Prometheus text format.  `active` masks which rows of the
-        per-stream arrays are exported (10k idle rows would be noise)."""
+        per-stream arrays are exported (10k idle rows would be noise).
+        `openmetrics=True` switches to the OpenMetrics rendering:
+        histogram buckets carry their exemplars and the exposition ends
+        with the mandatory `# EOF` terminator."""
         out: List[str] = []
         for name, (src, by, help_, kind) in self._arrays.items():
             arr = src() if callable(src) else src
@@ -277,16 +385,33 @@ class MetricsRegistry:
                 out.append(f"# HELP {full} {escape_help(help_)}")
             out.append(f"# TYPE {full} {kind}")
             out.append(f"{full} {fn()}")
+        for name, (fn, help_, kind) in self._multi.items():
+            full = f"{self.ns}_{name}"
+            if help_:
+                out.append(f"# HELP {full} {escape_help(help_)}")
+            out.append(f"# TYPE {full} {kind}")
+            for labels, value in fn():
+                block = ",".join(f'{k}="{escape_label_value(v)}"'
+                                 for k, v in labels.items())
+                out.append(f"{full}{{{block}}} {_fmt(value)}")
         for name, (hist, help_) in self._hists.items():
             full = f"{self.ns}_{name}"
             if help_:
                 out.append(f"# HELP {full} {escape_help(help_)}")
             out.append(f"# TYPE {full} histogram")
             cum = hist.cumulative()
-            for upper, c in zip(hist.uppers, cum[:-1]):
-                out.append(f'{full}_bucket{{le="{_fmt_le(upper)}"}} '
-                           f"{int(c)}")
-            out.append(f'{full}_bucket{{le="+Inf"}} {hist.count}')
+            ex = hist.exemplars if (openmetrics and
+                                    hist.exemplars is not None) else None
+            for i, (upper, c) in enumerate(zip(hist.uppers, cum[:-1])):
+                line = (f'{full}_bucket{{le="{_fmt_le(upper)}"}} '
+                        f"{int(c)}")
+                if ex is not None and ex[i] is not None:
+                    line += self._fmt_exemplar(*ex[i])
+                out.append(line)
+            line = f'{full}_bucket{{le="+Inf"}} {hist.count}'
+            if ex is not None and ex[-1] is not None:
+                line += self._fmt_exemplar(*ex[-1])
+            out.append(line)
             out.append(f"{full}_sum {_fmt(hist.sum)}")
             out.append(f"{full}_count {hist.count}")
         for name, ring in self.timings.items():
@@ -297,6 +422,8 @@ class MetricsRegistry:
                            f"{_fmt(ring.percentile(q))}")
             out.append(f"{full}_sum {_fmt(ring.sum)}")
             out.append(f"{full}_count {ring.count}")
+        if openmetrics:
+            out.append("# EOF")
         return "\n".join(out) + "\n"
 
 
@@ -345,14 +472,36 @@ def _parse_labels(block: str) -> Optional[Dict[str, str]]:
     return labels
 
 
-def parse_exposition(text: str) -> Tuple[
+def _split_exemplar(line: str) -> Tuple[str, Optional[str]]:
+    """Split a sample line at the exemplar separator `#`, quote-aware:
+    a `#` inside a quoted label value (hostile stream names) is data,
+    not a separator.  Returns (sample_part, exemplar_part_or_None)."""
+    in_quote = False
+    i, n = 0, len(line)
+    while i < n:
+        ch = line[i]
+        if ch == "\\" and in_quote:
+            i += 2
+            continue
+        if ch == '"':
+            in_quote = not in_quote
+        elif ch == "#" and not in_quote:
+            return line[:i].rstrip(), line[i + 1:].strip()
+        i += 1
+    return line, None
+
+
+def parse_exposition_full(text: str) -> Tuple[
         Dict[str, str], List[Tuple[str, Dict[str, str], float]],
-        List[str]]:
-    """Parse Prometheus text format -> (types, samples, errors).
-    types maps family name -> metric type; samples are
-    (sample_name, labels, value)."""
+        List[Tuple[int, str, str]], List[str]]:
+    """Parse Prometheus/OpenMetrics text -> (types, samples, exemplars,
+    errors).  types maps family name -> metric type; samples are
+    (sample_name, labels, value); exemplars are (lineno, sample_name,
+    raw exemplar text after `#`) — validated by
+    `validate_exposition(openmetrics=True)`."""
     types: Dict[str, str] = {}
     samples: List[Tuple[str, Dict[str, str], float]] = []
+    exemplars: List[Tuple[int, str, str]] = []
     errors: List[str] = []
     for lineno, line in enumerate(text.splitlines(), 1):
         if not line.strip():
@@ -372,25 +521,26 @@ def parse_exposition(text: str) -> Tuple[
             types[fam] = mtype
             continue
         if line.startswith("#"):
-            continue                        # HELP / comments
-        # sample: name{labels} value
-        name, labels, rest = line, {}, ""
-        brace = line.find("{")
+            continue                        # HELP / EOF / comments
+        # sample: name{labels} value [# {exemplar-labels} value [ts]]
+        sample_part, exemplar_part = _split_exemplar(line)
+        name, labels, rest = sample_part, {}, ""
+        brace = sample_part.find("{")
         if brace >= 0:
-            close = line.rfind("}")
+            close = sample_part.rfind("}")
             if close < brace:
                 errors.append(f"line {lineno}: unbalanced braces")
                 continue
-            name = line[:brace]
-            parsed = _parse_labels(line[brace + 1: close])
+            name = sample_part[:brace]
+            parsed = _parse_labels(sample_part[brace + 1: close])
             if parsed is None:
                 errors.append(f"line {lineno}: malformed labels in "
                               f"`{line}`")
                 continue
             labels = parsed
-            rest = line[close + 1:]
+            rest = sample_part[close + 1:]
         else:
-            parts = line.split(None, 1)
+            parts = sample_part.split(None, 1)
             if len(parts) != 2:
                 errors.append(f"line {lineno}: malformed sample `{line}`")
                 continue
@@ -401,6 +551,16 @@ def parse_exposition(text: str) -> Tuple[
             errors.append(f"line {lineno}: unparseable value in `{line}`")
             continue
         samples.append((name, labels, value))
+        if exemplar_part is not None:
+            exemplars.append((lineno, name, exemplar_part))
+    return types, samples, exemplars, errors
+
+
+def parse_exposition(text: str) -> Tuple[
+        Dict[str, str], List[Tuple[str, Dict[str, str], float]],
+        List[str]]:
+    """Back-compat 3-tuple view of `parse_exposition_full`."""
+    types, samples, _exemplars, errors = parse_exposition_full(text)
     return types, samples, errors
 
 
@@ -415,12 +575,71 @@ def _family_of(sample_name: str, types: Dict[str, str]) -> Optional[str]:
     return None
 
 
-def validate_exposition(text: str) -> List[str]:
+def _validate_exemplar(lineno: int, sample_name: str, raw: str
+                       ) -> List[str]:
+    """OpenMetrics exemplar contract: attached to a `_bucket` sample,
+    `{labels} value [timestamp]`, combined label runes <= 128."""
+    errs: List[str] = []
+    if not sample_name.endswith("_bucket"):
+        errs.append(f"line {lineno}: exemplar on `{sample_name}` — "
+                    "only histogram _bucket samples carry exemplars")
+    if not raw.startswith("{"):
+        errs.append(f"line {lineno}: exemplar must start with a "
+                    "label set")
+        return errs
+    close = raw.rfind("}")
+    if close < 0:
+        errs.append(f"line {lineno}: unbalanced exemplar braces")
+        return errs
+    labels = _parse_labels(raw[1:close])
+    if labels is None:
+        errs.append(f"line {lineno}: malformed exemplar labels")
+        return errs
+    runes = sum(len(k) + len(v) for k, v in labels.items())
+    if runes > EXEMPLAR_RUNES_MAX:
+        errs.append(f"line {lineno}: exemplar label set is {runes} "
+                    f"runes (limit {EXEMPLAR_RUNES_MAX})")
+    tail = raw[close + 1:].split()
+    if not tail or len(tail) > 2:
+        errs.append(f"line {lineno}: exemplar needs a value and at "
+                    "most a timestamp")
+        return errs
+    for tok in tail:
+        try:
+            float(tok)
+        except ValueError:
+            errs.append(f"line {lineno}: non-numeric exemplar "
+                        f"field `{tok}`")
+    return errs
+
+
+def count_exemplars(text: str) -> int:
+    """Number of syntactically valid exemplars in an exposition (the
+    obs smoke's 'at least one exemplar made it to the wire' check)."""
+    _types, _samples, exemplars, _errors = parse_exposition_full(text)
+    return sum(1 for lineno, name, raw in exemplars
+               if not _validate_exemplar(lineno, name, raw))
+
+
+def validate_exposition(text: str, openmetrics: bool = False
+                        ) -> List[str]:
     """Return a list of format violations (empty == valid): every
     sample family typed exactly once, histogram buckets cumulative
     with `le="+Inf"` == `_count` and a `_sum`, summaries with numeric
-    quantile labels plus `_sum`/`_count`."""
-    types, samples, errors = parse_exposition(text)
+    quantile labels plus `_sum`/`_count`.  With `openmetrics=True`,
+    additionally require the `# EOF` terminator and validate exemplar
+    syntax; exemplars on a non-OpenMetrics exposition are violations
+    (they are rendered only on the negotiated content type)."""
+    types, samples, exemplars, errors = parse_exposition_full(text)
+    if openmetrics:
+        tail = [ln.strip() for ln in text.splitlines() if ln.strip()]
+        if not tail or tail[-1] != "# EOF":
+            errors.append("openmetrics: missing `# EOF` terminator")
+        for lineno, name, raw in exemplars:
+            errors.extend(_validate_exemplar(lineno, name, raw))
+    elif exemplars:
+        errors.append(f"{len(exemplars)} exemplar(s) present on a "
+                      "non-OpenMetrics exposition")
     by_family: Dict[str, List[Tuple[str, Dict[str, str], float]]] = {}
     for name, labels, value in samples:
         fam = _family_of(name, types)

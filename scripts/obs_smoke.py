@@ -8,6 +8,10 @@ Drives media + a NACK through the bridge for N ticks, then asserts:
 - the five pipeline-stage summaries (ingress, reverse_chain, recovery,
   forward_chain, egress) are present with p50/p99 quantiles;
 - real histogram families expose cumulative buckets ending in +Inf;
+- an OpenMetrics scrape (Accept negotiation) carries at least one
+  VALID exemplar on packet_journey_seconds buckets plus the `# EOF`
+  terminator, and the default scrape stays exemplar-free;
+- the SLO engine exports slo_burn_rate gauges and serves /debug/slo;
 - a hostile SDES stream name round-trips escaped, not raw;
 - /healthz reports ok and /debug/streams serves a flight dump.
 
@@ -25,12 +29,16 @@ sys.path.insert(0, ".")
 HOSTILE_NAME = 'evil "name\nwith\\slashes'
 STAGES = ("ingress", "reverse_chain", "recovery", "forward_chain",
           "egress")
+ACCEPT_OM = "application/openmetrics-text; version=1.0.0"
 
 
-def _get(port, path):
-    with urllib.request.urlopen(
-            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
-        return r.status, r.read().decode("utf-8")
+def _get(port, path, accept=None):
+    req = urllib.request.Request(f"http://127.0.0.1:{port}{path}")
+    if accept is not None:
+        req.add_header("Accept", accept)
+    with urllib.request.urlopen(req, timeout=5) as r:
+        return r.status, r.read().decode("utf-8"), \
+            r.headers.get("Content-Type", "")
 
 
 def run(ticks: int = 40) -> None:
@@ -39,7 +47,9 @@ def run(ticks: int = 40) -> None:
     from libjitsi_tpu.service.sfu_bridge import SfuBridge
     from libjitsi_tpu.service.supervisor import (BridgeSupervisor,
                                                  SupervisorConfig)
-    from libjitsi_tpu.utils.metrics import validate_exposition
+    from libjitsi_tpu.utils.metrics import (count_exemplars,
+                                            validate_exposition)
+    from libjitsi_tpu.utils.slo import SloEngine, default_slos
 
     sys.path.insert(0, "tests")
     from test_sfu_bridge import _Endpoint
@@ -48,8 +58,9 @@ def run(ticks: int = 40) -> None:
     libjitsi_tpu.init()
     sfu = SfuBridge(libjitsi_tpu.configuration_service(), port=0,
                     capacity=8, recv_window_ms=0)
+    slo = SloEngine(sfu.loop.metrics, default_slos())
     sup = BridgeSupervisor(sfu, SupervisorConfig(deadline_ms=1000.0),
-                           metrics=sfu.loop.metrics)
+                           metrics=sfu.loop.metrics, slo=slo)
     srv = ObservabilityServer(metrics=sfu.loop.metrics,
                               supervisor=sup).start()
     try:
@@ -78,8 +89,9 @@ def run(ticks: int = 40) -> None:
             sup.tick(now=now)
         sfu.emit_feedback(now=now)
 
-        code, text = _get(srv.port, "/metrics")
+        code, text, ctype = _get(srv.port, "/metrics")
         assert code == 200, f"/metrics -> {code}"
+        assert "text/plain" in ctype, f"default scrape ctype: {ctype}"
         errors = validate_exposition(text)
         assert not errors, "exposition invalid:\n" + "\n".join(errors)
         ns = sfu.loop.metrics.ns
@@ -94,14 +106,50 @@ def run(ticks: int = 40) -> None:
         assert 'evil \\"name\\nwith\\\\slashes' in text, \
             "escaped stream name missing"
 
-        code, body = _get(srv.port, "/healthz")
+        # OpenMetrics negotiation: exemplars + # EOF, validator-clean
+        code, om, ctype = _get(srv.port, "/metrics", accept=ACCEPT_OM)
+        assert code == 200, f"/metrics (OM) -> {code}"
+        assert "application/openmetrics-text" in ctype, \
+            f"OM scrape ctype: {ctype}"
+        om_errors = validate_exposition(om, openmetrics=True)
+        assert not om_errors, \
+            "OpenMetrics exposition invalid:\n" + "\n".join(om_errors)
+        journey = f"{ns}_packet_journey_seconds"
+        assert f"# TYPE {journey} histogram" in om, f"missing {journey}"
+        n_ex = count_exemplars(om)
+        assert n_ex >= 1, "no exemplars in the OpenMetrics scrape"
+        ex_lines = [ln for ln in om.splitlines()
+                    if ln.startswith(f"{journey}_bucket") and " # " in ln]
+        assert ex_lines, "no exemplar on packet_journey_seconds buckets"
+        assert 'trace_id="' in ex_lines[0], \
+            f"exemplar lacks trace_id: {ex_lines[0]}"
+        assert count_exemplars(text) == 0, \
+            "default (non-OpenMetrics) scrape leaked exemplars"
+
+        # SLO engine: burn-rate gauges in the scrape + /debug/slo JSON
+        assert f"# TYPE {ns}_slo_burn_rate gauge" in text, \
+            "slo_burn_rate family missing"
+        assert f'{ns}_slo_burn_rate{{slo="journey_p99",window="1m"}}' \
+            in text, "journey_p99 1m burn-rate sample missing"
+        code, body, _ = _get(srv.port, "/debug/slo")
+        slo_doc = json.loads(body)
+        assert code == 200, f"/debug/slo -> {code}"
+        assert slo_doc["ticks"] > 0, "SLO engine never ticked"
+        names = {s["name"] for s in slo_doc["slos"]}
+        assert {"journey_p99", "residual_loss", "auth_fail"} <= names, \
+            f"missing stock SLOs: {names}"
+        for s in slo_doc["slos"]:
+            assert set(s["burn"]) == {"1m", "5m", "30m", "6h"}, \
+                f"bad windows on {s['name']}: {set(s['burn'])}"
+
+        code, body, _ = _get(srv.port, "/healthz")
         health = json.loads(body)
         assert code == 200 and health["ok"], f"unhealthy: {health}"
 
-        code, body = _get(srv.port, "/debug/streams")
+        code, body, _ = _get(srv.port, "/debug/streams")
         sids = json.loads(body)["streams"]
         assert sids, "flight recorder saw no streams"
-        code, body = _get(srv.port, "/debug/streams/%d" % sids[0])
+        code, body, _ = _get(srv.port, "/debug/streams/%d" % sids[0])
         dump = json.loads(body)
         assert code == 200 and dump["events"], "empty flight dump"
         kinds = {e["kind"] for e in dump["events"]}

@@ -419,6 +419,103 @@ def test_drift_metrics_dangling_registration_fires():
     assert any("hits_cuont" in f.message for f in found)
 
 
+def test_drift_slospec_unregistered_metric_fires():
+    """An SloSpec naming a family no registration defines burns
+    against a permanently-absent signal — the SLO can never fire."""
+    src = """
+    from libjitsi_tpu.utils.slo import SloSpec
+
+    SPECS = [
+        SloSpec("ghost", objective=0.99,
+                bad_metric="never_registered_bad",
+                total_metric="bridge_forwarded"),
+    ]
+
+    def register(registry):
+        registry.register_scalar("bridge_forwarded", lambda: 0,
+                                 kind="counter")
+    """
+    ctx = ctx_of(src)
+    found = check_metrics_drift({ctx.relpath: ctx})
+    assert len(found) == 1
+    assert "never_registered_bad" in found[0].message
+    assert "ghost" in found[0].message
+
+
+def test_drift_slospec_exact_and_suffix_matched_refs_clean():
+    """Refs resolved by an exact constant registration AND by a
+    register_counters suffix under a call-site prefix are both clean
+    (prefix-parameterized names must not false-positive)."""
+    src = """
+    from libjitsi_tpu.utils.slo import SloSpec
+
+    SPECS = [
+        SloSpec("loss", objective=0.999,
+                bad_metric="recovery_nacks_abandoned",
+                total_metric="bridge_forwarded"),
+    ]
+
+    class Recovery:
+        def __init__(self):
+            self.nacks_abandoned = 0
+
+        def work(self):
+            self.nacks_abandoned += 1
+
+        def register_metrics(self, registry):
+            registry.register_counters(self, (
+                ("nacks_abandoned", "deadline passed"),
+            ), prefix="recovery")
+
+    def register(registry):
+        registry.register_scalar("bridge_forwarded", lambda: 0,
+                                 kind="counter")
+    """
+    ctx = ctx_of(src)
+    assert check_metrics_drift({ctx.relpath: ctx}) == []
+
+
+def test_drift_exemplar_histogram_never_fed_fires():
+    """exemplars=True reserves exemplar slots; if no observe call ever
+    passes exemplar=, every OpenMetrics scrape ships them empty."""
+    src = """
+    class Loop:
+        def __init__(self, registry):
+            self.journey = registry.histogram(
+                "packet_journey_seconds", (0.001, 0.01),
+                exemplars=True)
+
+        def on_egress(self, dt):
+            self.journey.observe(dt)
+    """
+    ctx = ctx_of(src)
+    found = check_metrics_drift({ctx.relpath: ctx})
+    assert len(found) == 1
+    assert "exemplar" in found[0].message
+    assert "journey" in found[0].message
+
+
+def test_drift_exemplar_histogram_fed_anywhere_clean():
+    """The exemplar feed may live in another file — the check is over
+    the whole-tree index, not per file."""
+    src_def = """
+    class Loop:
+        def __init__(self, registry):
+            self.journey = registry.histogram(
+                "packet_journey_seconds", (0.001, 0.01),
+                exemplars=True)
+    """
+    src_use = """
+    class Egress:
+        def flush(self, loop, dt, trace):
+            loop.journey.observe(
+                dt, exemplar={"trace_id": str(trace)})
+    """
+    a = ctx_of(src_def, relpath="libjitsi_tpu/io/loop.py")
+    b = ctx_of(src_use, relpath="libjitsi_tpu/service/x.py")
+    assert check_metrics_drift({a.relpath: a, b.relpath: b}) == []
+
+
 def test_drift_histogram_observed_but_never_registered_fires():
     """A Histogram constructed and fed but never handed to the
     registry records distributions nobody can scrape."""

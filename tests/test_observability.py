@@ -17,7 +17,8 @@ from libjitsi_tpu.service.supervisor import (BridgeSupervisor,
                                              SupervisorConfig)
 from libjitsi_tpu.utils.flight import FlightRecorder
 from libjitsi_tpu.utils.metrics import (Histogram, MetricsRegistry,
-                                        TimingRing, escape_label_value,
+                                        TimingRing, count_exemplars,
+                                        escape_label_value,
                                         exponential_buckets,
                                         validate_exposition)
 from libjitsi_tpu.utils.tracing import PipelineTracer
@@ -75,6 +76,62 @@ def test_registry_histogram_factory_is_create_or_get():
     b = m.histogram("x", (5, 6))          # existing wins; buckets kept
     assert a is b
     assert a.uppers.tolist() == [1.0, 2.0]
+
+
+# ------------------------------------------------------------ exemplars
+
+def test_histogram_exemplar_slots_last_wins_and_tail_signal():
+    h = Histogram((0.01, 0.1), exemplars=True)
+    assert h.observe(0.005, exemplar={"trace_id": "1"}) is False
+    assert h.observe(0.007, exemplar={"trace_id": "2"}) is False
+    assert h.observe(5.0, exemplar={"trace_id": "3"}) is True  # +Inf
+    assert h.exemplars[0][0] == {"trace_id": "2"}   # last wins
+    assert h.exemplars[0][1] == pytest.approx(0.007)
+    assert h.exemplars[-1][0] == {"trace_id": "3"}
+    assert h.exemplars[1] is None                   # untouched slot
+    # observe_same spreads n observations, one exemplar
+    assert h.observe_same(0.05, 4, exemplar={"trace_id": "4"}) is False
+    assert h.exemplars[1][0] == {"trace_id": "4"}
+
+
+def test_exemplars_render_only_on_openmetrics():
+    m = MetricsRegistry()
+    h = m.histogram("journey_seconds", (0.01, 0.1), exemplars=True)
+    h.observe(0.005, exemplar={"trace_id": "42"})
+    plain = m.render()
+    om = m.render(openmetrics=True)
+    assert count_exemplars(plain) == 0
+    assert count_exemplars(om) == 1
+    assert '# {trace_id="42"} 0.005' in om
+    assert om.rstrip().endswith("# EOF")
+    assert validate_exposition(plain) == []
+    assert validate_exposition(om, openmetrics=True) == []
+
+
+@pytest.mark.parametrize("breakage,needle", [
+    # exemplar allowed on _bucket lines only
+    ('# TYPE h histogram\nh_bucket{le="1"} 1\nh_bucket{le="+Inf"} 1\n'
+     'h_sum 1\nh_count 1 # {t="1"} 0.5\n# EOF\n', "bucket"),
+    # exemplar label set over the 128-rune OpenMetrics cap
+    ('# TYPE h histogram\nh_bucket{le="1"} 1 # {t="' + "x" * 140
+     + '"} 0.5\nh_bucket{le="+Inf"} 1\nh_sum 1\nh_count 1\n# EOF\n',
+     "128"),
+    # exemplar value must be numeric
+    ('# TYPE h histogram\nh_bucket{le="1"} 1 # {t="1"} oops\n'
+     'h_bucket{le="+Inf"} 1\nh_sum 1\nh_count 1\n# EOF\n', "numeric"),
+    # OpenMetrics requires the EOF terminator, last
+    ('# TYPE g gauge\ng 1\n', "# EOF"),
+])
+def test_openmetrics_validator_rejects_seeded_breakage(breakage, needle):
+    errors = validate_exposition(breakage, openmetrics=True)
+    assert errors and any(needle in e for e in errors), errors
+
+
+def test_exemplar_in_plain_exposition_is_a_violation():
+    text = ('# TYPE h histogram\nh_bucket{le="1"} 1 # {t="1"} 0.5\n'
+            'h_bucket{le="+Inf"} 1\nh_sum 1\nh_count 1\n')
+    errors = validate_exposition(text)     # 0.0.4 format: no exemplars
+    assert errors and any("exemplar" in e.lower() for e in errors)
 
 
 # ------------------------------------------------------------ validator
@@ -266,16 +323,60 @@ def test_flight_recorder_rings_are_bounded_and_ordered():
     assert fr.dump(1)["events"] == []
 
 
-def test_flight_recorder_header_sampling_is_capped():
+def test_flight_recorder_header_sampling_is_capped_spread():
+    """Default sampling is a deterministic stride reservoir: capped at
+    max_headers rows, spread over the burst, ALWAYS including the last
+    row (the old first-N sampling was blind to burst tails)."""
     fr = FlightRecorder(max_headers=3)
     sids = [5] * 10 + [6]
     seqs = list(range(100, 110)) + [777]
     lens = [60] * 11
-    fr.record_headers(sids, seqs, lens, tick=2)
+    fr.record_headers(sids, seqs, lens, tick=2, trace=9)
     ev5 = fr.dump(5)["events"][0]
     assert ev5["kind"] == "hdr" and ev5["n"] == 3
-    assert ev5["headers"] == [[100, 60], [101, 60], [102, 60]]
+    assert ev5["total"] == 10 and ev5["mode"] == "spread"
+    assert ev5["trace"] == 9
+    assert ev5["headers"][0] == [100, 60]     # first row kept
+    assert ev5["headers"][-1] == [109, 60]    # last row ALWAYS kept
     assert fr.dump(6)["events"][0]["headers"] == [[777, 60]]
+
+
+def test_flight_recorder_burst_tail_regression():
+    """A 1k-packet burst must leave at least one header from the burst
+    TAIL on record — both in spread mode (stride reservoir includes the
+    final row) and, for a priority-marked stream, the full tail."""
+    fr = FlightRecorder(max_headers=16)
+    n = 1000
+    sids = [3] * n
+    seqs = list(range(n))
+    lens = [60] * n
+    fr.record_headers(sids, seqs, lens, tick=0)
+    ev = fr.dump(3)["events"][-1]
+    tail_seqs = set(range(n - 16, n))
+    assert any(h[0] in tail_seqs for h in ev["headers"]), \
+        "spread sample kept nothing from the burst tail"
+    assert ev["headers"][-1][0] == n - 1
+
+    # priority mark (set by a journey-tail overflow or a NACK/RTX/FEC
+    # event) biases the NEXT sample to the whole tail, then clears
+    fr.mark_priority(3)
+    fr.record_headers(sids, seqs, lens, tick=1)
+    ev = fr.dump(3)["events"][-1]
+    assert ev["mode"] == "tail"
+    assert [h[0] for h in ev["headers"]] == list(range(n - 16, n))
+    fr.record_headers(sids, seqs, lens, tick=2)   # mark consumed
+    assert fr.dump(3)["events"][-1]["mode"] == "spread"
+
+
+def test_flight_recorder_priority_kinds_mark_stream():
+    """NACK/RTX/FEC events auto-mark their stream: the next header
+    sample keeps the burst tail the event is about."""
+    fr = FlightRecorder(max_headers=2)
+    fr.record("rtx_served", sid=7, tick=0, seq=55)
+    fr.record_headers([7] * 5, [10, 11, 12, 13, 14], [60] * 5, tick=1)
+    ev = fr.dump(7)["events"][-1]
+    assert ev["mode"] == "tail"
+    assert [h[0] for h in ev["headers"]] == [13, 14]
 
 
 def test_flight_dump_is_json_serializable():
@@ -315,6 +416,64 @@ def test_obs_server_serves_metrics_health_and_debug():
         assert code == 200 and json.loads(body) == []
 
 
+def test_obs_server_negotiates_openmetrics_and_serves_slo():
+    from libjitsi_tpu.utils.slo import SloEngine, SloSpec
+
+    m = MetricsRegistry()
+    h = m.histogram("journey_seconds", (0.01, 0.1), exemplars=True)
+    h.observe(0.005, exemplar={"trace_id": "7"})
+    state = {"bad": 1.0, "total": 100.0}
+    m.register_scalar("bad_things", lambda: state["bad"],
+                      kind="counter")
+    m.register_scalar("all_things", lambda: state["total"],
+                      kind="counter")
+    slo = SloEngine(m, [SloSpec("r", objective=0.99,
+                                bad_metric="bad_things",
+                                total_metric="all_things")])
+    slo.on_tick()
+    sup = types.SimpleNamespace(
+        health=lambda: {"state": "healthy"}, flight=None,
+        postmortems=[])
+    with ObservabilityServer(metrics=m, supervisor=sup,
+                             slo=slo) as srv:
+        # plain scrape: 0.0.4 content type, no exemplars
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/metrics")
+        with urllib.request.urlopen(req, timeout=5) as r:
+            plain, ctype = r.read().decode("utf-8"), \
+                r.headers.get("Content-Type", "")
+        assert "text/plain" in ctype
+        assert count_exemplars(plain) == 0
+        # Accept negotiation flips to OpenMetrics: exemplars + # EOF
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/metrics",
+            headers={"Accept":
+                     "application/openmetrics-text; version=1.0.0"})
+        with urllib.request.urlopen(req, timeout=5) as r:
+            om, ctype = r.read().decode("utf-8"), \
+                r.headers.get("Content-Type", "")
+        assert "application/openmetrics-text" in ctype
+        assert validate_exposition(om, openmetrics=True) == []
+        assert count_exemplars(om) == 1 and 'trace_id="7"' in om
+        # /debug/slo mirrors SloEngine.status()
+        code, body = _get(srv.port, "/debug/slo")
+        doc = json.loads(body)
+        assert code == 200 and doc["ticks"] == 1
+        assert doc["slos"][0]["name"] == "r"
+
+
+def test_obs_server_slo_404_when_absent():
+    sup = types.SimpleNamespace(
+        health=lambda: {"state": "healthy"}, flight=None,
+        postmortems=[])
+    with ObservabilityServer(supervisor=sup) as srv:
+        try:
+            code, body = _get(srv.port, "/debug/slo")
+        except urllib.error.HTTPError as e:
+            code, body = e.code, e.read().decode("utf-8")
+        assert code == 404 and "no slo engine" in body
+
+
 def test_obs_server_healthz_503_when_stalled_and_404s():
     sup = types.SimpleNamespace(
         health=lambda: {"state": "stalled"}, flight=None,
@@ -335,6 +494,37 @@ def test_obs_server_healthz_503_when_stalled_and_404s():
         except urllib.error.HTTPError as e:
             code = e.code
         assert code == 404
+
+
+# ------------------------------------------------------------ dashboards
+
+def test_checked_in_dashboards_are_fresh():
+    """Round-trip: regenerating the recording rules + dashboard from
+    the live registry must reproduce the checked-in files byte-for-byte
+    (a metrics change that shifts the scrape surface fails here until
+    scripts/gen_dashboards.py is re-run)."""
+    import os
+    import sys
+    sys.path.insert(0, "scripts")
+    import gen_dashboards
+
+    texts = gen_dashboards.generate()
+    assert set(texts) == set(gen_dashboards.FILES)
+    for name, text in texts.items():
+        path = os.path.join(gen_dashboards.OUT_DIR, name)
+        assert os.path.exists(path), f"dashboards/{name} not checked in"
+        with open(path) as fh:
+            on_disk = fh.read()
+        assert on_disk == text, \
+            (f"dashboards/{name} is stale — "
+             "re-run scripts/gen_dashboards.py")
+    # every PromQL family referenced exists in the registry the
+    # generator saw: burn-rate rules name each stock SLO
+    rules = texts["recording_rules.yaml"]
+    for slo_name in ("journey_p99", "residual_loss", "auth_fail"):
+        assert f"slo: {slo_name}" in rules
+    dash = json.loads(texts["bridge_dashboard.json"])
+    assert dash["panels"], "dashboard generated with no panels"
 
 
 # ------------------------------------------------------------- soak twin

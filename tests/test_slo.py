@@ -1,0 +1,246 @@
+"""SLO engine: TickWindowRing algebra, burn-rate math against the
+analytic value, the multi-window alert pairing, spec/metric reads over
+a live registry, and the metric/flight-event exports.
+
+Burn rate is the SRE-workbook quantity `bad_fraction / (1 - objective)`
+— the properties tested here are the ones the engine's correctness
+hangs on: a steady error rate converges to the analytic burn in every
+window (step-change property), totals survive ring wrap-around without
+leaking old buckets, and empty windows read as zero burn rather than
+NaN.
+"""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.utils.flight import FlightRecorder
+from libjitsi_tpu.utils.metrics import MetricsRegistry
+from libjitsi_tpu.utils.slo import (SloEngine, SloSpec, TickWindowRing,
+                                    default_slos)
+
+
+# ------------------------------------------------------ TickWindowRing
+
+def test_ring_totals_match_naive_sliding_window():
+    """Property: after every push, ring totals equal a naive sliding
+    sum over the last `covered` pushes, where covered is within one
+    bucket of the window (the quantization the ring trades for O(1)
+    pushes): sum(last window-bucket+1) <= totals <= sum(last window)."""
+    rng = np.random.default_rng(3)
+    window, buckets = 100, 10
+    ring = TickWindowRing(window, buckets=buckets)
+    bt = ring.bucket_ticks
+    assert bt == 10 and ring.n_buckets == 10
+    goods, bads = [], []
+    for _ in range(350):
+        g, b = float(rng.integers(0, 50)), float(rng.integers(0, 5))
+        goods.append(g)
+        bads.append(b)
+        ring.push(g, b)
+        got_g, got_b = ring.totals()
+        for series, got in ((goods, got_g), (bads, got_b)):
+            lo = sum(series[-(window - bt + 1):])
+            hi = sum(series[-window:])
+            assert lo <= got <= hi, (len(series), lo, got, hi)
+
+
+def test_ring_wraps_without_leaking_old_buckets():
+    ring = TickWindowRing(64, buckets=8)     # 8 ticks per bucket
+    for _ in range(64):
+        ring.push(1.0, 1.0)
+    assert ring.totals() == (64.0, 64.0)
+    # 64 more zero pushes flush every bucket: nothing may survive
+    for _ in range(64 + 8):
+        ring.push(0.0, 0.0)
+    assert ring.totals() == (0.0, 0.0)
+
+
+def test_ring_tiny_and_degenerate_windows():
+    r = TickWindowRing(1, buckets=64)        # window smaller than buckets
+    r.push(2.0, 3.0)
+    assert r.totals() == (2.0, 3.0)
+    assert r.n_buckets >= 1
+    r0 = TickWindowRing(0)                   # clamps, never div-zero
+    r0.push(1.0, 0.0)
+    assert r0.totals()[0] >= 0.0
+
+
+# --------------------------------------------------------------- specs
+
+def test_slospec_validation():
+    with pytest.raises(ValueError):
+        SloSpec("x", objective=1.0)
+    with pytest.raises(ValueError):
+        SloSpec("x", objective=0.0)
+    with pytest.raises(ValueError):
+        SloSpec("x", objective=0.5, kind="weird")
+    assert default_slos()[0].kind == "latency"
+
+
+def test_engine_rejects_duplicate_slo():
+    eng = SloEngine(MetricsRegistry(), [SloSpec("a", objective=0.9)])
+    with pytest.raises(ValueError):
+        eng.add(SloSpec("a", objective=0.99))
+
+
+# ----------------------------------------------------- burn-rate math
+
+def _ratio_engine(objective=0.99, **kw):
+    reg = MetricsRegistry()
+    state = {"bad": 0.0, "total": 0.0}
+    reg.register_scalar("bad_things", lambda: state["bad"],
+                        kind="counter")
+    reg.register_scalar("all_things", lambda: state["total"],
+                        kind="counter")
+    eng = SloEngine(reg, [SloSpec("r", objective=objective,
+                                  bad_metric="bad_things",
+                                  total_metric="all_things")], **kw)
+    return eng, state
+
+
+def test_step_change_converges_to_analytic_burn_rate():
+    """A steady bad-fraction p must converge to burn = p/(1-objective)
+    in every window once the window fills."""
+    p, objective = 0.02, 0.99
+    eng, state = _ratio_engine(objective=objective)
+    for t in range(1, 4001):
+        state["total"] = 100.0 * t           # 100 events/tick
+        state["bad"] = 100.0 * t * p
+        eng.on_tick()
+    analytic = p / (1.0 - objective)         # = 2.0
+    burns = eng.burn_rates("r")
+    # 1m/5m windows (3000/15000 ticks at 20 ms) have fully converged
+    assert burns["1m"] == pytest.approx(analytic, rel=1e-6)
+    assert burns["5m"] == pytest.approx(analytic, rel=1e-6)
+    # longer windows are still part-full but must agree on the RATE
+    assert burns["30m"] == pytest.approx(analytic, rel=1e-6)
+
+
+def test_empty_windows_read_zero_burn_not_nan():
+    eng, _state = _ratio_engine()
+    assert eng.burn_rates("r") == {"1m": 0.0, "5m": 0.0,
+                                   "30m": 0.0, "6h": 0.0}
+    eng.on_tick()                            # zero traffic tick
+    assert all(v == 0.0 for v in eng.burn_rates("r").values())
+    assert eng.state("r") == "ok"
+
+
+def test_burn_survives_window_wrap_after_burst_clears():
+    """An error burst must age out of the fast windows: burn returns
+    to ~0 once the window has rotated past the burst."""
+    eng, state = _ratio_engine()
+    wt = eng._rings["r"]["1m"]
+    window_ticks = wt.bucket_ticks * wt.n_buckets
+    state["total"], state["bad"] = 1000.0, 100.0   # 10% bad burst
+    eng.on_tick()
+    assert eng.burn_rates("r")["1m"] > 0.0
+    for t in range(window_ticks + wt.bucket_ticks):
+        state["total"] += 100.0              # clean traffic after
+        eng.on_tick()
+    assert eng.burn_rates("r")["1m"] == pytest.approx(0.0)
+
+
+def test_counter_rewind_is_clamped_not_negative():
+    """A checkpoint restore can rewind counters; deltas clamp at 0."""
+    eng, state = _ratio_engine()
+    state["total"], state["bad"] = 1000.0, 10.0
+    eng.on_tick()
+    state["total"], state["bad"] = 100.0, 1.0    # rewind
+    eng.on_tick()
+    good, bad = eng._rings["r"]["1m"].totals()
+    assert good >= 0.0 and bad >= 0.0
+
+
+# ------------------------------------------------- alert state machine
+
+def test_fast_burn_requires_both_fast_windows_and_emits_event():
+    fr = FlightRecorder()
+    eng, state = _ratio_engine(flight=fr)
+    # saturate fast windows with a catastrophic error rate
+    for t in range(1, 3001):
+        state["total"] = 100.0 * t
+        state["bad"] = 50.0 * t              # 50% bad, burn = 50
+        eng.on_tick()
+    assert eng.state("r") == "fast_burn"
+    assert eng.alerts_total >= 1
+    alerts = [e for e in fr.dump_all()["global"]
+              if e["kind"] == "slo_alert"]
+    assert alerts and alerts[-1]["slo"] == "r"
+    assert alerts[-1]["state"] in ("fast_burn", "slow_burn")
+    assert set(alerts[-1]["burn"]) == {"1m", "5m", "30m", "6h"}
+
+
+def test_short_blip_does_not_fast_burn():
+    """One bad tick cannot trip the pair: the 5m window dilutes it."""
+    eng, state = _ratio_engine()
+    # fill with clean traffic first so the 5m window has ballast
+    for t in range(1, 15001):
+        state["total"] = 100.0 * t
+        eng.on_tick()
+    state["bad"] = 200.0                     # one nasty tick
+    state["total"] += 100.0
+    eng.on_tick()
+    assert eng.state("r") != "fast_burn"
+
+
+def test_worst_state_ranking():
+    reg = MetricsRegistry()
+    eng = SloEngine(reg, [SloSpec("a", objective=0.9),
+                          SloSpec("b", objective=0.9)])
+    eng._state["a"] = "slow_burn"
+    assert eng.state() == "slow_burn"
+    eng._state["b"] = "fast_burn"
+    assert eng.state() == "fast_burn"
+    assert SloEngine(reg).state() == "ok"
+
+
+# ------------------------------------------------------ latency + reads
+
+def test_latency_spec_reads_histogram_buckets():
+    reg = MetricsRegistry()
+    h = reg.histogram("lat_seconds", (0.01, 0.02, 0.05))
+    eng = SloEngine(reg, [SloSpec("lat", objective=0.9, kind="latency",
+                                  metric="lat_seconds",
+                                  budget_s=0.02)])
+    h.observe_array(np.array([0.005, 0.015, 0.03, 0.08]))
+    eng.on_tick()
+    good, bad = eng._rings["lat"]["1m"].totals()
+    assert (good, bad) == (2.0, 2.0)         # le=0.02 cumulative = 2
+
+
+def test_missing_family_reads_none_and_pushes_zero():
+    reg = MetricsRegistry()
+    eng = SloEngine(reg, [SloSpec("ghost", objective=0.9,
+                                  bad_metric="nope",
+                                  total_metric="also_nope")])
+    eng.on_tick()                            # must not raise
+    assert eng.burn_rates("ghost")["1m"] == 0.0
+    assert eng.state("ghost") == "ok"
+
+
+# ------------------------------------------------------------- exports
+
+def test_register_metrics_exports_burn_state_and_alert_families():
+    reg = MetricsRegistry()
+    eng, state = _ratio_engine()
+    eng.register_metrics(reg)
+    eng.on_tick()
+    text = reg.render()
+    assert "# TYPE libjitsi_tpu_slo_burn_rate gauge" in text
+    assert 'libjitsi_tpu_slo_burn_rate{slo="r",window="1m"}' in text
+    assert 'libjitsi_tpu_slo_state{slo="r"} 0' in text
+    assert "libjitsi_tpu_slo_alerts_total 0" in text
+
+
+def test_status_is_json_ready_and_complete():
+    import json
+
+    eng, state = _ratio_engine()
+    state["total"], state["bad"] = 100.0, 1.0
+    eng.on_tick()
+    doc = json.loads(json.dumps(eng.status()))
+    assert doc["ticks"] == 1 and doc["state"] == "ok"
+    (slo,) = doc["slos"]
+    assert slo["name"] == "r"
+    assert set(slo["burn"]) == {"1m", "5m", "30m", "6h"}
+    assert slo["totals"]["1m"]["bad"] == 1.0

@@ -136,6 +136,106 @@ def test_escalation_ladder_then_full_recovery():
         assert "shed_restore" in kinds
 
 
+def _stage_sup(ledger, durations, with_recovery=True, slo=None,
+               **cfg_kwargs):
+    """Supervisor over a DummyBridge with a seeded stage ledger (the
+    tracer stub returns the same per-stage seconds every tick) and an
+    optional recovery stub that records shed_fec/throttle_rtx calls."""
+    cfg = SupervisorConfig(deadline_ms=10.0, overload_after=1,
+                           **cfg_kwargs)
+    bridge = DummyBridge()
+    bridge.loop.tracer = types.SimpleNamespace(
+        take_ledger=lambda: dict(ledger))
+    calls = []
+    if with_recovery:
+        bridge.recovery = types.SimpleNamespace(
+            shed_fec=lambda on: calls.append(("shed_fec", on)),
+            throttle_rtx=lambda on: calls.append(("throttle_rtx", on)))
+    sup = BridgeSupervisor(bridge, cfg, clock=FakeClock(durations),
+                           slo=slo)
+    return sup, bridge, calls
+
+
+def _escalations(sup):
+    return [e for e in sup.flight.dump_all()["global"]
+            if e["kind"] == "ladder_escalate"]
+
+
+def test_stage_skew_forward_chain_sheds_fec_before_recv_window():
+    """forward_chain owning the tick budget must pick shed_fec FIRST —
+    not the wall-time ladder's recv_window rung."""
+    ledger = {"ingress": 0.0004, "forward_chain": 0.009,
+              "egress": 0.0006}
+    sup, bridge, calls = _stage_sup(ledger, [0.05])
+    sup.tick()
+    (ev,) = _escalations(sup)
+    assert ev["rung"] == "shed_fec"
+    assert ev["stage"] == "forward_chain"
+    assert ev["stage_share"] == pytest.approx(0.9, abs=0.01)
+    assert ev["slo_state"] == "none"
+    assert calls == [("shed_fec", True)]
+    # the wall-ladder rungs stayed untouched
+    assert bridge.loop.recv_window_ms == 1 and not bridge.degraded
+
+
+def test_stage_skew_ingress_shrinks_recv_window_and_unwinds_lifo():
+    ledger = {"ingress": 0.008, "forward_chain": 0.001,
+              "egress": 0.001}
+    sup, bridge, calls = _stage_sup(
+        ledger, [0.05, 0.05] + [0.001] * 10, overload_exit=2)
+    sup.tick()
+    (ev, ) = _escalations(sup)
+    assert ev["rung"] == "recv_window" and ev["stage"] == "ingress"
+    assert bridge.loop.recv_window_ms == 0
+    # second escalation: ingress rung already held -> wall ladder next
+    sup.tick()
+    assert _escalations(sup)[-1]["rung"] == "degrade"
+    assert bridge.degraded and not calls
+    # recovery unwinds LIFO: degrade first, then the window restores
+    for _ in range(2):
+        sup.tick()
+    assert not bridge.degraded and bridge.loop.recv_window_ms == 0
+    for _ in range(2):
+        sup.tick()
+    assert bridge.loop.recv_window_ms == 1
+    assert sup.level == 0
+
+
+def test_stage_skew_below_threshold_falls_back_to_wall_ladder():
+    """A balanced ledger (no stage >= stage_share_threshold) must walk
+    the PR-2 wall-time order even when forward_chain is nominally the
+    dominant stage."""
+    ledger = {"ingress": 0.003, "forward_chain": 0.004,
+              "egress": 0.003}
+    sup, bridge, calls = _stage_sup(ledger, [0.05, 0.05])
+    sup.tick()
+    sup.tick()
+    rungs = [e["rung"] for e in _escalations(sup)]
+    assert rungs == ["recv_window", "degrade"]
+    assert not calls
+
+
+def test_stage_skew_without_recovery_skips_fec_rung():
+    ledger = {"forward_chain": 0.009, "ingress": 0.001}
+    sup, bridge, calls = _stage_sup(ledger, [0.05],
+                                    with_recovery=False)
+    sup.tick()
+    (ev,) = _escalations(sup)
+    assert ev["rung"] == "recv_window"       # no controller to act on
+    assert not calls
+
+
+def test_escalation_event_carries_live_slo_state():
+    slo = types.SimpleNamespace(state=lambda *a: "fast_burn",
+                                on_tick=lambda: None)
+    ledger = {"forward_chain": 0.009, "ingress": 0.001}
+    sup, _bridge, _calls = _stage_sup(ledger, [0.05], slo=slo)
+    sup.tick()
+    (ev,) = _escalations(sup)
+    assert ev["slo_state"] == "fast_burn"
+    assert sup.health()["slo_state"] == "fast_burn"
+
+
 def test_shed_is_deterministic_and_priority_ordered():
     cfg = SupervisorConfig(deadline_ms=10.0, overload_after=1,
                            shed_step=2)
