@@ -26,6 +26,12 @@ pass ``exemplar=`` ships empty exemplar slots in every OpenMetrics
 scrape — both are silent-at-runtime wiring bugs, which is exactly what
 a static gate is for.
 
+**Perf-baseline drift** (global, disk-backed): ``PERF_BASELINE.json``
+keys must match the ``SCENARIOS`` ids in ``scripts/perf_gate.py`` both
+ways — a stale key gates nothing, and a scenario without a baseline
+entry can regress forever without failing the gate.  The gate script
+is AST-parsed, never imported (lint stays hermetic).
+
 **Snapshot drift** (per-file): subclasses of ``ArraySnapshotMixin``
 must list every mutable array field in ``_SNAP_FIELDS`` (or carry it
 via the scalar hooks) — a field missing from the snapshot restores
@@ -39,6 +45,8 @@ missing from both ``_SNAP_FIELDS`` and the scalar-hook sources, and
 from __future__ import annotations
 
 import ast
+import json
+import os
 import re
 from typing import Dict, List, Optional, Set, Tuple
 
@@ -359,6 +367,85 @@ def _exemplar_observed(ctx: FileContext) -> Set[str]:
     return out
 
 
+# -------------------------------------------------------- perf-baseline half
+
+def check_perf_baseline(baseline_keys: Set[str],
+                        scenario_ids: Set[str]) -> List[str]:
+    """Pure comparison: messages for baseline keys matching no perf-gate
+    scenario (stale — the gate never reads them) and scenarios with no
+    baseline entry (ungated — a regression there never fails)."""
+    msgs: List[str] = []
+    for key in sorted(baseline_keys - scenario_ids):
+        msgs.append(
+            f"PERF_BASELINE.json key `{key}` matches no perf_gate "
+            "scenario id — stale entry, the gate never compares it")
+    for sid in sorted(scenario_ids - baseline_keys):
+        msgs.append(
+            f"perf_gate scenario `{sid}` has no PERF_BASELINE.json "
+            "entry — ungated, a regression there never fails "
+            "(run scripts/perf_gate.py --write-baseline)")
+    return msgs
+
+
+def _perf_gate_scenario_ids(script_path: str) -> Optional[Set[str]]:
+    """String keys of the module-level ``SCENARIOS = {...}`` literal in
+    scripts/perf_gate.py (AST only, never imported: the gate pulls in
+    jax at import time and lint must stay hermetic)."""
+    try:
+        with open(script_path) as fh:
+            tree = ast.parse(fh.read(), filename=script_path)
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Dict) and any(
+                    isinstance(t, ast.Name) and t.id == "SCENARIOS"
+                    for t in node.targets):
+            return {k.value for k in node.value.keys
+                    if isinstance(k, ast.Constant) and
+                    isinstance(k.value, str)}
+    return None
+
+
+def _perf_baseline_findings(index: Dict[str, FileContext]
+                            ) -> List[Finding]:
+    """Disk wiring: lint only indexes .py files under the linted tree,
+    so the baseline json and the scripts/ gate are read from disk,
+    located by walking up from any indexed file."""
+    root = None
+    for ctx in index.values():
+        d = os.path.dirname(os.path.abspath(ctx.path))
+        for _ in range(6):
+            if os.path.exists(os.path.join(d, "PERF_BASELINE.json")):
+                root = d
+                break
+            parent = os.path.dirname(d)
+            if parent == d:
+                break
+            d = parent
+        if root:
+            break
+    if root is None:
+        return []
+    try:
+        with open(os.path.join(root, "PERF_BASELINE.json")) as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return [Finding(rule=RULE, path="PERF_BASELINE.json", line=1,
+                        col=0, message="PERF_BASELINE.json is not "
+                        "valid JSON — the perf gate cannot load it",
+                        snippet="PERF_BASELINE.json", symbol="")]
+    scenario_ids = _perf_gate_scenario_ids(
+        os.path.join(root, "scripts", "perf_gate.py"))
+    if scenario_ids is None:
+        return []
+    baseline_keys = {k for k in doc if not k.startswith("_")}
+    return [Finding(rule=RULE, path="PERF_BASELINE.json", line=1,
+                    col=0, message=msg, snippet=msg, symbol="")
+            for msg in check_perf_baseline(baseline_keys,
+                                           scenario_ids)]
+
+
 def check_metrics_drift(index: Dict[str, FileContext]) -> List[Finding]:
     registered: Set[str] = set()
     for ctx in index.values():
@@ -439,6 +526,11 @@ def check_metrics_drift(index: Dict[str, FileContext]) -> List[Finding]:
                     "exemplars=True but no observe call ever passes "
                     "exemplar= — its exemplar slots stay empty in "
                     "every OpenMetrics scrape"))
+
+    # perf-baseline half: PERF_BASELINE.json vs perf_gate SCENARIOS —
+    # a stale baseline key silently gates nothing; a scenario with no
+    # baseline entry silently never gates
+    findings.extend(_perf_baseline_findings(index))
 
     # vice versa: registered attribute names that exist nowhere
     for ctx in index.values():

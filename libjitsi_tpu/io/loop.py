@@ -29,6 +29,7 @@ from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.utils.flight import FlightRecorder
 from libjitsi_tpu.utils.logging import get_logger
 from libjitsi_tpu.utils.metrics import MetricsRegistry
+from libjitsi_tpu.utils.perf import PhaseProfiler
 from libjitsi_tpu.utils.tracing import PipelineTracer
 
 _log = get_logger("io.loop")
@@ -68,7 +69,8 @@ class MediaLoop:
                  recv_window_ms: int = 1,
                  pipelined: bool = False,
                  tracer: Optional[PipelineTracer] = None,
-                 flight: Optional[FlightRecorder] = None):
+                 flight: Optional[FlightRecorder] = None,
+                 phase_sample_every: int = 16):
         self.engine = engine
         self.registry = registry
         self.chain = chain
@@ -78,7 +80,8 @@ class MediaLoop:
         # serializing with it (SURVEY §7 step 4's budget).  Costs one
         # recv-window of latency on the reply path.
         self.pipelined = pipelined
-        self._inflight: List[Tuple[object, np.ndarray]] = []
+        # (pending, mask, journey origin, dispatch tick)
+        self._inflight: List[Tuple[object, np.ndarray, tuple, int]] = []
         # kernel arrival stamps ride along when the engine has them;
         # after each tick, `last_rtp_arrival_ns` aligns row-for-row with
         # the batch handed to on_media (BWE wants skb-receive times,
@@ -132,6 +135,15 @@ class MediaLoop:
         self.ticks = 0
         self.rx_packets = 0
         self.tx_packets = 0
+        # age (in ticks) of the oldest un-flushed async dispatch; >1
+        # means protected bytes sat across a full tick — pipeline depth
+        self.dispatch_inflight_ticks = 0
+        # host/device phase attribution: fenced probes every
+        # `phase_sample_every` ticks, byte counters every tick
+        self.perf = PhaseProfiler(
+            metrics=self.metrics, sample_every=phase_sample_every,
+            tracer=self.tracer,
+            inflight_fn=lambda: self.dispatch_inflight_ticks)
 
     # ------------------------------------------------------------- holds
     def hold_stream(self, sid: int, max_packets: int = 64) -> None:
@@ -168,17 +180,25 @@ class MediaLoop:
     # -------------------------------------------------------------- tick
     def tick(self) -> int:
         """One batching window; returns packets processed."""
+        self.perf.begin_tick()
+        try:
+            return self._tick_inner()
+        finally:
+            self.perf.end_tick()
+
+    def _tick_inner(self) -> int:
         # re-established below only when this tick carries RTP rows; a
         # stale previous-tick value must never masquerade as fresh
         self.last_rtp_arrival_ns = None
         with self.tracer.span("ingress"):
-            if self.use_kernel_ts:
-                batch, sip, sport, ats = self.engine.recv_batch_ts(
-                    self.recv_window_ms)
-            else:
-                batch, sip, sport = self.engine.recv_batch(
-                    self.recv_window_ms)
-                ats = None
+            with self.perf.phase("idle"):    # socket wait dominates here
+                if self.use_kernel_ts:
+                    batch, sip, sport, ats = self.engine.recv_batch_ts(
+                        self.recv_window_ms)
+                else:
+                    batch, sip, sport = self.engine.recv_batch(
+                        self.recv_window_ms)
+                    ats = None
         # arrival stamp: the batching window just closed — everything
         # this tick sends is measured against this instant (per-batch
         # journey; rows within one batch share the stamp)
@@ -189,6 +209,9 @@ class MediaLoop:
             self.pkt_size_hist.observe_array(
                 np.asarray(batch.length)[:n])
         self.ticks += 1
+        self.dispatch_inflight_ticks = max(
+            (self.ticks - t for _p, _m, _o, t in self._inflight),
+            default=0)
         # the recv window just elapsed: anything dispatched last tick
         # has had a full socket-wait of device time — flush it now
         if self._inflight:
@@ -288,8 +311,16 @@ class MediaLoop:
                 self.last_rtp_arrival_ns = (
                     ats[rtp_rows] if ats is not None else None)
                 if self.chain is not None:
-                    rtp, ok = self.chain.rtp_transformer.reverse_transform(
-                        rtp)
+                    self.perf.note_h2d(rtp.data.nbytes +
+                                       np.asarray(rtp.length).nbytes)
+                    self.perf.probe_h2d((rtp.data,))
+                    # the sync reverse call blends dispatch + compute +
+                    # d2h; attributed wholesale to device_compute (the
+                    # forward path's async seam splits them properly)
+                    with self.perf.phase("device_compute"):
+                        rtp, ok = (self.chain.rtp_transformer
+                                   .reverse_transform(rtp))
+                    self.perf.note_d2h(rtp.data.nbytes)
                     if not ok.all():
                         _log.warn("reverse_chain_drop",
                                   count=int((~ok).sum()),
@@ -352,7 +383,20 @@ class MediaLoop:
             return 0
         with self.tracer.span("forward_chain"):
             if self.chain is not None:
-                batch, ok = self.chain.rtp_transformer.transform(batch)
+                tr = self.chain.rtp_transformer
+                self.perf.note_h2d(batch.data.nbytes +
+                                   np.asarray(batch.length).nbytes)
+                if self.perf.sampled and hasattr(tr, "transform_async"):
+                    # sampled tick: run the same work through the async
+                    # seam so dispatch / device_compute / d2h split out
+                    with self.perf.phase("dispatch"):
+                        pending, ok = tr.transform_async(batch)
+                    self.perf.fence(pending)
+                    with self.perf.phase("d2h_transfer"):
+                        batch = pending.result()
+                else:
+                    batch, ok = tr.transform(batch)
+                self.perf.note_d2h(batch.data.nbytes)
             else:
                 ok = np.ones(batch.batch_size, bool)
         rows = np.nonzero(ok)[0]
@@ -378,9 +422,13 @@ class MediaLoop:
         if self.chain is None:
             return self.send_media(batch)       # nothing to overlap
         with self.tracer.span("forward_chain"):
-            pending, mask = self.chain.rtp_transformer.transform_async(
-                batch)
-        self._inflight.append((pending, mask, self.journey_origin()))
+            self.perf.note_h2d(batch.data.nbytes +
+                               np.asarray(batch.length).nbytes)
+            with self.perf.phase("dispatch"):
+                pending, mask = (self.chain.rtp_transformer
+                                 .transform_async(batch))
+        self._inflight.append((pending, mask, self.journey_origin(),
+                               self.ticks))
         return batch.batch_size
 
     def flush_sends(self) -> int:
@@ -388,8 +436,11 @@ class MediaLoop:
         sent = 0
         inflight, self._inflight = self._inflight, []
         with self.tracer.span("egress"):
-            for pending, mask, origin in inflight:
-                out = pending.result()
+            for pending, mask, origin, _tick in inflight:
+                self.perf.fence(pending)
+                with self.perf.phase("d2h_transfer"):
+                    out = pending.result()
+                self.perf.note_d2h(out.data.nbytes)
                 rows = np.nonzero(mask)[0]
                 if len(rows) == 0:
                     continue

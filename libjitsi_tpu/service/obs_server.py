@@ -10,7 +10,10 @@ A threaded `http.server` (no framework, no new deps) serving:
                         the `# EOF` terminator
   /healthz              supervisor health JSON; 503 when stalled
   /debug/slo            SloEngine status: per-SLO burn rates over the
-                        four windows, states, thresholds
+                        four windows, states, thresholds; plus the
+                        supervisor's host/device phase attribution
+  /debug/device         live device-memory stats per device
+                        (utils/profiling.device_memory)
   /debug/streams/<sid>  flight-recorder dump for one stream
   /debug/postmortems    supervisor's bounded post-mortem list
 
@@ -106,9 +109,32 @@ class ObservabilityServer:
             if slo is None:
                 return (404, "application/json",
                         b'{"error": "no slo engine attached"}')
+            doc = slo.status()
+            # host/device attribution rides along: a burning SLO plus
+            # `bound: host` names the fix (ingress path), not just the
+            # symptom
+            sup = self.supervisor
+            if sup is not None and hasattr(sup, "phase_attribution"):
+                doc["attribution"] = sup.phase_attribution()
             return (200, "application/json",
-                    json.dumps(slo.status(),
+                    json.dumps(doc,
                                default=_jsonable).encode("utf-8"))
+        if path == "/debug/device":
+            # live device-memory stats (utils/profiling.device_memory):
+            # leak-shaped growth is visible without attaching a profiler
+            try:
+                import jax
+
+                from libjitsi_tpu.utils.profiling import device_memory
+
+                devices = [device_memory(d) for d in jax.devices()]
+                return (200, "application/json",
+                        json.dumps({"devices": devices},
+                                   default=_jsonable).encode("utf-8"))
+            except Exception as exc:
+                return (500, "application/json",
+                        json.dumps({"error": repr(exc)})
+                        .encode("utf-8"))
         if path == "/healthz":
             h = self._health()
             code = 200 if h.get("ok") else 503

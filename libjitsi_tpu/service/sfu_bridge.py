@@ -559,10 +559,17 @@ class SfuBridge:
         (after the recv window — the launch overlaps the socket wait),
         same seam as MediaLoop's pipelined replies."""
         self._media_ran = True
+        perf = self.loop.perf
         if self._pending_fanout:
             self._flush_fanout()
-        dec, ok, idx = self.rx_table.unprotect_rtp(batch,
-                                                   return_index=True)
+        perf.note_h2d(batch.data.nbytes +
+                      np.asarray(batch.length).nbytes)
+        # sync unprotect blends dispatch+compute+d2h — attributed
+        # wholesale to device_compute, same as the loop's reverse chain
+        with perf.phase("device_compute"):
+            dec, ok, idx = self.rx_table.unprotect_rtp(
+                batch, return_index=True)
+        perf.note_d2h(dec.data.nbytes)
         rows = np.nonzero(ok)[0]
         if len(rows) == 0:
             return None
@@ -595,12 +602,14 @@ class SfuBridge:
                 # dispatch carries its ingress origin: the flush lands
                 # on a LATER tick, and the journey must charge the
                 # pipelining delay to the tick the packets arrived on
+                with perf.phase("dispatch"):
+                    pend = self.translator.translate_async(sub, idx_sel)
                 self._pending_fanout.append(
-                    (self.translator.translate_async(sub, idx_sel),
-                     self.loop.journey_origin()))
+                    (pend, self.loop.journey_origin()))
             return None
         with self.loop.tracer.span("forward_chain"):
-            wire, recv = self.translator.translate(sub, idx_sel)
+            with perf.phase("device_compute"):
+                wire, recv = self.translator.translate(sub, idx_sel)
         self._emit_fanout(wire, recv)
         return None
 
@@ -616,9 +625,13 @@ class SfuBridge:
             self._flush_fanout()
 
     def _flush_fanout(self) -> None:
+        perf = self.loop.perf
         pending, self._pending_fanout = self._pending_fanout, []
         for pend, origin in pending:
-            self._emit_fanout(*pend.result(), origin=origin)
+            perf.fence(pend)
+            with perf.phase("d2h_transfer"):
+                out = pend.result()
+            self._emit_fanout(*out, origin=origin)
 
     def _emit_fanout(self, wire: PacketBatch, recv: np.ndarray,
                      origin=None) -> None:

@@ -121,6 +121,11 @@ class BridgeSupervisor:
         self.tracer: Optional[PipelineTracer] = getattr(
             self.loop, "tracer", None)
         self.last_ledger: Dict[str, float] = {}
+        # host/device phase ledger (utils/perf.PhaseProfiler via the
+        # tracer): escalations say host-bound vs device-bound, not just
+        # which stage.  getattr-guarded — test stubs carry only
+        # take_ledger
+        self.last_phases: Dict[str, float] = {}
         cap = self.loop.registry.capacity
         self.watchdog = Watchdog(self.cfg.deadline_ms / 1000.0,
                                  overload_after=self.cfg.overload_after,
@@ -173,6 +178,12 @@ class BridgeSupervisor:
         over = self.watchdog.observe(self.clock() - t0)
         if self.tracer is not None:
             self.last_ledger = self.tracer.take_ledger()
+            take_phases = getattr(self.tracer, "take_phase_ledger",
+                                  None)
+            if take_phases is not None:
+                phases = take_phases()
+                if phases:       # sampled ticks only; keep last split
+                    self.last_phases = phases
         self.ticks += 1
         if self.slo is not None:
             self.slo.on_tick()
@@ -257,12 +268,14 @@ class BridgeSupervisor:
         total = sum(self.last_ledger.values())
         share = (stage_s / total) if total > 0 else 0.0
         rung = self._pick_rung(stage, share, rec)
+        phase, _phase_s, phase_share, bound = self._phase_attr()
         self.flight.record(
             "ladder_escalate", tick=self.ticks, level=self.level,
             worst_s=self.watchdog.worst_s,
             stage=stage or "unknown", stage_s=stage_s,
             stage_share=round(share, 4), rung=rung,
-            slo_state=self._slo_state())
+            phase=phase, phase_share=round(phase_share, 4),
+            bound=bound, slo_state=self._slo_state())
         self._apply_rung(rung)
         self._rungs.append(rung)
 
@@ -549,6 +562,26 @@ class BridgeSupervisor:
             if hasattr(bank, "register_metrics"):
                 bank.register_metrics(registry)
 
+    def _phase_attr(self):
+        """(phase, seconds, share, bound) of the last sampled phase
+        split — "which phase owns the tick, and is that host-side or
+        device-side?"."""
+        from libjitsi_tpu.utils.perf import classify_bound
+
+        phase, phase_s = PipelineTracer.dominant(self.last_phases)
+        total = sum(self.last_phases.values())
+        share = (phase_s / total) if total > 0 else 0.0
+        return (phase or "unknown", phase_s, share,
+                classify_bound(self.last_phases))
+
+    def phase_attribution(self) -> dict:
+        """Host/device attribution summary for /debug/slo: the phase
+        split the escalation ladder is currently judging by."""
+        phase, phase_s, share, bound = self._phase_attr()
+        return {"bound": bound, "phase": phase,
+                "phase_share": round(share, 4),
+                "phases": dict(self.last_phases)}
+
     def health(self) -> dict:
         """Liveness summary for probes / logs."""
         return {"state": self.watchdog.state, "level": self.level,
@@ -557,5 +590,7 @@ class BridgeSupervisor:
                 "quarantined": sorted(self._quarantined),
                 "ticks": self.ticks, "overruns": self.watchdog.overruns,
                 "last_ledger": dict(self.last_ledger),
+                "last_phases": dict(self.last_phases),
+                "bound": self._phase_attr()[3],
                 "slo_state": self._slo_state(),
                 "postmortems": len(self.postmortems)}

@@ -141,6 +141,11 @@ class _DonePending:
     def result(self):
         return self._batch
 
+    def block_until_ready(self):
+        """No device work outstanding — fencing is a no-op (the phase
+        profiler fences pendings uniformly)."""
+        return self
+
 
 class TransformEngineChain(TransformEngine):
     """Ordered engine composition (reference: TransformEngineChain).

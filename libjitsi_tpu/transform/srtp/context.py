@@ -1317,6 +1317,20 @@ class PendingProtect:
         self._capacity = capacity
         self._done = done
 
+    def block_until_ready(self) -> "PendingProtect":
+        """Fence the dispatched device work without transferring it
+        back — the phase profiler's device_compute/d2h boundary."""
+        if self._done is None:
+            try:
+                import jax
+
+                for _rows, arrs, _n in self._parts:
+                    jax.block_until_ready(
+                        [a for a in arrs if a is not None])
+            except Exception:
+                pass
+        return self
+
     def result(self) -> PacketBatch:
         if self._done is None:
             done = [(rows, PacketBatch(np.asarray(data),

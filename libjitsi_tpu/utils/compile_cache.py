@@ -8,6 +8,58 @@ best-effort: the cache is an optimization, never a requirement.
 from __future__ import annotations
 
 import os
+from typing import Optional
+
+
+class CompileCacheStats:
+    """Process-wide compile/cache counters fed by `jax.monitoring`
+    events.  Event names differ across jax versions, so matching is
+    by substring ("cache_hit" / "cache_miss" / "compil") and always
+    best-effort; the counters exist (and render as 0) even when no
+    listener ever fires.  `PhaseProfiler.register_metrics` exports
+    them as `compile_cache_hits` / `compile_cache_misses` /
+    `compile_events` (+ `compile_seconds_total`): a recompile landing
+    on the data path shows up as a counter step in the scrape, not a
+    mystery latency spike."""
+
+    def __init__(self) -> None:
+        self.hits = 0
+        self.misses = 0
+        self.compile_events = 0
+        self.compile_seconds = 0.0
+
+    def on_event(self, event: str, **kwargs) -> None:
+        if "cache_hit" in event:
+            self.hits += 1
+        elif "cache_miss" in event:
+            self.misses += 1
+
+    def on_duration(self, event: str, duration_secs: float,
+                    **kwargs) -> None:
+        if "compil" in event:
+            self.compile_events += 1
+            self.compile_seconds += float(duration_secs)
+
+
+_STATS: Optional[CompileCacheStats] = None
+
+
+def compile_stats() -> CompileCacheStats:
+    """Singleton stats, registering the jax.monitoring listeners on
+    first use (listener registration is additive and process-global,
+    so exactly one registration per process)."""
+    global _STATS
+    if _STATS is None:
+        _STATS = CompileCacheStats()
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_listener(_STATS.on_event)
+            monitoring.register_event_duration_secs_listener(
+                _STATS.on_duration)
+        except Exception:
+            pass                 # counters still exist, just never fed
+    return _STATS
 
 
 def enable_compile_cache(path: str = "") -> None:

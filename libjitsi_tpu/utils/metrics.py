@@ -219,6 +219,37 @@ class Histogram:
         return np.cumsum(self.bucket_counts)
 
 
+class HistogramVec:
+    """One histogram family fanned out over a single label (e.g.
+    `tick_phase_seconds{phase=...}`): each label value owns a child
+    `Histogram` over the same buckets, rendered under ONE `# TYPE`
+    line with the label on every `_bucket`/`_sum`/`_count` sample.
+
+    Children are created on first `labels(value)` call (or eagerly by
+    the caller, so a scrape never sees an empty family)."""
+
+    def __init__(self, buckets: Sequence[float], label: str,
+                 exemplars: bool = False):
+        self.buckets = tuple(buckets)
+        self.label = label
+        self.exemplars = exemplars
+        self._children: Dict[str, Histogram] = {}
+
+    def labels(self, value: str) -> Histogram:
+        key = str(value)
+        if key not in self._children:
+            self._children[key] = Histogram(self.buckets,
+                                            exemplars=self.exemplars)
+        return self._children[key]
+
+    def children(self) -> List[Tuple[str, Histogram]]:
+        return sorted(self._children.items())
+
+    @property
+    def count(self) -> int:
+        return sum(h.count for h in self._children.values())
+
+
 class MetricsRegistry:
     """Array-backed gauges/counters with Prometheus text rendering.
 
@@ -235,6 +266,7 @@ class MetricsRegistry:
         self._arrays: Dict[str, Tuple[ArraySource, str, str, str]] = {}
         self._scalars: Dict[str, Tuple[Callable[[], float], str, str]] = {}
         self._hists: Dict[str, Tuple[Histogram, str]] = {}
+        self._hist_vecs: Dict[str, Tuple[HistogramVec, str]] = {}
         self._multi: Dict[str, Tuple[MultiSource, str, str]] = {}
         self.timings: Dict[str, TimingRing] = {}
         # per-row display names for `by="stream"` arrays (SDES CNAMEs);
@@ -296,8 +328,23 @@ class MetricsRegistry:
                                  help_)
         return self._hists[name][0]
 
+    def histogram_vec(self, name: str, buckets: Sequence[float],
+                      label: str, help_: str = "",
+                      exemplars: bool = False) -> HistogramVec:
+        """Create-or-get a labeled histogram family (one label axis,
+        e.g. `tick_phase_seconds{phase=...}`).  Same factory contract
+        as `histogram()`: the returned vec is already exported."""
+        if name not in self._hist_vecs:
+            self._hist_vecs[name] = (
+                HistogramVec(buckets, label, exemplars=exemplars), help_)
+        return self._hist_vecs[name][0]
+
     def get_histogram(self, name: str) -> Optional[Histogram]:
         entry = self._hists.get(name)
+        return entry[0] if entry is not None else None
+
+    def get_histogram_vec(self, name: str) -> Optional[HistogramVec]:
+        entry = self._hist_vecs.get(name)
         return entry[0] if entry is not None else None
 
     def sample_total(self, name: str) -> float:
@@ -309,6 +356,8 @@ class MetricsRegistry:
             return float(self._scalars[name][0]())
         if name in self._hists:
             return float(self._hists[name][0].count)
+        if name in self._hist_vecs:
+            return float(self._hist_vecs[name][0].count)
         if name in self._arrays:
             src = self._arrays[name][0]
             arr = src() if callable(src) else src
@@ -317,7 +366,8 @@ class MetricsRegistry:
 
     def has_metric(self, name: str) -> bool:
         return (name in self._scalars or name in self._hists
-                or name in self._arrays or name in self._multi)
+                or name in self._hist_vecs or name in self._arrays
+                or name in self._multi)
 
     def families(self) -> List[Tuple[str, str]]:
         """(full_name, kind) of every registered family — the source of
@@ -329,6 +379,8 @@ class MetricsRegistry:
         for name, (_fn, _help, kind) in self._scalars.items():
             fams.append((f"{self.ns}_{name}", kind))
         for name in self._hists:
+            fams.append((f"{self.ns}_{name}", "histogram"))
+        for name in self._hist_vecs:
             fams.append((f"{self.ns}_{name}", "histogram"))
         for name, (_fn, _help, kind) in self._multi.items():
             fams.append((f"{self.ns}_{name}", kind))
@@ -414,6 +466,22 @@ class MetricsRegistry:
             out.append(line)
             out.append(f"{full}_sum {_fmt(hist.sum)}")
             out.append(f"{full}_count {hist.count}")
+        for name, (vec, help_) in self._hist_vecs.items():
+            full = f"{self.ns}_{name}"
+            if help_:
+                out.append(f"# HELP {full} {escape_help(help_)}")
+            out.append(f"# TYPE {full} histogram")
+            for lv, hist in vec.children():
+                pre = f'{vec.label}="{escape_label_value(lv)}",'
+                cum = hist.cumulative()
+                for upper, c in zip(hist.uppers, cum[:-1]):
+                    out.append(f'{full}_bucket{{{pre}le='
+                               f'"{_fmt_le(upper)}"}} {int(c)}')
+                out.append(f'{full}_bucket{{{pre}le="+Inf"}} '
+                           f"{hist.count}")
+                lbl = f'{vec.label}="{escape_label_value(lv)}"'
+                out.append(f"{full}_sum{{{lbl}}} {_fmt(hist.sum)}")
+                out.append(f"{full}_count{{{lbl}}} {hist.count}")
         for name, ring in self.timings.items():
             full = f"{self.ns}_{name}_seconds"
             out.append(f"# TYPE {full} summary")
@@ -651,36 +719,61 @@ def validate_exposition(text: str, openmetrics: bool = False
     for fam, mtype in types.items():
         fam_samples = by_family.get(fam, [])
         if mtype == "histogram":
-            buckets = [(s[1].get("le"), s[2]) for s in fam_samples
-                       if s[0] == fam + "_bucket"]
-            counts = [s[2] for s in fam_samples if s[0] == fam + "_count"]
-            sums = [s for s in fam_samples if s[0] == fam + "_sum"]
-            if not buckets:
+            # group by non-`le` label series: a labeled family (e.g.
+            # tick_phase_seconds{phase=...}) is N independent
+            # bucket/sum/count triples sharing one TYPE line
+            series: Dict[Tuple[Tuple[str, str], ...],
+                         Dict[str, list]] = {}
+            for sname, labels, value in fam_samples:
+                key = tuple(sorted((k, v) for k, v in labels.items()
+                                   if k != "le"))
+                s = series.setdefault(
+                    key, {"buckets": [], "counts": [], "sums": []})
+                if sname == fam + "_bucket":
+                    s["buckets"].append((labels.get("le"), value))
+                elif sname == fam + "_count":
+                    s["counts"].append(value)
+                elif sname == fam + "_sum":
+                    s["sums"].append(value)
+            if not any(s["buckets"] for s in series.values()):
                 errors.append(f"histogram {fam}: no _bucket samples")
                 continue
-            les = []
-            for le, _v in buckets:
-                if le is None:
-                    errors.append(f"histogram {fam}: bucket missing le")
+            for key, s in series.items():
+                tag = fam if not key else (
+                    fam + "{" + ",".join(f'{k}="{v}"' for k, v in key)
+                    + "}")
+                buckets = s["buckets"]
+                counts = s["counts"]
+                sums = s["sums"]
+                if not buckets:
+                    errors.append(f"histogram {tag}: no _bucket samples")
                     continue
-                les.append(math.inf if le == "+Inf" else float(le))
-            if les != sorted(les):
-                errors.append(f"histogram {fam}: buckets not in "
-                              "ascending le order")
-            vals = [v for _le, v in buckets]
-            if any(b > a for a, b in zip(vals[1:], vals)):
-                errors.append(f"histogram {fam}: bucket counts not "
-                              "cumulative")
-            if not les or not math.isinf(les[-1]):
-                errors.append(f'histogram {fam}: missing le="+Inf" '
-                              "bucket")
-            if not counts:
-                errors.append(f"histogram {fam}: missing _count")
-            elif les and math.isinf(les[-1]) and vals[-1] != counts[0]:
-                errors.append(f'histogram {fam}: le="+Inf" bucket '
-                              f"({vals[-1]:g}) != _count ({counts[0]:g})")
-            if not sums:
-                errors.append(f"histogram {fam}: missing _sum")
+                les = []
+                for le, _v in buckets:
+                    if le is None:
+                        errors.append(f"histogram {tag}: bucket "
+                                      "missing le")
+                        continue
+                    les.append(math.inf if le == "+Inf" else float(le))
+                if les != sorted(les):
+                    errors.append(f"histogram {tag}: buckets not in "
+                                  "ascending le order")
+                vals = [v for _le, v in buckets]
+                if any(b > a for a, b in zip(vals[1:], vals)):
+                    errors.append(f"histogram {tag}: bucket counts not "
+                                  "cumulative")
+                if not les or not math.isinf(les[-1]):
+                    errors.append(f'histogram {tag}: missing le="+Inf" '
+                                  "bucket")
+                if not counts:
+                    errors.append(f"histogram {tag}: missing _count")
+                elif les and math.isinf(les[-1]) \
+                        and vals[-1] != counts[0]:
+                    errors.append(
+                        f'histogram {tag}: le="+Inf" bucket '
+                        f"({vals[-1]:g}) != _count ({counts[0]:g})")
+                if not sums:
+                    errors.append(f"histogram {tag}: missing _sum")
         elif mtype == "summary":
             quantiles = [s for s in fam_samples if s[0] == fam]
             for _name, labels, _v in quantiles:

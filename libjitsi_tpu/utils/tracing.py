@@ -89,9 +89,31 @@ class PipelineTracer:
         self.annotate = bool(annotate) and _TraceAnnotation is not None
         self._ledger: Dict[str, float] = {}
         self.last_ledger: Dict[str, float] = {}
+        # host/device *phase* ledger (host_python/dispatch/h2d/... from
+        # utils.perf.PhaseProfiler) — kept separate from the stage
+        # ledger so phase rows can never outrank stages in the
+        # supervisor's rung choice, but drained on the same cadence
+        self._phase_ledger: Dict[str, float] = {}
+        self.last_phase_ledger: Dict[str, float] = {}
 
     def span(self, stage: str) -> _StageSpan:
         return _StageSpan(self, stage)
+
+    def merge_phases(self, phases: Dict[str, float]) -> None:
+        """Accumulate a tick's phase split (phase -> seconds) into the
+        phase ledger; the PhaseProfiler calls this at end_tick on
+        sampled ticks."""
+        led = self._phase_ledger
+        for phase, seconds in phases.items():
+            led[phase] = led.get(phase, 0.0) + float(seconds)
+
+    def take_phase_ledger(self) -> Dict[str, float]:
+        """Drain and return the accumulated phase ledger (same
+        contract as `take_ledger`, retained as `last_phase_ledger`)."""
+        led, self._phase_ledger = self._phase_ledger, {}
+        if led:
+            self.last_phase_ledger = led
+        return led
 
     def ledger(self) -> Dict[str, float]:
         """The accumulating (not-yet-taken) ledger, read-only view."""

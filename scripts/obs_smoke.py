@@ -13,7 +13,12 @@ Drives media + a NACK through the bridge for N ticks, then asserts:
   terminator, and the default scrape stays exemplar-free;
 - the SLO engine exports slo_burn_rate gauges and serves /debug/slo;
 - a hostile SDES stream name round-trips escaped, not raw;
-- /healthz reports ok and /debug/streams serves a flight dump.
+- /healthz reports ok and /debug/streams serves a flight dump;
+- the phase profiler's tick_phase_seconds histogram carries sampled
+  ticks, dispatch_inflight_ticks and the h2d/d2h byte counters are
+  live, and /debug/device serves device-memory stats;
+- a synthetic host-dominant overload escalates with the HOST phase
+  named on the ladder_escalate event and /debug/slo attribution.
 
 Prints OBS_SMOKE_OK on success; any failure raises (exit != 0).
 Tier-1 runs this after the jitlint gate (scripts/tier1.sh).
@@ -154,6 +159,57 @@ def run(ticks: int = 40) -> None:
         assert code == 200 and dump["events"], "empty flight dump"
         kinds = {e["kind"] for e in dump["events"]}
         assert "hdr" in kinds, f"no header samples in dump: {kinds}"
+
+        # phase profiler: with the default sample_every=16 at least
+        # ticks 1/17/33 were fenced over 40 ticks, so the phase
+        # histogram family must carry samples and the dispatch-depth
+        # gauge must be present (0 on the sync path is fine)
+        code, text, _ = _get(srv.port, "/metrics")
+        phase_fam = f"{ns}_tick_phase_seconds"
+        assert f"# TYPE {phase_fam} histogram" in text, \
+            "tick_phase_seconds family missing"
+        for ph in ("host_python", "dispatch", "device_compute", "idle"):
+            assert f'{phase_fam}_bucket{{phase="{ph}",le="+Inf"}}' \
+                in text, f"phase {ph} missing from scrape"
+        assert f'{phase_fam}_count{{phase="host_python"}} 0' not in \
+            text, "no sampled ticks reached the phase histogram"
+        assert f"# TYPE {ns}_dispatch_inflight_ticks gauge" in text, \
+            "dispatch_inflight_ticks gauge missing"
+        assert f"# TYPE {ns}_h2d_bytes_total counter" in text
+        h2d = [ln for ln in text.splitlines()
+               if ln.startswith(f"{ns}_h2d_bytes_total ")]
+        assert h2d and float(h2d[0].split()[1]) > 0, \
+            f"h2d byte accounting never ran: {h2d}"
+
+        # /debug/device: live device-memory stats JSON
+        code, body, _ = _get(srv.port, "/debug/device")
+        assert code == 200, f"/debug/device -> {code}"
+        devices = json.loads(body)["devices"]
+        assert devices and "device" in devices[0], \
+            f"bad /debug/device doc: {devices}"
+
+        # host-bound overload drill: feed the supervisor a synthetic
+        # host-dominant phase ledger while the watchdog is overrun —
+        # the resulting ladder_escalate event must NAME the host phase
+        sup.watchdog.deadline_s = 1e-9
+        for _ in range(sup.cfg.overload_after):
+            sfu.loop.tracer.merge_phases(
+                {"host_python": 0.018, "dispatch": 0.001,
+                 "device_compute": 0.0005, "idle": 0.0005})
+            sup.tick(now=now)
+            now += 0.02
+        evs = [e for e in sup.flight.dump_all()["global"]
+               if e.get("kind") == "ladder_escalate"]
+        assert evs, "overrun ticks produced no ladder_escalate"
+        ev = evs[-1]
+        assert ev.get("phase") == "host_python", \
+            f"escalation did not name the host phase: {ev}"
+        assert ev.get("bound") == "host", \
+            f"escalation not attributed host-bound: {ev}"
+        code, body, _ = _get(srv.port, "/debug/slo")
+        attr = json.loads(body).get("attribution", {})
+        assert attr.get("bound") == "host", \
+            f"/debug/slo attribution missing host bound: {attr}"
     finally:
         srv.stop()
         sfu.close()

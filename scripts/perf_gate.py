@@ -1,0 +1,379 @@
+#!/usr/bin/env python
+"""Continuous perf-regression gate over a pinned fast bench subset.
+
+The BENCH_r0x records chart a trajectory but nothing *compares* them —
+a PR that halves loop-echo throughput lands silently.  This gate runs
+three fast scenarios (small-shape twins of bench.py's heavy ones),
+compares each against the checked-in `PERF_BASELINE.json`, appends a
+trend row to `PERF_TREND.jsonl`, and exits non-zero on regression
+beyond tolerance.
+
+Timer-floor discipline (PR 3): every scenario's net measured span must
+clear 10x the scalar-fetch-floor jitter; one that doesn't records
+`below_floor: ...` — a string, never a number — and is excluded from
+comparison on BOTH sides.  Tolerances are generous (CPU CI boxes are
+noisy); the gate is a ratchet against order-of-magnitude rot, not a
+±5% benchmark.
+
+Re-baselining honestly: run `--write-baseline` on a quiet machine,
+eyeball the delta vs the old file in the diff, and say WHY in the
+commit message.  Never re-baseline to make a red gate green.
+
+  python scripts/perf_gate.py                 # compare + trend + gate
+  python scripts/perf_gate.py --write-baseline
+  PERF_GATE_INJECT_SLOW=loop_echo_pps=10 ...  # test hook: divide a
+                                              # measured value by N
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+BASELINE_PATH = os.path.join(REPO, "PERF_BASELINE.json")
+TREND_PATH = os.path.join(REPO, "PERF_TREND.jsonl")
+
+#: net span must clear this many floor-jitters to count as a number
+FLOOR_MULT = 10.0
+
+#: default regression tolerance (fraction of baseline a value may drop
+#: before the gate fails); per-scenario overrides live in the baseline
+DEFAULT_TOLERANCE = 0.6
+
+_FLOOR = {"median": None, "jitter": None}
+
+
+def fetch_floor():
+    """(median, jitter) of the 4-byte scalar-fetch floor, bench.py's
+    `_fetch_floor` discipline: median of 7 samples, jitter = max-min."""
+    if _FLOOR["median"] is None:
+        import jax
+        import jax.numpy as jnp
+
+        g = jax.jit(lambda x: jnp.sum(x))
+        x = jnp.arange(8, dtype=jnp.uint32)
+        _ = np.asarray(g(x))
+        samples = []
+        for _i in range(7):
+            t0 = time.perf_counter()
+            _ = np.asarray(g(x))
+            samples.append(time.perf_counter() - t0)
+        arr = np.asarray(samples)
+        _FLOOR["median"] = float(np.median(arr))
+        _FLOOR["jitter"] = float(arr.max() - arr.min())
+    return _FLOOR["median"], _FLOOR["jitter"]
+
+
+def floor_check(value: float, net_s: float):
+    """Apply the timer-floor bar: a number only when the net span
+    clears FLOOR_MULT x jitter, else the `below_floor:` record."""
+    _median, jitter = fetch_floor()
+    bar = FLOOR_MULT * jitter
+    if net_s <= bar:
+        return (f"below_floor: net={net_s * 1e3:.3f}ms <= "
+                f"{FLOOR_MULT:g}x jitter={bar * 1e3:.3f}ms")
+    return float(value)
+
+
+# ------------------------------------------------------------ scenarios
+
+def _scenario_loop_echo():
+    """Small loop-echo twin of bench.py `_loop_rtt_child`: client
+    protect -> loopback UDP -> MediaLoop tick (demux + unprotect +
+    echo + re-protect) -> client recv.  Returns echoed pps."""
+    import libjitsi_tpu
+    from libjitsi_tpu.io import UdpEngine
+    from libjitsi_tpu.io.loop import MediaLoop
+    from libjitsi_tpu.rtp import header as rtp_header
+    from libjitsi_tpu.transform.srtp import SrtpStreamTable
+    from libjitsi_tpu.core.packet import PacketBatch
+    from libjitsi_tpu.service.media_stream import StreamRegistry
+    from libjitsi_tpu.transform import (SrtpTransformEngine,
+                                        TransformEngineChain)
+
+    n_pkts, cycles = 64, 4
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    mk, ms = bytes(range(16)), bytes(range(30, 44))
+    mk2, ms2 = bytes(range(60, 76)), bytes(range(80, 94))
+    reg = StreamRegistry(libjitsi_tpu.configuration_service(),
+                         capacity=16)
+    rx_tab = SrtpStreamTable(capacity=16)
+    rx_tab.add_stream(3, mk, ms)
+    tx_tab = SrtpStreamTable(capacity=16)
+    tx_tab.add_stream(3, mk2, ms2)
+    chain = TransformEngineChain([SrtpTransformEngine(tx_tab, rx_tab)])
+
+    def on_media(batch, ok):
+        rows = np.nonzero(ok)[0]
+        if len(rows) == 0:
+            return None
+        return PacketBatch(batch.data[rows],
+                           np.asarray(batch.length)[rows],
+                           batch.stream[rows])
+
+    bridge = MediaLoop(UdpEngine(port=0, max_batch=n_pkts + 8), reg,
+                       on_media=on_media, chain=chain,
+                       recv_window_ms=0)
+    reg.map_ssrc(0xBEEF01, 3)
+    c_tx = SrtpStreamTable(capacity=1)
+    c_tx.add_stream(0, mk, ms)
+    c_rx = SrtpStreamTable(capacity=1)
+    c_rx.add_stream(0, mk2, ms2)
+    client = UdpEngine(port=0, max_batch=n_pkts + 8)
+    done = 0
+    try:
+        t_all = None
+        for cyc in range(cycles + 1):       # cycle 0 is compile warmup
+            if cyc == 1:
+                t_all = time.perf_counter()
+            b = rtp_header.build(
+                [b"\xab" * 160] * n_pkts,
+                list(range(cyc * n_pkts, (cyc + 1) * n_pkts)),
+                [cyc * 960] * n_pkts, [0xBEEF01] * n_pkts,
+                [96] * n_pkts, stream=[0] * n_pkts)
+            wire = c_tx.protect_rtp(b)
+            client.send_batch(wire, "127.0.0.1", bridge.engine.port)
+            got = 0
+            cyc_deadline = time.perf_counter() + 10.0
+            while got < n_pkts and time.perf_counter() < cyc_deadline:
+                bridge.tick()
+                back, _, _ = client.recv_batch(timeout_ms=1)
+                if back.batch_size:
+                    back.stream[:] = 0
+                    _, ok = c_rx.unprotect_rtp(back)
+                    if cyc > 0:
+                        done += int(ok.sum())
+                    got += back.batch_size
+        net = time.perf_counter() - t_all
+    finally:
+        bridge.engine.close()
+        client.close()
+    return floor_check(done / net, net)
+
+
+def _scenario_protect_small():
+    """Small-shape protect plane: one SRTP table, 256-packet batches,
+    chained protect calls (distinct pre-built seqs).  Returns pps."""
+    from libjitsi_tpu.rtp import header as rtp_header
+    from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+    n_streams, bsz, reps = 8, 256, 6
+    rng = np.random.default_rng(11)
+    tab = SrtpStreamTable(capacity=64)
+    mks = rng.integers(0, 256, (n_streams, 16), dtype=np.uint8)
+    mss = rng.integers(0, 256, (n_streams, 14), dtype=np.uint8)
+    tab.add_streams(np.arange(n_streams), mks, mss)
+    batches = []
+    for k in range(reps + 1):
+        streams = rng.integers(0, n_streams, bsz)
+        b = rtp_header.build(
+            [b"\xcd" * 160] * bsz, [100 + k] * bsz, [k * 960] * bsz,
+            (0x20000 + streams).tolist(), [96] * bsz,
+            stream=streams.tolist())
+        batches.append(b)
+    _ = tab.protect_rtp(batches[0])         # compile warmup
+    t0 = time.perf_counter()
+    acc = 0
+    for b in batches[1:]:
+        out = tab.protect_rtp(b)
+        acc += int(np.asarray(out.length)[0])   # force materialization
+    net = time.perf_counter() - t0
+    assert acc >= 0
+    return floor_check(reps * bsz / net, net)
+
+
+def _scenario_install_streams():
+    """Stream-install churn: bulk add_streams into a fresh table
+    (bench.py `_production_tables` install_rate twin).  Returns
+    streams/sec."""
+    from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+    rng = np.random.default_rng(7)
+    warm = SrtpStreamTable(capacity=16)     # derivation compile warmup
+    warm.add_streams(np.arange(8),
+                     rng.integers(0, 256, (8, 16), dtype=np.uint8),
+                     rng.integers(0, 256, (8, 14), dtype=np.uint8))
+    n = 256
+    mks = rng.integers(0, 256, (n, 16), dtype=np.uint8)
+    mss = rng.integers(0, 256, (n, 14), dtype=np.uint8)
+    tab = SrtpStreamTable(capacity=n)
+    t0 = time.perf_counter()
+    tab.add_streams(np.arange(n), mks, mss)
+    net = time.perf_counter() - t0
+    return floor_check(n / net, net)
+
+
+#: pinned scenario ids — the jitlint `drift` checker cross-checks this
+#: mapping against PERF_BASELINE.json keys (stale/missing entries)
+SCENARIOS = {
+    "loop_echo_pps": _scenario_loop_echo,
+    "protect_small_pps": _scenario_protect_small,
+    "install_streams_per_sec": _scenario_install_streams,
+}
+
+
+# ----------------------------------------------------------- comparison
+
+def judge(measured, baseline_value, tolerance: float,
+          higher_is_better: bool = True):
+    """-> (status, detail).  Statuses: "ok", "regression",
+    "below_floor" (either side is a below_floor record — never
+    numerically compared), "new" (no baseline)."""
+    if baseline_value is None:
+        return "new", "no baseline entry"
+    if isinstance(measured, str):
+        return "below_floor", measured
+    if isinstance(baseline_value, str):
+        return "below_floor", f"baseline is {baseline_value}"
+    base = float(baseline_value)
+    if higher_is_better:
+        bar = base * (1.0 - tolerance)
+        if measured < bar:
+            return ("regression",
+                    f"{measured:.1f} < {bar:.1f} "
+                    f"(baseline {base:.1f}, tol {tolerance:g})")
+    else:
+        bar = base * (1.0 + tolerance)
+        if measured > bar:
+            return ("regression",
+                    f"{measured:.1f} > {bar:.1f} "
+                    f"(baseline {base:.1f}, tol {tolerance:g})")
+    return "ok", f"{measured:.1f} vs baseline {base:.1f}"
+
+
+def compare(results: dict, baseline: dict):
+    """Judge every scenario result against the baseline doc.
+    -> (failures, report_rows)."""
+    failures = []
+    rows = []
+    for name, measured in results.items():
+        entry = baseline.get(name)
+        if entry is None:
+            status, detail = judge(measured, None, DEFAULT_TOLERANCE)
+        else:
+            status, detail = judge(
+                measured, entry.get("value"),
+                float(entry.get("tolerance", DEFAULT_TOLERANCE)),
+                bool(entry.get("higher_is_better", True)))
+        rows.append((name, status, detail))
+        if status == "regression":
+            failures.append((name, detail))
+    return failures, rows
+
+
+def _inject_slow(results: dict) -> dict:
+    """Test hook: PERF_GATE_INJECT_SLOW="scenario=factor[,...]" divides
+    the named measured values — how the acceptance test proves a
+    slowed scenario turns the gate red without slowing anything."""
+    spec = os.environ.get("PERF_GATE_INJECT_SLOW", "")
+    for part in filter(None, (p.strip() for p in spec.split(","))):
+        name, _, factor = part.partition("=")
+        if name in results and not isinstance(results[name], str):
+            results[name] = results[name] / float(factor or 1)
+    return results
+
+
+def run_scenarios(names=None) -> dict:
+    from libjitsi_tpu.utils.compile_cache import enable_compile_cache
+
+    enable_compile_cache()
+    results = {}
+    for name, fn in SCENARIOS.items():
+        if names and name not in names:
+            continue
+        t0 = time.perf_counter()
+        results[name] = fn()
+        print(f"  {name}: {results[name]} "
+              f"({time.perf_counter() - t0:.1f}s)", flush=True)
+    return _inject_slow(results)
+
+
+def _git_sha() -> str:
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=REPO,
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def append_trend(path: str, results: dict) -> None:
+    row = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "git": _git_sha(), "results": results}
+    with open(path, "a") as f:
+        f.write(json.dumps(row) + "\n")
+
+
+def write_baseline(path: str, results: dict,
+                   old: dict | None = None) -> dict:
+    tol = {"loop_echo_pps": 0.75}           # loopback UDP is noisiest
+    doc = {"_meta": {
+        "written": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "git": _git_sha(),
+        "note": "fast perf-gate baseline; re-baseline honestly "
+                "(quiet machine, explain the delta in the commit)"}}
+    for name, value in results.items():
+        doc[name] = {"value": value,
+                     "tolerance": tol.get(name, DEFAULT_TOLERANCE),
+                     "higher_is_better": True}
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--trend", default=TREND_PATH)
+    ap.add_argument("--no-trend", action="store_true",
+                    help="skip appending the trend row")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="measure and (re)write the baseline file")
+    ap.add_argument("--scenarios", default="",
+                    help="comma-separated subset of scenario ids")
+    args = ap.parse_args(argv)
+    names = set(filter(None, args.scenarios.split(","))) or None
+    if names:
+        unknown = names - set(SCENARIOS)
+        if unknown:
+            print(f"perf_gate: unknown scenarios {sorted(unknown)}")
+            return 2
+    print("perf_gate: running scenarios...", flush=True)
+    results = run_scenarios(names)
+    if args.write_baseline:
+        write_baseline(args.baseline, results)
+        print(f"perf_gate: baseline written to {args.baseline}")
+        return 0
+    if not os.path.exists(args.baseline):
+        print(f"perf_gate: no baseline at {args.baseline}; run "
+              "--write-baseline first")
+        return 2
+    with open(args.baseline) as f:
+        baseline = {k: v for k, v in json.load(f).items()
+                    if not k.startswith("_")}
+    failures, rows = compare(results, baseline)
+    for name, status, detail in rows:
+        print(f"  {name}: {status.upper()} — {detail}")
+    if not args.no_trend:
+        append_trend(args.trend, results)
+    if failures:
+        print(f"perf_gate: FAIL ({len(failures)} regression(s))")
+        return 1
+    print("PERF_GATE_OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

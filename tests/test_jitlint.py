@@ -572,6 +572,73 @@ def test_drift_histogram_registered_forms_are_clean():
     assert check_metrics_drift({c3.relpath: c3}) == []
 
 
+def _perf_tree(tmp_path, baseline_keys, scenario_ids):
+    """Fake repo: PERF_BASELINE.json + scripts/perf_gate.py + one
+    indexed file whose path anchors the disk walk-up."""
+    tmp_path.joinpath("PERF_BASELINE.json").write_text(json.dumps(
+        {"_meta": {"note": "x"}, **{k: {"value": 1.0}
+                                    for k in baseline_keys}}))
+    sdir = tmp_path / "scripts"
+    sdir.mkdir()
+    body = "\n".join(f'def _s{i}():\n    return 1.0'
+                     for i in range(len(scenario_ids)))
+    entries = ", ".join(f'"{sid}": _s{i}'
+                        for i, sid in enumerate(scenario_ids))
+    sdir.joinpath("perf_gate.py").write_text(
+        body + "\nSCENARIOS = {" + entries + "}\n")
+    pkg = tmp_path / "libjitsi_tpu"
+    pkg.mkdir()
+    mod = pkg / "mod.py"
+    mod.write_text("x = 1\n")
+    ctx = FileContext(str(mod), "libjitsi_tpu/mod.py", "x = 1\n")
+    return {ctx.relpath: ctx}
+
+
+def test_drift_perf_baseline_stale_and_ungated_fire(tmp_path):
+    """Both directions in one tree: a baseline key no scenario backs
+    (the gate never compares it) AND a scenario with no baseline entry
+    (free to regress forever)."""
+    index = _perf_tree(tmp_path, baseline_keys={"old_pps", "loop_x"},
+                       scenario_ids={"loop_x", "new_y"})
+    found = [f for f in check_metrics_drift(index)
+             if f.path == "PERF_BASELINE.json"]
+    msgs = "\n".join(f.message for f in found)
+    assert len(found) == 2
+    assert "`old_pps` matches no perf_gate scenario" in msgs
+    assert "`new_y` has no PERF_BASELINE.json entry" in msgs
+    assert all(f.rule == "drift" for f in found)
+
+
+def test_drift_perf_baseline_in_sync_is_clean(tmp_path):
+    """Matching key sets (plus the ignored _meta) produce nothing; a
+    corrupt baseline is a single loud finding, not a crash."""
+    index = _perf_tree(tmp_path, baseline_keys={"loop_x", "prot_y"},
+                       scenario_ids={"loop_x", "prot_y"})
+    assert [f for f in check_metrics_drift(index)
+            if f.path == "PERF_BASELINE.json"] == []
+    tmp_path.joinpath("PERF_BASELINE.json").write_text("{nope")
+    found = [f for f in check_metrics_drift(index)
+             if f.path == "PERF_BASELINE.json"]
+    assert len(found) == 1 and "not valid JSON" in found[0].message
+
+
+def test_drift_perf_baseline_pure_helper_and_real_files_agree():
+    """check_perf_baseline is a set comparison; and the REAL checked-in
+    baseline must match the REAL gate script right now."""
+    from libjitsi_tpu.analysis.checkers.drift import (
+        _perf_gate_scenario_ids, check_perf_baseline)
+
+    assert check_perf_baseline({"a"}, {"a"}) == []
+    msgs = check_perf_baseline({"a", "stale"}, {"a", "ungated"})
+    assert len(msgs) == 2
+    real_ids = _perf_gate_scenario_ids(
+        os.path.join(REPO, "scripts", "perf_gate.py"))
+    with open(os.path.join(REPO, "PERF_BASELINE.json")) as fh:
+        real_keys = {k for k in json.load(fh) if not k.startswith("_")}
+    assert real_ids, "SCENARIOS literal not found in perf_gate.py"
+    assert check_perf_baseline(real_keys, real_ids) == []
+
+
 # ------------------------------------------------- pragmas and baseline
 
 def test_line_pragma_suppresses():

@@ -462,6 +462,38 @@ def test_obs_server_negotiates_openmetrics_and_serves_slo():
         assert doc["slos"][0]["name"] == "r"
 
 
+def test_obs_server_debug_device_and_slo_attribution():
+    """/debug/device serves per-device memory stats; /debug/slo picks
+    up the supervisor's host/device attribution when it offers one."""
+    from libjitsi_tpu.utils.slo import SloEngine, SloSpec
+
+    m = MetricsRegistry()
+    slo = SloEngine(m, [SloSpec("r", objective=0.99,
+                                bad_metric="bad_things",
+                                total_metric="all_things")])
+    m.register_scalar("bad_things", lambda: 0)
+    m.register_scalar("all_things", lambda: 1)
+    slo.on_tick()
+    phases = {"host_python": 0.02, "device_compute": 0.001}
+    sup = types.SimpleNamespace(
+        health=lambda: {"state": "healthy"}, flight=None,
+        postmortems=[],
+        phase_attribution=lambda: {
+            "bound": "host", "phase": "host_python",
+            "phase_share": 0.95, "phases": phases})
+    with ObservabilityServer(metrics=m, supervisor=sup,
+                             slo=slo) as srv:
+        code, body = _get(srv.port, "/debug/device")
+        doc = json.loads(body)
+        assert code == 200 and doc["devices"]
+        assert "device" in doc["devices"][0]
+        assert "bytes_in_use" in doc["devices"][0]
+        code, body = _get(srv.port, "/debug/slo")
+        attr = json.loads(body)["attribution"]
+        assert code == 200 and attr["bound"] == "host"
+        assert attr["phases"]["host_python"] == 0.02
+
+
 def test_obs_server_slo_404_when_absent():
     sup = types.SimpleNamespace(
         health=lambda: {"state": "healthy"}, flight=None,
@@ -525,6 +557,14 @@ def test_checked_in_dashboards_are_fresh():
         assert f"slo: {slo_name}" in rules
     dash = json.loads(texts["bridge_dashboard.json"])
     assert dash["panels"], "dashboard generated with no panels"
+    # alertmanager routing: per-SLO fast-burn routes page, slow-burn
+    # routes ticket, and fast inhibits slow on the same slo label
+    am = texts["alertmanager.yaml"]
+    for slo_name in ("journey_p99", "residual_loss", "auth_fail"):
+        assert f'- slo = "{slo_name}"' in am
+    assert am.count("receiver: rtc-oncall-pager") == 3
+    assert "alertname = SloFastBurn" in am
+    assert "inhibit_rules:" in am and "equal: [slo]" in am
 
 
 # ------------------------------------------------------------- soak twin

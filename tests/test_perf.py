@@ -1,0 +1,264 @@
+"""Performance-attribution plane: PhaseProfiler phase ledger,
+HistogramVec exposition, compile-cache stats, and the trace_report
+occupancy analyzer.
+
+The load-bearing property: on a SAMPLED tick the six phases sum to the
+tick's wall time exactly (host_python is the clamped residual), and on
+a fence-free tick the profiler adds ZERO probe overhead — steady-state
+ticks must not pay for attribution.
+"""
+
+import gzip
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from libjitsi_tpu.utils.compile_cache import CompileCacheStats
+from libjitsi_tpu.utils.metrics import (MetricsRegistry,
+                                        validate_exposition)
+from libjitsi_tpu.utils.perf import (DEVICE_PHASES, HOST_PHASES, PHASES,
+                                     PhaseProfiler, classify_bound,
+                                     host_share)
+from libjitsi_tpu.utils.tracing import PipelineTracer
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+
+# ------------------------------------------------------- phase ledger
+
+def test_sampled_tick_phases_sum_to_wall():
+    prof = PhaseProfiler(sample_every=1)
+    t0 = time.perf_counter()
+    prof.begin_tick()
+    with prof.phase("idle"):
+        time.sleep(0.004)
+    with prof.phase("device_compute"):
+        time.sleep(0.002)
+    prof.end_tick()
+    outer_wall = time.perf_counter() - t0
+    phases = prof.last_phases
+    assert set(phases) == set(PHASES)
+    assert phases["idle"] >= 0.004
+    assert phases["device_compute"] >= 0.002
+    assert phases["host_python"] >= 0.0
+    total = sum(phases.values())
+    # the six phases sum to the profiler's wall: bounded above by the
+    # outer measurement and below by what we provably slept
+    assert 0.006 <= total <= outer_wall + 1e-4
+    # residual construction: total - explicit spans == host_python
+    explicit = phases["idle"] + phases["device_compute"]
+    assert phases["host_python"] == pytest.approx(total - explicit)
+
+
+def test_unsampled_ticks_are_fence_free():
+    prof = PhaseProfiler(sample_every=0)
+    prof.begin_tick()
+    with prof.phase("device_compute"):
+        time.sleep(0.001)
+    prof.probe_h2d([None])
+    prof.fence(object())
+    prof.note_h2d(100)
+    prof.note_d2h(50)
+    prof.end_tick()
+    assert prof.probe_overhead_s == 0.0
+    assert prof.last_phases == {}
+    assert prof.sampled_ticks == 0
+    # byte accounting stays live even with fencing disabled
+    assert prof.h2d_bytes == 100 and prof.d2h_bytes == 50
+
+
+def test_sample_every_n_selects_first_tick_of_each_window():
+    prof = PhaseProfiler(sample_every=16)
+    sampled_at = []
+    for t in range(1, 41):
+        prof.begin_tick()
+        if prof.sampled:
+            sampled_at.append(t)
+        prof.end_tick()
+    assert sampled_at == [1, 17, 33]
+    assert prof.sampled_ticks == 3
+
+
+def test_fence_counts_into_named_phase_and_overhead():
+    class SlowPending:
+        def block_until_ready(self):
+            time.sleep(0.003)
+
+    prof = PhaseProfiler(sample_every=1)
+    prof.begin_tick()
+    prof.fence(SlowPending(), phase="d2h_transfer")
+    prof.end_tick()
+    assert prof.last_phases["d2h_transfer"] >= 0.003
+    assert prof.probe_overhead_s >= 0.003
+
+
+def test_phase_ledger_reaches_tracer_and_drains_once():
+    tracer = PipelineTracer()
+    prof = PhaseProfiler(sample_every=1, tracer=tracer)
+    prof.begin_tick()
+    with prof.phase("dispatch"):
+        time.sleep(0.001)
+    prof.end_tick()
+    led = tracer.take_phase_ledger()
+    assert led["dispatch"] >= 0.001
+    assert tracer.take_phase_ledger() == {}         # drained
+    assert tracer.last_phase_ledger == led          # but remembered
+
+
+def test_phase_totals_accumulate_across_sampled_ticks():
+    prof = PhaseProfiler(sample_every=1)
+    for _ in range(3):
+        prof.begin_tick()
+        with prof.phase("idle"):
+            time.sleep(0.001)
+        prof.end_tick()
+    assert prof.phase_totals["idle"] >= 0.003
+
+
+def test_classify_bound_and_host_share():
+    host = {"host_python": 0.01, "dispatch": 0.004,
+            "device_compute": 0.002, "idle": 0.001}
+    dev = {"host_python": 0.001, "h2d_transfer": 0.002,
+           "device_compute": 0.02, "d2h_transfer": 0.003}
+    assert classify_bound(host) == "host"
+    assert classify_bound(dev) == "device"
+    assert classify_bound({"idle": 1.0}) == "idle"
+    assert classify_bound({}) == "unknown"
+    assert classify_bound({"host_python": 0.0}) == "unknown"
+    assert host_share(host) == pytest.approx(0.014 / 0.016)
+    assert host_share({}) == 0.0
+    assert set(HOST_PHASES) | set(DEVICE_PHASES) | {"idle"} == \
+        set(PHASES)
+
+
+# ----------------------------------------------------- metrics surface
+
+def test_profiler_metrics_render_and_validate():
+    reg = MetricsRegistry()
+    prof = PhaseProfiler(metrics=reg, sample_every=1,
+                         inflight_fn=lambda: 2)
+    prof.begin_tick()
+    with prof.phase("device_compute"):
+        time.sleep(0.001)
+    prof.note_h2d(1234)
+    prof.end_tick()
+    text = reg.render()
+    assert not validate_exposition(text)
+    ns = reg.ns
+    assert f"# TYPE {ns}_tick_phase_seconds histogram" in text
+    for p in PHASES:       # family complete even for untouched phases
+        assert f'{ns}_tick_phase_seconds_bucket{{phase="{p}",' in text
+    assert f'{ns}_tick_phase_seconds_count{{phase="device_compute"}} 1' \
+        in text
+    assert f"{ns}_dispatch_inflight_ticks 2" in text
+    assert f"{ns}_h2d_bytes_total 1234" in text
+    assert f"# TYPE {ns}_compile_events counter" in text
+
+
+def test_histogram_vec_children_and_count():
+    reg = MetricsRegistry()
+    vec = reg.histogram_vec("demo_seconds", (0.1, 1.0), "phase")
+    vec.labels("a").observe(0.05)
+    vec.labels("a").observe(0.5)
+    vec.labels("b").observe(2.0)
+    assert vec.labels("a") is vec.labels("a")       # create-or-get
+    assert vec.count == 3
+    assert reg.get_histogram_vec("demo_seconds") is vec
+    assert reg.histogram_vec("demo_seconds", (9.9,), "phase") is vec
+    text = reg.render()
+    assert not validate_exposition(text)
+    assert f'{reg.ns}_demo_seconds_bucket{{phase="a",le="0.1"}} 1' \
+        in text
+    assert f'{reg.ns}_demo_seconds_bucket{{phase="b",le="+Inf"}} 1' \
+        in text
+    assert f'{reg.ns}_demo_seconds_count{{phase="b"}} 1' in text
+
+
+# -------------------------------------------------- compile-cache stats
+
+def test_compile_cache_stats_listener_contract():
+    st = CompileCacheStats()
+    st.on_event("/jax/compilation_cache/cache_hit")
+    st.on_event("/jax/compilation_cache/cache_miss")
+    st.on_event("/jax/compilation_cache/cache_miss")
+    st.on_event("/jax/unrelated/event")
+    st.on_duration("/jax/core/compile", 0.25)
+    st.on_duration("/jax/backend_compile", 0.5)
+    st.on_duration("/jax/unrelated", 99.0)
+    assert st.hits == 1
+    assert st.misses == 2
+    assert st.compile_events == 2
+    assert st.compile_seconds == pytest.approx(0.75)
+
+
+# -------------------------------------------------------- trace report
+
+def _slice(pid, name, ts, dur):
+    return {"ph": "X", "pid": pid, "tid": 1, "name": name,
+            "ts": ts, "dur": dur}
+
+
+def _device_events():
+    """Synthetic Chrome trace: host pid 1, device pid 2; device busy
+    [0,100) and [300,400) us over a 0..1000 us capture."""
+    return [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "python host"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "/device:TPU:0"}},
+        _slice(1, "host_stuff", 0, 1000),
+        _slice(2, "fusion.1", 0, 60),
+        _slice(2, "copy.h2d", 60, 40),
+        _slice(2, "fusion.1", 300, 100),
+    ]
+
+
+def test_trace_report_occupancy_math():
+    import trace_report
+
+    rep = trace_report.build_report(_device_events())
+    assert rep["device_tracks"] == ["/device:TPU:0"]
+    assert rep["trace_wall_s"] == pytest.approx(1000e-6)
+    assert rep["device_busy_s"] == pytest.approx(200e-6)
+    assert rep["device_idle_pct"] == pytest.approx(80.0)
+    assert rep["device_transfer_s"] == pytest.approx(40e-6)
+    # one gap: busy [0,100) then [300,400) -> 200us stall
+    assert rep["largest_dispatch_gaps_s"][0] == pytest.approx(200e-6)
+    top = dict(rep["top_kernels"])
+    assert top["fusion.1"] == pytest.approx(160e-6)
+    text = trace_report.format_report(rep)
+    assert "device idle" in text and "80.0 %" in text
+
+
+def test_trace_report_host_only_capture_degrades_gracefully():
+    import trace_report
+
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "python host"}},
+        _slice(1, "host_stuff", 0, 500),
+    ]
+    rep = trace_report.build_report(events)
+    assert "error" in rep and "no device track" in rep["error"]
+    assert "NOTE:" in trace_report.format_report(rep)
+    assert "error" in trace_report.build_report([])
+
+
+def test_trace_report_loads_gzipped_trace(tmp_path):
+    import trace_report
+
+    doc = {"traceEvents": _device_events()}
+    path = tmp_path / "run" / "x.trace.json.gz"
+    path.parent.mkdir()
+    with gzip.open(path, "wt") as f:
+        json.dump(doc, f)
+    found = trace_report.find_trace_file(str(tmp_path))
+    assert found == str(path)
+    rep = trace_report.build_report(trace_report.load_events(found))
+    assert rep["device_idle_pct"] == pytest.approx(80.0)
+    with pytest.raises(FileNotFoundError):
+        trace_report.find_trace_file(str(tmp_path / "run" / "empty"))

@@ -1,0 +1,165 @@
+"""perf_gate: the floor-aware comparison logic with synthetic
+baseline/result pairs, the CLI exit contract with stubbed scenarios,
+and (slow twin) the real gate against the checked-in baseline.
+
+The invariants that make the gate trustworthy rather than flaky:
+a `below_floor:` record on EITHER side is never numerically compared,
+tolerance is an exact boundary (not fuzz), and a value slowed past
+tolerance always exits 1.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "scripts"))
+
+import perf_gate  # noqa: E402
+
+
+# ------------------------------------------------------------ judge()
+
+def test_judge_ok_and_regression_boundary():
+    # tolerance 0.5 of baseline 100 -> bar at 50; at the bar is OK,
+    # below it is a regression (exact boundary, no fuzz)
+    assert perf_gate.judge(50.0, 100.0, 0.5)[0] == "ok"
+    assert perf_gate.judge(49.999, 100.0, 0.5)[0] == "regression"
+    assert perf_gate.judge(100.0, 100.0, 0.5)[0] == "ok"
+    assert perf_gate.judge(500.0, 100.0, 0.5)[0] == "ok"
+
+
+def test_judge_tolerance_respected_per_entry():
+    assert perf_gate.judge(30.0, 100.0, 0.75)[0] == "ok"
+    assert perf_gate.judge(30.0, 100.0, 0.6)[0] == "regression"
+
+
+def test_judge_below_floor_never_compared():
+    # measured below floor: no comparison even against a tiny baseline
+    s, detail = perf_gate.judge("below_floor: net=0.1ms", 1e9, 0.1)
+    assert s == "below_floor" and "net=0.1ms" in detail
+    # baseline below floor: a huge measured value is not judged either
+    s, _ = perf_gate.judge(1e9, "below_floor: net=0.1ms", 0.1)
+    assert s == "below_floor"
+
+
+def test_judge_new_scenario_and_lower_is_better():
+    assert perf_gate.judge(123.0, None, 0.5)[0] == "new"
+    assert perf_gate.judge(
+        1.4, 1.0, 0.5, higher_is_better=False)[0] == "ok"
+    assert perf_gate.judge(
+        1.6, 1.0, 0.5, higher_is_better=False)[0] == "regression"
+
+
+def test_compare_collects_failures():
+    baseline = {
+        "a": {"value": 100.0, "tolerance": 0.5},
+        "b": {"value": 100.0, "tolerance": 0.5},
+        "c": {"value": "below_floor: old box", "tolerance": 0.5},
+    }
+    failures, rows = perf_gate.compare(
+        {"a": 90.0, "b": 10.0, "c": 5.0, "d": 7.0}, baseline)
+    statuses = {name: status for name, status, _ in rows}
+    assert statuses == {"a": "ok", "b": "regression",
+                        "c": "below_floor", "d": "new"}
+    assert [name for name, _ in failures] == ["b"]
+
+
+def test_floor_check_records_string_under_bar(monkeypatch):
+    monkeypatch.setitem(perf_gate._FLOOR, "median", 1e-4)
+    monkeypatch.setitem(perf_gate._FLOOR, "jitter", 1e-3)
+    # bar = 10 * 1ms = 10ms: a 5ms net span is not measurable
+    rec = perf_gate.floor_check(1234.0, 0.005)
+    assert isinstance(rec, str) and rec.startswith("below_floor:")
+    assert perf_gate.floor_check(1234.0, 0.5) == 1234.0
+
+
+# ------------------------------------------------------- exit contract
+
+@pytest.fixture
+def stub_gate(monkeypatch, tmp_path):
+    """perf_gate with cheap deterministic scenarios and tmp paths."""
+    monkeypatch.setattr(perf_gate, "SCENARIOS", {
+        "loop_echo_pps": lambda: 1000.0,
+        "protect_small_pps": lambda: 50000.0,
+        "install_streams_per_sec": lambda: "below_floor: stub",
+    })
+    monkeypatch.setattr(
+        "libjitsi_tpu.utils.compile_cache.enable_compile_cache",
+        lambda *a, **k: None)
+    base = tmp_path / "base.json"
+    trend = tmp_path / "trend.jsonl"
+    return base, trend
+
+
+def _args(base, trend, *extra):
+    return ["--baseline", str(base), "--trend", str(trend),
+            *extra]
+
+
+def test_gate_green_and_trend_row(stub_gate, capsys):
+    base, trend = stub_gate
+    assert perf_gate.main(_args(base, trend, "--write-baseline")) == 0
+    doc = json.loads(base.read_text())
+    assert doc["loop_echo_pps"] == {
+        "value": 1000.0, "tolerance": 0.75, "higher_is_better": True}
+    assert doc["install_streams_per_sec"]["value"].startswith(
+        "below_floor:")
+    assert "_meta" in doc
+    assert perf_gate.main(_args(base, trend)) == 0
+    assert "PERF_GATE_OK" in capsys.readouterr().out
+    rows = [json.loads(ln) for ln in
+            trend.read_text().splitlines()]
+    assert len(rows) == 1 and rows[0]["results"][
+        "loop_echo_pps"] == 1000.0
+    assert perf_gate.main(_args(base, trend, "--no-trend")) == 0
+    assert len(trend.read_text().splitlines()) == 1    # unchanged
+
+
+def test_gate_injected_slowdown_exits_nonzero(stub_gate, monkeypatch,
+                                              capsys):
+    base, trend = stub_gate
+    assert perf_gate.main(_args(base, trend, "--write-baseline")) == 0
+    monkeypatch.setenv("PERF_GATE_INJECT_SLOW", "loop_echo_pps=10")
+    assert perf_gate.main(_args(base, trend, "--no-trend")) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSION" in out and "loop_echo_pps" in out
+    # a below_floor scenario is immune to injection (string, no math)
+    monkeypatch.setenv("PERF_GATE_INJECT_SLOW",
+                       "install_streams_per_sec=1000")
+    assert perf_gate.main(_args(base, trend, "--no-trend")) == 0
+
+
+def test_gate_usage_errors_exit_two(stub_gate):
+    base, trend = stub_gate
+    assert perf_gate.main(_args(base, trend)) == 2    # no baseline yet
+    assert perf_gate.main(_args(base, trend,
+                                "--scenarios", "nope")) == 2
+
+
+def test_gate_subset_runs_named_scenario_only(stub_gate, capsys):
+    base, trend = stub_gate
+    assert perf_gate.main(_args(base, trend, "--write-baseline",
+                                "--scenarios", "loop_echo_pps")) == 0
+    doc = json.loads(base.read_text())
+    assert set(doc) == {"_meta", "loop_echo_pps"}
+
+
+# ----------------------------------------------------------- slow twin
+
+@pytest.mark.slow
+def test_real_gate_green_against_checked_in_baseline():
+    """The full run tier-1 smokes, as a pytest twin: real scenarios vs
+    the checked-in PERF_BASELINE.json."""
+    assert os.path.exists(perf_gate.BASELINE_PATH), \
+        "PERF_BASELINE.json missing (run --write-baseline)"
+    assert perf_gate.main(["--no-trend"]) == 0
+
+
+@pytest.mark.slow
+def test_real_gate_detects_injected_regression(monkeypatch):
+    monkeypatch.setenv("PERF_GATE_INJECT_SLOW",
+                       "loop_echo_pps=100,protect_small_pps=100")
+    assert perf_gate.main(["--no-trend"]) == 1

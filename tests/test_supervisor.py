@@ -137,15 +137,19 @@ def test_escalation_ladder_then_full_recovery():
 
 
 def _stage_sup(ledger, durations, with_recovery=True, slo=None,
-               **cfg_kwargs):
+               phases=None, **cfg_kwargs):
     """Supervisor over a DummyBridge with a seeded stage ledger (the
     tracer stub returns the same per-stage seconds every tick) and an
-    optional recovery stub that records shed_fec/throttle_rtx calls."""
+    optional recovery stub that records shed_fec/throttle_rtx calls.
+    `phases` seeds a phase ledger (host/device split) the same way."""
     cfg = SupervisorConfig(deadline_ms=10.0, overload_after=1,
                            **cfg_kwargs)
     bridge = DummyBridge()
     bridge.loop.tracer = types.SimpleNamespace(
         take_ledger=lambda: dict(ledger))
+    if phases is not None:
+        bridge.loop.tracer.take_phase_ledger = \
+            lambda: dict(phases)
     calls = []
     if with_recovery:
         bridge.recovery = types.SimpleNamespace(
@@ -234,6 +238,64 @@ def test_escalation_event_carries_live_slo_state():
     (ev,) = _escalations(sup)
     assert ev["slo_state"] == "fast_burn"
     assert sup.health()["slo_state"] == "fast_burn"
+
+
+def test_escalation_names_host_phase_when_host_bound():
+    """A host-dominant phase split must reach the ladder_escalate
+    event: the page says "host-bound, host_python owns the tick", not
+    just which pipeline stage overran."""
+    ledger = {"ingress": 0.008, "forward_chain": 0.001}
+    phases = {"host_python": 0.016, "dispatch": 0.002,
+              "device_compute": 0.001, "idle": 0.001}
+    sup, _bridge, _calls = _stage_sup(ledger, [0.05], phases=phases)
+    sup.tick()
+    (ev,) = _escalations(sup)
+    assert ev["phase"] == "host_python"
+    assert ev["bound"] == "host"
+    assert ev["phase_share"] == pytest.approx(0.8, abs=0.01)
+    attr = sup.phase_attribution()
+    assert attr["bound"] == "host"
+    assert attr["phase"] == "host_python"
+    assert attr["phases"] == phases
+    assert sup.health()["bound"] == "host"
+
+
+def test_escalation_names_device_phase_when_device_bound():
+    ledger = {"forward_chain": 0.009, "ingress": 0.001}
+    phases = {"host_python": 0.001, "dispatch": 0.001,
+              "device_compute": 0.015, "d2h_transfer": 0.002}
+    sup, _bridge, _calls = _stage_sup(ledger, [0.05], phases=phases)
+    sup.tick()
+    (ev,) = _escalations(sup)
+    assert ev["phase"] == "device_compute"
+    assert ev["bound"] == "device"
+
+
+def test_escalation_without_phase_ledger_reports_unknown():
+    """Tracer stubs (and pre-profiler loops) have no phase ledger at
+    all — attribution degrades to unknown, never crashes."""
+    ledger = {"forward_chain": 0.009, "ingress": 0.001}
+    sup, _bridge, _calls = _stage_sup(ledger, [0.05])
+    sup.tick()
+    (ev,) = _escalations(sup)
+    assert ev["phase"] == "unknown"
+    assert ev["bound"] == "unknown"
+    assert sup.phase_attribution()["phases"] == {}
+
+
+def test_phase_ledger_keeps_last_sampled_split_across_empty_drains():
+    """Supervisor ticks outpace sampled profiler ticks: an empty drain
+    must NOT wipe the last real split."""
+    ledger = {"forward_chain": 0.009, "ingress": 0.001}
+    drains = [{"host_python": 0.01, "device_compute": 0.002}, {}, {}]
+    sup, _bridge, _calls = _stage_sup(ledger, [0.05] * 3)
+    sup.tracer.take_phase_ledger = lambda: drains.pop(0) if drains \
+        else {}
+    for _ in range(3):
+        sup.tick()
+    assert sup.last_phases == {"host_python": 0.01,
+                               "device_compute": 0.002}
+    assert _escalations(sup)[-1]["bound"] == "host"
 
 
 def test_shed_is_deterministic_and_priority_ordered():
