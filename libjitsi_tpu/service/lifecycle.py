@@ -1,0 +1,378 @@
+"""Stream lifecycle plane: churn-proof admit/evict for the whole bridge.
+
+The translator/SFU primitive benchmarks beautifully on a STATIC stream
+population, but the north-star traffic is continuous join/leave: every
+naive install risks landing a recompile or a multi-hundred-ms table
+copy on the data path, departed streams leak recovery/PLC/BWE state,
+and overload shedding can "restore" a stream that already left.  One
+`StreamLifecycleManager` owns the whole problem:
+
+1. **O(1) slot admit/evict into pre-compiled bucketed shapes** — the
+   device only ever sees the size-class shapes of core/packet.py
+   (`LENGTH_CLASSES` x `ROW_CLASSES`); the manager warms each row class
+   OFF-TICK the first time the population bucket (power of two) could
+   reach it, so growing from 63 to 64 streams compiles nothing on the
+   media path.  `utils/compile_cache.CompileCacheStats` brackets every
+   tick (`tick_begin`/`tick_end`, wired by BridgeSupervisor): any
+   compile event inside the window increments `datapath_recompiles`,
+   and `assert_datapath_clean()` turns the "zero recompiles ever land
+   on the data path" claim into a checkable invariant.
+
+2. **Pipelined off-tick key install** — `request_join` only queues; the
+   KDF/key-schedule/GHASH work runs between ticks in batches
+   (`SfuBridge.stage_endpoints` -> one vectorized `add_streams` per
+   table), media racing the install queues on the MediaLoop hold mask,
+   and `commit_endpoints` flips the whole batch live atomically between
+   ticks (one route rebuild, held media replayed).  In-flight admits
+   ride the supervisor checkpoint and are completed or rolled back by
+   `_reconcile` after `recover()` — never left half-installed.
+
+3. **Burn-aware admission control** — joins are refused with a TYPED
+   reason (`fast_burn`, `host_bound`, `shedding`, `stalled`,
+   `capacity`, `backlog`, `duplicate`) exported as
+   `lifecycle_admit_rejected{reason=...}` and flight-recorded, via
+   `BridgeSupervisor.admission_decision()`.  Evictions are bookkept as
+   `evicted` (distinct from overload `shed`), so the supervisor's LIFO
+   unwind never resurrects a departed stream.
+
+Reference: no analog — the reference allocates a MediaStream object
+per join and lets the JVM GC departures; a dense-table runtime must
+manage stream mortality explicitly.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from libjitsi_tpu.core.packet import ROW_CLASSES
+from libjitsi_tpu.utils.compile_cache import compile_stats
+from libjitsi_tpu.utils.flight import FlightRecorder
+from libjitsi_tpu.utils.logging import get_logger
+
+_log = get_logger("lifecycle")
+
+#: every reason `request_join` can refuse with (typed: metrics, flight
+#: events and callers all share these strings)
+ADMIT_REASONS = ("capacity", "backlog", "duplicate", "fast_burn",
+                 "stalled", "shedding", "host_bound")
+
+
+@dataclass
+class LifecycleConfig:
+    """Knobs for the admit/evict pipeline."""
+
+    min_bucket: int = 16         # smallest population bucket warmed
+    install_batch: int = 64      # joins staged per between-ticks window
+    max_pending: int = 512       # queued + staged backlog cap
+    warm_payload_len: int = 160  # representative payload for warmups
+    # est. packets per stream per tick: sizes the row classes a
+    # population bucket can drive (warmup_rtp uses the same figure)
+    pkts_per_stream: int = 4
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class StreamLifecycleManager:
+    """Owns admit/evict for one bridge.  Construct after the
+    BridgeSupervisor; the manager attaches itself
+    (`supervisor.lifecycle = self`) so the supervisor's tick brackets
+    the data path with the compile guard and runs the commit barrier +
+    install stage between ticks.  Without a supervisor, call
+    `run_between_ticks()` manually after each `bridge.tick()`."""
+
+    def __init__(self, bridge, supervisor=None,
+                 config: Optional[LifecycleConfig] = None,
+                 metrics=None, flight: Optional[FlightRecorder] = None):
+        self.bridge = bridge
+        self.supervisor = supervisor
+        self.cfg = config or LifecycleConfig()
+        if flight is None:
+            flight = (supervisor.flight if supervisor is not None
+                      else getattr(bridge, "flight", None))
+        self.flight = flight if flight is not None else FlightRecorder()
+        # join queue: (ssrc, rx_key, tx_key, name) host-side only until
+        # poll() stages a batch
+        self._join_q: deque = deque()
+        self._queued_ssrcs: set = set()
+        self._staged: List[int] = []     # staged sids awaiting commit
+        self._evict_q: List[int] = []
+        # counters (all registered in register_metrics)
+        self.admits = 0
+        self.evicts = 0
+        self.key_installs = 0
+        self.datapath_recompiles = 0
+        self.admit_rejected: Dict[str, int] = {}
+        # population bucket whose shapes are warm; row classes warmed
+        self._warm_bucket = 0
+        self._warm_rows: set = set()
+        self._tick_compiles0: Optional[int] = None
+        if supervisor is not None:
+            supervisor.lifecycle = self
+            pend = getattr(supervisor, "pending_lifecycle", None)
+            if pend:
+                self._reconcile(pend)
+                supervisor.pending_lifecycle = None
+        if metrics is not None:
+            self.register_metrics(metrics)
+
+    # ------------------------------------------------------- admission
+
+    def ticks(self) -> int:
+        return self.supervisor.ticks if self.supervisor is not None else 0
+
+    def _admission_reason(self, ssrc: int) -> Optional[str]:
+        if (ssrc in self.bridge._ssrc_of.values()
+                or ssrc in self._queued_ssrcs):
+            return "duplicate"
+        if len(self._join_q) + len(self._staged) >= self.cfg.max_pending:
+            return "backlog"
+        # queued joins have slots spoken for; evictions still queued do
+        # NOT count as free (they only free up at the barrier)
+        if self.bridge.registry.free_slots <= len(self._join_q):
+            return "capacity"
+        if self.supervisor is not None:
+            ok, reason = self.supervisor.admission_decision()
+            if not ok:
+                return reason
+        return None
+
+    def request_join(self, ssrc: int, rx_key: Tuple[bytes, bytes],
+                     tx_key: Tuple[bytes, bytes],
+                     name: Optional[str] = None) -> Tuple[bool, str]:
+        """Admission decision + queue.  Returns (accepted, reason):
+        (True, "queued") or (False, <typed reason>).  Nothing touches
+        the device here — keys install off-tick in poll()."""
+        ssrc = int(ssrc) & 0xFFFFFFFF
+        reason = self._admission_reason(ssrc)
+        if reason is not None:
+            self.admit_rejected[reason] = \
+                self.admit_rejected.get(reason, 0) + 1
+            self.flight.record("admit_reject", tick=self.ticks(),
+                               ssrc=ssrc, reason=reason)
+            _log.info("admit_reject", ssrc=ssrc, reason=reason)
+            return False, reason
+        self._join_q.append((ssrc, tuple(rx_key), tuple(tx_key), name))
+        self._queued_ssrcs.add(ssrc)
+        self.flight.record("admit_queued", tick=self.ticks(), ssrc=ssrc)
+        return True, "queued"
+
+    def request_leave(self, sid: Optional[int] = None,
+                      ssrc: Optional[int] = None) -> bool:
+        """Queue an evict (by sid or ssrc).  A join still queued
+        host-side is simply cancelled; anything staged or live is torn
+        down at the next between-ticks barrier."""
+        if sid is None:
+            if ssrc is None:
+                raise ValueError("need sid or ssrc")
+            ssrc = int(ssrc) & 0xFFFFFFFF
+            if ssrc in self._queued_ssrcs:          # never installed
+                self._queued_ssrcs.discard(ssrc)
+                self._join_q = deque(j for j in self._join_q
+                                     if j[0] != ssrc)
+                self.flight.record("admit_cancelled",
+                                   tick=self.ticks(), ssrc=ssrc)
+                return True
+            sid = next((s for s, v in self.bridge._ssrc_of.items()
+                        if v == ssrc), None)
+            if sid is None:
+                return False
+        self._evict_q.append(int(sid))
+        return True
+
+    # ------------------------------------------- between-ticks pipeline
+
+    def run_between_ticks(self, now=None) -> None:
+        """The off-tick half of the plane: commit barrier first (staged
+        rows flip live, queued evicts tear down — both between ticks,
+        never inside one), then stage the next install wave."""
+        self.commit()
+        self.poll()
+
+    def commit(self) -> None:
+        """Atomic (w.r.t. the tick) population flip: committed admits
+        and processed evicts both land here, between ticks."""
+        if self._staged:
+            sids, self._staged = self._staged, []
+            self.bridge.commit_endpoints(sids)
+            self.admits += len(sids)
+            if self.supervisor is not None:
+                self.supervisor.note_admitted(sids)
+            for sid in sids:
+                self.flight.record("admit_commit", tick=self.ticks(),
+                                   sid=sid)
+        if self._evict_q:
+            live = dict.fromkeys(self._evict_q)  # de-dup, keep order
+            self._evict_q = []
+            sids = [s for s in live if s in self.bridge._ssrc_of]
+            if sids:
+                self.bridge.remove_endpoints(sids)
+                self.evicts += len(sids)
+                if self.supervisor is not None:
+                    self.supervisor.note_evicted(sids)
+
+    def poll(self) -> None:
+        """Stage the next install wave: batch-limited, slot-limited,
+        with the target bucket's shapes warmed BEFORE any new stream
+        can contribute traffic."""
+        n = min(len(self._join_q), self.cfg.install_batch,
+                self.bridge.registry.free_slots)
+        if n <= 0:
+            return
+        specs = [self._join_q.popleft() for _ in range(n)]
+        for spec in specs:
+            self._queued_ssrcs.discard(spec[0])
+        self._ensure_warm(len(self.bridge._ssrc_of) + n)
+        sids = self.bridge.stage_endpoints(specs)
+        self.key_installs += n
+        self._staged.extend(sids)
+        for sid, spec in zip(sids, specs):
+            self.flight.record("key_install", tick=self.ticks(),
+                               sid=sid, ssrc=spec[0])
+
+    @property
+    def key_installs_pending(self) -> int:
+        return len(self._join_q) + len(self._staged)
+
+    # ----------------------------------------------- bucketed warmup
+
+    def _ensure_warm(self, population: int) -> None:
+        """Grow the warm bucket to the next power of two covering
+        `population` and pre-compile (off-tick, throwaway tables) every
+        RTP row class that bucket's aggregate traffic can drive.  Shapes
+        depend only on the size classes, so within a bucket admits and
+        evicts compile NOTHING; crossing a boundary pays compile cost
+        here, never inside a tick."""
+        bucket = _next_pow2(max(self.cfg.min_bucket, population))
+        if bucket <= self._warm_bucket:
+            return
+        max_rows = min(bucket * self.cfg.pkts_per_stream,
+                       ROW_CLASSES[-1])
+        # one class of headroom: fan-out rows are packets x receivers,
+        # which can cross the class ABOVE the aggregate-traffic estimate
+        # while the population is still inside this bucket — that first
+        # crossing must not compile inside a tick
+        above = [rc for rc in ROW_CLASSES if rc > max_rows]
+        cover = above[0] if above else ROW_CLASSES[-1]
+        want = [rc for rc in ROW_CLASSES
+                if rc <= cover and rc not in self._warm_rows]
+        if not want and ROW_CLASSES[0] not in self._warm_rows:
+            want = [ROW_CLASSES[0]]
+        tr = getattr(self.bridge, "translator", None)
+        for rc in want:
+            self.bridge.rx_table.warmup_rtp(
+                rc, payload_len=self.cfg.warm_payload_len)
+            self.bridge.tx_table.warmup_rtp(
+                rc, payload_len=self.cfg.warm_payload_len)
+            if tr is not None and hasattr(tr, "warmup_fanout"):
+                # the fan-out expansion (packets x receivers) has its own
+                # class-padded shape space — compile it here, off-tick
+                tr.warmup_fanout(rc, payload_len=self.cfg.warm_payload_len)
+            if hasattr(self.bridge.rx_table, "warmup_rtcp"):
+                # control traffic (NACK/RR/SR) rides the same
+                # zero-recompile discipline as media
+                self.bridge.rx_table.warmup_rtcp(rc)
+                self.bridge.tx_table.warmup_rtcp(rc)
+            self._warm_rows.add(rc)
+        self.flight.record("bucket_warm", tick=self.ticks(),
+                           bucket=bucket, rows=sorted(self._warm_rows))
+        _log.info("bucket_warm", bucket=bucket,
+                  row_classes=sorted(self._warm_rows))
+        self._warm_bucket = bucket
+
+    # --------------------------------------------- data-path compile proof
+
+    def tick_begin(self) -> None:
+        self._tick_compiles0 = compile_stats().compile_events
+
+    def tick_end(self) -> None:
+        if self._tick_compiles0 is None:
+            return
+        delta = compile_stats().compile_events - self._tick_compiles0
+        self._tick_compiles0 = None
+        if delta > 0:
+            self.datapath_recompiles += delta
+            self.flight.record("datapath_recompile",
+                               tick=self.ticks(), n=delta)
+            _log.warn("datapath_recompile", n=delta)
+
+    def assert_datapath_clean(self) -> None:
+        """The zero-recompile invariant, as an assertion: call after a
+        soak window (once all shapes are warm) — raises if any compile
+        event landed inside a tick."""
+        if self.datapath_recompiles:
+            raise AssertionError(
+                f"{self.datapath_recompiles} compile event(s) landed on "
+                f"the data path (inside tick windows)")
+
+    # --------------------------------------------------- checkpointing
+
+    def snapshot(self) -> dict:
+        """In-flight admit state for the supervisor checkpoint: queued
+        joins carry their keys (host-side only so far); staged sids'
+        keys already ride the bridge snapshot."""
+        return {
+            "queued": [(ssrc, rx, tx, name)
+                       for ssrc, rx, tx, name in self._join_q],
+            "staged": [(sid, self.bridge._ssrc_of.get(sid))
+                       for sid in self._staged],
+        }
+
+    def _reconcile(self, pend: dict) -> None:
+        """Post-`recover()` reconciliation: every in-flight admit either
+        COMPLETES or ROLLS BACK — never a half state.
+
+        * staged installs: the bridge snapshot captured their keys, SSRC
+          mapping and table rows, and `restore()` routed them — the
+          admit completes here (counted, flight-recorded).  A staged sid
+          whose keys did NOT survive is rolled back: its remnants are
+          removed and the slot freed.
+        * queued joins: never touched the device; they re-enter the
+          queue and install through the normal off-tick pipeline.
+        """
+        for sid, ssrc in pend.get("staged", []):
+            sid = int(sid)
+            if (sid in self.bridge._ssrc_of
+                    and sid in self.bridge._tx_keys):
+                self.admits += 1
+                self.flight.record("admit_commit", tick=self.ticks(),
+                                   sid=sid, recovered=True)
+            else:
+                if sid in self.bridge._ssrc_of:
+                    self.bridge.remove_endpoints([sid])
+                self.flight.record("admit_rollback", tick=self.ticks(),
+                                   sid=sid, ssrc=ssrc)
+                _log.info("admit_rollback", sid=sid)
+        for ssrc, rx, tx, name in pend.get("queued", []):
+            self.request_join(ssrc, rx, tx, name=name)
+
+    # --------------------------------------------------- observability
+
+    def register_metrics(self, registry, prefix: str = "lifecycle") -> None:
+        registry.register_counters(self, (
+            ("admits", "streams admitted (committed live)"),
+            ("evicts", "streams evicted by the lifecycle plane"),
+            ("key_installs", "streams whose keys installed off-tick"),
+            ("datapath_recompiles",
+             "compile events inside tick windows (invariant: 0)"),
+        ), prefix=prefix)
+        registry.register_scalar(
+            f"{prefix}_key_installs_pending",
+            lambda: self.key_installs_pending,
+            help_="joins queued or staged, not yet committed")
+        registry.register_scalar(
+            f"{prefix}_warm_bucket", lambda: self._warm_bucket,
+            help_="population bucket whose shapes are pre-compiled")
+        registry.register_multi(
+            f"{prefix}_admit_rejected", self._rejected_samples,
+            help_="admissions refused, by typed reason", kind="counter")
+
+    def _rejected_samples(self):
+        return [({"reason": r}, float(c))
+                for r, c in sorted(self.admit_rejected.items())]

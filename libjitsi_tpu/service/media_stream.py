@@ -79,6 +79,11 @@ class StreamRegistry:
         self._ssrc_to_sid: Dict[int, int] = {}
         self.streams: Dict[int, "MediaStream"] = {}
 
+    @property
+    def free_slots(self) -> int:
+        """Rows available to alloc (admission control's capacity gate)."""
+        return len(self._free)
+
     def alloc(self, stream: "MediaStream") -> int:
         if not self._free:
             raise RuntimeError("stream capacity exhausted")

@@ -434,10 +434,27 @@ class ReceiveBank:
         self._last_pcm.pop(sid, None)
 
     def remove_stream(self, sid: int) -> None:
-        self._kind[sid] = -1
-        self._decode.pop(sid, None)
-        self.jb.reset_streams([sid])
-        self._last_pcm.pop(sid, None)
+        self.remove_streams([sid])
+
+    def remove_streams(self, sids) -> None:
+        """Batched evict hook for the lifecycle plane: recycle the
+        jitter-bank rows, decoder closures, PLC run state and per-stream
+        stats in one pass so a departed stream's concealment tail can
+        never bleed into the row's next occupant."""
+        sids = [int(s) for s in sids]
+        if not sids:
+            return
+        for sid in sids:
+            self._decode.pop(sid, None)
+            self._last_pcm.pop(sid, None)
+        arr = np.asarray(sids, dtype=np.int64)
+        self._kind[arr] = -1
+        self._plc_run[arr] = 0
+        self.decoded_frames[arr] = 0
+        self.lost_frames[arr] = 0
+        self.decode_errors[arr] = 0
+        self.plc_frames[arr] = 0
+        self.jb.reset_streams(sids)
 
     def register_metrics(self, registry, prefix: str = "bank") -> None:
         """Expose the bank's dense counters and distributions.

@@ -19,7 +19,7 @@ forwards to everyone else).
 from __future__ import annotations
 
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -208,6 +208,10 @@ class SfuBridge:
             recv_window_ms=recv_window_ms)
         self.port = self.loop.engine.port
         self._ssrc_of: Dict[int, int] = {}     # sid -> sender ssrc
+        # rows keyed by stage_endpoints but not yet committed: demuxed
+        # media queues on the hold mask, and the route mesh excludes
+        # them until commit_endpoints flips them live between ticks
+        self._staged: set = set()
         self.forwarded = 0
         self.retransmitted = 0
         # overload degradation (set by BridgeSupervisor): suppress the
@@ -307,51 +311,146 @@ class SfuBridge:
         _log.info("dtls_keys_installed", sid=sid, profile=profile.name)
 
     def remove_endpoint(self, sid: int) -> None:
+        self.remove_endpoints([sid])
+
+    def remove_endpoints(self, sids) -> None:
+        """Batched evict: `remove_endpoint` for many legs at once — one
+        fan-out quiesce, ONE `remove_streams` pass per SRTP table (one
+        copy-on-write episode however many streams leave), one route
+        rebuild.  The lifecycle plane's leave path; O(evicted), not
+        O(evicted * per-call table copies)."""
+        sids = [int(s) for s in sids]
+        if not sids:
+            return
         self._quiesce_fanout()
-        ssrc = self._ssrc_of.pop(sid, None)
-        if ssrc is not None:
-            self.registry.unmap_ssrc(ssrc)
-        self.rx_table.remove_stream(sid)
-        self.tx_table.remove_stream(sid)
-        self.translator.disconnect(sid)
-        self.translator.remove_receiver(sid)
-        self.rtcp_term.forget_receiver(sid)
-        self.bwe.reset_rows([sid])
-        self._bwe_fed[sid] = False
-        self._dtls.forget(sid)
-        self._rx_keys.pop(sid, None)
-        self._tx_keys.pop(sid, None)
-        self._recv_bw.pop(sid, None)
-        # as a video sender: tear the track + its layer rows down (the
-        # SSRC unmap matters: a recycled row must not demux the old
-        # layer SSRCs and latch the departed sender's address)
-        for lsid in [k for k, t in self._video.items()
-                     if t.sender_sid == sid]:
-            track = self._video.pop(lsid)
-            li = track.layer_sids.index(lsid)
-            self.registry.unmap_ssrc(track.layer_ssrcs[li])
-            self.rx_table.remove_stream(lsid)
-            self._transport_of[lsid] = lsid
-            self.registry.release(lsid)
-            for d in (track.tx_sid, track.rtx_sid):
-                for row in d.values():
-                    self.tx_table.remove_stream(row)
-                    self.registry.release(row)
-        # as a video receiver: drop forwarders + projection/RTX rows
-        for track in set(self._video.values()):
-            track.fwd.pop(sid, None)
-            track.rtx_seq.pop(sid, None)
-            for d in (track.tx_sid, track.rtx_sid):
-                row = d.pop(sid, None)
-                if row is not None:
-                    self.tx_table.remove_stream(row)
-                    self.registry.release(row)
-        self.loop.addr_ip[sid] = 0
-        self.loop.addr_port[sid] = 0
-        self.loop.metrics.set_stream_name(sid, None)
-        self.registry.release(sid)
+        rx_rows: list = []
+        tx_rows: list = []
+        gone_ssrcs: list = []
+        for sid in sids:
+            ssrc = self._ssrc_of.pop(sid, None)
+            if ssrc is not None:
+                self.registry.unmap_ssrc(ssrc)
+                gone_ssrcs.append(ssrc)
+            if self.rx_table.active[sid]:
+                rx_rows.append(sid)
+            if self.tx_table.active[sid]:
+                tx_rows.append(sid)
+            self.translator.disconnect(sid)
+            self.translator.remove_receiver(sid)
+            self.rtcp_term.forget_receiver(sid)
+            self._bwe_fed[sid] = False
+            self._dtls.forget(sid)
+            self._rx_keys.pop(sid, None)
+            self._tx_keys.pop(sid, None)
+            self._recv_bw.pop(sid, None)
+            # a staged-but-never-committed row: throw its held media
+            # away (the endpoint left before its admit flipped live)
+            if sid in self._staged:
+                self._staged.discard(sid)
+                self.loop.discard_stream(sid)
+            # as a video sender: tear the track + its layer rows down
+            # (the SSRC unmap matters: a recycled row must not demux the
+            # old layer SSRCs and latch the departed sender's address)
+            for lsid in [k for k, t in self._video.items()
+                         if t.sender_sid == sid]:
+                track = self._video.pop(lsid)
+                li = track.layer_sids.index(lsid)
+                self.registry.unmap_ssrc(track.layer_ssrcs[li])
+                gone_ssrcs.append(track.layer_ssrcs[li])
+                rx_rows.append(lsid)
+                self._transport_of[lsid] = lsid
+                self.registry.release(lsid)
+                for d in (track.tx_sid, track.rtx_sid):
+                    for row in d.values():
+                        tx_rows.append(row)
+                        self.registry.release(row)
+            # as a video receiver: drop forwarders + projection/RTX rows
+            for track in set(self._video.values()):
+                track.fwd.pop(sid, None)
+                track.rtx_seq.pop(sid, None)
+                for d in (track.tx_sid, track.rtx_sid):
+                    row = d.pop(sid, None)
+                    if row is not None:
+                        tx_rows.append(row)
+                        self.registry.release(row)
+            self.loop.addr_ip[sid] = 0
+            self.loop.addr_port[sid] = 0
+            self.loop.metrics.set_stream_name(sid, None)
+            self.registry.release(sid)
+        self.rx_table.remove_streams(rx_rows)
+        self.tx_table.remove_streams(tx_rows)
+        self.bwe.reset_rows(sids)
+        # recovery state is per departed sender SSRC / receiver leg:
+        # recycle it so churn can't grow trackers without bound
+        self.recovery.forget_ssrcs(gone_ssrcs)
+        self.recovery.forget_legs(sids)
         self._rebuild_routes()
-        _log.info("endpoint_leave", sid=sid)
+        for sid in sids:
+            _log.info("endpoint_leave", sid=sid)
+
+    # ---------------------------------------------------- lifecycle plane
+    def stage_endpoints(self, specs) -> List[int]:
+        """Off-tick half of a batched admit: allocate rows, install BOTH
+        SRTP tables and the translator legs in ONE vectorized
+        `add_streams` pass each, map the SSRCs (media racing the admit
+        queues on the hold mask instead of being dropped), and leave the
+        rows STAGED — no route includes them and no held packet replays
+        until `commit_endpoints` flips them live between ticks.
+
+        specs: iterable of (ssrc, (rx_mk, rx_ms), (tx_mk, tx_ms), name).
+        Returns the allocated sids in spec order.
+        """
+        specs = list(specs)
+        if not specs:
+            return []
+        for ssrc, _rx, _tx, _name in specs:
+            if ssrc in self._ssrc_of.values():
+                raise ValueError(f"ssrc {ssrc:#x} already joined")
+        self._quiesce_fanout()
+        sids = [self.registry.alloc(self) for _ in specs]
+        arr = np.asarray(sids, dtype=np.int64)
+        rx_mks = np.stack([np.frombuffer(rx[0], np.uint8)
+                           for _, rx, _, _ in specs])
+        rx_mss = np.stack([np.frombuffer(rx[1], np.uint8)
+                           for _, rx, _, _ in specs])
+        tx_mks = np.stack([np.frombuffer(tx[0], np.uint8)
+                           for _, _, tx, _ in specs])
+        tx_mss = np.stack([np.frombuffer(tx[1], np.uint8)
+                           for _, _, tx, _ in specs])
+        self.rx_table.add_streams(arr, rx_mks, rx_mss)
+        self.tx_table.add_streams(arr, tx_mks, tx_mss)
+        self.translator.add_receivers(
+            sids, [tx[0] for _, _, tx, _ in specs],
+            [tx[1] for _, _, tx, _ in specs])
+        for sid, (ssrc, rx, tx, name) in zip(sids, specs):
+            self.registry.map_ssrc(ssrc, sid)
+            self._ssrc_of[sid] = ssrc & 0xFFFFFFFF
+            self._rx_keys[sid] = tuple(rx)
+            self._tx_keys[sid] = tuple(tx)
+            if name is not None:
+                self.loop.metrics.set_stream_name(sid, name)
+            self.loop.hold_stream(sid)
+            self._staged.add(sid)
+            _log.info("endpoint_staged", sid=sid, ssrc=ssrc)
+        return sids
+
+    def commit_endpoints(self, sids) -> None:
+        """Between-ticks commit barrier: flip staged rows live — one
+        route rebuild for the whole batch, held media replayed through
+        the normal receive path, video receivers attached."""
+        sids = [int(s) for s in sids if int(s) in self._staged]
+        if not sids:
+            return
+        self._quiesce_fanout()
+        for sid in sids:
+            self._staged.discard(sid)
+        self._rebuild_routes()
+        for sid in sids:
+            for track in set(self._video.values()):
+                self._attach_video_receiver(track, sid)
+            self.loop.release_stream(sid)
+            _log.info("endpoint_join", sid=sid,
+                      ssrc=self._ssrc_of.get(sid))
 
     def _sid_of_ssrc(self, ssrc: int) -> Optional[int]:
         """Reverse of `_ssrc_of` (recovery's sid resolver): uplink
@@ -544,9 +643,10 @@ class SfuBridge:
     def _rebuild_routes(self) -> None:
         """Full mesh: every sender forwards to every OTHER endpoint.
         DTLS-pending rows have no leg keys yet and stay out of the mesh
-        until their install completes."""
+        until their install completes; staged rows (lifecycle admit in
+        flight) stay out until their commit barrier."""
         sids = [s for s in sorted(self._ssrc_of)
-                if s not in self._dtls.pending]
+                if s not in self._dtls.pending and s not in self._staged]
         for s in sids:
             self.translator.connect(s, [r for r in sids if r != s])
 

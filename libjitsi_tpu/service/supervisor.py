@@ -151,6 +151,13 @@ class BridgeSupervisor:
         self._good = 0               # consecutive on-deadline ticks
         self._shed: List[int] = []   # shed sids, LIFO restore order
         self._shed_set: set = set()
+        # sids evicted by the lifecycle plane (stream LEFT, the slot is
+        # dead or recycled): distinct from overload sheds, so the LIFO
+        # unwind never "restores" a departed stream
+        self._evicted: set = set()
+        # StreamLifecycleManager attaches itself here; when present its
+        # commit barrier + off-tick install stage run between ticks
+        self.lifecycle = None
         self._quarantined: Dict[int, int] = {}  # sid -> release tick
         self._q_strikes: Dict[int, int] = {}    # sid -> conviction count
         self.quarantine_total = 0
@@ -172,10 +179,18 @@ class BridgeSupervisor:
     # ------------------------------------------------------------- tick
 
     def tick(self, now: Optional[float] = None):
+        lc = self.lifecycle
+        if lc is not None:
+            # bracket the data path with the compile-cache guard: any
+            # compile event landing inside this window is a lifecycle
+            # bug (shapes must be warmed off-tick)
+            lc.tick_begin()
         t0 = self.clock()
         result = (self.bridge.tick(now=now) if now is not None
                   else self.bridge.tick())
         over = self.watchdog.observe(self.clock() - t0)
+        if lc is not None:
+            lc.tick_end()
         if self.tracer is not None:
             self.last_ledger = self.tracer.take_ledger()
             take_phases = getattr(self.tracer, "take_phase_ledger",
@@ -202,6 +217,10 @@ class BridgeSupervisor:
         if (self.cfg.checkpoint_every
                 and self.ticks % self.cfg.checkpoint_every == 0):
             self.save_checkpoint()
+        if lc is not None:
+            # between-ticks window: flip staged streams live (commit
+            # barrier), then stage the next admit/evict wave off-tick
+            lc.run_between_ticks(now=now)
         return result
 
     # ------------------------------------------- overload escalation
@@ -288,12 +307,19 @@ class BridgeSupervisor:
                            level=self.level - 1, rung=rung)
         if rung == "shed_streams":
             if self._shed:
-                for _ in range(min(self.cfg.shed_step,
-                                   len(self._shed))):
+                restored = 0
+                while self._shed and restored < self.cfg.shed_step:
                     sid = self._shed.pop()
                     self._shed_set.discard(sid)
+                    if sid in self._evicted:
+                        # the stream LEFT while shed: its slot is dead
+                        # (or already recycled) — restoring it would
+                        # resurrect a departed stream into someone
+                        # else's row.  Skip without consuming budget.
+                        continue
                     self.flight.record("shed_restore", sid=sid,
                                        tick=self.ticks)
+                    restored += 1
                 self._sync_drop_mask()
         elif rung == "throttle_rtx" and rec is not None:
             rec.throttle_rtx(False)
@@ -322,9 +348,10 @@ class BridgeSupervisor:
         speaker is never shed."""
         speaker = getattr(self.bridge, "speaker", None)
         dominant = getattr(speaker, "dominant", -1) if speaker else -1
+        staged = getattr(self.bridge, "_staged", ())
         cands = [s for s in self._active_sids()
                  if s not in self._shed_set and s not in self._quarantined
-                 and s != dominant]
+                 and s not in staged and s != dominant]
         cands.sort(key=lambda s: (self.priorities.get(s, 0), -s))
         stage, stage_s = PipelineTracer.dominant(self.last_ledger)
         for sid in cands[:k]:
@@ -340,6 +367,55 @@ class BridgeSupervisor:
                 "dump": self.flight.dump(sid)})
         if cands[:k]:
             self._sync_drop_mask()
+
+    # ------------------------------------------------- lifecycle plane
+
+    def note_evicted(self, sids) -> None:
+        """Lifecycle evict bookkeeping: the stream LEFT — this is not an
+        overload shed.  Clear every per-sid mechanism (shed membership,
+        quarantine, strike history, failure windows) so the departed
+        stream can never be restored, and its row's next occupant starts
+        with a clean record.  Flight-records `evicted`, distinct from
+        `shed`."""
+        changed = False
+        for sid in sids:
+            sid = int(sid)
+            self._evicted.add(sid)
+            self._shed_set.discard(sid)
+            if self._quarantined.pop(sid, None) is not None:
+                changed = True
+            self._q_strikes.pop(sid, None)
+            if sid < len(self._last_auth):
+                self._auth_win.reset_rows([sid])
+                self._replay_win.reset_rows([sid])
+            self.flight.record("evicted", sid=sid, tick=self.ticks)
+        if changed or sids:
+            self._sync_drop_mask()
+
+    def note_admitted(self, sids) -> None:
+        """Lifecycle admit bookkeeping: a row given to a NEW stream is
+        no longer 'evicted' — overload shedding may target it again."""
+        for sid in sids:
+            self._evicted.discard(int(sid))
+
+    def admission_decision(self):
+        """Burn-aware admission control for the lifecycle plane:
+        `(ok, reason)` where reason is a typed string.  Joins are
+        refused while the error budget is burning fast, while the phase
+        ledger says the tick is host-bound under overload (installing
+        more streams feeds the bottleneck), or while streams are
+        actively being shed (admitting during shedding thrashes)."""
+        if self._slo_state() == "fast_burn":
+            return False, "fast_burn"
+        if self.watchdog.state == "stalled":
+            return False, "stalled"
+        if self._shed_set:
+            return False, "shedding"
+        if self.level > 0:
+            _phase, _s, share, bound = self._phase_attr()
+            if bound == "host" and share >= self.cfg.stage_share_threshold:
+                return False, "host_bound"
+        return True, "ok"
 
     # ------------------------------------------------------ quarantine
 
@@ -425,6 +501,11 @@ class BridgeSupervisor:
                 "bridge": type(self.bridge).__name__,
                 "ticks": self.ticks,
                 "snap": self.bridge.snapshot()}
+        if self.lifecycle is not None:
+            # in-flight admits (queued joins + staged-but-uncommitted
+            # installs) ride the checkpoint so recover() can complete
+            # or roll them back instead of leaving half-installed rows
+            blob["lifecycle"] = self.lifecycle.snapshot()
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
@@ -463,6 +544,11 @@ class BridgeSupervisor:
             retries=retries, backoff_s=backoff_s, sleep=sleep)
         sup = cls(bridge, config=supervisor_config, metrics=metrics)
         sup.ticks = blob["ticks"]
+        # lifecycle in-flight state (if any) is held for the next
+        # StreamLifecycleManager attached to this supervisor: its
+        # constructor reconciles every half-installed stream (complete
+        # or roll back — never a half state)
+        sup.pending_lifecycle = blob.get("lifecycle")
         # crash-restart is a destructive action like any other: it
         # leaves a post-mortem naming the checkpoint it rose from
         ev = sup.flight.record("recovered", tick=sup.ticks, path=path,
@@ -587,6 +673,7 @@ class BridgeSupervisor:
         return {"state": self.watchdog.state, "level": self.level,
                 "rungs": list(self._rungs),
                 "shed": sorted(self._shed_set),
+                "evicted": len(self._evicted),
                 "quarantined": sorted(self._quarantined),
                 "ticks": self.ticks, "overruns": self.watchdog.overruns,
                 "last_ledger": dict(self.last_ledger),

@@ -389,6 +389,25 @@ class RecoveryController:
                              [self.cfg.fec_pt], stream=[0])
         return b.to_bytes(0)
 
+    # --------------------------------------------- lifecycle coupling
+    def forget_ssrcs(self, ssrcs) -> None:
+        """Evict hook: drop a departed sender's uplink loss trackers and
+        any pending upstream NACKs for it, so churn cannot grow recovery
+        state without bound (streams are mortal)."""
+        for ssrc in ssrcs:
+            ssrc = int(ssrc) & 0xFFFFFFFF
+            self._trackers.pop(ssrc, None)
+            self.nacks._pending.pop(ssrc, None)
+
+    def forget_legs(self, leg_sids) -> None:
+        """Evict hook: drop per-receiver-leg FEC groups and seq spaces
+        (keyed `(leg_sid << 32) | media_ssrc`) for departed legs."""
+        legs = {int(s) for s in leg_sids}
+        for d in (self.fec._groups, self.fec._base, self._fec_seq):
+            for key in [k for k in d
+                        if isinstance(k, int) and (k >> 32) in legs]:
+                del d[key]
+
     # ------------------------------------------- supervisor coupling
     def shed_fec(self, shed: bool) -> None:
         """Escalation rung: FEC overhead is the first bandwidth shed."""

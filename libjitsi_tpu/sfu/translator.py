@@ -26,7 +26,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.core.packet import (CLASS_HEADROOM, DEFAULT_CAPACITY,
+                                      LENGTH_CLASSES, PacketBatch,
+                                      _round_rows)
 from libjitsi_tpu.kernels import gcm as gcm_kernel
 from libjitsi_tpu.kernels.aes import aes_encrypt_np, expand_key
 from libjitsi_tpu.kernels.ghash import ghash_matrix
@@ -35,6 +37,26 @@ from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.transform.srtp import kernel
 from libjitsi_tpu.transform.srtp.kdf import derive_session_keys
 from libjitsi_tpu.transform.srtp.policy import Cipher, SrtpProfile
+
+
+def _round_width(w: int) -> int:
+    """Fan-out data width quantized to the packet size classes (+ tag
+    headroom) so the compiled-shape space stays (LENGTH_CLASSES x
+    ROW_CLASSES), independent of the tick's exact longest packet."""
+    for c in LENGTH_CLASSES:
+        if w <= c + CLASS_HEADROOM:
+            return c + CLASS_HEADROOM
+    return w
+
+
+def _cycle_rows(n: int) -> Optional[np.ndarray]:
+    """Row indices padding `n` up to its ROW_CLASSES bucket by cycling
+    the real rows (the bucket_by_size idiom — fan-out encrypt reads
+    table state but never writes it, so repeats are SRTP-safe; padded
+    output rows are sliced off in PendingTranslate).  None when `n`
+    already sits on a class boundary."""
+    n_pad = _round_rows(n)
+    return np.resize(np.arange(n), n_pad) if n_pad > n else None
 
 
 @functools.partial(jax.jit,
@@ -159,6 +181,59 @@ class RtpTranslator:
     def disconnect(self, sender_sid: int) -> None:
         self._routes.pop(sender_sid, None)
 
+    # ------------------------------------------------------------- warmup
+    def warmup_fanout(self, rows: int, payload_len: int = 160) -> None:
+        """Pre-compile the fan-out kernels for one ROW_CLASSES bucket —
+        off the data path (StreamLifecycleManager calls this when the
+        population bucket grows, before any admit can drive traffic at
+        the new scale).  Covers the class-padded shapes translate_async
+        produces: the common uniform payload offsets (bare RTP header at
+        12, header + one-byte abs-send-time ext at 20) plus the general
+        mixed-offset entry.  Reads the live key tables (row 0, key
+        material irrelevant); outputs are garbage and discarded.
+
+        Widths: the data path clips the fan-out buffer to the tick's
+        largest packet's LENGTH_CLASSES bucket, so this warms the class
+        covering `payload_len` (the configured media size) and the
+        full-MTU class (video keyframes, FEC bursts)."""
+        rows = _round_rows(max(1, rows))
+        tag = self.policy.auth_tag_len
+        widths = sorted({_round_width(12 + payload_len + tag),
+                         _round_width(DEFAULT_CAPACITY + tag)})
+        recv = np.zeros(rows, dtype=np.int64)
+        idx = np.zeros(rows, dtype=np.int64)
+        length = np.full(rows, 12 + payload_len, dtype=np.int32)
+        offs = [np.full(rows, 12, dtype=np.int32),
+                np.full(rows, 20, dtype=np.int32)]
+        mixed = np.full(rows, 12, dtype=np.int32)
+        if rows > 1:
+            mixed[0] = 16            # non-uniform: off_const=None entry
+        offs.append(mixed)
+        for w in widths:
+            data = np.zeros((rows, w), dtype=np.uint8)
+            data[:, 0] = 0x80
+            for off in offs:
+                if self._gcm:
+                    iv12 = np.zeros((rows, 12), dtype=np.uint8)
+                    out, _ = self._gcm_fanout_call(recv, data, length,
+                                                   off, iv12, w)
+                else:
+                    iv = np.zeros((rows, 16), dtype=np.uint8)
+                    out, _ = self._cm_fanout_call(recv, data, length,
+                                                  off, iv, idx)
+                np.asarray(out)      # block: compile NOW, off-tick
+            if self._gcm:
+                # grouped full-mesh path: legs = this bucket, packets =
+                # the smallest row class (both axes class-padded live)
+                p = _round_rows(1)
+                pdata = np.zeros((p, w), dtype=np.uint8)
+                plen = np.full(p, 12 + payload_len, dtype=np.int32)
+                iv = np.zeros((rows, p, 12), dtype=np.uint8)
+                for aad in (12, 20):
+                    out_gp, _ = self._gcm_uniform_fanout_call(
+                        recv, pdata, plen, iv, aad)
+                    np.asarray(out_gp)
+
     def _device(self):
         if self._dev is None:
             aux = self._gm if self._gcm else self._mid
@@ -232,8 +307,24 @@ class RtpTranslator:
                 iv[:, 8 + k] ^= ((idx >> (8 * (5 - k))) & 0xFF
                                  ).astype(np.uint8)
 
-            out, out_len = self._cm_fanout_call(recv, data, length,
-                                                payload_off, iv, idx)
+            # class-pad rows AND width: under churn the receiver count
+            # changes every tick, so raw (packets x receivers) shapes
+            # would retrace the fan-out jit unboundedly — bucketing
+            # keeps the compiled-shape space at LENGTH x ROW classes
+            rr_idx = _cycle_rows(len(recv))
+            if rr_idx is None:
+                rr_idx = np.arange(len(recv))
+            # width clips to the tick's largest packet's class, not the
+            # wire buffer: voice riding full-MTU rx buffers would pay
+            # ~7x keystream over every leg
+            pw = _round_width(int(np.max(length, initial=12))
+                              + self.policy.auth_tag_len)
+            cw = min(pw, data.shape[-1])
+            pdata = np.zeros((len(rr_idx), pw), dtype=np.uint8)
+            pdata[:, :cw] = data[rr_idx][:, :cw]
+            out, out_len = self._cm_fanout_call(
+                recv[rr_idx], pdata, length[rr_idx],
+                payload_off[rr_idx], iv[rr_idx], idx[rr_idx])
         return PendingTranslate(out, out_len, recv, batch.capacity)
 
     def _cm_fanout_call(self, recv, data, length, payload_off, iv, idx):
@@ -286,28 +377,58 @@ class RtpTranslator:
         if uniform:
             rr = recvs[0]
             p_rows = np.asarray(rows, dtype=np.int64)
-            pdata = batch.data[p_rows]
-            plen = np.asarray(batch.length, dtype=np.int32)[p_rows]
-            pssrc = hdr.ssrc[p_rows]
             pidx = np.asarray(idx).reshape(len(rows), len(rr))[:, 0] \
                 if len(rr) else np.zeros(0, np.int64)
+            # class-pad BOTH grouped axes (legs and packets, cycled)
+            # plus the data width: churn varies the leg count every
+            # tick, and raw (G, P) shapes would retrace unboundedly
+            g_real, p_real = len(rr), len(p_rows)
+            g_idx = _cycle_rows(g_real)
+            rr_p = rr[g_idx] if g_idx is not None else rr
+            p_idx = _cycle_rows(p_real)
+            if p_idx is None:
+                p_idx = np.arange(p_real)
+            pr = p_rows[p_idx]
+            plen = np.asarray(batch.length, dtype=np.int32)[pr]
+            # width clips to the largest packet's class (see the CM path)
+            pw = _round_width(int(np.max(plen, initial=12))
+                              + self.policy.auth_tag_len)
+            cw = min(pw, batch.capacity)
+            pdata = np.zeros((len(pr), pw), dtype=np.uint8)
+            pdata[:, :cw] = batch.data[pr][:, :cw]
+            pssrc = hdr.ssrc[pr]
+            pidx = pidx[p_idx]
             # iv [G, P, 12]: leg salt x sender ssrc/index
             iv = gcm_kernel.srtp_gcm_iv(
-                np.broadcast_to(self._salt[rr][:, None, :12],
-                                (len(rr), len(p_rows), 12)),
+                np.broadcast_to(self._salt[rr_p][:, None, :12],
+                                (len(rr_p), len(pr), 12)),
                 pssrc[None, :], pidx[None, :])
             out_gp, out_len_p = self._gcm_uniform_fanout_call(
-                rr, pdata, plen, iv, int(off0[0]))
+                rr_p, pdata, plen, iv, int(off0[0]))
+            out_gp = jnp.asarray(out_gp)[:g_real, :p_real]
             # grouped output is leg-major [G, P, W]; the contract is
             # packet-major rows (p0r0, p0r1, ...) matching `src`/`recv`
-            out = jnp.transpose(jnp.asarray(out_gp), (1, 0, 2)).reshape(
-                len(p_rows) * len(rr), batch.capacity)
-            out_len = jnp.tile(jnp.asarray(out_len_p)[:, None],
-                               (1, len(rr))).reshape(-1)
+            out = jnp.transpose(out_gp, (1, 0, 2)).reshape(
+                p_real * g_real, out_gp.shape[-1])
+            out_len = jnp.tile(
+                jnp.asarray(out_len_p)[:p_real, None],
+                (1, g_real)).reshape(-1)
             return out, out_len
-        iv = gcm_kernel.srtp_gcm_iv(self._salt[recv], ssrc, idx)
-        return self._gcm_fanout_call(recv, data, length, payload_off,
-                                     iv, batch.capacity)
+        rr_idx = _cycle_rows(len(recv))
+        if rr_idx is None:
+            rr_idx = np.arange(len(recv))
+        # width clips to the largest packet's class (see the CM path)
+        pw = _round_width(int(np.max(length, initial=12))
+                          + self.policy.auth_tag_len)
+        cw = min(pw, data.shape[-1])
+        pdata = np.zeros((len(rr_idx), pw), dtype=np.uint8)
+        pdata[:, :cw] = data[rr_idx][:, :cw]
+        iv = gcm_kernel.srtp_gcm_iv(self._salt[recv[rr_idx]],
+                                    ssrc[rr_idx], idx[rr_idx])
+        return self._gcm_fanout_call(recv[rr_idx], pdata,
+                                     length[rr_idx],
+                                     payload_off[rr_idx], iv,
+                                     pdata.shape[-1])
 
     def _gcm_uniform_fanout_call(self, rr, pdata, plen, iv, aad_const):
         """Full-mesh per-LEG-matrix fan-out device call: P packets
@@ -355,9 +476,13 @@ class PendingTranslate:
             if self._out is None:
                 wire = PacketBatch.empty(0, self._capacity)
             else:
-                wire = PacketBatch(np.asarray(self._out),
+                # drop the class-padding rows (cycled copies appended
+                # by translate_async to keep the fan-out shapes on the
+                # ROW_CLASSES grid)
+                n = len(self.recv)
+                wire = PacketBatch(np.asarray(self._out)[:n],
                                    np.asarray(self._out_len,
-                                              dtype=np.int32),
+                                              dtype=np.int32)[:n],
                                    self.recv.astype(np.int32))
             self._done = (wire, self.recv)
             self._out = self._out_len = None

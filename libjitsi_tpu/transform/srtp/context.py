@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from libjitsi_tpu.core.packet import (PacketBatch,
+from libjitsi_tpu.core.packet import (PacketBatch, _round_rows,
                                       bucket_by_size, unbucket)
 from libjitsi_tpu.core.rtp_math import (
     _segments,
@@ -103,6 +103,18 @@ def _unprotect_rtcp_dev(tab_rk, tab_mid, stream, data, length, iv,
     return kernel.srtcp_unprotect(
         data, length, tab_rk[stream], iv, tab_mid[stream], tag_len, encrypt,
         f8_round_keys=None if tab_f8 is None else tab_f8[stream])
+
+
+def _rtcp_row_pad(n: int):
+    """Row indices padding an RTCP batch up to its ROW_CLASSES bucket by
+    cycling the real rows — the device calls are pure w.r.t. table state
+    (index assignment and replay bookkeeping run on the REAL rows on the
+    host), so repeats are safe and padded output rows are sliced off.
+    Bounds the compiled RTCP shape space to the row classes instead of
+    one cache entry per distinct per-tick RTCP count (which churns
+    without bound on a live bridge).  None when already on a boundary."""
+    n_pad = _round_rows(n)
+    return np.resize(np.arange(n), n_pad) if n_pad > n else None
 
 
 @functools.partial(jax.jit, static_argnames=("aad_const",))
@@ -484,6 +496,22 @@ class SrtpStreamTable:
         wire = scratch.protect_rtp(b)
         scratch.unprotect_rtp(wire)
 
+    def warmup_rtcp(self, batch_size: int = 1) -> None:
+        """Pre-compile the SRTCP protect/unprotect programs for the row
+        class covering `batch_size` — control traffic rides the same
+        zero-recompile discipline as media (the per-tick RTCP count is
+        row-class padded, so one warm per class covers every count in
+        it).  Scratch table, same rationale as `warmup_rtp`."""
+        scratch = SrtpStreamTable(self.capacity, self.profile)
+        scratch.add_stream(0, b"\x00" * self.policy.enc_key_len,
+                           b"\x00" * self.policy.salt_len)
+        # minimal valid compound: one empty receiver report (PT 201)
+        blob = bytes([0x80, 201, 0, 1]) + (0x4000).to_bytes(4, "big")
+        b = PacketBatch.from_payloads([blob] * max(1, batch_size),
+                                      stream=[0] * max(1, batch_size))
+        wire = scratch.protect_rtcp(b)
+        scratch.unprotect_rtcp(wire)
+
     @staticmethod
     def _row_subset(batch: PacketBatch, rows: np.ndarray) -> PacketBatch:
         return PacketBatch(batch.data[rows].copy(),
@@ -604,22 +632,51 @@ class SrtpStreamTable:
         return np.where(base >= 0, idx_est, idx_chain)
 
     def remove_stream(self, sid: int) -> None:
-        self.active[sid] = False
+        self.remove_streams([sid])
+
+    def remove_streams(self, sids) -> None:
+        """Vectorized bulk teardown: `remove_stream` for many rows in
+        one pass — the evict half of the lifecycle plane.
+
+        Key material is zeroed (a recycled row must never authenticate
+        under a departed stream's keys) and ALL sequential state is
+        reset so the row is immediately reusable by a future
+        add_stream/add_streams with no leftover replay window, rollover
+        counter, or kdr epoch.  The whole batch pays ONE copy-on-write
+        table copy instead of one per stream, so a join/leave storm
+        evicting hundreds of streams costs the same table copy a single
+        evict does.
+        """
+        sids = np.asarray(sids, dtype=np.int64)
+        if sids.size == 0:
+            return
+        self.active[sids] = False
         self._cow_tables()
-        self._rk_rtp[sid] = 0
-        self._rk_rtcp[sid] = 0
-        self._mid_rtp[sid] = 0
-        self._mid_rtcp[sid] = 0
+        self._rk_rtp[sids] = 0
+        self._rk_rtcp[sids] = 0
+        self._mid_rtp[sids] = 0
+        self._mid_rtcp[sids] = 0
         if self._gcm:
-            self._gm_rtp[sid] = 0
-            self._gm_rtcp[sid] = 0
+            self._gm_rtp[sids] = 0
+            self._gm_rtcp[sids] = 0
         if self._f8:
-            self._rk_f8_rtp[sid] = 0
-            self._rk_f8_rtcp[sid] = 0
-        self._masters.pop(sid, None)
-        self.kdr[sid] = 0
-        self.auth_fail[sid] = 0
-        self.replay_reject[sid] = 0
+            self._rk_f8_rtp[sids] = 0
+            self._rk_f8_rtcp[sids] = 0
+        self._salt_rtp[sids] = 0
+        self._salt_rtcp[sids] = 0
+        for sid in sids:
+            self._masters.pop(int(sid), None)
+        self.tx_ext[sids] = -1
+        self.rx_max[sids] = -1
+        self.rx_mask[sids] = 0
+        self.rtcp_tx_index[sids] = -1
+        self.rtcp_rx_max[sids] = -1
+        self.rtcp_rx_mask[sids] = 0
+        self.kdr[sids] = 0
+        self.auth_fail[sids] = 0
+        self.replay_reject[sids] = 0
+        self._epoch_rtp[sids] = 0
+        self._epoch_rtcp[sids] = 0
         self._dev = None
 
     def _device(self):
@@ -1046,12 +1103,24 @@ class SrtpStreamTable:
 
         if self._f8:
             iv = self._f8_rtcp_iv(batch.data, index_word)
-            data, length = self._rtcp_protect_call(
-                stream, batch, iv, index_word, True, f8=True)
+            enc_flag, f8 = True, True
         else:
             iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
+            enc_flag, f8 = encrypting, False
+        n = batch.batch_size
+        pad = _rtcp_row_pad(n)
+        if pad is None:
             data, length = self._rtcp_protect_call(
-                stream, batch, iv, index_word, encrypting)
+                stream, batch, iv, index_word, enc_flag, f8=f8)
+        else:
+            data, length = self._rtcp_protect_call(
+                stream[pad],
+                PacketBatch(batch.data[pad],
+                            np.asarray(batch.length)[pad],
+                            np.asarray(batch.stream)[pad]),
+                iv[pad], index_word[pad], enc_flag, f8=f8)
+            data = np.asarray(data)[:n]
+            length = np.asarray(length)[:n]
         np.maximum.at(self.rtcp_tx_index, stream, index)
         return PacketBatch(np.asarray(data), np.asarray(length, dtype=np.int32),
                            batch.stream)
@@ -1126,9 +1195,15 @@ class SrtpStreamTable:
         kin = np.where(sel, shifted, kin).astype(np.uint8)
 
         iv12 = self._gcm_rtcp_iv(self._salt_rtcp[stream], ssrc, index)
-        out, out_len = self._gcm_rtcp_seal_call(stream, kin, 12 + plen,
-                                                iv12)
-        out = np.asarray(out)
+        pad = _rtcp_row_pad(n)
+        if pad is None:
+            out, out_len = self._gcm_rtcp_seal_call(stream, kin,
+                                                    12 + plen, iv12)
+            out = np.asarray(out)
+        else:
+            out, out_len = self._gcm_rtcp_seal_call(
+                stream[pad], kin[pad], (12 + plen)[pad], iv12[pad])
+            out = np.asarray(out)[:n]
         # wire: hdr8 || ct || tag || word
         wire = np.zeros_like(out)
         wire[:, :8] = out[:, :8]
@@ -1178,14 +1253,27 @@ class SrtpStreamTable:
         if self._gcm:
             data, mlen, auth_ok = self._unprotect_rtcp_gcm(
                 batch, stream, ssrc, index, word, length)
-        elif self._f8:
-            iv = self._f8_rtcp_iv(batch.data, word)
-            data, mlen, auth_ok, _e, _idx = self._rtcp_unprotect_call(
-                stream, batch, iv, length, True, f8=True)
         else:
-            iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
-            data, mlen, auth_ok, _e, _idx = self._rtcp_unprotect_call(
-                stream, batch, iv, length, p.cipher != Cipher.NULL)
+            if self._f8:
+                iv = self._f8_rtcp_iv(batch.data, word)
+                enc_flag, f8 = True, True
+            else:
+                iv = self._cm_iv(self._salt_rtcp[stream], ssrc, index)
+                enc_flag, f8 = p.cipher != Cipher.NULL, False
+            n = batch.batch_size
+            pad = _rtcp_row_pad(n)
+            if pad is None:
+                data, mlen, auth_ok, _e, _idx = self._rtcp_unprotect_call(
+                    stream, batch, iv, length, enc_flag, f8=f8)
+            else:
+                data, mlen, auth_ok, _e, _idx = self._rtcp_unprotect_call(
+                    stream[pad],
+                    PacketBatch(batch.data[pad], length[pad],
+                                np.asarray(batch.stream)[pad]),
+                    iv[pad], length[pad], enc_flag, f8=f8)
+                data = np.asarray(data)[:n]
+                mlen = np.asarray(mlen)[:n]
+                auth_ok = np.asarray(auth_ok)[:n]
         auth_ok = np.asarray(auth_ok)
         srow = np.clip(stream, 0, self.capacity - 1)
         np.add.at(self.auth_fail, srow, valid & not_replayed & ~auth_ok)
@@ -1221,9 +1309,16 @@ class SrtpStreamTable:
         kin = np.where(sel, shifted, kin).astype(np.uint8)
 
         iv12 = self._gcm_rtcp_iv(self._salt_rtcp[stream], ssrc, index)
-        dec, _, auth_ok = self._gcm_rtcp_open_call(stream, kin,
-                                                   12 + ctlen + 16, iv12)
-        dec = np.asarray(dec)
+        pad = _rtcp_row_pad(n)
+        if pad is None:
+            dec, _, auth_ok = self._gcm_rtcp_open_call(
+                stream, kin, 12 + ctlen + 16, iv12)
+            dec = np.asarray(dec)
+        else:
+            dec, _, auth_ok = self._gcm_rtcp_open_call(
+                stream[pad], kin[pad], (12 + ctlen + 16)[pad], iv12[pad])
+            dec = np.asarray(dec)[:n]
+            auth_ok = np.asarray(auth_ok)[:n]
         out = np.zeros_like(dec)
         out[:, :8] = dec[:, :8]
         unshift = np.take_along_axis(dec, np.minimum(cols + 4, cap - 1),

@@ -196,3 +196,107 @@ class FaultInjectionEngine(TransformEngine):
     @property
     def rtp_transformer(self):
         return self._rtp
+
+
+class DiurnalProfile:
+    """Sinusoidal day-curve rate modulation for churn models.
+
+    `factor(t)` swings between `1 - depth` (trough) and 1.0 (peak) over
+    one `period_s`; real conference load follows the working day, and a
+    churn soak compressed to seconds still exercises the ramp-up /
+    ramp-down regimes by shrinking the period."""
+
+    def __init__(self, period_s: float = 86400.0, depth: float = 0.5,
+                 peak_t: float = 0.0):
+        if not 0.0 <= depth <= 1.0:
+            raise ValueError("depth must be in [0, 1]")
+        self.period_s = period_s
+        self.depth = depth
+        self.peak_t = peak_t
+
+    def factor(self, t: float) -> float:
+        phase = 2.0 * np.pi * (t - self.peak_t) / self.period_s
+        return 1.0 - self.depth * 0.5 * (1.0 - np.cos(phase + np.pi))
+
+
+class TalkSpurtModel:
+    """Vectorized per-stream on/off voice-activity source (ITU-T P.59
+    style: exponential talk-spurt and pause holding times).
+
+    `advance(dt)` moves every stream's two-state chain forward and
+    returns the boolean "speaking" mask — the churn soak uses it so
+    admitted streams offer realistic bursty traffic instead of a
+    constant packet wall.  Deterministic per seed."""
+
+    def __init__(self, n: int, spurt_s: float = 1.004,
+                 pause_s: float = 1.587, seed: int = 0):
+        self.spurt_s = spurt_s
+        self.pause_s = pause_s
+        self.rng = np.random.default_rng(seed)
+        self.speaking = self.rng.random(n) < (
+            spurt_s / (spurt_s + pause_s))
+        self._left = np.where(
+            self.speaking,
+            self.rng.exponential(spurt_s, n),
+            self.rng.exponential(pause_s, n))
+
+    def reset_rows(self, rows) -> None:
+        """Fresh state for recycled rows (a new stream must not inherit
+        the departed occupant's mid-spurt phase)."""
+        rows = np.asarray(rows, dtype=np.int64)
+        self.speaking[rows] = False
+        self._left[rows] = self.rng.exponential(self.pause_s, len(rows))
+
+    def advance(self, dt: float) -> np.ndarray:
+        """Advance all chains by `dt` seconds; returns the speaking
+        mask.  Streams may flip several times within a large dt."""
+        self._left -= dt
+        expired = np.nonzero(self._left <= 0.0)[0]
+        # per-row loop only over EXPIRED rows: at voice time constants
+        # (~1 s) and tick dt (~20 ms) that's a few percent of rows
+        for i in expired:
+            while self._left[i] <= 0.0:
+                self.speaking[i] = not self.speaking[i]
+                mean = self.spurt_s if self.speaking[i] else self.pause_s
+                self._left[i] += self.rng.exponential(mean)
+        return self.speaking
+
+
+class ChurnModel:
+    """Poisson join/leave churn: joins arrive as a Poisson process at
+    `join_rate_hz` (optionally modulated by a `DiurnalProfile`), each
+    admitted stream's hold time is exponential with mean `mean_hold_s`,
+    so departures are a per-stream hazard `dt / mean_hold_s`.  In
+    steady state the population settles near join_rate * mean_hold
+    (M/M/inf), and total churn is ~2 * join_rate events/sec.
+
+    Deterministic per seed.  The model only COUNTS events —
+    `step(dt, now, population)` returns (n_joins, n_leaves) and the
+    driver decides which streams those are (LIFO, random, ...)."""
+
+    def __init__(self, join_rate_hz: float, mean_hold_s: float,
+                 seed: int = 0,
+                 diurnal: Optional[DiurnalProfile] = None):
+        if join_rate_hz < 0 or mean_hold_s <= 0:
+            raise ValueError("need join_rate_hz >= 0, mean_hold_s > 0")
+        self.join_rate_hz = join_rate_hz
+        self.mean_hold_s = mean_hold_s
+        self.diurnal = diurnal
+        self.rng = np.random.default_rng(seed)
+        self.joins_offered = 0
+        self.leaves_offered = 0
+
+    def step(self, dt: float, now: float,
+             population: int) -> Tuple[int, int]:
+        """Advance model time by `dt`; returns (joins, leaves) offered
+        in the window given the current population."""
+        rate = self.join_rate_hz
+        if self.diurnal is not None:
+            rate *= self.diurnal.factor(now)
+        joins = int(self.rng.poisson(rate * dt))
+        hazard = min(1.0, dt / self.mean_hold_s)
+        leaves = (int(self.rng.binomial(population, hazard))
+                  if population > 0 else 0)
+        self.joins_offered += joins
+        self.leaves_offered += leaves
+        return joins, leaves

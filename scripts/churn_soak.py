@@ -1,0 +1,470 @@
+#!/usr/bin/env python
+"""Churn soak: continuous Poisson join/leave against the stream
+lifecycle plane, with a capacity-per-chip report.
+
+Drives an SfuBridge + BridgeSupervisor + StreamLifecycleManager with a
+`ChurnModel` (Poisson joins, exponential holds, diurnal rate swing)
+while persistent probe endpoints exchange talk-spurt-gated media over
+loopback UDP under simulated downlink loss, recovering via NACK.  After
+a ramp to steady state the measured window asserts the lifecycle
+plane's acceptance invariants:
+
+- ZERO compile events land inside tick windows (CompileCacheStats
+  bracketing via lifecycle.tick_begin/tick_end) — admits/evicts ride
+  pre-warmed bucket shapes;
+- `table_protect` p99 against the LIVE churn-mutated table stays
+  within `--p99-factor` (2x) of the pre-churn static-batch p99;
+- residual media loss across the probes stays under `--residual-bound`
+  (1%) with NACK recovery enabled;
+- rejected admissions carry TYPED reasons in both the metrics scrape
+  and the flight ring;
+- sustained churn meets `--target-events` joins+leaves per second.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/churn_soak.py            # full
+    JAX_PLATFORMS=cpu python scripts/churn_soak.py --smoke    # tier-1
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import libjitsi_tpu  # noqa: E402
+from libjitsi_tpu.core.packet import PacketBatch  # noqa: E402
+from libjitsi_tpu.io import UdpEngine  # noqa: E402
+from libjitsi_tpu.rtp import header as rtp_header  # noqa: E402
+from libjitsi_tpu.rtp import rtcp  # noqa: E402
+from libjitsi_tpu.service.lifecycle import (  # noqa: E402
+    StreamLifecycleManager)
+from libjitsi_tpu.service.sfu_bridge import SfuBridge  # noqa: E402
+from libjitsi_tpu.service.supervisor import (  # noqa: E402
+    BridgeSupervisor, SupervisorConfig)
+from libjitsi_tpu.transform.srtp import SrtpStreamTable  # noqa: E402
+from libjitsi_tpu.utils.faults import (  # noqa: E402
+    ChurnModel, DiurnalProfile, TalkSpurtModel)
+
+
+def _keys(b: int):
+    """Deterministic (master key, master salt) from one byte seed."""
+    return (bytes([b & 0xFF]) * 16, bytes([(b + 1) & 0xFF]) * 14)
+
+
+class _Probe:
+    """Persistent endpoint measuring end-to-end loss under churn: sends
+    talk-spurt media, drops `drop_rate` of its downlink before decrypt
+    (wire-level loss — seq/ssrc are read from the clear header), NACKs
+    the gaps, and accounts every (sender, seq) it eventually decrypts."""
+
+    FIRST_SEQ = 1000
+
+    def __init__(self, ssrc: int, bridge_port: int, n_probes: int,
+                 seed: int):
+        self.ssrc = ssrc
+        self.rx_key = _keys(ssrc & 0xFF)
+        self.tx_key = _keys((ssrc + 2) & 0xFF)
+        self.protect = SrtpStreamTable(capacity=1)
+        self.protect.add_stream(0, *self.rx_key)
+        self.open = SrtpStreamTable(capacity=max(4, n_probes))
+        self.row_of = {}
+        self.engine = UdpEngine(port=0, max_batch=256)
+        self.bridge_port = bridge_port
+        self.seq = self.FIRST_SEQ
+        self.sid = None                    # filled once committed
+        self.got = set()                   # (sender ssrc, seq)
+        self.pending = {}                  # sender ssrc -> set(seq)
+        self.scanned_to = {}               # sender ssrc -> seq
+        self._head = {}                    # sender ssrc -> seq @ last round
+        self.wire_drops = 0
+        self.rng = np.random.default_rng(seed)
+
+    def expect_sender(self, ssrc: int) -> None:
+        row = len(self.row_of)
+        self.row_of[ssrc] = row
+        self.open.add_stream(row, *self.tx_key)
+        self.pending[ssrc] = set()
+        self.scanned_to[ssrc] = self.FIRST_SEQ
+
+    def send_media(self, n: int = 2) -> None:
+        pls = [b"\x5a" * 120] * n
+        b = rtp_header.build(pls, [self.seq + i for i in range(n)],
+                             [0] * n, [self.ssrc] * n, [96] * n,
+                             stream=[0] * n)
+        self.seq += n
+        self.engine.send_batch(self.protect.protect_rtp(b),
+                               "127.0.0.1", self.bridge_port)
+
+    def drain(self, drop_rate: float = 0.0) -> None:
+        back, _, _ = self.engine.recv_batch(timeout_ms=0)
+        if back.batch_size == 0:
+            return
+        hdr = rtp_header.parse(back)
+        drop = self.rng.random(back.batch_size) < drop_rate
+        keep = []
+        for i in range(back.batch_size):
+            ssrc = int(hdr.ssrc[i])
+            if ssrc not in self.row_of:
+                continue                   # FEC / foreign stream
+            if drop[i] and (ssrc, int(hdr.seq[i])) not in self.got:
+                self.wire_drops += 1       # lost on the simulated wire
+                continue
+            keep.append(i)
+        if not keep:
+            return
+        sub = PacketBatch(
+            back.data[keep], np.asarray(back.length)[keep],
+            np.asarray([self.row_of[int(hdr.ssrc[i])] for i in keep]))
+        dec, ok = self.open.unprotect_rtp(sub)
+        dhdr = rtp_header.parse(dec)
+        for j in np.nonzero(np.asarray(ok))[0]:
+            j = int(j)
+            self.got.add((int(dhdr.ssrc[j]), int(dhdr.seq[j])))
+
+    def nack_round(self, senders, max_seqs: int = 30) -> None:
+        """Scan each sender's seq space for gaps, NACK the freshest.
+
+        The horizon is the sender's head as of the PREVIOUS round —
+        those packets have had a full round trip to arrive, so anything
+        absent is a real gap.  (A fixed in-flight allowance freezes the
+        horizon just below a pausing talker's final packets, and the
+        bridge cache ages them out before the first NACK ever goes
+        out.)"""
+        for other in senders:
+            if other is self:
+                continue
+            hi = self._head.get(other.ssrc, self.scanned_to[other.ssrc])
+            self._head[other.ssrc] = other.seq
+            pend = self.pending[other.ssrc]
+            for s in range(self.scanned_to[other.ssrc], hi):
+                if (other.ssrc, s) not in self.got:
+                    pend.add(s)
+            self.scanned_to[other.ssrc] = max(
+                self.scanned_to[other.ssrc], hi)
+            pend -= {s for s in pend if (other.ssrc, s) in self.got}
+            if not pend:
+                continue
+            want = sorted(pend)[-max_seqs:]
+            blob = rtcp.build_compound([rtcp.build_nack(rtcp.Nack(
+                sender_ssrc=self.ssrc, media_ssrc=other.ssrc,
+                lost_seqs=want))])
+            wire = self.protect.protect_rtcp(
+                PacketBatch.from_payloads([blob], stream=[0]))
+            self.engine.send_batch(wire, "127.0.0.1", self.bridge_port)
+
+    def close(self) -> None:
+        self.engine.close()
+
+
+def _timed_protect(table, sid: int, seq0: int, n: int = 64,
+                   payload_len: int = 160) -> float:
+    """One protect launch against the LIVE table (includes any pending
+    copy-on-write / re-upload the churn left behind); returns seconds."""
+    pls = [b"\x00" * payload_len] * n
+    b = rtp_header.build(pls, [(seq0 + i) & 0xFFFF for i in range(n)],
+                         [0] * n, [0x7E57] * n, [96] * n,
+                         stream=[sid] * n)
+    t0 = time.perf_counter()
+    out = table.protect_rtp(b)
+    np.asarray(out.data).ravel()[0]        # force materialization
+    return time.perf_counter() - t0
+
+
+def run_soak(duration_s: float = 30.0, ramp_s: float = 6.0,
+             settle_s: float = 1.0, dt: float = 0.02,
+             join_rate_hz: float = 300.0, mean_hold_s: float = 0.6,
+             capacity: int = 1024, probes: int = 3,
+             drop_rate: float = 0.05,
+             target_events_per_sec: float = 500.0,
+             residual_bound: float = 0.01,
+             p99_factor_bound: float = 2.0, seed: int = 0,
+             verbose: bool = True, report_path=None) -> dict:
+    """Run the soak; returns the report dict (every `ok_*` must hold)."""
+    import jax
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    bridge = SfuBridge(cfg, port=0, capacity=capacity, recv_window_ms=0)
+    reg = bridge.loop.metrics
+    sup = BridgeSupervisor(
+        bridge,
+        SupervisorConfig(deadline_ms=1000.0,
+                         quarantine_auth_threshold=1 << 30,
+                         quarantine_replay_threshold=1 << 30),
+        metrics=reg)
+    lc = StreamLifecycleManager(bridge, supervisor=sup, metrics=reg)
+
+    now = 100.0
+    t0_wall = time.perf_counter()
+
+    # ---- probes join through the lifecycle plane like anyone else
+    plist = [_Probe(0x50 + 11 * k, bridge.port, probes, seed + 10 + k)
+             for k in range(probes)]
+    for p in plist:
+        accepted, why = lc.request_join(p.ssrc, p.rx_key, p.tx_key,
+                                        name=f"probe-{p.ssrc:#x}")
+        assert accepted, f"probe admission refused: {why}"
+    while any(p.ssrc not in bridge._ssrc_of.values() for p in plist):
+        sup.tick(now=now)
+        now += dt
+    sid_of = {s: v for v, s in
+              ((sid, ssrc) for sid, ssrc in bridge._ssrc_of.items())}
+    for p in plist:
+        p.sid = sid_of[p.ssrc]
+        for other in plist:
+            if other is not p:
+                p.expect_sender(other.ssrc)
+
+    # ---- address-latch phase: fan-out toward a receiver is filtered
+    # (and NOT cached for NACK) until that receiver's source address
+    # latches on its first inbound packet, so the first few packets of
+    # a brand-new pair are unrecoverable by design.  Every probe sends
+    # until all addresses are live, then the per-sender accounting
+    # floor is the seq AFTER latch — the soak measures churn loss, not
+    # bring-up loss.
+    for _ in range(6):
+        for p in plist:
+            p.send_media(1)
+        sup.tick(now=now)
+        now += dt
+        for p in plist:
+            p.drain(0.0)
+    floor = {p.ssrc: p.seq for p in plist}
+    for p in plist:
+        for other in plist:
+            if other is not p:
+                p.scanned_to[other.ssrc] = floor[other.ssrc]
+
+    # ---- static protect p99 baseline: same tick cadence, probe
+    # traffic, wire drops and NACK rounds as the churn window — only
+    # the population is frozen.  (A tight idle timing loop would
+    # flatter the baseline: no interleaved tick work, perfectly warm
+    # caches — and the 2x bound would then measure the cost of ticking,
+    # not the cost of churn.)
+    spurt = TalkSpurtModel(probes, seed=seed + 1)
+    meas_sid = plist[0].sid
+    meas_seq = 0
+    for _ in range(5):                   # settle the protect path
+        _timed_protect(bridge.tx_table, meas_sid, meas_seq)
+        meas_seq += 64
+    static_samples = []
+    static_ticks = max(20, int(round(min(duration_s, 4.0) / dt)))
+    for t in range(static_ticks):
+        speaking = spurt.advance(dt)
+        if t % 2 == 0:
+            for i, p in enumerate(plist):
+                if speaking[i]:
+                    p.send_media(2)
+        sup.tick(now=now)
+        for p in plist:
+            p.drain(drop_rate)
+        if t % 2 == 1:
+            for p in plist:
+                p.nack_round(plist)
+        if t % 2 == 0:
+            static_samples.append(
+                _timed_protect(bridge.tx_table, meas_sid, meas_seq))
+            meas_seq += 64
+        now += dt
+    p99_static = float(np.percentile(static_samples, 99))
+
+    # ---- churn drivers
+    period = 8.0 * duration_s
+    t_mid = now + ramp_s + duration_s / 2.0
+    cm = ChurnModel(join_rate_hz, mean_hold_s, seed=seed,
+                    diurnal=DiurnalProfile(period_s=period, depth=0.2,
+                                           peak_t=t_mid + period / 2.0))
+    drv = np.random.default_rng(seed + 2)
+    next_ssrc = 0x10000
+    alive: list = []                       # churned ssrcs not yet left
+    churn_samples: list = []
+    peak_pop = len(bridge._ssrc_of)
+
+    ramp_ticks = int(round(ramp_s / dt))
+    window_ticks = int(round(duration_s / dt))
+    settle_ticks = int(round(settle_s / dt))
+    w0 = {}                                # counters at window start
+    for t in range(ramp_ticks + window_ticks + settle_ticks):
+        in_window = ramp_ticks <= t < ramp_ticks + window_ticks
+        in_settle = t >= ramp_ticks + window_ticks
+        if t == ramp_ticks:
+            w0 = dict(recompiles=lc.datapath_recompiles,
+                      admits=lc.admits, evicts=lc.evicts,
+                      joins=cm.joins_offered, leaves=cm.leaves_offered)
+        speaking = spurt.advance(dt)
+        if t % 2 == 0:
+            for i, p in enumerate(plist):
+                if speaking[i]:
+                    p.send_media(2)
+        if not in_settle:
+            joins, leaves = cm.step(dt, now, len(alive))
+            for _ in range(joins):
+                ssrc = next_ssrc
+                next_ssrc += 1
+                ok_j, _why = lc.request_join(
+                    ssrc, _keys(ssrc & 0xFF), _keys((ssrc + 2) & 0xFF))
+                if ok_j:
+                    alive.append(ssrc)
+            if leaves and alive:
+                committed = set(bridge._ssrc_of.values())
+                pool = [s for s in alive if s in committed]
+                drv.shuffle(pool)
+                for ssrc in pool[:leaves]:
+                    lc.request_leave(ssrc=ssrc)
+                    alive.remove(ssrc)
+        sup.tick(now=now)
+        for p in plist:
+            p.drain(0.0 if in_settle else drop_rate)
+        if t % 2 == 1:
+            for p in plist:
+                p.nack_round(plist)
+        if in_window and t % 2 == 0:
+            churn_samples.append(
+                _timed_protect(bridge.tx_table, meas_sid, meas_seq))
+            meas_seq += 64
+        peak_pop = max(peak_pop, len(bridge._ssrc_of))
+        now += dt
+
+    # ---- force at least one typed rejection (a duplicate join)
+    dup_ok, dup_reason = lc.request_join(plist[0].ssrc,
+                                         plist[0].rx_key,
+                                         plist[0].tx_key)
+    assert not dup_ok and dup_reason == "duplicate", dup_reason
+
+    # ---- accounting
+    p99_churn = float(np.percentile(churn_samples, 99))
+    expected = 0
+    missing = 0
+    missing_pairs = []
+    for p in plist:
+        for other in plist:
+            if other is p:
+                continue
+            lo, hi = floor[other.ssrc], other.seq
+            expected += hi - lo
+            for s in range(lo, hi):
+                if (other.ssrc, s) not in p.got:
+                    missing += 1
+                    missing_pairs.append(
+                        (hex(p.ssrc), hex(other.ssrc), s, hi))
+    residual = missing / expected if expected else 0.0
+
+    window_admits = lc.admits - w0["admits"]
+    window_evicts = lc.evicts - w0["evicts"]
+    events_per_sec = (window_admits + window_evicts) / duration_s
+    window_recompiles = lc.datapath_recompiles - w0["recompiles"]
+
+    scrape = reg.render()
+    flight_kinds = {e.get("kind")
+                    for e in sup.flight.dump_all()["global"]}
+    typed_in_scrape = "_admit_rejected{reason=" in scrape
+    n_dev = jax.device_count()
+
+    report = {
+        "model_time_s": round(ramp_s + duration_s + settle_s, 3),
+        "window_s": duration_s,
+        "wall_s": round(time.perf_counter() - t0_wall, 3),
+        "devices": n_dev,
+        "capacity_rows": capacity,
+        "peak_population": int(peak_pop),
+        "peak_population_per_chip": round(peak_pop / n_dev, 1),
+        "window_admits": window_admits,
+        "window_evicts": window_evicts,
+        "events_per_sec": round(events_per_sec, 1),
+        "events_per_sec_per_chip": round(events_per_sec / n_dev, 1),
+        "joins_offered": cm.joins_offered,
+        "leaves_offered": cm.leaves_offered,
+        "admit_rejected": dict(lc.admit_rejected),
+        "key_installs": lc.key_installs,
+        "warm_bucket": lc._warm_bucket,
+        "priming_recompiles": w0["recompiles"],
+        "window_recompiles": window_recompiles,
+        "protect_p99_static_ms": round(p99_static * 1e3, 3),
+        "protect_p99_churn_ms": round(p99_churn * 1e3, 3),
+        "probe_expected": expected,
+        "probe_wire_drops": sum(p.wire_drops for p in plist),
+        "probe_missing": missing,
+        "probe_missing_pairs": missing_pairs[:8],
+        "rtx_served": bridge.recovery.rtx_requests_served,
+        "rtx_cache_miss": bridge.recovery.rtx_cache_miss,
+        "retransmitted": bridge.retransmitted,
+        "residual_loss_ratio": round(residual, 5),
+        # ---- invariants
+        "ok_zero_datapath_recompiles": window_recompiles == 0,
+        "ok_protect_p99_bounded":
+            p99_churn <= p99_factor_bound * p99_static,
+        "ok_residual_loss": residual <= residual_bound,
+        "ok_churn_rate": events_per_sec >= target_events_per_sec,
+        "ok_typed_reasons": (bool(lc.admit_rejected)
+                             and typed_in_scrape
+                             and "admit_reject" in flight_kinds),
+        "ok_media_flowed": expected > 0 and len(plist[0].got) > 0,
+    }
+    for p in plist:
+        p.close()
+    bridge.close()
+    libjitsi_tpu.stop()
+    if verbose:
+        print("---- churn soak report ----")
+        for k, v in report.items():
+            print(f"{k:32s} {v}")
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    return report
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--duration", type=float, default=30.0,
+                    help="measured churn window, model seconds")
+    ap.add_argument("--ramp", type=float, default=6.0,
+                    help="ramp to steady state before the window")
+    ap.add_argument("--join-rate", type=float, default=300.0)
+    ap.add_argument("--hold", type=float, default=0.6,
+                    help="mean stream hold time, seconds")
+    ap.add_argument("--capacity", type=int, default=1024)
+    ap.add_argument("--probes", type=int, default=3)
+    ap.add_argument("--drop", type=float, default=0.05,
+                    help="simulated probe downlink loss rate")
+    ap.add_argument("--target-events", type=float, default=500.0,
+                    help="required sustained joins+leaves per second")
+    ap.add_argument("--residual-bound", type=float, default=0.01)
+    ap.add_argument("--p99-factor", type=float, default=2.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--report", type=str, default=None,
+                    help="write the JSON report here")
+    ap.add_argument("--smoke", action="store_true",
+                    help="fast tier-1 configuration (~3 s model time)")
+    args = ap.parse_args()
+    kw = dict(duration_s=args.duration, ramp_s=args.ramp,
+              join_rate_hz=args.join_rate, mean_hold_s=args.hold,
+              capacity=args.capacity, probes=args.probes,
+              drop_rate=args.drop,
+              target_events_per_sec=args.target_events,
+              residual_bound=args.residual_bound,
+              p99_factor_bound=args.p99_factor, seed=args.seed,
+              report_path=args.report)
+    if args.smoke:
+        kw.update(duration_s=2.0, ramp_s=1.0, join_rate_hz=60.0,
+                  mean_hold_s=0.5, capacity=128, probes=2,
+                  target_events_per_sec=100.0)
+    report = run_soak(**kw)
+    failed = [k for k, v in report.items()
+              if k.startswith("ok_") and not v]
+    if failed:
+        print(f"INVARIANT FAILURES: {failed}", file=sys.stderr)
+        return 1
+    print("all churn invariants held")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

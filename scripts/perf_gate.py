@@ -213,12 +213,69 @@ def _scenario_install_streams():
     return floor_check(n / net, net)
 
 
+def _scenario_churn_admit():
+    """Lifecycle churn plane: admits + evicts per second through the
+    staged off-tick pipeline (request_join -> stage -> commit barrier
+    -> request_leave -> slot recycle), supervisor ticks included.
+    First pass warms the bucket (table/fan-out/RTCP pre-compiles);
+    the second, all-warm pass is the measured one.  Returns lifecycle
+    events/sec."""
+    import libjitsi_tpu
+    from libjitsi_tpu.service.lifecycle import StreamLifecycleManager
+    from libjitsi_tpu.service.sfu_bridge import SfuBridge
+    from libjitsi_tpu.service.supervisor import (BridgeSupervisor,
+                                                 SupervisorConfig)
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    n = 128
+    bridge = SfuBridge(libjitsi_tpu.configuration_service(), port=0,
+                       capacity=256, recv_window_ms=0)
+    sup = BridgeSupervisor(bridge, SupervisorConfig(deadline_ms=1000.0),
+                           metrics=bridge.loop.metrics)
+    lc = StreamLifecycleManager(bridge, supervisor=sup,
+                                metrics=bridge.loop.metrics)
+    now = [100.0]
+
+    def settle(pred):
+        deadline = time.perf_counter() + 300.0
+        while not pred() and time.perf_counter() < deadline:
+            sup.tick(now=now[0])
+            now[0] += 0.02
+        assert pred(), "lifecycle settle timed out"
+
+    def churn_pass(base):
+        a0, e0 = lc.admits, lc.evicts
+        for k in range(n):
+            ok, why = lc.request_join(
+                base + k, (bytes([k & 0xFF]) * 16,
+                           bytes([(k + 1) & 0xFF]) * 14),
+                (bytes([(k + 2) & 0xFF]) * 16,
+                 bytes([(k + 3) & 0xFF]) * 14))
+            assert ok, why
+        settle(lambda: lc.admits - a0 >= n)
+        for k in range(n):
+            lc.request_leave(ssrc=base + k)
+        settle(lambda: lc.evicts - e0 >= n)
+
+    try:
+        churn_pass(0x10000)             # warmup: bucket + jit compiles
+        t0 = time.perf_counter()
+        churn_pass(0x20000)             # measured, all-warm
+        net = time.perf_counter() - t0
+    finally:
+        bridge.close()
+        libjitsi_tpu.stop()
+    return floor_check(2 * n / net, net)
+
+
 #: pinned scenario ids — the jitlint `drift` checker cross-checks this
 #: mapping against PERF_BASELINE.json keys (stale/missing entries)
 SCENARIOS = {
     "loop_echo_pps": _scenario_loop_echo,
     "protect_small_pps": _scenario_protect_small,
     "install_streams_per_sec": _scenario_install_streams,
+    "churn_admit_per_sec": _scenario_churn_admit,
 }
 
 

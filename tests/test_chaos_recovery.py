@@ -28,6 +28,8 @@ from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.io import UdpEngine
 from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.service.bridge import ConferenceBridge
+from libjitsi_tpu.service.lifecycle import StreamLifecycleManager
+from libjitsi_tpu.service.sfu_bridge import SfuBridge
 from libjitsi_tpu.service.supervisor import (BridgeSupervisor,
                                              SupervisorConfig)
 from libjitsi_tpu.transform.srtp import SrtpStreamTable
@@ -279,3 +281,90 @@ def test_quarantine_isolates_auth_storm_then_readmits():
     for e in (eng0, eng1, atk):
         e.close()
     bridge.close()
+
+
+def test_recover_with_half_installed_streams_completes_or_rolls_back(
+        tmp_path):
+    """Kill mid-admit: the checkpoint lands while one join is STAGED
+    (keys installed, commit barrier not yet crossed) and another is
+    still QUEUED host-side.  After `recover()` the next lifecycle
+    manager reconciles every in-flight admit to a whole state: staged
+    rows whose keys survived COMPLETE (fully routed — media decodes),
+    staged rows whose keys were torn ROLL BACK (fully absent, slot
+    freed), queued joins re-enter the normal pipeline.  Never a half
+    state."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    bridge = SfuBridge(cfg, port=0, capacity=8, recv_window_ms=0)
+    sup = BridgeSupervisor(bridge, SupervisorConfig(
+        deadline_ms=1000.0, quarantine_auth_threshold=1 << 30,
+        quarantine_replay_threshold=1 << 30))
+    lc = StreamLifecycleManager(bridge, supervisor=sup)
+    # bucketed warmups are the churn soak's subject; skip them here so
+    # the test pins reconcile semantics without minutes of pre-compiles
+    lc._warm_bucket = 1 << 30
+    for ssrc in (0x60, 0x70):                   # committed audience
+        assert lc.request_join(ssrc, *_keys(ssrc))[0]
+    sup.tick(now=100.0)                         # stage
+    sup.tick(now=100.02)                        # commit
+    assert lc.admits == 2
+    # two admits in flight at the crash: both staged, neither committed
+    assert lc.request_join(0x80, *_keys(0x80))[0]
+    assert lc.request_join(0x84, *_keys(0x84))[0]
+    lc.poll()                                   # stage only, NO commit
+    assert len(lc._staged) == 2 and lc.admits == 2
+    sid80 = next(s for s, v in bridge._ssrc_of.items() if v == 0x80)
+    sid84 = next(s for s, v in bridge._ssrc_of.items() if v == 0x84)
+    # a third join is still queued host-side
+    assert lc.request_join(0x90, *_keys(0x90))[0]
+    ckpt = str(tmp_path / "half.ckpt")
+    sup.save_checkpoint(ckpt)
+    bridge.close()                              # the crash
+
+    sup2 = BridgeSupervisor.recover(cfg, ckpt, SfuBridge, port=0,
+                                    supervisor_config=sup.cfg,
+                                    recv_window_ms=0)
+    bridge2 = sup2.bridge
+    # simulate a torn install for ONE staged row (as if the checkpoint
+    # raced the key write): reconcile must treat it as unrecoverable
+    bridge2._tx_keys.pop(sid84)
+    assert sup2.pending_lifecycle is not None
+    lc2 = StreamLifecycleManager(bridge2, supervisor=sup2)
+    lc2._warm_bucket = 1 << 30
+    assert sup2.pending_lifecycle is None       # consumed
+
+    # survivor COMPLETED: counted, routed, flagged recovered
+    assert lc2.admits == 1
+    assert 0x80 in bridge2._ssrc_of.values()
+    assert any(e["kind"] == "admit_commit" and e.get("recovered")
+               for e in sup2.flight.dump(sid80)["events"])
+    # torn row ROLLED BACK: fully absent, nothing half-installed
+    assert 0x84 not in bridge2._ssrc_of.values()
+    assert sid84 not in bridge2._tx_keys
+    assert not bridge2.rx_table.active[sid84]
+    assert any(e["kind"] == "admit_rollback"
+               for e in sup2.flight.dump(sid84)["events"])
+    # queued join re-entered the pipeline and installs normally
+    sup2.tick(now=100.04)                       # stage 0x90
+    sup2.tick(now=100.06)                       # commit 0x90
+    assert lc2.admits == 2 and 0x90 in bridge2._ssrc_of.values()
+    # whole-state invariant across every row the crash touched
+    for sid in range(bridge2.capacity):
+        assert ((sid in bridge2._ssrc_of) == (sid in bridge2._tx_keys)
+                == bool(bridge2.rx_table.active[sid]))
+
+    # the completed admit is not just bookkeeping: its media decodes
+    rx80, _tx80 = _keys(0x80)
+    prot = SrtpStreamTable(capacity=1)
+    prot.add_stream(0, *rx80)
+    b = rtp_header.build([bytes(160)], [100], [16000], [0x80], [0],
+                         stream=[0])
+    eng = UdpEngine(port=0, max_batch=8)
+    eng.send_batch(prot.protect_rtp(b), "127.0.0.1", bridge2.port)
+    _pump(sup2, 100.08, 1)
+    sup2.tick(now=100.10)
+    eng.close()
+    assert int(bridge2.rx_table.rx_max[sid80]) >= 0, \
+        "recovered staged stream's media did not decode"
+    bridge2.close()

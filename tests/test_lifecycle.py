@@ -1,0 +1,470 @@
+"""StreamLifecycleManager unit tests: the admission state machine
+(queue -> stage -> commit), every typed rejection reason, evict
+bookkeeping vs overload shedding, bucketed warmup cadence, the
+tick-bracket compile guard, and checkpoint reconciliation — all
+against a host-only dummy bridge (no sockets, no device).  The e2e
+staged-install recovery proof lives in tests/test_chaos_recovery.py
+and the full churn soak in scripts/churn_soak.py (slow twin below).
+"""
+
+import importlib.util
+import os
+import types
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.service.lifecycle import (ADMIT_REASONS,
+                                            LifecycleConfig,
+                                            StreamLifecycleManager)
+from libjitsi_tpu.service.supervisor import (BridgeSupervisor,
+                                             SupervisorConfig)
+from libjitsi_tpu.utils.metrics import MetricsRegistry
+
+_SOAK = os.path.join(os.path.dirname(__file__), os.pardir,
+                     "scripts", "churn_soak.py")
+
+
+class WarmTable:
+    """Records warmup calls: the lifecycle plane's pre-compile cadence
+    is observable as the exact (row_class) sequence it warms."""
+
+    def __init__(self):
+        self.rtp_warms = []
+        self.rtcp_warms = []
+        self.active = np.zeros(64, dtype=bool)
+
+    def warmup_rtp(self, rows, payload_len=160):
+        self.rtp_warms.append(rows)
+
+    def warmup_rtcp(self, batch_size=1):
+        self.rtcp_warms.append(batch_size)
+
+
+class LcBridge:
+    """Host-side stand-in implementing exactly the surface the manager
+    drives: slot registry, stage/commit/remove, warmable tables."""
+
+    def __init__(self, capacity=8):
+        self.capacity = capacity
+        self._free = list(range(capacity))
+        self._ssrc_of = {}
+        self._tx_keys = {}
+        self._staged = set()
+        self.rx_table = WarmTable()
+        self.tx_table = WarmTable()
+        self.calls = []
+        bridge = self
+
+        class _Reg:
+            @property
+            def free_slots(self):
+                return len(bridge._free)
+
+        self.registry = _Reg()
+
+    def stage_endpoints(self, specs):
+        sids = []
+        for ssrc, rx, tx, _name in specs:
+            sid = self._free.pop(0)
+            self._ssrc_of[sid] = ssrc
+            self._tx_keys[sid] = tuple(tx)
+            self._staged.add(sid)
+            sids.append(sid)
+        self.calls.append(("stage", tuple(sids)))
+        return sids
+
+    def commit_endpoints(self, sids):
+        for sid in sids:
+            self._staged.discard(int(sid))
+        self.calls.append(("commit", tuple(int(s) for s in sids)))
+
+    def remove_endpoints(self, sids):
+        for sid in sids:
+            sid = int(sid)
+            self._ssrc_of.pop(sid, None)
+            self._tx_keys.pop(sid, None)
+            self._staged.discard(sid)
+            self._free.append(sid)
+        self.calls.append(("remove", tuple(int(s) for s in sids)))
+
+
+def _keys(b):
+    return (bytes([b]) * 16, bytes([b + 1]) * 14)
+
+
+def _lc(capacity=8, supervisor=None, **cfg):
+    bridge = LcBridge(capacity=capacity)
+    lc = StreamLifecycleManager(bridge, supervisor=supervisor,
+                                config=LifecycleConfig(**cfg))
+    return lc, bridge
+
+
+def _all_events(flight):
+    """Flatten global + per-stream rings, in record order (sid-keyed
+    events route to per-stream rings; `seq` restores the interleave)."""
+    d = flight.dump_all()
+    evs = list(d["global"])
+    for ring in d["streams"].values():
+        evs.extend(ring)
+    return sorted(evs, key=lambda e: e["seq"])
+
+
+def _global_kinds(lc):
+    return [e["kind"] for e in lc.flight.dump_all()["global"]]
+
+
+# ---------------------------------------------------- admit pipeline
+
+def test_join_queues_then_stages_then_commits_off_tick():
+    lc, bridge = _lc()
+    ok, why = lc.request_join(0x10, _keys(2), _keys(4))
+    assert (ok, why) == (True, "queued")
+    # nothing touched the bridge yet — admission is pure bookkeeping
+    assert not bridge.calls and lc.admits == 0
+    # barrier 1: commit (nothing staged) then stage the install wave
+    lc.run_between_ticks()
+    assert bridge.calls == [("stage", (0,))]
+    assert lc.key_installs == 1 and lc.admits == 0
+    assert 0 in bridge._staged            # staged, not yet live
+    # barrier 2: the staged batch flips live atomically
+    lc.run_between_ticks()
+    assert bridge.calls[1] == ("commit", (0,))
+    assert lc.admits == 1 and 0 not in bridge._staged
+    kinds = [e["kind"] for e in _all_events(lc.flight)]
+    assert kinds.index("admit_queued") < kinds.index("key_install") \
+        < kinds.index("admit_commit")
+
+
+def test_install_wave_is_batch_limited():
+    lc, bridge = _lc(capacity=8, install_batch=2)
+    for i in range(5):
+        assert lc.request_join(0x100 + i, _keys(2), _keys(4))[0]
+    lc.run_between_ticks()
+    assert len(bridge._staged) == 2       # install_batch, not all 5
+    assert lc.key_installs_pending == 5   # 3 queued + 2 staged
+    lc.run_between_ticks()                # commit 2, stage next 2
+    assert lc.admits == 2 and len(bridge._staged) == 2
+    lc.run_between_ticks()
+    lc.run_between_ticks()
+    assert lc.admits == 5 and lc.key_installs_pending == 0
+
+
+def test_leave_cancels_queued_join_without_touching_bridge():
+    lc, bridge = _lc()
+    lc.request_join(0x42, _keys(2), _keys(4))
+    assert lc.request_leave(ssrc=0x42)
+    lc.run_between_ticks()
+    assert not bridge.calls and lc.admits == 0 and lc.evicts == 0
+    assert "admit_cancelled" in _global_kinds(lc)
+    # unknown ssrc: nothing to cancel or evict
+    assert not lc.request_leave(ssrc=0xDEAD)
+
+
+def test_live_evict_lands_at_the_barrier_and_recycles_the_slot():
+    lc, bridge = _lc(capacity=2)
+    lc.request_join(0x21, _keys(2), _keys(4))
+    lc.request_join(0x22, _keys(6), _keys(8))
+    lc.run_between_ticks()
+    lc.run_between_ticks()
+    assert lc.admits == 2 and bridge.registry.free_slots == 0
+    assert lc.request_leave(ssrc=0x21)
+    # queued evict frees nothing until the barrier
+    assert bridge.registry.free_slots == 0
+    lc.run_between_ticks()
+    assert lc.evicts == 1 and bridge.registry.free_slots == 1
+    assert ("remove", (0,)) in bridge.calls
+    # duplicate evict requests de-dup; departed sid is simply gone
+    lc.request_leave(sid=0)
+    lc.request_leave(sid=0)
+    lc.run_between_ticks()
+    assert lc.evicts == 1
+    # the freed slot admits a NEW stream
+    assert lc.request_join(0x23, _keys(10), _keys(12))[0]
+    lc.run_between_ticks()
+    lc.run_between_ticks()
+    assert lc.admits == 3 and 0x23 in bridge._ssrc_of.values()
+
+
+# ------------------------------------------------- typed rejections
+
+def test_host_side_rejections_are_typed_and_counted():
+    lc, bridge = _lc(capacity=2, max_pending=8)
+    assert lc.request_join(0x31, _keys(2), _keys(4))[0]
+    # duplicate: already queued
+    assert lc.request_join(0x31, _keys(2), _keys(4)) \
+        == (False, "duplicate")
+    # capacity: queued joins have slots spoken for (2 slots, 1 queued,
+    # next join fits; the one after does not)
+    assert lc.request_join(0x32, _keys(6), _keys(8))[0]
+    assert lc.request_join(0x33, _keys(10), _keys(12)) \
+        == (False, "capacity")
+    lc.run_between_ticks()
+    lc.run_between_ticks()
+    # duplicate: already live
+    assert lc.request_join(0x31, _keys(2), _keys(4)) \
+        == (False, "duplicate")
+    assert lc.admit_rejected == {"duplicate": 2, "capacity": 1}
+    rejects = [e for e in lc.flight.dump_all()["global"]
+               if e["kind"] == "admit_reject"]
+    assert [e["reason"] for e in rejects] \
+        == ["duplicate", "capacity", "duplicate"]
+    assert all(e["reason"] in ADMIT_REASONS for e in rejects)
+
+
+def test_backlog_rejection_bounds_the_queue():
+    lc, _bridge = _lc(capacity=8, max_pending=3)
+    for i in range(3):
+        assert lc.request_join(0x50 + i, _keys(2), _keys(4))[0]
+    assert lc.request_join(0x60, _keys(2), _keys(4)) \
+        == (False, "backlog")
+    assert lc.admit_rejected == {"backlog": 1}
+
+
+def test_supervisor_burn_reasons_pass_through():
+    for reason in ("fast_burn", "stalled", "shedding", "host_bound"):
+        sup = types.SimpleNamespace(
+            ticks=7, flight=None, pending_lifecycle=None,
+            admission_decision=lambda r=reason: (False, r))
+        lc, _bridge = _lc()
+        lc.supervisor = sup        # attach after init: flight stays own
+        assert lc.request_join(0x70, _keys(2), _keys(4)) \
+            == (False, reason)
+        assert lc.admit_rejected == {reason: 1}
+        assert reason in ADMIT_REASONS
+        (ev,) = [e for e in lc.flight.dump_all()["global"]
+                 if e["kind"] == "admit_reject"]
+        assert ev["tick"] == 7 and ev["reason"] == reason
+
+
+def test_rejections_render_as_typed_metric_labels():
+    reg = MetricsRegistry()
+    bridge = LcBridge(capacity=1)
+    lc = StreamLifecycleManager(bridge, config=LifecycleConfig(),
+                                metrics=reg)
+    lc.request_join(0x10, _keys(2), _keys(4))
+    lc.request_join(0x10, _keys(2), _keys(4))     # duplicate
+    lc.request_join(0x11, _keys(2), _keys(4))     # capacity
+    txt = reg.render()
+    assert ('libjitsi_tpu_lifecycle_admit_rejected'
+            '{reason="duplicate"} 1') in txt
+    assert ('libjitsi_tpu_lifecycle_admit_rejected'
+            '{reason="capacity"} 1') in txt
+    assert "# TYPE libjitsi_tpu_lifecycle_admits counter" in txt
+
+
+# ------------------------------------------------- bucketed warmup
+
+def test_warmups_fire_only_at_bucket_boundaries():
+    lc, bridge = _lc(capacity=64, min_bucket=4, pkts_per_stream=4,
+                     install_batch=64, max_pending=512)
+    lc.request_join(0x80, _keys(2), _keys(4))
+    lc.run_between_ticks()
+    # bucket 4 -> aggregate estimate 16 rows -> one class of headroom
+    # covers 64; both tables warm RTP and RTCP for each class
+    assert bridge.rx_table.rtp_warms == [16, 64]
+    assert bridge.tx_table.rtp_warms == [16, 64]
+    assert bridge.rx_table.rtcp_warms == [16, 64]
+    # admits WITHIN the bucket compile nothing new
+    for i in range(3):
+        lc.request_join(0x81 + i, _keys(2), _keys(4))
+    lc.run_between_ticks()
+    assert bridge.rx_table.rtp_warms == [16, 64]
+    # crossing the boundary warms only the NEW classes, off-tick
+    for i in range(10):
+        lc.request_join(0x90 + i, _keys(2), _keys(4))
+    lc.run_between_ticks()
+    assert bridge.rx_table.rtp_warms == [16, 64, 256]
+    assert lc._warm_bucket == 16
+
+
+# -------------------------------------------- tick compile bracket
+
+def test_tick_bracket_counts_in_window_compiles(monkeypatch):
+    from libjitsi_tpu.service import lifecycle as lc_mod
+    events = {"n": 0}
+    monkeypatch.setattr(
+        lc_mod, "compile_stats",
+        lambda: types.SimpleNamespace(compile_events=events["n"]))
+    lc, _bridge = _lc()
+    lc.tick_begin()
+    lc.tick_end()                 # quiet tick: clean
+    assert lc.datapath_recompiles == 0
+    lc.assert_datapath_clean()
+    lc.tick_begin()
+    events["n"] += 3              # a compile landed INSIDE the tick
+    lc.tick_end()
+    assert lc.datapath_recompiles == 3
+    assert "datapath_recompile" in _global_kinds(lc)
+    with pytest.raises(AssertionError, match="3 compile event"):
+        lc.assert_datapath_clean()
+    # compiles between brackets (off-tick) never count
+    events["n"] += 5
+    lc.tick_begin()
+    lc.tick_end()
+    assert lc.datapath_recompiles == 3
+
+
+# ------------------------------------------ shed vs evict separation
+
+class DummyLoop:
+    def __init__(self, cap):
+        self.registry = types.SimpleNamespace(capacity=cap)
+        self.recv_window_ms = 1
+        self.inbound_drop = np.zeros(cap, dtype=bool)
+        self.inbound_dropped = np.zeros(cap, dtype=np.int64)
+        self.inbound_dropped_total = 0
+
+
+class DummyBridge:
+    def __init__(self, cap=8, sids=(0, 1, 2, 3)):
+        self.loop = DummyLoop(cap)
+        self.degraded = False
+        self._ssrc_of = {s: 100 + s for s in sids}
+        self.rx_table = types.SimpleNamespace(
+            auth_fail=np.zeros(cap, dtype=np.int64),
+            replay_reject=np.zeros(cap, dtype=np.int64))
+        self.speaker = types.SimpleNamespace(dominant=0)
+
+    def tick(self, now=None):
+        return {"rx": 0}
+
+
+class FakeClock:
+    def __init__(self, durations):
+        self.durations = list(durations)
+        self.t = 0.0
+        self.half = False
+
+    def __call__(self):
+        if self.half:
+            self.t += self.durations.pop(0) if self.durations else 0.0
+        self.half = not self.half
+        return self.t
+
+
+def test_lifo_unwind_never_resurrects_an_evicted_stream():
+    # drive the ladder until streams shed, evict one of them via the
+    # lifecycle path, then recover: the LIFO unwind must restore the
+    # OTHER shed streams and skip the departed one
+    sup = BridgeSupervisor(
+        DummyBridge(), SupervisorConfig(deadline_ms=10.0,
+                                        overload_after=1, shed_step=2,
+                                        overload_exit=1),
+        clock=FakeClock([0.05] * 7 + [0.001] * 30))
+    for _ in range(7):
+        sup.tick()
+    shed = list(sup._shed)
+    assert len(shed) >= 2
+    gone = shed[-1]
+    sup.note_evicted([gone])
+    assert gone not in sup._shed_set      # membership cleared at once
+    assert gone in sup._evicted
+    assert sup.health()["evicted"] == 1
+    for _ in range(30):
+        sup.tick()
+    assert sup.level == 0 and not sup._shed
+    restored = [e["sid"] for e in _all_events(sup.flight)
+                if e["kind"] == "shed_restore"]
+    assert gone not in restored
+    assert set(restored) == set(shed) - {gone}
+    # flight keeps the two mortalities distinct
+    kinds_gone = [e["kind"] for e in sup.flight.dump(gone)["events"]]
+    assert "evicted" in kinds_gone and "shed" in kinds_gone
+    # a NEW stream admitted into the recycled row is shed-eligible again
+    sup.note_admitted([gone])
+    assert gone not in sup._evicted and sup.health()["evicted"] == 0
+
+
+def test_eviction_clears_quarantine_and_strike_history():
+    cfg = SupervisorConfig(deadline_ms=1000.0, quarantine_window=5,
+                           quarantine_auth_threshold=10,
+                           quarantine_backoff_ticks=4)
+    bridge = DummyBridge()
+    sup = BridgeSupervisor(bridge, cfg)
+    for _ in range(3):
+        bridge.rx_table.auth_fail[2] += 4
+        sup.tick(now=0.0)
+    assert 2 in sup._quarantined
+    sup.note_evicted([2])
+    # the departed stream's ban and strike history die with it: the
+    # row's next occupant starts with a clean record
+    assert 2 not in sup._quarantined and 2 not in sup._q_strikes
+    assert not bridge.loop.inbound_drop[2]
+
+
+def test_admission_decision_reflects_live_pressure():
+    sup = BridgeSupervisor(DummyBridge(),
+                           SupervisorConfig(deadline_ms=10.0))
+    assert sup.admission_decision() == (True, "ok")
+    sup._shed_set.add(3)
+    assert sup.admission_decision() == (False, "shedding")
+    sup._shed_set.clear()
+    sup.slo = types.SimpleNamespace(state=lambda *a: "fast_burn",
+                                    on_tick=lambda: None)
+    assert sup.admission_decision() == (False, "fast_burn")
+
+
+# --------------------------------------------------- reconciliation
+
+def test_reconcile_completes_surviving_staged_and_rolls_back_rest():
+    lc, bridge = _lc()
+    # survivor: keys + ssrc mapping rode the bridge snapshot
+    bridge._ssrc_of[3] = 0xA1
+    bridge._tx_keys[3] = _keys(4)
+    bridge._free.remove(3)
+    # half state: row mapped but its keys did NOT survive
+    bridge._ssrc_of[5] = 0xA2
+    bridge._free.remove(5)
+    lc._reconcile({
+        "staged": [(3, 0xA1), (5, 0xA2), (6, 0xA3)],
+        "queued": [(0xB1, _keys(2), _keys(4), None)],
+    })
+    # survivor completed
+    assert lc.admits == 1
+    assert any(e["kind"] == "admit_commit" and e.get("recovered")
+               for e in _all_events(lc.flight))
+    # half-installed row rolled back — removed, slot freed
+    assert ("remove", (5,)) in bridge.calls
+    assert 5 not in bridge._ssrc_of and 5 in bridge._free
+    # fully-absent row: rollback recorded, nothing to remove
+    rb = [e for e in _all_events(lc.flight)
+          if e["kind"] == "admit_rollback"]
+    assert sorted(e["sid"] for e in rb) == [5, 6]
+    # queued join re-entered the normal pipeline
+    assert lc.key_installs_pending == 1
+    lc.run_between_ticks()
+    lc.run_between_ticks()
+    assert 0xB1 in bridge._ssrc_of.values() and lc.admits == 2
+    # invariant: nothing is left half-installed
+    for sid in (3, 5, 6):
+        assert (sid in bridge._ssrc_of) == (sid in bridge._tx_keys)
+
+
+def test_constructor_consumes_supervisor_pending_lifecycle():
+    sup = BridgeSupervisor(DummyBridge(sids=()),
+                           SupervisorConfig(deadline_ms=10.0))
+    sup.pending_lifecycle = {
+        "staged": [], "queued": [(0xC1, _keys(2), _keys(4), None)]}
+    bridge = LcBridge()
+    lc = StreamLifecycleManager(bridge, supervisor=sup)
+    assert sup.lifecycle is lc and sup.pending_lifecycle is None
+    assert lc.key_installs_pending == 1
+
+
+# --------------------------------------------------------- slow twin
+
+@pytest.mark.slow
+def test_churn_soak_invariants():
+    spec = importlib.util.spec_from_file_location("churn_soak", _SOAK)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    report = mod.run_soak(duration_s=2.0, ramp_s=1.0, join_rate_hz=60.0,
+                          mean_hold_s=0.5, capacity=128, probes=2,
+                          target_events_per_sec=100.0, seed=0,
+                          verbose=False)
+    failed = {k: v for k, v in report.items()
+              if k.startswith("ok_") and not v}
+    assert not failed, (failed, report)
+    assert report["window_recompiles"] == 0
+    assert report["window_admits"] > 0 and report["window_evicts"] > 0
