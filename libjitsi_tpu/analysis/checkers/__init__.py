@@ -4,6 +4,7 @@ facts: metric registrations vs counter definitions)."""
 
 from libjitsi_tpu.analysis.checkers.drift import (check_snapshot_drift,
                                                   check_metrics_drift)
+from libjitsi_tpu.analysis.checkers.hotalloc import check_hotpath_alloc
 from libjitsi_tpu.analysis.checkers.hotpath import check_hotpath_purity
 from libjitsi_tpu.analysis.checkers.rtpmod16 import check_rtp_mod16
 from libjitsi_tpu.analysis.checkers.secrets import check_secret_taint
@@ -11,6 +12,7 @@ from libjitsi_tpu.analysis.checkers.secrets import check_secret_taint
 #: checker(ctx) -> [Finding]
 PER_FILE_CHECKERS = (
     check_hotpath_purity,
+    check_hotpath_alloc,
     check_secret_taint,
     check_rtp_mod16,
     check_snapshot_drift,
@@ -21,4 +23,5 @@ GLOBAL_CHECKERS = (
     check_metrics_drift,
 )
 
-RULES = ("hotpath-purity", "secret-taint", "rtp-mod16", "drift")
+RULES = ("hotpath-purity", "hotpath-alloc", "secret-taint", "rtp-mod16",
+         "drift")

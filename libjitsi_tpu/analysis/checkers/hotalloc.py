@@ -1,0 +1,88 @@
+"""hotpath-alloc: per-tick bulk allocations in the host I/O modules.
+
+The zero-copy arena work (io/udp.py) exists because `buf[:n].copy()` on
+every recv window was the single largest host cost in the phase ledger:
+a fresh O(batch x capacity) allocation + memcpy per tick, then another
+on egress (`np.ascontiguousarray`) to re-materialize rows the arena
+already held contiguously.  This rule keeps those from creeping back.
+
+Scope: functions in the ``libjitsi_tpu/io/`` modules — the per-tick
+hot path — excluding dunders (constructors allocate by design) and the
+teardown/observability surface.  Flagged forms:
+
+- ``x.copy()`` method calls (ndarray copy),
+- ``np.copy(x)`` / ``numpy.copy(x)``,
+- ``np.ascontiguousarray(x)`` / ``numpy.ascontiguousarray(x)``.
+
+Deliberate copies — the legacy copy-semantics recv API, per-row
+metadata staging for the C ABI — carry ``# jitlint:
+disable=hotpath-alloc`` pragmas stating why the allocation stays.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from libjitsi_tpu.analysis.core import (FileContext, Finding,
+                                        call_func_name, node_name)
+
+RULE = "hotpath-alloc"
+
+#: path fragments marking host-I/O tick modules
+HOT_DIR_FRAGMENT = "/io/"
+
+#: function names exempt from the rule even inside hot modules: one-time
+#: setup/teardown and metrics render paths, not per-tick work
+COLD_FUNCS = {"close", "register_metrics"}
+
+ALLOC_FUNCS = {"copy", "ascontiguousarray"}
+
+
+def _in_hot_module(ctx: FileContext) -> bool:
+    path = ctx.relpath
+    return HOT_DIR_FRAGMENT in path or path.startswith("io/")
+
+
+def _enclosing_function(node: ast.AST) -> Optional[str]:
+    cur = getattr(node, "_jl_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur.name
+        cur = getattr(cur, "_jl_parent", None)
+    return None
+
+
+def check_hotpath_alloc(ctx: FileContext) -> List[Finding]:
+    if not _in_hot_module(ctx):
+        return []
+    out: List[Optional[Finding]] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        msg = None
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "copy" and not node.args:
+            owner = node_name(node.func.value)
+            if owner in ("np", "numpy"):
+                continue                    # np.copy handled below
+            msg = "`.copy()` in a host-I/O tick path allocates per " \
+                  "tick; return an arena view (recv_batch_view) or " \
+                  "gather-send (send_rows) instead"
+        elif call_func_name(node) in ALLOC_FUNCS \
+                and isinstance(node.func, ast.Attribute) \
+                and node_name(node.func.value) in ("np", "numpy"):
+            msg = (f"`np.{node.func.attr}` in a host-I/O tick path "
+                   "re-materializes a contiguous copy per tick; keep "
+                   "rows contiguous at the source or use the native "
+                   "gather path")
+        if msg is None:
+            continue
+        fn = _enclosing_function(node)
+        if fn is None:                       # module level: import-time
+            continue
+        if fn in COLD_FUNCS or (fn.startswith("__")
+                                and fn.endswith("__")):
+            continue
+        out.append(ctx.finding(RULE, node, msg))
+    return [f for f in out if f is not None]

@@ -1,0 +1,115 @@
+"""Adaptive per-tick batching: the recv window and the batch-size cap
+become LIVE knobs, retuned every tick from two signals the runtime
+already produces:
+
+- **backlog** — the recv window filled to the current cap, i.e. the
+  socket queue is deeper than one window's worth.  Waiting is pure
+  added latency at that point: the window drops to poll mode (0 ms) and
+  the cap opens to the arena width so each syscall drains the most.
+- **SLO burn state** (utils/slo.py) — `fast_burn` is a latency
+  emergency: the window drops AND the cap halves, trading syscall
+  efficiency for shorter per-batch journeys (smaller batches leave the
+  device sooner).  `slow_burn` holds the cap and halves the window.
+
+Recovery is deliberately asymmetric (AIMD, same reasoning as congestion
+control): pressure moves the knobs multiplicatively, calm ticks walk
+them back additively toward the configured baseline, so a single calm
+tick inside a storm can't re-widen the window it just escaped.
+
+Ladder coordination: the supervisor's `recv_window` rung owns the
+window while held — `clamp_window(True)` freezes this tuner's window
+writes (the cap stays adaptive) until the rung unwinds.  Without the
+clamp the two controllers would fight over `loop.recv_window_ms`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class AdaptiveBatcher:
+    """Retunes `loop.recv_window_ms` and `engine.max_batch` each tick.
+
+    Attach to a `BridgeSupervisor` (``sup.batcher = AdaptiveBatcher(...)``)
+    to be ticked on the supervisor cadence and clamped by its ladder, or
+    call `on_tick()` manually after each `loop.tick()`.
+    """
+
+    def __init__(self, loop, slo=None, min_batch: int = 8):
+        self.loop = loop
+        self.engine = loop.engine
+        self.slo = slo
+        self.base_window_ms = loop.recv_window_ms
+        base = int(getattr(self.engine, "max_batch", 0) or 0)
+        self.base_batch = base
+        self.min_batch = max(1, min(int(min_batch), base) if base
+                             else int(min_batch))
+        self.window_clamped = False
+        self._prev_rx = int(loop.rx_packets)
+        # observability: how often each pressure source moved a knob
+        self.backlog_polls = 0
+        self.burn_shrinks = 0
+        self.recoveries = 0
+
+    # ---------------------------------------------------------- signals
+    def clamp_window(self, clamped: bool) -> None:
+        """Ladder handoff: while the supervisor's recv_window rung is
+        held, the window belongs to the ladder — stop writing it."""
+        self.window_clamped = bool(clamped)
+
+    def _state(self) -> str:
+        return self.slo.state() if self.slo is not None else "ok"
+
+    # ------------------------------------------------------------- tick
+    def on_tick(self) -> None:
+        n = int(self.loop.rx_packets) - self._prev_rx
+        self._prev_rx = int(self.loop.rx_packets)
+        cur = int(getattr(self.engine, "max_batch", 0) or 0)
+        if cur <= 0:
+            return                       # engine without a batch cap
+        state = self._state()
+        saturated = n >= cur
+        if state == "fast_burn":
+            # latency emergency: smaller batches finish sooner
+            batch = max(self.min_batch, cur // 2)
+            window: Optional[float] = 0
+            self.burn_shrinks += 1
+        elif saturated:
+            # backlog: the queue outruns the window — stop waiting,
+            # drain at full width
+            batch = self.base_batch
+            window = 0
+            self.backlog_polls += 1
+        elif state == "slow_burn":
+            batch = cur
+            window = (self.base_window_ms / 2
+                      if self.base_window_ms else 0)
+        else:
+            # calm: additive recovery toward the configured baseline
+            step = max(1, self.base_batch // 8)
+            batch = min(self.base_batch, cur + step)
+            window = self.base_window_ms
+            if batch != cur or self.loop.recv_window_ms != window:
+                self.recoveries += 1
+        self.engine.max_batch = batch
+        if window is not None and not self.window_clamped:
+            self.loop.recv_window_ms = window
+
+    # ---------------------------------------------------- observability
+    def register_metrics(self, registry, prefix: str = "batcher") -> None:
+        registry.register_scalar(
+            f"{prefix}_batch_cap",
+            lambda: int(getattr(self.engine, "max_batch", 0) or 0),
+            help_="current adaptive recv batch cap")
+        registry.register_scalar(
+            f"{prefix}_recv_window_ms",
+            lambda: float(self.loop.recv_window_ms),
+            help_="current adaptive recv window")
+        registry.register_scalar(
+            f"{prefix}_backlog_polls", lambda: self.backlog_polls,
+            help_="ticks the backlog signal forced poll mode",
+            kind="counter")
+        registry.register_scalar(
+            f"{prefix}_burn_shrinks", lambda: self.burn_shrinks,
+            help_="ticks SLO fast-burn shrank the batch cap",
+            kind="counter")

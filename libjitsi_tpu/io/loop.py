@@ -68,20 +68,35 @@ class MediaLoop:
                  metrics: Optional[MetricsRegistry] = None,
                  recv_window_ms: int = 1,
                  pipelined: bool = False,
+                 pipeline_depth: int = 1,
                  tracer: Optional[PipelineTracer] = None,
                  flight: Optional[FlightRecorder] = None,
                  phase_sample_every: int = 16):
         self.engine = engine
         self.registry = registry
         self.chain = chain
+        # pipeline_depth: how many ticks' reverse-chain work may be in
+        # flight at once.  1 = the classic serial tick (recv → decrypt →
+        # reply, all within one tick).  Depth D>1 deep-pipelines the
+        # receive path: tick N's auth/decrypt is DISPATCHED only and
+        # materializes D-1 ticks later, so the device round trip of
+        # tick N overlaps the recv windows of ticks N+1..N+D-1 — and
+        # ingress lands in zero-copy recv-arena views (io/udp.py) that
+        # stay pinned until materialization.  `drain()` is the barrier
+        # that collapses the pipeline for checkpoint / lifecycle commit
+        # points.  Depth implies pipelined replies.
+        self.pipeline_depth = max(1, int(pipeline_depth))
         # pipelined: sink replies are DISPATCHED (device launch only)
         # and their bytes flush at the top of the next tick, so the
         # protect launch overlaps the next recv window instead of
         # serializing with it (SURVEY §7 step 4's budget).  Costs one
         # recv-window of latency on the reply path.
-        self.pipelined = pipelined
+        self.pipelined = pipelined or self.pipeline_depth > 1
         # (pending, mask, journey origin, dispatch tick)
         self._inflight: List[Tuple[object, np.ndarray, tuple, int]] = []
+        # in-flight reverse-chain (receive) dispatches, FIFO by tick:
+        # dicts of {pend, tick, origin, ats, token, n}
+        self._rx_inflight: List[dict] = []
         # kernel arrival stamps ride along when the engine has them;
         # after each tick, `last_rtp_arrival_ns` aligns row-for-row with
         # the batch handed to on_media (BWE wants skb-receive times,
@@ -132,6 +147,23 @@ class MediaLoop:
         self.inbound_drop = np.zeros(registry.capacity, dtype=bool)
         self.inbound_dropped = np.zeros(registry.capacity, dtype=np.int64)
         self.inbound_dropped_total = 0
+        # unknown-SSRC accounting: the warning is interval-suppressed
+        # (at most one log line per `unknown_warn_interval` ticks, with
+        # the suppressed count carried on the next line) — a flood of
+        # unmapped senders must not flood the log
+        self.unknown_ssrc_dropped = 0
+        self.unknown_warn_interval = 100
+        self._unknown_suppressed = 0
+        self._unknown_last_warn: Optional[int] = None
+        self.metrics.register_scalar(
+            "loop_unknown_ssrc_dropped",
+            lambda: self.unknown_ssrc_dropped,
+            help_="packets dropped for unmapped SSRCs", kind="counter")
+        self.metrics.register_scalar(
+            "loop_unknown_ssrc_warn_suppressed",
+            lambda: self._unknown_suppressed,
+            help_="unknown-SSRC warnings suppressed since the last "
+                  "logged one")
         self.ticks = 0
         self.rx_packets = 0
         self.tx_packets = 0
@@ -174,7 +206,13 @@ class MediaLoop:
         if self.on_media is not None:
             reply = self.on_media(batch, ok)
             if reply is not None:
-                self.send_media(reply)
+                # a release mid-flood must not serialize the tick: the
+                # pipelined loop dispatches the replayed replies like any
+                # other and flushes them on the next tick
+                if self.pipelined:
+                    self.send_media_async(reply)
+                else:
+                    self.send_media(reply)
         return len(q)
 
     # -------------------------------------------------------------- tick
@@ -190,15 +228,25 @@ class MediaLoop:
         # re-established below only when this tick carries RTP rows; a
         # stale previous-tick value must never masquerade as fresh
         self.last_rtp_arrival_ns = None
+        deep = (self.pipeline_depth > 1 and self.chain is not None
+                and hasattr(self.chain.rtp_transformer,
+                            "reverse_transform_async"))
+        # deep pipeline: ingress lands in a zero-copy arena view, pinned
+        # until the tick's reverse pending materializes; classic depth-1
+        # keeps copy semantics (sinks may hold the batch indefinitely)
+        use_view = deep and hasattr(self.engine, "recv_batch_view")
         with self.tracer.span("ingress"):
             with self.perf.phase("idle"):    # socket wait dominates here
                 if self.use_kernel_ts:
-                    batch, sip, sport, ats = self.engine.recv_batch_ts(
-                        self.recv_window_ms)
+                    recv = (self.engine.recv_batch_ts_view if use_view
+                            else self.engine.recv_batch_ts)
+                    batch, sip, sport, ats = recv(self.recv_window_ms)
                 else:
-                    batch, sip, sport = self.engine.recv_batch(
-                        self.recv_window_ms)
+                    recv = (self.engine.recv_batch_view if use_view
+                            else self.engine.recv_batch)
+                    batch, sip, sport = recv(self.recv_window_ms)
                     ats = None
+        token = getattr(batch, "arena_token", None)
         # arrival stamp: the batching window just closed — everything
         # this tick sends is measured against this instant (per-batch
         # journey; rows within one batch share the stamp)
@@ -209,63 +257,78 @@ class MediaLoop:
             self.pkt_size_hist.observe_array(
                 np.asarray(batch.length)[:n])
         self.ticks += 1
-        self.dispatch_inflight_ticks = max(
-            (self.ticks - t for _p, _m, _o, t in self._inflight),
-            default=0)
-        # the recv window just elapsed: anything dispatched last tick
-        # has had a full socket-wait of device time — flush it now
+        self._note_inflight_age()
+        # the recv window just elapsed: anything dispatched on EARLIER
+        # ticks has had a full socket-wait of device time.  Egress bytes
+        # first (lowest journey latency), then reverse pendings that
+        # reached their pipeline depth — whose replies dispatch now and
+        # flush at the top of the next tick.
         if self._inflight:
             self.flush_sends()
+        if self._rx_inflight:
+            self._materialize_rx(due_only=True)
         if n == 0:
+            # idle window: nothing to overlap with — collapse the
+            # pipeline instead of parking bytes for another tick
+            if self._rx_inflight or self._inflight:
+                self.drain()
             return 0
         self.rx_packets += n
         if self.pcap is not None:
             self.pcap.write_batch(batch)
 
-        # 1. split DTLS (first byte 20..63) from media — host, cheap
+        # 1. split DTLS (first byte 20..63) from media — host, cheap;
+        # the no-DTLS fast path keeps `sub` a view of the recv batch
         first = batch.data[:, 0]
-        dtls_rows = np.nonzero((first >= 20) & (first <= 63))[0]
-        if len(dtls_rows) and self.on_dtls is not None:
-            for i in dtls_rows:
-                replies = self.on_dtls(batch.to_bytes(int(i)),
-                                       (int(sip[i]), int(sport[i])))
-                for rep in replies or ():
-                    out = PacketBatch.from_payloads([rep],
-                                                    batch.capacity)
-                    self.engine.send_batch(out, int(sip[i]), int(sport[i]))
-
-        media_rows = np.nonzero(~((first >= 20) & (first <= 63)))[0]
-        if len(media_rows) == 0:
-            return n
-        sub = PacketBatch(batch.data[media_rows],
-                          np.asarray(batch.length)[media_rows],
-                          batch.stream[media_rows])
-        sip, sport = sip[media_rows], sport[media_rows]
-        if ats is not None:
-            ats = ats[media_rows]
+        is_dtls_row = (first >= 20) & (first <= 63)
+        if is_dtls_row.any():
+            dtls_rows = np.nonzero(is_dtls_row)[0]
+            if self.on_dtls is not None:
+                for i in dtls_rows:
+                    replies = self.on_dtls(batch.to_bytes(int(i)),
+                                           (int(sip[i]), int(sport[i])))
+                    for rep in replies or ():
+                        out = PacketBatch.from_payloads([rep],
+                                                        batch.capacity)
+                        self.engine.send_batch(out, int(sip[i]),
+                                               int(sport[i]))
+            media_rows = np.nonzero(~is_dtls_row)[0]
+            if len(media_rows) == 0:
+                self._release_token(token)
+                return n
+            sub = PacketBatch(batch.data[media_rows],  # jitlint: disable=hotpath-alloc
+                              np.asarray(batch.length)[media_rows],
+                              batch.stream[media_rows])
+            sip, sport = sip[media_rows], sport[media_rows]
+            if ats is not None:
+                ats = ats[media_rows]
+        else:
+            sub = batch
 
         # 2. RTCP vs RTP split (rtcp-mux), then ssrc -> stream row
         # (the SSRC field sits at different offsets in the two formats)
         rtcp_mask = _is_rtcp(sub.data, np.asarray(sub.length))
+        any_rtcp = rtcp_mask.any()
         sids = np.full(sub.batch_size, -1, dtype=np.int64)
-        rtp_sel = np.nonzero(~rtcp_mask)[0]
-        if len(rtp_sel):
-            rtp_sub = PacketBatch(sub.data[rtp_sel],
-                                  np.asarray(sub.length)[rtp_sel],
-                                  sub.stream[rtp_sel])
-            sids[rtp_sel] = self.registry.demux(rtp_sub)
-        rtcp_sel = np.nonzero(rtcp_mask)[0]
-        if len(rtcp_sel):
-            rtcp_sub = PacketBatch(sub.data[rtcp_sel],
-                                   np.asarray(sub.length)[rtcp_sel],
-                                   sub.stream[rtcp_sel])
-            sids[rtcp_sel] = self.registry.demux_rtcp(rtcp_sub)
+        if not any_rtcp:
+            sids[:] = self.registry.demux(sub)
+        else:
+            rtp_sel = np.nonzero(~rtcp_mask)[0]
+            if len(rtp_sel):
+                rtp_sub = PacketBatch(sub.data[rtp_sel],  # jitlint: disable=hotpath-alloc
+                                      np.asarray(sub.length)[rtp_sel],
+                                      sub.stream[rtp_sel])
+                sids[rtp_sel] = self.registry.demux(rtp_sub)
+            rtcp_sel = np.nonzero(rtcp_mask)[0]
+            if len(rtcp_sel):
+                rtcp_sub = PacketBatch(sub.data[rtcp_sel],  # jitlint: disable=hotpath-alloc
+                                       np.asarray(sub.length)[rtcp_sel],
+                                       sub.stream[rtcp_sel])
+                sids[rtcp_sel] = self.registry.demux_rtcp(rtcp_sub)
         sub.stream[:] = sids
         known = sids >= 0
         if not known.all():
-            # rate-limited: an unknown-SSRC flood must not flood the log
-            _log.warn("unknown_ssrc_drop", count=int((~known).sum()),
-                      tick=self.ticks)
+            self._warn_unknown_ssrc(int((~known).sum()))
         if self.inbound_drop.any():
             # quarantined / shed streams are dropped BEFORE the address
             # latch below, so a quarantined sender's packets can never
@@ -296,9 +359,14 @@ class MediaLoop:
 
         with self.tracer.span("reverse_chain"):
             if len(rtp_rows):
-                rtp = PacketBatch(sub.data[rtp_rows],
-                                  np.asarray(sub.length)[rtp_rows],
-                                  sub.stream[rtp_rows])
+                if len(rtp_rows) == sub.batch_size:
+                    rtp = sub     # all-RTP fast path: still a view
+                    ats_sel = ats
+                else:
+                    rtp = PacketBatch(sub.data[rtp_rows],  # jitlint: disable=hotpath-alloc
+                                      np.asarray(sub.length)[rtp_rows],
+                                      sub.stream[rtp_rows])
+                    ats_sel = ats[rtp_rows] if ats is not None else None
                 if self.flight is not None:
                     # sample RTP headers (seq at bytes 2..3) into the
                     # per-stream flight rings — vectorized field pulls,
@@ -308,34 +376,59 @@ class MediaLoop:
                     self.flight.record_headers(
                         rtp.stream, seqs, np.asarray(rtp.length),
                         tick=self.ticks, trace=self.trace_id)
-                self.last_rtp_arrival_ns = (
-                    ats[rtp_rows] if ats is not None else None)
-                if self.chain is not None:
+                if deep:
+                    # dispatch-only: auth/decrypt overlaps the NEXT
+                    # recv window(s); the arena pin travels with the
+                    # pending and is released at materialization
                     self.perf.note_h2d(rtp.data.nbytes +
                                        np.asarray(rtp.length).nbytes)
                     self.perf.probe_h2d((rtp.data,))
-                    # the sync reverse call blends dispatch + compute +
-                    # d2h; attributed wholesale to device_compute (the
-                    # forward path's async seam splits them properly)
-                    with self.perf.phase("device_compute"):
-                        rtp, ok = (self.chain.rtp_transformer
-                                   .reverse_transform(rtp))
-                    self.perf.note_d2h(rtp.data.nbytes)
-                    if not ok.all():
-                        _log.warn("reverse_chain_drop",
-                                  count=int((~ok).sum()),
-                                  tick=self.ticks)
+                    # the serialization barrier (previous window's
+                    # replay-state commit) is a fenced wait on already-
+                    # dispatched device auth work — run it here so the
+                    # dispatch span below measures only the new launch
+                    commit = getattr(self.chain.rtp_transformer,
+                                     "commit_inflight", None)
+                    if commit is not None:
+                        with self.perf.phase("device_compute"):
+                            commit()
+                    with self.perf.phase("dispatch"):
+                        pend = (self.chain.rtp_transformer
+                                .reverse_transform_async(rtp))
+                    self._rx_inflight.append({
+                        "pend": pend, "tick": self.ticks,
+                        "origin": self.journey_origin(),
+                        "ats": ats_sel, "token": token,
+                        "n": rtp.batch_size})
+                    token = None          # ownership moved to the entry
                 else:
-                    ok = np.ones(rtp.batch_size, bool)
-                if self.on_media is not None:
-                    reply = self.on_media(rtp, ok)
-                    if reply is not None:
-                        if self.pipelined:
-                            self.send_media_async(reply)
-                        else:
-                            self.send_media(reply)
+                    self.last_rtp_arrival_ns = ats_sel
+                    if self.chain is not None:
+                        self.perf.note_h2d(rtp.data.nbytes +
+                                           np.asarray(rtp.length).nbytes)
+                        self.perf.probe_h2d((rtp.data,))
+                        # the sync reverse call blends dispatch + compute
+                        # + d2h; attributed wholesale to device_compute
+                        # (the async seams split them properly)
+                        with self.perf.phase("device_compute"):
+                            rtp, ok = (self.chain.rtp_transformer
+                                       .reverse_transform(rtp))
+                        self.perf.note_d2h(rtp.data.nbytes)
+                        if not ok.all():
+                            _log.warn("reverse_chain_drop",
+                                      count=int((~ok).sum()),
+                                      tick=self.ticks)
+                    else:
+                        ok = np.ones(rtp.batch_size, bool)
+                    if self.on_media is not None:
+                        reply = self.on_media(rtp, ok)
+                        if reply is not None:
+                            if self.pipelined:
+                                self.send_media_async(reply)
+                            else:
+                                self.send_media(reply)
             if len(rtcp_rows) and self.on_rtcp is not None:
-                rb = PacketBatch(sub.data[rtcp_rows],
+                rb = PacketBatch(sub.data[rtcp_rows],  # jitlint: disable=hotpath-alloc
                                  np.asarray(sub.length)[rtcp_rows],
                                  sub.stream[rtcp_rows])
                 if self.chain is not None and \
@@ -345,7 +438,98 @@ class MediaLoop:
                 else:
                     okc = np.ones(rb.batch_size, bool)
                 self.on_rtcp(rb, okc)
+        self._release_token(token)
         return n
+
+    # --------------------------------------------------- deep pipeline
+    def _note_inflight_age(self) -> None:
+        """Age (ticks) of the oldest un-materialized dispatch, across
+        both the egress (`_inflight`) and reverse (`_rx_inflight`)
+        pipelines — the depth the phase profiler reports."""
+        self.dispatch_inflight_ticks = max(
+            max((self.ticks - t for _p, _m, _o, t in self._inflight),
+                default=0),
+            max((self.ticks - e["tick"] for e in self._rx_inflight),
+                default=0))
+
+    def _release_token(self, token) -> None:
+        if token is not None:
+            self.engine.release_arena(token)
+
+    def _warn_unknown_ssrc(self, count: int) -> None:
+        """Interval-suppressed unknown-SSRC warning: at most one log
+        line per `unknown_warn_interval` ticks; skipped occurrences ride
+        on the next line's `suppressed` count."""
+        self.unknown_ssrc_dropped += count
+        last = self._unknown_last_warn
+        if last is not None and \
+                self.ticks - last < self.unknown_warn_interval:
+            self._unknown_suppressed += 1
+            return
+        _log.warn("unknown_ssrc_drop", count=count, tick=self.ticks,
+                  suppressed=self._unknown_suppressed,
+                  total=self.unknown_ssrc_dropped)
+        self._unknown_last_warn = self.ticks
+        self._unknown_suppressed = 0
+
+    def _materialize_rx(self, due_only: bool = True) -> int:
+        """Materialize in-flight reverse pendings (FIFO): deliver media
+        to the sink, dispatch its reply, release the arena pin.  With
+        `due_only`, only entries that have aged `pipeline_depth - 1`
+        ticks come due — the depth bound."""
+        if not self._rx_inflight:
+            return 0
+        if due_only:
+            horizon = self.pipeline_depth - 1
+            due = [e for e in self._rx_inflight
+                   if self.ticks - e["tick"] >= horizon]
+            if not due:
+                return 0
+            self._rx_inflight = [e for e in self._rx_inflight
+                                 if self.ticks - e["tick"] < horizon]
+        else:
+            due, self._rx_inflight = self._rx_inflight, []
+        done = 0
+        for e in due:
+            done += self._finish_rx(e)
+        return done
+
+    def _finish_rx(self, e: dict) -> int:
+        pend = e["pend"]
+        with self.tracer.span("reverse_chain"):
+            self.perf.fence(pend)
+            with self.perf.phase("d2h_transfer"):
+                rtp, ok = pend.result()
+        self.perf.note_d2h(rtp.data.nbytes)
+        # the original arena bytes were last read inside result() (the
+        # failed-row passthrough) — safe to recycle from here on
+        self._release_token(e["token"])
+        if not ok.all():
+            _log.warn("reverse_chain_drop", count=int((~ok).sum()),
+                      tick=self.ticks)
+        self.last_rtp_arrival_ns = e["ats"]
+        if self.on_media is not None:
+            reply = self.on_media(rtp, ok)
+            if reply is not None:
+                # replies charge their journey to the ARRIVAL tick: the
+                # pipeline delay is real latency those packets paid
+                if self.pipelined:
+                    self.send_media_async(reply, origin=e["origin"])
+                else:
+                    self.send_media(reply, origin=e["origin"])
+        return e["n"]
+
+    def drain(self) -> int:
+        """Pipeline drain barrier: materialize EVERY in-flight reverse
+        dispatch (delivering media and committing replay state) and
+        flush every dispatched send.  Checkpoint and lifecycle commit
+        points run behind this barrier so snapshots never capture — and
+        row recycling never races — half-finished ticks."""
+        done = self._materialize_rx(due_only=False)
+        if self._inflight:
+            self.flush_sends()
+        self._note_inflight_age()
+        return done
 
     # ----------------------------------------------------------- journey
     def journey_origin(self) -> Tuple[int, Optional[float]]:
@@ -376,9 +560,33 @@ class MediaLoop:
         return dt
 
     # -------------------------------------------------------------- send
-    def send_media(self, batch: PacketBatch) -> int:
+    def _send_masked(self, out: PacketBatch, mask: np.ndarray) -> int:
+        """Transmit the mask-selected rows of a protected batch to each
+        stream row's latched address.  All-rows batches go out as-is;
+        subsets use the engine's native gather (`send_rows`) so the
+        host never materializes a contiguous copy of the egress burst."""
+        rows = np.nonzero(mask)[0]
+        if len(rows) == 0:
+            return 0
+        if len(rows) == out.batch_size:
+            sids = np.clip(out.stream, 0, self.registry.capacity - 1)
+            return self.engine.send_batch(out, self.addr_ip[sids],
+                                          self.addr_port[sids])
+        sids = np.clip(np.asarray(out.stream)[rows], 0,
+                       self.registry.capacity - 1)
+        if hasattr(self.engine, "send_rows"):
+            return self.engine.send_rows(out, rows, self.addr_ip[sids],
+                                         self.addr_port[sids])
+        sub = PacketBatch(out.data[rows],  # jitlint: disable=hotpath-alloc
+                          np.asarray(out.length)[rows],
+                          np.asarray(out.stream)[rows])
+        return self.engine.send_batch(sub, self.addr_ip[sids],
+                                      self.addr_port[sids])
+
+    def send_media(self, batch: PacketBatch, origin=None) -> int:
         """Protect (forward chain) + send a batch; rows route to each
-        stream row's latched address."""
+        stream row's latched address.  `origin` overrides the journey
+        origin (pipelined callers charge the arrival tick)."""
         if batch.batch_size == 0:
             return 0
         with self.tracer.span("forward_chain"):
@@ -399,36 +607,33 @@ class MediaLoop:
                 self.perf.note_d2h(batch.data.nbytes)
             else:
                 ok = np.ones(batch.batch_size, bool)
-        rows = np.nonzero(ok)[0]
-        if len(rows) == 0:
-            return 0
-        out = PacketBatch(batch.data[rows],
-                          np.asarray(batch.length)[rows],
-                          batch.stream[rows])
-        sids = np.clip(out.stream, 0, self.registry.capacity - 1)
         with self.tracer.span("egress"):
-            sent = self.engine.send_batch(out, self.addr_ip[sids],
-                                          self.addr_port[sids])
-            self.note_journey(sent, sids=out.stream)
+            sent = self._send_masked(batch, ok)
+            streams = np.asarray(batch.stream)[np.nonzero(ok)[0]]
+            self.note_journey_at(
+                self.journey_origin() if origin is None else origin,
+                sent, sids=streams)
         self.tx_packets += sent
         return sent
 
-    def send_media_async(self, batch: PacketBatch) -> int:
+    def send_media_async(self, batch: PacketBatch, origin=None) -> int:
         """Dispatch the forward chain without materializing; protected
         bytes go out on the next tick's flush (or an explicit
         `flush_sends`)."""
         if batch.batch_size == 0:
             return 0
         if self.chain is None:
-            return self.send_media(batch)       # nothing to overlap
+            return self.send_media(batch, origin=origin)  # nothing to overlap
         with self.tracer.span("forward_chain"):
             self.perf.note_h2d(batch.data.nbytes +
                                np.asarray(batch.length).nbytes)
             with self.perf.phase("dispatch"):
                 pending, mask = (self.chain.rtp_transformer
                                  .transform_async(batch))
-        self._inflight.append((pending, mask, self.journey_origin(),
-                               self.ticks))
+        self._inflight.append((
+            pending, mask,
+            self.journey_origin() if origin is None else origin,
+            self.ticks))
         return batch.batch_size
 
     def flush_sends(self) -> int:
@@ -441,19 +646,12 @@ class MediaLoop:
                 with self.perf.phase("d2h_transfer"):
                     out = pending.result()
                 self.perf.note_d2h(out.data.nbytes)
-                rows = np.nonzero(mask)[0]
-                if len(rows) == 0:
-                    continue
-                sub = PacketBatch(out.data[rows],
-                                  np.asarray(out.length)[rows],
-                                  out.stream[rows])
-                sids = np.clip(sub.stream, 0,
-                               self.registry.capacity - 1)
-                k = self.engine.send_batch(sub, self.addr_ip[sids],
-                                           self.addr_port[sids])
+                k = self._send_masked(out, mask)
                 # journey measured from the DISPATCH tick's arrival:
                 # the pipelining window is real latency the packet paid
-                self.note_journey_at(origin, k, sids=sub.stream)
+                self.note_journey_at(
+                    origin, k,
+                    sids=np.asarray(out.stream)[np.nonzero(mask)[0]])
                 sent += k
         self.tx_packets += sent
         return sent

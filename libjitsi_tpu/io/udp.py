@@ -52,6 +52,12 @@ def _load():
     lib.udp_send_batch.argtypes = [
         ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int]
+    if hasattr(lib, "udp_send_batch_idx"):  # older sanitized builds
+        lib.udp_send_batch_idx.restype = ctypes.c_int
+        lib.udp_send_batch_idx.argtypes = [
+            ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int]
     if hasattr(lib, "udp_enable_timestamps"):  # older sanitized builds
         lib.udp_enable_timestamps.restype = ctypes.c_int
         lib.udp_enable_timestamps.argtypes = [ctypes.c_int]
@@ -62,6 +68,24 @@ def _load():
             ctypes.c_void_p, ctypes.c_int]
     _lib = lib
     return lib
+
+
+class _Arena:
+    """One pinned recv arena: the PacketBatch SoA the kernel scatters
+    into.  `gen` tags the arena's current occupancy; `pins` counts live
+    zero-copy views — the ring never hands a pinned arena back to the
+    kernel, so a view is never overwritten while in flight."""
+
+    __slots__ = ("buf", "len", "sip", "sport", "ats", "gen", "pins")
+
+    def __init__(self, rows: int, capacity: int):
+        self.buf = np.zeros((rows, capacity), dtype=np.uint8)
+        self.len = np.zeros(rows, dtype=np.int32)
+        self.sip = np.zeros(rows, dtype=np.uint32)
+        self.sport = np.zeros(rows, dtype=np.uint16)
+        self.ats = np.zeros(rows, dtype=np.int64)
+        self.gen = 0
+        self.pins = 0
 
 
 def ip_to_u32(ip: str) -> int:
@@ -83,9 +107,12 @@ class UdpEngine:
     def __init__(self, port: int = 0, bind_ip: str = "0.0.0.0",
                  reuseport: bool = False, capacity: int = DEFAULT_CAPACITY,
                  max_batch: int = 1024, rcvbuf: int = 4 << 20,
-                 kernel_timestamps: bool = False):
+                 kernel_timestamps: bool = False, arenas: int = 4):
         lib = _load()
         self.capacity = capacity
+        #: live batching knob — recv windows honor the CURRENT value
+        #: (adaptive batching tunes it tick to tick); arena allocation
+        #: is sized once from the construction-time value
         self.max_batch = max_batch
         fd = lib.udp_create(bind_ip.encode(), port, int(reuseport), rcvbuf)
         if fd < 0:
@@ -103,12 +130,47 @@ class UdpEngine:
                 # userspace stamps must not be silent
                 get_logger("io.udp").warn(
                     "kernel_timestamps_unavailable", port=self.port)
-        # persistent receive arena (the PacketBatch SoA itself)
-        self._buf = np.zeros((max_batch, capacity), dtype=np.uint8)
-        self._len = np.zeros(max_batch, dtype=np.int32)
-        self._sip = np.zeros(max_batch, dtype=np.uint32)
-        self._sport = np.zeros(max_batch, dtype=np.uint16)
-        self._ats = np.zeros(max_batch, dtype=np.int64)
+        # rotating ring of pinned receive arenas (each one IS a
+        # PacketBatch SoA); `recv_batch_view` hands out in-place views
+        # and pins the arena until `release_arena`, so deep-pipelined
+        # callers can hold tick N's bytes while tick N+1 receives
+        self._rows = max_batch
+        self._ring = [_Arena(max_batch, capacity)
+                      for _ in range(max(1, arenas))]
+        self._ring_pos = 0
+        #: times the ring grew because every arena was pinned — a
+        #: pipeline holding views longer than the ring depth
+        self.arena_grows = 0
+        self._alias_arena(self._ring[0])
+
+    def _alias_arena(self, a: _Arena) -> None:
+        # legacy aliases: the most recently used arena's raw arrays
+        self._buf, self._len = a.buf, a.len
+        self._sip, self._sport, self._ats = a.sip, a.sport, a.ats
+
+    def _next_arena(self) -> _Arena:
+        """Unpinned arena at the ring cursor, growing the ring when
+        every arena still has a live view in flight (the invariant: a
+        pinned arena is NEVER handed back to the kernel)."""
+        ring = self._ring
+        for _ in range(len(ring)):
+            a = ring[self._ring_pos]
+            if a.pins == 0:
+                return a
+            self._ring_pos = (self._ring_pos + 1) % len(ring)
+        a = _Arena(self._rows, self.capacity)
+        ring.insert(self._ring_pos, a)
+        self.arena_grows += 1
+        return a
+
+    def release_arena(self, token) -> None:
+        """Drop the pin a `recv_batch_view` placed; `token` is the
+        batch's `arena_token`.  Safe to call twice (generation-checked)."""
+        if token is None:
+            return
+        a, gen = token
+        if a.gen == gen and a.pins > 0:
+            a.pins -= 1
 
     @classmethod
     def create_with_retry(cls, retries: int = 5, backoff_s: float = 0.05,
@@ -127,23 +189,60 @@ class UdpEngine:
                         backoff_s=backoff_s,
                         sleep=_time.sleep if sleep is None else sleep)
 
+    def _recv_arena(self, timeout_ms: int, want_ts: bool):
+        """Receive one batching window into a fresh (unpinned) arena.
+        Returns (arena, n); the arena's gen is already bumped so any
+        stale token from its previous occupancy is invalidated."""
+        a = self._next_arena()
+        a.gen += 1
+        self._alias_arena(a)
+        lib = _load()
+        limit = max(1, min(int(self.max_batch), self._rows))
+        if want_ts:
+            n = lib.udp_recv_batch_ts(
+                self._fd, a.buf.ctypes.data, self.capacity, limit,
+                a.len.ctypes.data, a.sip.ctypes.data,
+                a.sport.ctypes.data, a.ats.ctypes.data, timeout_ms)
+        else:
+            n = lib.udp_recv_batch(
+                self._fd, a.buf.ctypes.data, self.capacity, limit,
+                a.len.ctypes.data, a.sip.ctypes.data,
+                a.sport.ctypes.data, timeout_ms)
+        if n < 0:
+            raise OSError(-n, os.strerror(-n))
+        return a, n
+
     def recv_batch(self, timeout_ms: int = 1
                    ) -> Tuple[PacketBatch, np.ndarray, np.ndarray]:
         """One batching window: up to max_batch datagrams.
 
         Returns (batch, src_ip_u32, src_port); batch_size 0 on timeout.
         The batching window (timeout for the first packet + drain) is
-        the latency/throughput knob from SURVEY §7 step 4.
+        the latency/throughput knob from SURVEY §7 step 4.  Copy
+        semantics: callers may hold the batch indefinitely.  Hot paths
+        use `recv_batch_view` instead.
         """
-        n = _load().udp_recv_batch(
-            self._fd, self._buf.ctypes.data, self.capacity, self.max_batch,
-            self._len.ctypes.data, self._sip.ctypes.data,
-            self._sport.ctypes.data, timeout_ms)
-        if n < 0:
-            raise OSError(-n, os.strerror(-n))
-        batch = PacketBatch(self._buf[:n].copy(), self._len[:n].copy(),
+        a, n = self._recv_arena(timeout_ms, want_ts=False)
+        batch = PacketBatch(a.buf[:n].copy(),  # jitlint: disable=hotpath-alloc
+                            a.len[:n].copy(),
                             np.full(n, -1, dtype=np.int32))
-        return batch, self._sip[:n].copy(), self._sport[:n].copy()
+        # jitlint: disable=hotpath-alloc — copy-semantics API by contract
+        return batch, a.sip[:n].copy(), a.sport[:n].copy()
+
+    def recv_batch_view(self, timeout_ms: int = 1
+                        ) -> Tuple[PacketBatch, np.ndarray, np.ndarray]:
+        """Zero-copy `recv_batch`: the returned batch's data/length are
+        in-place VIEWS of the recv arena, tagged with `arena_token`.
+        The arena stays pinned (never re-handed to the kernel) until
+        the caller passes that token to `release_arena` — exactly once
+        per returned batch."""
+        a, n = self._recv_arena(timeout_ms, want_ts=False)
+        batch = PacketBatch(a.buf[:n], a.len[:n],
+                            np.full(n, -1, dtype=np.int32))
+        if n > 0:
+            a.pins += 1
+            batch.arena_token = (a, a.gen)
+        return batch, a.sip[:n], a.sport[:n]
 
     def recv_batch_ts(self, timeout_ms: int = 1
                       ) -> Tuple[PacketBatch, np.ndarray, np.ndarray,
@@ -153,16 +252,34 @@ class UdpEngine:
         enabled, else a per-batch syscall-time fallback).  Feed these to
         the GCC inter-arrival filters — userspace arrival times carry
         scheduler jitter the kernel stamp does not."""
-        n = _load().udp_recv_batch_ts(
-            self._fd, self._buf.ctypes.data, self.capacity, self.max_batch,
-            self._len.ctypes.data, self._sip.ctypes.data,
-            self._sport.ctypes.data, self._ats.ctypes.data, timeout_ms)
-        if n < 0:
-            raise OSError(-n, os.strerror(-n))
-        batch = PacketBatch(self._buf[:n].copy(), self._len[:n].copy(),
+        a, n = self._recv_arena(timeout_ms, want_ts=True)
+        batch = PacketBatch(a.buf[:n].copy(),  # jitlint: disable=hotpath-alloc
+                            a.len[:n].copy(),
                             np.full(n, -1, dtype=np.int32))
-        return (batch, self._sip[:n].copy(), self._sport[:n].copy(),
-                self._ats[:n].copy())
+        # jitlint: disable=hotpath-alloc — copy-semantics API by contract
+        return (batch, a.sip[:n].copy(), a.sport[:n].copy(),
+                a.ats[:n].copy())  # jitlint: disable=hotpath-alloc
+
+    def recv_batch_ts_view(self, timeout_ms: int = 1
+                           ) -> Tuple[PacketBatch, np.ndarray, np.ndarray,
+                                      np.ndarray]:
+        """Zero-copy `recv_batch_ts` (see `recv_batch_view` for the
+        arena-pinning contract)."""
+        a, n = self._recv_arena(timeout_ms, want_ts=True)
+        batch = PacketBatch(a.buf[:n], a.len[:n],
+                            np.full(n, -1, dtype=np.int32))
+        if n > 0:
+            a.pins += 1
+            batch.arena_token = (a, a.gen)
+        return batch, a.sip[:n], a.sport[:n], a.ats[:n]
+
+    @staticmethod
+    def _c_u8(arr: np.ndarray) -> np.ndarray:
+        # no-op when already contiguous uint8 (numpy returns the same
+        # object) — only non-contiguous callers pay a materialization
+        if arr.dtype == np.uint8 and arr.flags["C_CONTIGUOUS"]:
+            return arr
+        return np.ascontiguousarray(arr, dtype=np.uint8)  # jitlint: disable=hotpath-alloc
 
     def send_batch(self, batch: PacketBatch, dst_ip, dst_port) -> int:
         """Send all rows; dst_ip (u32 or dotted str) / dst_port broadcast."""
@@ -173,13 +290,55 @@ class UdpEngine:
             dst_ip = ip_to_u32(dst_ip)
         ips = np.broadcast_to(np.asarray(dst_ip, dtype=np.uint32), (n,))
         ports = np.broadcast_to(np.asarray(dst_port, dtype=np.uint16), (n,))
-        data = np.ascontiguousarray(batch.data)
-        lens = np.ascontiguousarray(batch.length, dtype=np.int32)
-        ips = np.ascontiguousarray(ips)
-        ports = np.ascontiguousarray(ports)
+        data = self._c_u8(batch.data)
+        # O(n) metadata staging for the C ABI (int32/u32/u16 arrays),
+        # not O(n*capacity) payload bytes
+        lens = np.ascontiguousarray(  # jitlint: disable=hotpath-alloc
+            batch.length, dtype=np.int32)
+        ips = np.ascontiguousarray(ips)  # jitlint: disable=hotpath-alloc
+        ports = np.ascontiguousarray(ports)  # jitlint: disable=hotpath-alloc
         sent = _load().udp_send_batch(
-            self._fd, data.ctypes.data, batch.capacity, lens.ctypes.data,
+            self._fd, data.ctypes.data, data.shape[1], lens.ctypes.data,
             ips.ctypes.data, ports.ctypes.data, n)
+        if sent < 0:
+            raise OSError(-sent, os.strerror(-sent))
+        return sent
+
+    def send_rows(self, batch: PacketBatch, rows, dst_ip, dst_port) -> int:
+        """Gather-send selected rows in ONE multi-destination sendmmsg.
+
+        `rows` indexes into `batch`; `dst_ip`/`dst_port` are scalars or
+        per-selected-row arrays (in `rows` order).  The native iovec
+        gather IS the row selection — the host never materializes a
+        contiguous copy of the egress subset.  Falls back to the copy
+        path when the loaded engine predates `udp_send_batch_idx`."""
+        rows = np.asarray(rows, dtype=np.int32)
+        n = int(rows.shape[0])
+        if n == 0:
+            return 0
+        if isinstance(dst_ip, str):
+            dst_ip = ip_to_u32(dst_ip)
+        lib = _load()
+        data = batch.data
+        if (not hasattr(lib, "udp_send_batch_idx")
+                or data.dtype != np.uint8
+                or not data.flags["C_CONTIGUOUS"]):
+            sub = PacketBatch(data[rows],  # jitlint: disable=hotpath-alloc
+                              np.asarray(batch.length)[rows],
+                              np.asarray(batch.stream)[rows])
+            return self.send_batch(sub, dst_ip, dst_port)
+        # O(n) metadata staging for the C ABI; the payload rows
+        # themselves go out via iovec gather
+        lens = np.ascontiguousarray(  # jitlint: disable=hotpath-alloc
+            np.asarray(batch.length, dtype=np.int32)[rows])
+        ips = np.ascontiguousarray(np.broadcast_to(  # jitlint: disable=hotpath-alloc
+            np.asarray(dst_ip, dtype=np.uint32), (n,)))
+        ports = np.ascontiguousarray(np.broadcast_to(  # jitlint: disable=hotpath-alloc
+            np.asarray(dst_port, dtype=np.uint16), (n,)))
+        idx = np.ascontiguousarray(rows)  # jitlint: disable=hotpath-alloc
+        sent = lib.udp_send_batch_idx(
+            self._fd, data.ctypes.data, data.shape[1], lens.ctypes.data,
+            ips.ctypes.data, ports.ctypes.data, idx.ctypes.data, n)
         if sent < 0:
             raise OSError(-sent, os.strerror(-sent))
         return sent

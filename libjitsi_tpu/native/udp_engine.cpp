@@ -96,6 +96,12 @@ int udp_recv_batch(int fd, uint8_t *buf, int capacity, int max_pkts,
 // (CLOCK_REALTIME nanoseconds).  Packets without a kernel stamp
 // (SO_TIMESTAMPNS not enabled / not delivered) fall back to a
 // syscall-time clock_gettime taken once per batch.
+//
+// After the first recvmmsg a busy-poll drain pass keeps calling
+// recvmmsg(MSG_DONTWAIT) into the remaining rows while datagrams are
+// still queued, so a burst that straddles the first syscall fills the
+// batch instead of spilling into the next tick.  The drain is bounded
+// by max_pkts — it never spins on an idle socket.
 int udp_recv_batch_ts(int fd, uint8_t *buf, int capacity, int max_pkts,
                       int32_t *lengths, uint32_t *src_ip,
                       uint16_t *src_port, int64_t *arrival_ns,
@@ -106,12 +112,21 @@ int udp_recv_batch_ts(int fd, uint8_t *buf, int capacity, int max_pkts,
     if (pr < 0) return -errno;
     if (pr == 0) return 0;
   }
-  std::vector<mmsghdr> hdrs(max_pkts);
-  std::vector<iovec> iovs(max_pkts);
-  std::vector<sockaddr_in> addrs(max_pkts);
+  // hoisted per-call scratch: the tick loop calls this at high rate and
+  // the header/iov arrays are identical shape every time
+  thread_local std::vector<mmsghdr> hdrs;
+  thread_local std::vector<iovec> iovs;
+  thread_local std::vector<sockaddr_in> addrs;
+  thread_local std::vector<uint8_t> ctrl;
+  if (static_cast<int>(hdrs.size()) < max_pkts) {
+    hdrs.resize(max_pkts);
+    iovs.resize(max_pkts);
+    addrs.resize(max_pkts);
+  }
   constexpr size_t kCtrl = 64;  // room for one timestampns cmsg
-  std::vector<uint8_t> ctrl;
-  if (arrival_ns) ctrl.resize(static_cast<size_t>(max_pkts) * kCtrl);
+  if (arrival_ns &&
+      ctrl.size() < static_cast<size_t>(max_pkts) * kCtrl)
+    ctrl.resize(static_cast<size_t>(max_pkts) * kCtrl);
   for (int i = 0; i < max_pkts; i++) {
     iovs[i].iov_base = buf + static_cast<size_t>(i) * capacity;
     iovs[i].iov_len = capacity;
@@ -126,15 +141,29 @@ int udp_recv_batch_ts(int fd, uint8_t *buf, int capacity, int max_pkts,
       hdrs[i].msg_hdr.msg_controllen = kCtrl;
     }
   }
-  int n = recvmmsg(fd, hdrs.data(), max_pkts, MSG_DONTWAIT, nullptr);
-  if (n < 0) return (errno == EAGAIN || errno == EWOULDBLOCK) ? 0 : -errno;
+  int total = 0;
+  while (total < max_pkts) {
+    int want = max_pkts - total;
+    int n = recvmmsg(fd, hdrs.data() + total, want, MSG_DONTWAIT, nullptr);
+    if (n < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (total > 0) break;  // deliver what we have; error next call
+      return -errno;
+    }
+    if (n == 0) break;
+    // a short return means the queue emptied mid-call — but datagrams
+    // may have landed during the copy, so go around again and let
+    // EAGAIN (not the short count) terminate the drain
+    total += n;
+  }
+  if (total == 0) return 0;
   int64_t fallback = 0;
   if (arrival_ns) {
     timespec now{};
     clock_gettime(CLOCK_REALTIME, &now);
     fallback = static_cast<int64_t>(now.tv_sec) * 1000000000LL + now.tv_nsec;
   }
-  for (int i = 0; i < n; i++) {
+  for (int i = 0; i < total; i++) {
     lengths[i] = static_cast<int32_t>(hdrs[i].msg_len);
     src_ip[i] = ntohl(addrs[i].sin_addr.s_addr);
     src_port[i] = ntohs(addrs[i].sin_port);
@@ -151,20 +180,33 @@ int udp_recv_batch_ts(int fd, uint8_t *buf, int capacity, int max_pkts,
       }
     }
   }
-  return n;
+  return total;
 }
 
-// Batched send via sendmmsg from the same row-major layout.
-// dst_ip is host-order ip4.  Returns packets sent or -errno.
-int udp_send_batch(int fd, const uint8_t *buf, int capacity,
-                   const int32_t *lengths, const uint32_t *dst_ip,
-                   const uint16_t *dst_port, int n) {
-  std::vector<mmsghdr> hdrs(n);
-  std::vector<iovec> iovs(n);
-  std::vector<sockaddr_in> addrs(n);
+// Row-indexed gather send via sendmmsg.  Rows are selected by idx[]
+// into the caller's full [*, capacity] row-major matrix, so the host
+// never materializes a contiguous copy of the egress subset: the iovec
+// gather IS the row selection, and the whole multi-destination burst
+// is one syscall (per-msg msg_name carries each row's destination).
+// lengths/dst_ip/dst_port are length-n arrays in idx order; idx may be
+// nullptr for the identity (rows 0..n-1).  dst_ip is host-order ip4.
+// Returns packets sent or -errno.
+int udp_send_batch_idx(int fd, const uint8_t *buf, int capacity,
+                       const int32_t *lengths, const uint32_t *dst_ip,
+                       const uint16_t *dst_port, const int32_t *idx,
+                       int n) {
+  thread_local std::vector<mmsghdr> hdrs;
+  thread_local std::vector<iovec> iovs;
+  thread_local std::vector<sockaddr_in> addrs;
+  if (static_cast<int>(hdrs.size()) < n) {
+    hdrs.resize(n);
+    iovs.resize(n);
+    addrs.resize(n);
+  }
   for (int i = 0; i < n; i++) {
+    int row = idx ? idx[i] : i;
     iovs[i].iov_base = const_cast<uint8_t *>(buf) +
-                       static_cast<size_t>(i) * capacity;
+                       static_cast<size_t>(row) * capacity;
     iovs[i].iov_len = lengths[i];
     addrs[i] = sockaddr_in{};
     addrs[i].sin_family = AF_INET;
@@ -186,6 +228,15 @@ int udp_send_batch(int fd, const uint8_t *buf, int capacity,
     sent += r;
   }
   return sent;
+}
+
+// Batched send via sendmmsg from the same row-major layout.
+// dst_ip is host-order ip4.  Returns packets sent or -errno.
+int udp_send_batch(int fd, const uint8_t *buf, int capacity,
+                   const int32_t *lengths, const uint32_t *dst_ip,
+                   const uint16_t *dst_port, int n) {
+  return udp_send_batch_idx(fd, buf, capacity, lengths, dst_ip, dst_port,
+                            nullptr, n);
 }
 
 }  // extern "C"

@@ -61,6 +61,7 @@ class ConferenceBridge:
                  on_speaker_change=None,
                  recorder=None,
                  pipelined: bool = False,
+                 pipeline_depth: int = 1,
                  mesh=None,
                  plc: bool = False):
         self.capacity = capacity
@@ -110,7 +111,8 @@ class ConferenceBridge:
                       kernel_timestamps=kernel_timestamps),
             self.registry, on_media=self._on_media, chain=self.chain,
             on_dtls=lambda d, a: self._dtls.on_dtls(d, a),
-            recv_window_ms=recv_window_ms, pipelined=pipelined)
+            recv_window_ms=recv_window_ms, pipelined=pipelined,
+            pipeline_depth=pipeline_depth)
         from libjitsi_tpu.control.dtls import DtlsAssociationTable
         self._dtls = DtlsAssociationTable(self.loop, profile,
                                           self._install_dtls)

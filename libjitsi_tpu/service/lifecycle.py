@@ -198,6 +198,14 @@ class StreamLifecycleManager:
     def commit(self) -> None:
         """Atomic (w.r.t. the tick) population flip: committed admits
         and processed evicts both land here, between ticks."""
+        if self._staged or self._evict_q:
+            # pipeline drain barrier: a deep-pipelined loop may still
+            # hold in-flight reverse work referencing rows about to be
+            # evicted/recycled — collapse it before the population flips
+            loop = getattr(self.bridge, "loop", None)
+            drain = getattr(loop, "drain", None)
+            if drain is not None:
+                drain()
         if self._staged:
             sids, self._staged = self._staged, []
             self.bridge.commit_endpoints(sids)

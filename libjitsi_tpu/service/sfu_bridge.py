@@ -153,12 +153,13 @@ class SfuBridge:
                  kernel_timestamps: bool = False,
                  abs_send_time_ext_id: int = 3,
                  pipelined: bool = False,
+                 pipeline_depth: int = 1,
                  mesh=None,
                  recovery_config: Optional[RecoveryConfig] = None):
         self.capacity = capacity
         self.profile = profile
         self.ast_ext_id = abs_send_time_ext_id
-        self.pipelined = pipelined
+        self.pipelined = pipelined or pipeline_depth > 1
         self._pending_fanout: list = []
         self._media_ran = False
         self.registry = StreamRegistry(config, capacity=capacity)
@@ -205,7 +206,11 @@ class SfuBridge:
             self.registry, on_media=self._on_media,
             on_rtcp=self._on_rtcp,
             on_dtls=lambda d, a: self._dtls.on_dtls(d, a), chain=None,
-            recv_window_ms=recv_window_ms)
+            recv_window_ms=recv_window_ms,
+            # the SFU unprotects inside _on_media (chain=None), so deep
+            # reverse pipelining doesn't engage here — depth > 1 still
+            # turns on pipelined replies/fan-out (loop.pipelined)
+            pipeline_depth=pipeline_depth)
         self.port = self.loop.engine.port
         self._ssrc_of: Dict[int, int] = {}     # sid -> sender ssrc
         # rows keyed by stage_endpoints but not yet committed: demuxed

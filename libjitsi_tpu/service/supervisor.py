@@ -158,6 +158,10 @@ class BridgeSupervisor:
         # StreamLifecycleManager attaches itself here; when present its
         # commit barrier + off-tick install stage run between ticks
         self.lifecycle = None
+        # optional AdaptiveBatcher (io/batching.py): ticked on this
+        # cadence; the recv_window rung clamps its window writes so the
+        # ladder and the tuner never fight over the same knob
+        self.batcher = None
         self._quarantined: Dict[int, int] = {}  # sid -> release tick
         self._q_strikes: Dict[int, int] = {}    # sid -> conviction count
         self.quarantine_total = 0
@@ -202,6 +206,8 @@ class BridgeSupervisor:
         self.ticks += 1
         if self.slo is not None:
             self.slo.on_tick()
+        if self.batcher is not None:
+            self.batcher.on_tick()
         self._update_quarantine()
         if over:
             self._good = 0
@@ -263,6 +269,8 @@ class BridgeSupervisor:
                                          None)
             if self._saved_window is not None:
                 self.loop.recv_window_ms = 0
+            if self.batcher is not None:
+                self.batcher.clamp_window(True)
         elif rung == "degrade":
             self.bridge.degraded = True
         elif rung == "shed_fec":
@@ -330,6 +338,8 @@ class BridgeSupervisor:
         elif rung == "recv_window" and self._saved_window is not None:
             self.loop.recv_window_ms = self._saved_window
             self._saved_window = None
+            if self.batcher is not None:
+                self.batcher.clamp_window(False)
         self.level -= 1
 
     def _active_sids(self) -> List[int]:
@@ -497,6 +507,12 @@ class BridgeSupervisor:
         path = path or self.cfg.checkpoint_path
         if path is None:
             raise ValueError("no checkpoint path configured")
+        # pipeline drain barrier: a deep-pipelined loop may hold
+        # dispatched-but-uncommitted ticks (replay state, egress bytes,
+        # pinned arenas) — the snapshot must never capture a half tick
+        drain = getattr(self.loop, "drain", None)
+        if drain is not None:
+            drain()
         blob = {"magic": CKPT_MAGIC, "version": CKPT_VERSION,
                 "bridge": type(self.bridge).__name__,
                 "ticks": self.ticks,

@@ -132,6 +132,36 @@ class _ChainTransformer(PacketTransformer):
         return _DonePending(batch), self._fold(mask, ok)
 
 
+    def reverse_transform_async(self, batch, mask=None):
+        """Dispatch-only inbound pass, mirroring `transform_async`: the
+        FIRST engine of the receive direction (the chain's LAST — SRTP,
+        by chain discipline) is dispatched without materializing when it
+        supports it; every remaining engine runs sync at materialization
+        time (host-cheap header work).  Returns a pending whose
+        `.result()` gives (batch, mask) — the deep-pipelining seam: the
+        device auth/decrypt overlaps whatever the caller does next
+        (typically the next recv window)."""
+        mask = _ones(batch) if mask is None else mask.copy()
+        if not self._ts:
+            return _DoneReverse((batch, mask))
+        name, head = self._ts[-1]
+        if not hasattr(head, "reverse_transform_async"):
+            return _DoneReverse(self.reverse_transform(batch, mask))
+        return _PendingReverse(self, head.reverse_transform_async(batch),
+                               name, mask)
+
+    def commit_inflight(self):
+        """Force-commit any outstanding dispatch-only unprotect state
+        across the chain (see _SrtpRtpTransformer.commit_inflight):
+        a fenced wait on PREVIOUSLY dispatched device work, split out
+        so callers can attribute it to the device phase rather than
+        the next dispatch span."""
+        for _name, t in self._ts:
+            commit = getattr(t, "commit_inflight", None)
+            if commit is not None:
+                commit()
+
+
 class _DonePending:
     """Degenerate pending for chains without an async tail."""
 
@@ -145,6 +175,56 @@ class _DonePending:
         """No device work outstanding — fencing is a no-op (the phase
         profiler fences pendings uniformly)."""
         return self
+
+
+class _DoneReverse:
+    """Degenerate reverse pending (no async head / already done)."""
+
+    def __init__(self, out):
+        self._out = out
+
+    def result(self):
+        return self._out
+
+    def block_until_ready(self):
+        return self
+
+
+class _PendingReverse:
+    """An in-flight chain `reverse_transform_async`: the head engine's
+    device work is dispatched; the downstream engines run when the
+    caller materializes.  Single-shot: result() caches."""
+
+    def __init__(self, chain: "_ChainTransformer", pend, head_name: str,
+                 mask):
+        self._chain = chain
+        self._pend = pend
+        self._head_name = head_name
+        self._mask = mask
+        self._out = None
+
+    def block_until_ready(self):
+        if self._out is None:
+            self._pend.block_until_ready()
+        return self
+
+    def result(self):
+        if self._out is not None:
+            return self._out
+        chain = self._chain
+        batch, ok = self._pend.result()
+        mask = self._mask
+        before = mask.sum()
+        mask = chain._fold(mask, ok)
+        chain.dropped[self._head_name] += max(0, int(before - mask.sum()))
+        for name, t in reversed(chain._ts[:-1]):
+            before = mask.sum()
+            batch, ok = t.reverse_transform(batch, mask)
+            mask = chain._fold(mask, ok)
+            chain.dropped[name] += max(0, int(before - mask.sum()))
+        self._out = (batch, mask)
+        self._pend = self._chain = None
+        return self._out
 
 
 class TransformEngineChain(TransformEngine):

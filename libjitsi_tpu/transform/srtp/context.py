@@ -287,9 +287,33 @@ class SrtpStreamTable:
         self._epoch_rtp = np.zeros(s, dtype=np.int64)
         self._epoch_rtcp = np.zeros(s, dtype=np.int64)
         self._masters: Dict[int, Tuple[bytes, bytes]] = {}
+        # the one outstanding dispatch-only unprotect (pipelined rx):
+        # its replay/counter commit is forced before any state reader
+        # or new dispatch can observe a stale window
+        self._inflight_unprotect: "PendingUnprotect | None" = None
+
+    def _commit_inflight_unprotect(self) -> None:
+        """Ordering barrier for the pipelined receive path: host replay
+        state of the outstanding `unprotect_rtp_async` must land before
+        anything re-reads or mutates per-stream RX state."""
+        p = self._inflight_unprotect
+        if p is not None:
+            p.commit()
+
+    def commit_inflight(self) -> None:
+        """Public commit barrier: materialize the outstanding async
+        unprotect's auth verdicts (a fenced wait on ITS device work)
+        and land the replay-window update now, instead of implicitly
+        inside the next dispatch."""
+        self._commit_inflight_unprotect()
 
     def _cow_tables(self) -> None:
         """Copy-on-write before any key-table mutation.
+
+        Also the safe point to force the pipelined receive commit:
+        every table mutator funnels through here, and a pending
+        unprotect must not commit replay state into rows a mutation is
+        about to recycle.
 
         On the CPU backend `jnp.asarray` can zero-copy ALIAS the host
         numpy buffers (see the project's asarray-alias note), so writing
@@ -304,6 +328,7 @@ class SrtpStreamTable:
         or a kdr epoch re-keying many streams — pays ONE table copy,
         not one per stream (a 10k GCM table is ~340 MB of matrices).
         """
+        self._commit_inflight_unprotect()
         if not self._aliased:
             self._dev = None
             return
@@ -973,6 +998,7 @@ class SrtpStreamTable:
         Reference: SRTPTransformer.reverseTransform →
         SRTPCryptoContext.reverseTransformPacket.
         """
+        self._commit_inflight_unprotect()
         if batch.batch_size == 0:
             ok0 = np.zeros(0, dtype=bool)
             if return_index:
@@ -1015,6 +1041,76 @@ class SrtpStreamTable:
                 idx[rows] = idxp
             return out, ok, idx
         return out, ok
+
+    def unprotect_rtp_async(self, batch: PacketBatch,
+                            return_index: bool = False
+                            ) -> "PendingUnprotect":
+        """Dispatch-only unprotect: device auth/decrypt is enqueued,
+        results are NOT materialized — the deep-pipelined receive seam
+        (the launch overlaps the next recv window).
+
+        Unlike protect, unprotect's host state (replay window, failure
+        counters) depends on the device verdicts, so NOTHING host-side
+        commits at dispatch; `PendingUnprotect.commit()` does, and the
+        table force-commits the outstanding pending before any new
+        unprotect (sync or async), any key-table mutation
+        (`_cow_tables`) and any snapshot — so successive windows always
+        replay-check against a current window, in dispatch order.  kdr
+        epoch batches fall back to the sync path (inherently
+        sequential).  `.result()` returns (batch, ok[, index]) exactly
+        like `unprotect_rtp`; failed rows keep their original bytes,
+        which means `batch` (possibly a recv-arena view) is read again
+        at materialization time — arena callers keep it pinned until
+        then.
+        """
+        self._commit_inflight_unprotect()
+        if batch.batch_size == 0:
+            ok0 = np.zeros(0, dtype=bool)
+            done = ((batch, ok0, np.zeros(0, dtype=np.int64))
+                    if return_index else (batch, ok0))
+            return PendingUnprotect(self, [], batch, return_index,
+                                    done=done)
+        stream0 = np.asarray(batch.stream, dtype=np.int64)
+        if self._kdr_active(stream0):
+            done = self.unprotect_rtp(batch, return_index)
+            return PendingUnprotect(self, [], batch, return_index,
+                                    done=done)
+        parts = bucket_by_size(batch)
+        pend = [(rows, self._unprotect_rtp_dispatch(part), n)
+                for rows, part, n in parts]
+        p = PendingUnprotect(self, pend, batch, return_index)
+        self._inflight_unprotect = p
+        return p
+
+    def _unprotect_rtp_dispatch(self, batch: PacketBatch) -> dict:
+        """Per-part device dispatch for the async unprotect: header
+        parse, index estimation and the device call — no host RX state
+        is read beyond `rx_max` (index estimation, current thanks to
+        the commit barrier) and none is written."""
+        p = self.policy
+        hdr = rtp_header.parse(batch)
+        stream = np.asarray(batch.stream, dtype=np.int64)
+        length = np.asarray(batch.length, dtype=np.int32)
+        valid = ((hdr.version == 2)
+                 & (length >= hdr.header_len + p.auth_tag_len)
+                 & self.active[stream] & (stream >= 0))
+        idx = self._estimate_rx_indices(stream, hdr.seq)
+        v = idx >> 16
+        if self._gcm:
+            iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
+            data, mlen, auth_ok = self._gcm_rtp_unprotect_call(
+                stream, batch, hdr, iv12, length)
+        elif self._f8:
+            iv = self._f8_rtp_iv(hdr, v)
+            data, mlen, auth_ok = self._f8_rtp_unprotect_call(
+                stream, batch, hdr, iv, v, length)
+        else:
+            iv = self._cm_iv(self._salt_rtp[stream], hdr.ssrc, idx)
+            data, mlen, auth_ok = self._cm_rtp_unprotect_call(
+                stream, batch, hdr, iv, v, length)
+        return {"part": batch, "stream": stream, "length": length,
+                "valid": valid, "idx": idx, "data": data, "mlen": mlen,
+                "auth_ok": auth_ok}
 
     def _unprotect_rtp_direct(self, batch: PacketBatch,
                               return_index: bool = False):
@@ -1331,6 +1427,7 @@ class SrtpStreamTable:
     def snapshot(self) -> dict:
         """Serializable crypto-state snapshot (ROC/replay survive restarts —
         without them streams die; see SURVEY §5 checkpoint/resume)."""
+        self._commit_inflight_unprotect()
         snap = {
             "profile": self.profile.value,
             "active": self.active.copy(),
@@ -1435,4 +1532,102 @@ class PendingProtect:
             out, _ = unbucket(done, self._batch_size, self._capacity)
             self._done = out
             self._parts = []
+        return self._done
+
+
+class PendingUnprotect:
+    """An in-flight `unprotect_rtp_async` call.
+
+    The device auth/decrypt is dispatched; host RX state is NOT — the
+    replay verdict chain (check → dedup → update) must run in dispatch
+    order against current windows, so it is deferred to `commit()`,
+    which the owning table forces before any newer unprotect, key
+    mutation or snapshot can observe stale state.  `result()` commits,
+    then assembles the output batch: failed rows keep their ORIGINAL
+    bytes, read from the dispatched batch at materialization time (so
+    a recv-arena view must stay pinned until then).  Single-shot:
+    result() caches and re-returns.
+    """
+
+    def __init__(self, table, parts, batch: PacketBatch,
+                 return_index: bool, done=None):
+        self._table = table
+        self._parts = parts
+        self._batch = batch
+        self._return_index = return_index
+        self._committed = done is not None
+        self._ok_parts: "list | None" = None
+        self._done = done
+
+    def block_until_ready(self) -> "PendingUnprotect":
+        """Fence the dispatched device work without transferring it
+        back (phase-profiler boundary)."""
+        if self._done is None:
+            try:
+                import jax
+
+                for _rows, rec, _n in self._parts:
+                    jax.block_until_ready(
+                        [rec["data"], rec["mlen"], rec["auth_ok"]])
+            except Exception:
+                pass
+        return self
+
+    def commit(self) -> None:
+        """Materialize the auth verdicts and commit host replay state +
+        failure counters, per size-class part IN ORDER (each part's
+        replay check sees the previous part's update, exactly like the
+        sync path)."""
+        if self._committed:
+            return
+        self._committed = True
+        t = self._table
+        if t._inflight_unprotect is self:
+            t._inflight_unprotect = None
+        self._ok_parts = []
+        for _rows, rec, _n in self._parts:
+            stream, idx, valid = rec["stream"], rec["idx"], rec["valid"]
+            auth_ok = np.asarray(rec["auth_ok"])
+            not_replayed = replay.check(t.rx_max, t.rx_mask, stream, idx)
+            srow = np.clip(stream, 0, t.capacity - 1)
+            np.add.at(t.auth_fail, srow, valid & not_replayed & ~auth_ok)
+            np.add.at(t.replay_reject, srow, valid & ~not_replayed)
+            ok = valid & not_replayed & auth_ok
+            ok &= ~replay.dedup_first(stream, idx, ok)
+            replay.update(t.rx_max, t.rx_mask, stream, idx, ok)
+            self._ok_parts.append(ok)
+
+    def result(self):
+        """(batch, ok) — or (batch, ok, index) when dispatched with
+        `return_index` — matching `unprotect_rtp`'s contract."""
+        if self._done is not None:
+            return self._done
+        self.commit()
+        batch = self._batch
+        done, masks, idx_parts = [], [], []
+        for (rows, rec, n), ok in zip(self._parts, self._ok_parts):
+            data = np.asarray(rec["data"])
+            mlen = np.asarray(rec["mlen"], dtype=np.int32)
+            pdat = rec["part"].data
+            out_data = np.where(ok[:, None], data, pdat)
+            out_len = np.where(ok, mlen, rec["length"]).astype(np.int32)
+            done.append((rows, PacketBatch(out_data, out_len,
+                                           rec["part"].stream), n))
+            masks.append(ok)
+            idx_parts.append((rows, rec["idx"][:n]))
+        out, okall = unbucket(done, batch.batch_size,
+                              batch.capacity, masks)
+        # ok=False rows keep their original bytes (sync-path contract)
+        out.data[~okall, :] = 0
+        take = min(out.capacity, batch.capacity)
+        out.data[~okall, :take] = batch.data[~okall, :take]
+        out.length[~okall] = np.asarray(batch.length)[~okall]
+        if self._return_index:
+            idx = np.zeros(batch.batch_size, dtype=np.int64)
+            for rows, idxp in idx_parts:
+                idx[rows] = idxp
+            self._done = (out, okall, idx)
+        else:
+            self._done = (out, okall)
+        self._parts, self._batch, self._ok_parts = [], None, None
         return self._done

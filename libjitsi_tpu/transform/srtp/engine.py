@@ -38,6 +38,21 @@ class _SrtpRtpTransformer(PacketTransformer):
             ok = ok & mask
         return out, ok
 
+    def reverse_transform_async(self, batch, mask=None):
+        """Dispatch-only unprotect (see
+        SrtpStreamTable.unprotect_rtp_async): the chain's pipelined
+        receive path materializes — and commits replay state — on
+        flush.  The pending's result() is (batch, ok)."""
+        return self.rx.unprotect_rtp_async(batch)
+
+    def commit_inflight(self):
+        """Force-commit the outstanding dispatch-only unprotect (a
+        fenced wait on ITS device auth work).  The next
+        `reverse_transform_async` would do this implicitly; calling it
+        explicitly lets the loop attribute the wait to the device
+        phase instead of the dispatch span."""
+        self.rx.commit_inflight()
+
 
 class _SrtpRtcpTransformer(PacketTransformer):
     def __init__(self, tx: SrtpStreamTable, rx: SrtpStreamTable):

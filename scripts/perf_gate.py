@@ -86,10 +86,22 @@ def floor_check(value: float, net_s: float):
 
 # ------------------------------------------------------------ scenarios
 
-def _scenario_loop_echo():
-    """Small loop-echo twin of bench.py `_loop_rtt_child`: client
-    protect -> loopback UDP -> MediaLoop tick (demux + unprotect +
-    echo + re-protect) -> client recv.  Returns echoed pps."""
+def _run_loop_echo(n_pkts=64, cycles=16, pipeline_depth=3,
+                   on_steady=None):
+    """Shared pipelined loop-echo harness: client -> loopback UDP ->
+    deep-pipelined MediaLoop (arena-view recv + async unprotect + echo
+    + async re-protect + gather egress) -> client recv.
+
+    Honesty rules: client-side SRTP is not the subject, so every burst
+    is protected OFF-clock before the timer starts and reply auth is
+    verified OFF-clock after it stops; the timed span covers only
+    wire-in -> loop ticks -> wire-out, with `pipeline_depth` bursts
+    kept in flight so the pipeline is actually full.  `on_steady` is
+    called once after the warm pass, right before the clock starts —
+    profiling callers snapshot their ledgers there so warmup compiles
+    (charged to `dispatch` by the phase taxonomy) don't pollute the
+    steady-state attribution.  Returns (authenticated_replies,
+    net_seconds)."""
     import libjitsi_tpu
     from libjitsi_tpu.io import UdpEngine
     from libjitsi_tpu.io.loop import MediaLoop
@@ -100,7 +112,6 @@ def _scenario_loop_echo():
     from libjitsi_tpu.transform import (SrtpTransformEngine,
                                         TransformEngineChain)
 
-    n_pkts, cycles = 64, 4
     libjitsi_tpu.stop()
     libjitsi_tpu.init()
     mk, ms = bytes(range(16)), bytes(range(30, 44))
@@ -121,44 +132,140 @@ def _scenario_loop_echo():
                            np.asarray(batch.length)[rows],
                            batch.stream[rows])
 
-    bridge = MediaLoop(UdpEngine(port=0, max_batch=n_pkts + 8), reg,
-                       on_media=on_media, chain=chain,
-                       recv_window_ms=0)
+    # the engine cap rides ABOVE the client burst size: the native
+    # drain pass coalesces several in-flight bursts into one window,
+    # which is the batching-depth optimization under test
+    loop = MediaLoop(UdpEngine(port=0, max_batch=4 * n_pkts), reg,
+                     on_media=on_media, chain=chain, recv_window_ms=0,
+                     pipeline_depth=pipeline_depth)
     reg.map_ssrc(0xBEEF01, 3)
     c_tx = SrtpStreamTable(capacity=1)
     c_tx.add_stream(0, mk, ms)
     c_rx = SrtpStreamTable(capacity=1)
     c_rx.add_stream(0, mk2, ms2)
-    client = UdpEngine(port=0, max_batch=n_pkts + 8)
-    done = 0
+    client = UdpEngine(port=0, max_batch=4 * n_pkts)
+    # protect every burst off-clock; bursts [0, cycles] are the warm
+    # pass (windowed sends land recv windows of MANY sizes, so the
+    # whole bucket ladder must compile before the clock starts),
+    # bursts (cycles, 2*cycles] are the measured steady-state pass
+    wires = []
+    for cyc in range(2 * cycles + 1):
+        b = rtp_header.build(
+            [b"\xab" * 160] * n_pkts,
+            list(range(cyc * n_pkts, (cyc + 1) * n_pkts)),
+            [cyc * 960] * n_pkts, [0xBEEF01] * n_pkts,
+            [96] * n_pkts, stream=[0] * n_pkts)
+        wires.append(c_tx.protect_rtp(b))
+    replies = []
+
+    def pump_once():
+        loop.tick()
+        back, _, _ = client.recv_batch(timeout_ms=0)
+        if back.batch_size:
+            replies.append(back)
+        return back.batch_size
+
+    def windowed_pass(first, last, deadline_s):
+        window = max(2, pipeline_depth + 1)
+        total = (last - first + 1) * n_pkts
+        nxt, outstanding, got = first, 0, 0
+        deadline = time.perf_counter() + deadline_s
+        while got < total and time.perf_counter() < deadline:
+            while nxt <= last and outstanding < window * n_pkts:
+                client.send_batch(wires[nxt], "127.0.0.1",
+                                  loop.engine.port)
+                outstanding += n_pkts
+                nxt += 1
+            k = pump_once()
+            got += k
+            outstanding -= k
+        loop.drain()
+        return got
+
     try:
-        t_all = None
-        for cyc in range(cycles + 1):       # cycle 0 is compile warmup
-            if cyc == 1:
-                t_all = time.perf_counter()
-            b = rtp_header.build(
-                [b"\xab" * 160] * n_pkts,
-                list(range(cyc * n_pkts, (cyc + 1) * n_pkts)),
-                [cyc * 960] * n_pkts, [0xBEEF01] * n_pkts,
-                [96] * n_pkts, stream=[0] * n_pkts)
-            wire = c_tx.protect_rtp(b)
-            client.send_batch(wire, "127.0.0.1", bridge.engine.port)
-            got = 0
-            cyc_deadline = time.perf_counter() + 10.0
-            while got < n_pkts and time.perf_counter() < cyc_deadline:
-                bridge.tick()
-                back, _, _ = client.recv_batch(timeout_ms=1)
-                if back.batch_size:
-                    back.stream[:] = 0
-                    _, ok = c_rx.unprotect_rtp(back)
-                    if cyc > 0:
-                        done += int(ok.sum())
-                    got += back.batch_size
-        net = time.perf_counter() - t_all
+        windowed_pass(0, cycles, 60.0)      # warm: compiles, arenas
+        replies.clear()                     # warm replies don't count
+        if on_steady is not None:
+            on_steady()
+        t0 = time.perf_counter()
+        windowed_pass(cycles + 1, 2 * cycles, 30.0)
+        net = time.perf_counter() - t0
     finally:
-        bridge.engine.close()
+        loop.engine.close()
         client.close()
+    done = 0
+    for back in replies:                    # auth verified off-clock
+        back.stream[:] = 0
+        _, ok = c_rx.unprotect_rtp(back)
+        done += int(ok.sum())
+    return done, net
+
+
+def _scenario_loop_echo():
+    """Deep-pipelined loop-echo twin of bench.py `_loop_rtt_child`:
+    loopback UDP -> MediaLoop at depth 3 (demux + unprotect + echo +
+    re-protect, recv/compute/send overlapped) -> client recv.  Returns
+    authenticated echoed pps."""
+    done, net = _run_loop_echo(n_pkts=64, cycles=16, pipeline_depth=3)
     return floor_check(done / net, net)
+
+
+def _scenario_loop_host_share():
+    """Phase-ledger host share of the pipelined loop-echo tick:
+    (host_python + dispatch) / non-idle time, captured with an
+    every-tick fenced PhaseProfiler (trace_report's capture
+    discipline).  Median of three passes — a ratio of two noisy sums
+    on a shared box needs the repeat-and-median treatment, same as
+    bench.py's timer discipline.  Lower is better; the baseline entry
+    carries a hard `ceiling` — the gate fails if the share exceeds it
+    regardless of the recorded baseline value.
+
+    Calibrated for the default single-device CPU backend (how tier-1
+    invokes this script).  Under tests/conftest.py's virtual 8-way
+    mesh (`--xla_force_host_platform_device_count=8`) XLA's thread
+    pool is split and the host/device balance shifts — the pytest slow
+    twin therefore re-execs the gate in a clean subprocess instead of
+    calling it in-process."""
+    from libjitsi_tpu.utils import perf as perf_mod
+
+    def one_pass():
+        profilers = []
+        orig_init = perf_mod.PhaseProfiler.__init__
+
+        def every_tick_init(self, *a, **kw):
+            kw["sample_every"] = 1
+            orig_init(self, *a, **kw)
+            profilers.append(self)
+
+        warm_marks = []
+
+        def snapshot_warm():
+            warm_marks.extend(
+                (prof, dict(getattr(prof, "phase_totals", {})))
+                for prof in profilers)
+
+        perf_mod.PhaseProfiler.__init__ = every_tick_init
+        try:
+            # saturated offered load (128-pkt bursts -> up to 512-pkt
+            # windows): host share is the overload-classification
+            # signal, so it is measured where it decides anything
+            _done, net = _run_loop_echo(n_pkts=128, cycles=16,
+                                        pipeline_depth=3,
+                                        on_steady=snapshot_warm)
+        finally:
+            perf_mod.PhaseProfiler.__init__ = orig_init
+        # steady-state delta only: warmup bucket compiles land in the
+        # `dispatch` phase and would swamp the share otherwise
+        phases = {}
+        for prof, warm in warm_marks:
+            for name, secs in getattr(prof, "phase_totals", {}).items():
+                phases[name] = (phases.get(name, 0.0) + secs
+                                - warm.get(name, 0.0))
+        return perf_mod.host_share(phases), net
+
+    passes = [one_pass() for _ in range(3)]
+    share = float(np.median([s for s, _n in passes]))
+    return floor_check(share, min(n for _s, n in passes))
 
 
 def _scenario_protect_small():
@@ -273,6 +380,7 @@ def _scenario_churn_admit():
 #: mapping against PERF_BASELINE.json keys (stale/missing entries)
 SCENARIOS = {
     "loop_echo_pps": _scenario_loop_echo,
+    "loop_host_share": _scenario_loop_host_share,
     "protect_small_pps": _scenario_protect_small,
     "install_streams_per_sec": _scenario_install_streams,
     "churn_admit_per_sec": _scenario_churn_admit,
@@ -282,14 +390,21 @@ SCENARIOS = {
 # ----------------------------------------------------------- comparison
 
 def judge(measured, baseline_value, tolerance: float,
-          higher_is_better: bool = True):
+          higher_is_better: bool = True, ceiling=None):
     """-> (status, detail).  Statuses: "ok", "regression",
     "below_floor" (either side is a below_floor record — never
-    numerically compared), "new" (no baseline)."""
-    if baseline_value is None:
-        return "new", "no baseline entry"
+    numerically compared), "new" (no baseline).  A `ceiling` is an
+    ABSOLUTE bar, enforced before any baseline-relative tolerance: a
+    measured value above it fails even if the recorded baseline has
+    drifted up with it."""
     if isinstance(measured, str):
         return "below_floor", measured
+    if ceiling is not None and float(measured) > float(ceiling):
+        return ("regression",
+                f"{measured:.3f} > ceiling {float(ceiling):g} "
+                "(absolute bar, independent of baseline)")
+    if baseline_value is None:
+        return "new", "no baseline entry"
     if isinstance(baseline_value, str):
         return "below_floor", f"baseline is {baseline_value}"
     base = float(baseline_value)
@@ -321,7 +436,8 @@ def compare(results: dict, baseline: dict):
             status, detail = judge(
                 measured, entry.get("value"),
                 float(entry.get("tolerance", DEFAULT_TOLERANCE)),
-                bool(entry.get("higher_is_better", True)))
+                bool(entry.get("higher_is_better", True)),
+                ceiling=entry.get("ceiling"))
         rows.append((name, status, detail))
         if status == "regression":
             failures.append((name, detail))
@@ -381,9 +497,15 @@ def write_baseline(path: str, results: dict,
         "note": "fast perf-gate baseline; re-baseline honestly "
                 "(quiet machine, explain the delta in the commit)"}}
     for name, value in results.items():
-        doc[name] = {"value": value,
-                     "tolerance": tol.get(name, DEFAULT_TOLERANCE),
-                     "higher_is_better": True}
+        entry = {"value": value,
+                 "tolerance": tol.get(name, DEFAULT_TOLERANCE),
+                 "higher_is_better": True}
+        if name == "loop_host_share":
+            # ISSUE 9 acceptance bar: host share of the echo tick must
+            # stay under 35% absolutely, not merely near its baseline
+            entry["higher_is_better"] = False
+            entry["ceiling"] = 0.35
+        doc[name] = entry
     with open(path, "w") as f:
         json.dump(doc, f, indent=2, sort_keys=True)
         f.write("\n")

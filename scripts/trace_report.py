@@ -170,32 +170,58 @@ def format_report(report: dict) -> str:
 
 
 def capture_loop_echo(log_dir: str) -> dict:
-    """Run the small loop-echo under jax.profiler.trace with an
-    every-tick PhaseProfiler; return {trace report, phase ledger}."""
+    """Two-pass loop-echo evidence capture: {trace report, phase ledger}.
+
+    Pass 1 (phase ledger + pps): the gate's windowed loop-echo with an
+    every-tick-fenced PhaseProfiler and NO jax.profiler trace active —
+    profiler instrumentation overhead lands inside the dispatch spans
+    and would misattribute the tick.  Warmup totals are snapshotted out
+    so bucket compiles don't pollute the steady-state ledger (the same
+    discipline as perf_gate's `loop_host_share` scenario).
+
+    Pass 2 (occupancy report): a shorter run of the same scenario under
+    jax.profiler.trace for the offline Perfetto view.  It is slower
+    under instrumentation by design; pass 1 owns the headline numbers.
+    """
     import perf_gate
     from libjitsi_tpu.utils import perf as perf_mod
     from libjitsi_tpu.utils.profiling import trace
 
-    ledger = {}
+    profilers = []
+    warm_marks = []
     orig_init = perf_mod.PhaseProfiler.__init__
 
     def every_tick_init(self, *a, **kw):
         kw["sample_every"] = 1          # fence every tick: evidence
         orig_init(self, *a, **kw)       # capture, not steady state
-        ledger.setdefault("profilers", []).append(self)
+        profilers.append(self)
+
+    def snapshot_warm():
+        warm_marks.extend(
+            (prof, dict(getattr(prof, "phase_totals", {})))
+            for prof in profilers)
 
     perf_mod.PhaseProfiler.__init__ = every_tick_init
     try:
-        with trace(log_dir):
-            value = perf_gate._scenario_loop_echo()
+        # saturated offered load (128-pkt bursts, the gate scenario's
+        # configuration): host share is workload-dependent — per-call
+        # dispatch overhead is constant, so it is measured where it
+        # classifies overload, not at trickle load
+        done, net = perf_gate._run_loop_echo(
+            n_pkts=128, cycles=16, pipeline_depth=3,
+            on_steady=snapshot_warm)
     finally:
         perf_mod.PhaseProfiler.__init__ = orig_init
+    # steady-state delta only (warmup compiles land in `dispatch`)
     phases = {}
-    for prof in ledger.get("profilers", ()):
+    for prof, warm in warm_marks:
         for name, secs in getattr(prof, "phase_totals", {}).items():
-            phases[name] = phases.get(name, 0.0) + secs
+            phases[name] = (phases.get(name, 0.0) + secs
+                            - warm.get(name, 0.0))
+    with trace(log_dir):
+        perf_gate._run_loop_echo(n_pkts=64, cycles=8, pipeline_depth=3)
     report = build_report(load_events(find_trace_file(log_dir)))
-    return {"loop_echo_pps": value, "phases": phases,
+    return {"loop_echo_pps": done / net, "phases": phases,
             "host_share": perf_mod.host_share(phases),
             "bound": perf_mod.classify_bound(phases),
             "trace": report}
@@ -217,7 +243,7 @@ def main(argv=None) -> int:
             print(json.dumps(doc, indent=2, default=str))
             return 0
         print(format_report(doc["trace"]))
-        print("== phase ledger (every tick fenced) ==")
+        print("== phase ledger (every tick fenced, steady state) ==")
         total = sum(doc["phases"].values()) or 1.0
         for name, secs in sorted(doc["phases"].items(),
                                  key=lambda kv: -kv[1]):
@@ -225,7 +251,9 @@ def main(argv=None) -> int:
                   f"({100 * secs / total:5.1f} %)")
         print(f"  host share (host / host+device): "
               f"{100 * doc['host_share']:.1f} %  -> {doc['bound']}-bound")
-        print(f"  loop_echo_pps: {doc['loop_echo_pps']}")
+        print(f"  loop_echo_pps (every-tick fenced — attribution "
+              f"overhead depresses this vs the perf-gate number): "
+              f"{doc['loop_echo_pps']}")
         return 0
     report = build_report(load_events(find_trace_file(args.path)))
     if args.json:

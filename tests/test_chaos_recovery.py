@@ -101,13 +101,14 @@ def _pump(sup, now, want):
     return got
 
 
-def _run_universe(wire, ckpt_path=None):
+def _run_universe(wire, ckpt_path=None, pipeline_depth=1):
     """Feed the faulted wire tick-by-tick; if ckpt_path is set, the
     bridge is checkpointed, destroyed, and recovered at KILL_AT."""
     libjitsi_tpu.stop()
     libjitsi_tpu.init()
     cfg = libjitsi_tpu.configuration_service()
-    bridge = ConferenceBridge(cfg, port=0, capacity=8, recv_window_ms=0)
+    bridge = ConferenceBridge(cfg, port=0, capacity=8, recv_window_ms=0,
+                              pipeline_depth=pipeline_depth)
     # quarantine OFF for this experiment: bans are deliberately
     # ephemeral runtime policy (not part of the checkpoint), so they
     # must not perturb the bit-exact accept-set comparison — the
@@ -129,7 +130,8 @@ def _run_universe(wire, ckpt_path=None):
             bridge.close()                      # the "crash"
             sup = BridgeSupervisor.recover(
                 cfg, ckpt_path, ConferenceBridge, port=0,
-                supervisor_config=sup.cfg, recv_window_ms=0)
+                supervisor_config=sup.cfg, recv_window_ms=0,
+                pipeline_depth=pipeline_depth)
             bridge = sup.bridge
             _record_media(bridge, accepted)
             port = bridge.port
@@ -143,6 +145,14 @@ def _run_universe(wire, ckpt_path=None):
         _pump(sup, now, sent)
         sup.tick(now=now + 0.001)               # decode tick
         now += 0.020
+    # collapse any in-flight pipeline stages (idle ticks drain, but be
+    # explicit): at depth d, the last d-1 arrivals are still deferred
+    for _ in range(4):
+        sup.tick(now=now)
+        now += 0.020
+    drain = getattr(bridge.loop, "drain", None)
+    if drain is not None:
+        drain()
     for eng in engines:
         eng.close()
     return accepted, bridge, sup
@@ -200,6 +210,29 @@ def test_kill_and_resume_is_bit_exact_under_loss_and_corruption(tmp_path):
     assert int(bridge_b.rx_table.replay_reject[replay_ci]) > before, \
         "pre-checkpoint replay re-entered after recovery"
     bridge_b.close()
+
+
+def test_depth3_pipeline_accept_set_is_bit_exact_across_kill(tmp_path):
+    """The deep pipeline reorders WORK, not PACKETS: a depth-3 bridge
+    fed the identical faulted wire accepts exactly the depth-1 accept
+    set — and a kill/recover at KILL_AT (the checkpoint lands with two
+    ticks of rx still in flight; save_checkpoint's drain barrier must
+    materialize them first) changes nothing."""
+    wire = _make_wire()
+    accepted_1, bridge_1, _ = _run_universe(wire)
+    bridge_1.close()
+
+    accepted_3, bridge_3, _ = _run_universe(wire, pipeline_depth=3)
+    bridge_3.close()
+    assert accepted_3 == accepted_1, \
+        "depth-3 pipeline changed the observable accept set"
+
+    ckpt = str(tmp_path / "deep.ckpt")
+    accepted_3k, bridge_3k, _ = _run_universe(wire, ckpt_path=ckpt,
+                                              pipeline_depth=3)
+    bridge_3k.close()
+    assert accepted_3k == accepted_1, \
+        "kill/recover mid-pipeline lost or duplicated acceptances"
 
 
 def test_quarantine_isolates_auth_storm_then_readmits():

@@ -21,6 +21,7 @@ import pytest
 from libjitsi_tpu.analysis import baseline as baseline_mod
 from libjitsi_tpu.analysis.checkers.drift import (check_metrics_drift,
                                                   check_snapshot_drift)
+from libjitsi_tpu.analysis.checkers.hotalloc import check_hotpath_alloc
 from libjitsi_tpu.analysis.checkers.hotpath import check_hotpath_purity
 from libjitsi_tpu.analysis.checkers.rtpmod16 import check_rtp_mod16
 from libjitsi_tpu.analysis.checkers.secrets import check_secret_taint
@@ -830,5 +831,98 @@ def test_checkers_have_seeded_true_positive_coverage():
     fixture test in this file (greps itself)."""
     with open(os.path.abspath(__file__)) as fh:
         me = fh.read()
-    for rule in ("hotpath", "secret", "mod16", "drift"):
+    for rule in ("hotpath", "hotalloc", "secret", "mod16", "drift"):
         assert me.count(f"def test_{rule}") >= 2
+
+
+# -------------------------------------------------------- hotpath-alloc
+
+def test_hotalloc_copy_and_ascontiguousarray_fire_in_io():
+    """Seeded from the zero-copy arena work: buf[:n].copy() per recv
+    window was the dominant host cost in the phase ledger."""
+    src = """
+    import numpy as np
+
+    def recv_window(self, buf, n):
+        batch = buf[:n].copy()
+        return batch
+
+    def egress(self, data):
+        return np.ascontiguousarray(data)
+    """
+    found = check_hotpath_alloc(
+        ctx_of(src, "libjitsi_tpu/io/fake.py"))
+    assert len(found) == 2
+    assert all(f.rule == "hotpath-alloc" for f in found)
+    assert "per" in found[0].message  # says it allocates per tick
+
+
+def test_hotalloc_pragma_suppresses():
+    src = """
+    import numpy as np
+
+    def recv_window(self, buf, n):
+        batch = buf[:n].copy()  # jitlint: disable=hotpath-alloc
+        return batch
+    """
+    assert check_hotpath_alloc(
+        ctx_of(src, "libjitsi_tpu/io/fake.py")) == []
+
+
+def test_hotalloc_scope_is_io_only():
+    """The same allocation outside io/ is not a tick-path concern."""
+    src = """
+    import numpy as np
+
+    def anywhere(self, buf, n):
+        return buf[:n].copy()
+    """
+    assert check_hotpath_alloc(
+        ctx_of(src, "libjitsi_tpu/transform/fake.py")) == []
+    assert check_hotpath_alloc(
+        ctx_of(src, "libjitsi_tpu/service/fake.py")) == []
+
+
+def test_hotalloc_cold_functions_do_not_fire():
+    """Constructors and teardown allocate by design; dict.copy-style
+    non-numpy receivers still fire (conservative) but np.copy via the
+    module alias is caught by the function arm, not the method arm."""
+    src = """
+    import numpy as np
+
+    class Engine:
+        def __init__(self):
+            self.buf = np.zeros((4, 1504), np.uint8).copy()
+
+        def close(self):
+            self.last = self.buf.copy()
+
+        def register_metrics(self, reg):
+            snap = self.buf.copy()
+            return snap
+    """
+    assert check_hotpath_alloc(
+        ctx_of(src, "libjitsi_tpu/io/fake.py")) == []
+
+
+def test_hotalloc_module_level_and_views_do_not_fire():
+    src = """
+    import numpy as np
+
+    _SCRATCH = np.zeros(16, np.uint8).copy()
+
+    def recv_view(self, buf, n):
+        return buf[:n]          # a view, not an allocation
+    """
+    assert check_hotpath_alloc(
+        ctx_of(src, "libjitsi_tpu/io/fake.py")) == []
+
+
+def test_hotalloc_repo_io_modules_are_clean():
+    """The shipped host-I/O modules carry no unpragma'd tick-path
+    allocations (every deliberate one states its rationale)."""
+    for mod in ("udp.py", "loop.py", "tcp.py"):
+        path = os.path.join(PKG, "io", mod)
+        with open(path) as fh:
+            ctx = FileContext(path, f"libjitsi_tpu/io/{mod}", fh.read())
+        assert check_hotpath_alloc(ctx) == [], mod

@@ -10,6 +10,7 @@ tolerance always exits 1.
 
 import json
 import os
+import subprocess
 import sys
 
 import pytest
@@ -51,6 +52,31 @@ def test_judge_new_scenario_and_lower_is_better():
         1.4, 1.0, 0.5, higher_is_better=False)[0] == "ok"
     assert perf_gate.judge(
         1.6, 1.0, 0.5, higher_is_better=False)[0] == "regression"
+
+
+def test_judge_ceiling_is_absolute():
+    # under the ceiling and near baseline: ok
+    assert perf_gate.judge(0.30, 0.30, 0.6, higher_is_better=False,
+                           ceiling=0.35)[0] == "ok"
+    # over the ceiling fails even within tolerance of a drifted
+    # baseline — the bar must not ratchet upward with the baseline
+    s, detail = perf_gate.judge(0.40, 0.38, 0.6,
+                                higher_is_better=False, ceiling=0.35)
+    assert s == "regression" and "ceiling" in detail
+    # the ceiling binds even without a baseline value
+    assert perf_gate.judge(0.40, None, 0.6,
+                           ceiling=0.35)[0] == "regression"
+    # a below_floor record is still never numerically compared
+    assert perf_gate.judge("below_floor: x", 0.3, 0.6,
+                           ceiling=0.35)[0] == "below_floor"
+
+
+def test_compare_passes_ceiling_through():
+    baseline = {"h": {"value": 0.5, "tolerance": 0.6,
+                      "higher_is_better": False, "ceiling": 0.35}}
+    failures, rows = perf_gate.compare({"h": 0.4}, baseline)
+    assert [name for name, _ in failures] == ["h"]
+    assert rows[0][1] == "regression"
 
 
 def test_compare_collects_failures():
@@ -149,17 +175,41 @@ def test_gate_subset_runs_named_scenario_only(stub_gate, capsys):
 
 # ----------------------------------------------------------- slow twin
 
+def _run_gate_subprocess(extra_env=None):
+    """Run the real gate exactly the way tier-1 does: a fresh
+    interpreter WITHOUT conftest's
+    `--xla_force_host_platform_device_count=8` mesh split.  The gate's
+    baseline (and the `loop_host_share` ceiling) are calibrated against
+    the default single-device CPU backend; the virtual 8-way mesh
+    splits XLA's thread pool and shifts the host/device balance, so
+    running the scenarios in-process under pytest measures a different
+    machine than the one tier-1 gates."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f)
+    if extra_env:
+        env.update(extra_env)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return subprocess.run(
+        [sys.executable, os.path.join(root, "scripts", "perf_gate.py"),
+         "--no-trend"],
+        cwd=root, env=env, capture_output=True, text=True, timeout=600)
+
+
 @pytest.mark.slow
 def test_real_gate_green_against_checked_in_baseline():
     """The full run tier-1 smokes, as a pytest twin: real scenarios vs
     the checked-in PERF_BASELINE.json."""
     assert os.path.exists(perf_gate.BASELINE_PATH), \
         "PERF_BASELINE.json missing (run --write-baseline)"
-    assert perf_gate.main(["--no-trend"]) == 0
+    proc = _run_gate_subprocess()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 @pytest.mark.slow
-def test_real_gate_detects_injected_regression(monkeypatch):
-    monkeypatch.setenv("PERF_GATE_INJECT_SLOW",
-                       "loop_echo_pps=100,protect_small_pps=100")
-    assert perf_gate.main(["--no-trend"]) == 1
+def test_real_gate_detects_injected_regression():
+    proc = _run_gate_subprocess(
+        {"PERF_GATE_INJECT_SLOW": "loop_echo_pps=100,protect_small_pps=100"})
+    assert proc.returncode == 1, proc.stdout + proc.stderr
