@@ -6,6 +6,8 @@ from libjitsi_tpu.analysis.checkers.drift import (check_snapshot_drift,
                                                   check_metrics_drift)
 from libjitsi_tpu.analysis.checkers.hotalloc import check_hotpath_alloc
 from libjitsi_tpu.analysis.checkers.hotpath import check_hotpath_purity
+from libjitsi_tpu.analysis.checkers.meshcollective import (
+    check_mesh_collectives)
 from libjitsi_tpu.analysis.checkers.rtpmod16 import check_rtp_mod16
 from libjitsi_tpu.analysis.checkers.secrets import check_secret_taint
 
@@ -21,7 +23,8 @@ PER_FILE_CHECKERS = (
 #: checker({relpath: ctx}) -> [Finding]
 GLOBAL_CHECKERS = (
     check_metrics_drift,
+    check_mesh_collectives,
 )
 
 RULES = ("hotpath-purity", "hotpath-alloc", "secret-taint", "rtp-mod16",
-         "drift")
+         "drift", "mesh-collective")

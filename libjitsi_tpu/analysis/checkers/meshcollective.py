@@ -1,0 +1,128 @@
+"""mesh-collective: cross-chip collectives outside sanctioned sites.
+
+PR 10's conference-affinity layout makes "zero cross-chip collectives
+on the steady-state tick" an architectural invariant, not a habit: a
+conference never straddles chips, so the mix-minus is a shard-local
+``segment_sum`` and the only collectives left in ``mesh/`` are the
+explicit giant-conference escape hatches enumerated in
+``libjitsi_tpu/mesh/placement.py``'s ``SANCTIONED_COLLECTIVE_SITES``.
+This rule is what keeps the invariant true under maintenance: any
+``psum`` / ``all_gather`` / ``ppermute`` (or kin) appearing in a
+``mesh/`` module outside a sanctioned (file, function) pair fails the
+lint gate — the perf claim "aggregate scaling is exact because shards
+share nothing" (``mesh_agg_pps_ratio``) is only as strong as this
+check.
+
+Global checker (not per-file): the sanctioned list is parsed from
+``placement.py``'s AST inside the same index, so placement stays the
+single source of truth and lint never imports jax.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from libjitsi_tpu.analysis.core import FileContext, Finding, node_name
+
+RULE = "mesh-collective"
+
+#: cross-device communication primitives (jax.lax and shard_map-body
+#: spellings); anything here outside a sanctioned site is a finding
+COLLECTIVES = {"psum", "pmean", "pmax", "pmin", "all_gather",
+               "all_to_all", "ppermute", "pshuffle", "psum_scatter"}
+
+_PLACEMENT_SUFFIX = "mesh/placement.py"
+
+
+def _in_mesh_module(relpath: str) -> bool:
+    return "/mesh/" in relpath or relpath.startswith("mesh/")
+
+
+def _sanctioned_sites(index: Dict[str, FileContext]
+                      ) -> Optional[Set[Tuple[str, str]]]:
+    """(path, function) pairs from placement.py's module-level
+    ``SANCTIONED_COLLECTIVE_SITES`` tuple literal (AST only)."""
+    for relpath, ctx in index.items():
+        if not relpath.endswith(_PLACEMENT_SUFFIX):
+            continue
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.AnnAssign) and
+                    isinstance(node.target, ast.Name) and
+                    node.target.id == "SANCTIONED_COLLECTIVE_SITES"):
+                if not (isinstance(node, ast.Assign) and any(
+                        isinstance(t, ast.Name) and
+                        t.id == "SANCTIONED_COLLECTIVE_SITES"
+                        for t in node.targets)):
+                    continue
+            value = getattr(node, "value", None)
+            if not isinstance(value, (ast.Tuple, ast.List)):
+                continue
+            sites = set()
+            for elt in value.elts:
+                if isinstance(elt, (ast.Tuple, ast.List)) and \
+                        len(elt.elts) == 2 and all(
+                            isinstance(e, ast.Constant) and
+                            isinstance(e.value, str) for e in elt.elts):
+                    sites.add((elt.elts[0].value, elt.elts[1].value))
+            return sites
+    return None
+
+
+def _collective_name(call: ast.Call) -> Optional[str]:
+    """Collective id when `call` is one, else None: matches both the
+    attribute spelling (`jax.lax.psum`, `lax.psum`) and a bare
+    imported name (`psum(...)`)."""
+    func = call.func
+    if isinstance(func, ast.Attribute) and func.attr in COLLECTIVES:
+        return func.attr
+    name = node_name(func)
+    if name in COLLECTIVES:
+        return name
+    return None
+
+
+def _enclosing_functions(node: ast.AST) -> Set[str]:
+    names = set()
+    cur = getattr(node, "_jl_parent", None)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(cur.name)
+        cur = getattr(cur, "_jl_parent", None)
+    return names
+
+
+def check_mesh_collectives(index: Dict[str, FileContext]
+                           ) -> List[Finding]:
+    sanctioned = _sanctioned_sites(index)
+    if sanctioned is None:
+        sanctioned = set()
+    out: List[Optional[Finding]] = []
+    for relpath, ctx in index.items():
+        if not _in_mesh_module(relpath):
+            continue
+        site_funcs = {fn for path, fn in sanctioned
+                      if relpath.endswith(path)}
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            coll = _collective_name(node)
+            if coll is None:
+                continue
+            if relpath.endswith(_PLACEMENT_SUFFIX):
+                # placement module itself defines the sanction list;
+                # a collective THERE would be the steady-state tick
+                # regressing — never sanctioned
+                pass
+            elif _enclosing_functions(node) & site_funcs:
+                continue
+            out.append(ctx.finding(
+                RULE, node,
+                f"cross-chip collective `{coll}` outside the "
+                "sanctioned escape hatches "
+                "(mesh/placement.py SANCTIONED_COLLECTIVE_SITES): "
+                "the steady-state tick must stay shard-local — place "
+                "whole conferences (ConferencePlacer) instead of "
+                "participant-sharding, or sanction the site "
+                "explicitly"))
+    return [f for f in out if f is not None]

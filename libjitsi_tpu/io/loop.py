@@ -164,6 +164,19 @@ class MediaLoop:
             lambda: self._unknown_suppressed,
             help_="unknown-SSRC warnings suppressed since the last "
                   "logged one")
+        # shard-major dispatch (0 = off): when conference-affinity
+        # placement is enabled, rows for one shard occupy one
+        # contiguous block of stream ids, so a stable sort of the RTP
+        # batch by `sid // rows_per_shard` groups each device's rows
+        # together — the layout the mesh table's affine owner-plan
+        # fast path needs to skip the argsort/scatter permutation
+        self.rows_per_shard = 0
+        self.shard_major_reorders = 0
+        self.metrics.register_scalar(
+            "loop_shard_major_reorders",
+            lambda: self.shard_major_reorders,
+            help_="RTP batches re-sorted into shard-major order before "
+                  "dispatch", kind="counter")
         self.ticks = 0
         self.rx_packets = 0
         self.tx_packets = 0
@@ -176,6 +189,17 @@ class MediaLoop:
             metrics=self.metrics, sample_every=phase_sample_every,
             tracer=self.tracer,
             inflight_fn=lambda: self.dispatch_inflight_ticks)
+
+    # ---------------------------------------------------- dispatch order
+    def enable_shard_major(self, rows_per_shard: int) -> None:
+        """Sort each RTP batch into shard-major row order before the
+        reverse chain.  Only meaningful with conference-affinity
+        placement (contiguous per-shard sid ranges); packet order
+        within a shard is preserved (stable sort), and RTP rows are
+        independent, so semantics are unchanged."""
+        if rows_per_shard <= 0:
+            raise ValueError("rows_per_shard must be positive")
+        self.rows_per_shard = int(rows_per_shard)
 
     # ------------------------------------------------------------- holds
     def hold_stream(self, sid: int, max_packets: int = 64) -> None:
@@ -357,9 +381,20 @@ class MediaLoop:
         if len(rtcp_rows) and self._hold_q:
             rtcp_rows = rtcp_rows[~self._hold_mask[sids[rtcp_rows]]]
 
+        # shard-major dispatch seam: group the batch by owning shard so
+        # the mesh table's affine fast path can place rows with a
+        # reshape instead of a gather/scatter permutation
+        reordered = False
+        if self.rows_per_shard and len(rtp_rows) > 1:
+            shard = sids[rtp_rows] // self.rows_per_shard
+            if np.any(shard[:-1] > shard[1:]):
+                rtp_rows = rtp_rows[np.argsort(shard, kind="stable")]
+                self.shard_major_reorders += 1
+                reordered = True
+
         with self.tracer.span("reverse_chain"):
             if len(rtp_rows):
-                if len(rtp_rows) == sub.batch_size:
+                if len(rtp_rows) == sub.batch_size and not reordered:
                     rtp = sub     # all-RTP fast path: still a view
                     ats_sel = ats
                 else:

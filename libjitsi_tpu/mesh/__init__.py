@@ -1,3 +1,20 @@
+"""Multi-chip plane.  Default path: conference-affinity sharding
+(`placement`) — whole conferences pinned to shards, zero-collective
+`affinity_tick` steady state.  The participant-sharded kernels
+(`sharded_mix_minus`, `sharded_media_step`) are the explicit
+giant-conference escape hatches and pay a cross-chip psum per tick;
+the `mesh-collective` lint gate keeps collectives confined to them."""
+
+from libjitsi_tpu.mesh.placement import (  # noqa: F401
+    SANCTIONED_COLLECTIVE_SITES,
+    ConferencePlacer,
+    PlacementMove,
+    ShardRowAllocator,
+    affinity_step_ref,
+    affinity_tick,
+    shard_local_mix,
+    size_class,
+)
 from libjitsi_tpu.mesh.sharded import (  # noqa: F401
     make_media_mesh,
     make_multihost_mesh,

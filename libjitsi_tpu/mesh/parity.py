@@ -217,3 +217,76 @@ def assert_sfu_parity(cfg, mesh, capacity: int,
     if plain != meshed:
         raise AssertionError(
             "assembled mesh SfuBridge egress != single-chip")
+
+
+# ------------------------------------------------- conference affinity
+
+def build_affinity_workload(batch: int, n_conf: int, rng,
+                            part: int = 4, width: int = 128,
+                            frame: int = 160, tag_len: int = 10):
+    """Argument tuple for `affinity_tick`/`affinity_step_ref`: rx rows
+    are authentic ciphertext (protected off-line so unprotect's auth
+    passes), `conf` numbers conferences within each shard slice."""
+    from libjitsi_tpu.kernels.aes import expand_key
+    from libjitsi_tpu.kernels.sha1 import hmac_precompute
+    from libjitsi_tpu.transform.srtp import kernel as k
+
+    def dense_args():
+        # dense per-row SRTP inputs, keys pre-gathered per row (the
+        # same shape family as __graft_entry__'s example args)
+        rk = np.stack([
+            expand_key(rng.integers(0, 256, 16,
+                                    dtype=np.uint8).tobytes())
+            for _ in range(batch)])
+        mid = np.stack([
+            hmac_precompute(rng.integers(0, 256, 20,
+                                         dtype=np.uint8).tobytes())
+            for _ in range(batch)])
+        data = rng.integers(0, 256, (batch, width), dtype=np.uint8)
+        data[:, 0] = 0x80
+        length = np.full(batch, width - 16, dtype=np.int32)
+        payload_off = np.full(batch, 12, dtype=np.int32)
+        iv = rng.integers(0, 256, (batch, 16), dtype=np.uint8)
+        roc = np.zeros(batch, dtype=np.uint32)
+        return data, length, payload_off, rk, iv, mid, roc
+
+    rx = dense_args()
+    enc, enc_len = k.srtp_protect(*rx, tag_len=tag_len, encrypt=True)
+    rx = (np.asarray(enc), np.asarray(enc_len, np.int32)) + rx[2:]
+    tx = dense_args()
+    pcm = rng.integers(-2000, 2000, (batch, frame)).astype(np.int16)
+    active = np.ones(batch, dtype=bool)
+    conf = ((np.arange(batch) // part) % n_conf).astype(np.int32)
+    return rx + (pcm, active, conf) + tx
+
+
+def assert_affinity_parity(mesh, n_devices: int, b_shard: int = 32,
+                           part: int = 4, tag_len: int = 10,
+                           seed: int = 11) -> None:
+    """`affinity_tick` on the mesh must be bit-identical, shard by
+    shard, to `affinity_step_ref` (the same body under plain jit) —
+    the structural proof that the tick is shard-local: if anything
+    leaked across the mesh axis, some shard's slice would differ from
+    the single-device run of that slice alone."""
+    import jax
+
+    from libjitsi_tpu.mesh.placement import (affinity_step_ref,
+                                             affinity_tick)
+
+    rng = np.random.default_rng(seed)
+    n_conf = b_shard // part
+    args = build_affinity_workload(n_devices * b_shard, n_conf, rng,
+                                   part=part, tag_len=tag_len)
+    got = affinity_tick(mesh, n_conf, tag_len)(*args)
+    jax.block_until_ready(got[3])
+    if not bool(np.all(np.asarray(got[2]))):
+        raise AssertionError("affinity tick failed SRTP auth")
+    ref = affinity_step_ref(n_conf, tag_len)
+    for s in range(n_devices):
+        sl = slice(s * b_shard, (s + 1) * b_shard)
+        want = ref(*[a[sl] for a in args])
+        for got_a, want_a in zip(got, want):
+            if not np.array_equal(np.asarray(got_a)[sl],
+                                  np.asarray(want_a)):
+                raise AssertionError(
+                    f"affinity tick != per-shard reference on shard {s}")

@@ -1,0 +1,472 @@
+"""Conference-affinity placement: a conference never straddles chips.
+
+`mesh/sharded.py`'s original design sharded one conference's
+PARTICIPANTS over the mesh axis and paid a cross-chip `psum` inside
+every steady-state mixer tick — measured on the 8-way CPU mesh as an
+~2x SLOWDOWN versus one plain device (`mesh_cpu8_ratio_vs_plain`
+~1.95, BENCH r05).  Conferences, though, are independent: nothing in a
+mixer tick couples conference A to conference B.  This module flips
+the unit of distribution from participants to conferences:
+
+- **`ConferencePlacer`** assigns each WHOLE conference to one shard at
+  join time (greedy least-loaded over a size-class cost model), so a
+  conference's SRTP rows, jitter state and recovery state are
+  shard-resident and a steady-state tick needs **zero cross-chip
+  collectives** — the mix-minus `psum` becomes a shard-local
+  `segment_sum` over the shard's own conference rows.
+- **`affinity_tick`** is that steady-state tick: one `shard_map` whose
+  body runs unprotect → segment-sum mix-minus → protect entirely
+  shard-locally.  The only cross-chip traffic left in the system is
+  placement/rebalance at join/leave time, which rides the
+  `StreamLifecycleManager` staged-install/commit-barrier path (a
+  placement move is a lifecycle event, never a mid-tick one).
+- **`ShardRowAllocator`** partitions the dense row space into
+  contiguous per-shard ranges so "conference C lives on shard S" is a
+  row-range invariant the device layout can rely on.
+
+The zero-collective claim is a hard gate, not a convention: the
+`mesh-collective` jitlint checker flags any `psum`/`all_gather`/
+`ppermute` in `mesh/` outside the escape-hatch kernels sanctioned in
+`SANCTIONED_COLLECTIVE_SITES` below (participant-sharding remains
+available for the one conference that outgrows a chip — see
+`sharded_mix_minus` — but nothing on the steady-state path reaches
+it).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from libjitsi_tpu.conference.mixer import I16_MAX, I16_MIN, audio_levels
+from libjitsi_tpu.mesh.compat import shard_map
+from libjitsi_tpu.transform.srtp import kernel
+
+AXIS = "streams"
+
+#: The ONLY call sites allowed to use cross-chip collectives, each the
+#: explicit giant-conference escape hatch (a single conference larger
+#: than one chip's row budget participant-shards and pays its psum).
+#: The `mesh-collective` jitlint checker reads this list; adding a
+#: collective anywhere else in mesh/ fails the lint gate.
+SANCTIONED_COLLECTIVE_SITES: Tuple[Tuple[str, str], ...] = (
+    ("libjitsi_tpu/mesh/sharded.py", "sharded_mix_minus"),
+    ("libjitsi_tpu/mesh/sharded.py", "sharded_mix_minus_2d"),
+    ("libjitsi_tpu/mesh/sharded.py", "sharded_media_step"),
+)
+
+#: participant counts a conference is padded to for cost/warmup
+#: purposes (matches the bridge's size-class discipline: shapes the
+#: device sees are class shapes, so cost should be class cost)
+SIZE_CLASSES: Tuple[int, ...] = (4, 8, 16, 32, 64, 128, 256)
+
+
+def size_class(n: int) -> int:
+    """Round a participant count up to its size class (the shape the
+    device actually pays for)."""
+    n = int(n)
+    for c in SIZE_CLASSES:
+        if n <= c:
+            return c
+    return n  # giant conference: costed at its true size
+
+
+@dataclass(frozen=True)
+class PlacementMove:
+    """One rebalance decision: move `conf_id` from `src` to `dst`.
+    Executed by the lifecycle plane through the commit barrier."""
+
+    conf_id: int
+    src: int
+    dst: int
+    n_participants: int
+
+
+@dataclass
+class _ShardLoad:
+    cost: float = 0.0
+    rows: int = 0
+    confs: int = 0
+
+
+class ConferencePlacer:
+    """Greedy least-loaded whole-conference placement.
+
+    Cost model: a conference of n participants costs
+    ``alpha * class(n) + beta * class(n)**2`` — the linear term is the
+    per-row crypto/mix work, the quadratic term the fan-out legs
+    (every participant receives every other's media), both rounded up
+    to the size class because class shapes are what the device
+    executes.  Placement is deterministic: identical join order yields
+    identical placement (ties break to the lowest shard index).
+
+    Rebalance happens ONLY through `plan_rebalance()` — called by the
+    lifecycle plane on join/leave, never mid-tick — and only when the
+    most-loaded shard exceeds `hysteresis` x the mean (so steady churn
+    does not thrash conferences between shards).
+    """
+
+    def __init__(self, n_shards: int, rows_per_shard: int = 128,
+                 alpha: float = 1.0, beta: float = 1.0 / 64.0,
+                 hysteresis: float = 1.3, max_moves: int = 4):
+        if n_shards < 1:
+            raise ValueError("need at least one shard")
+        self.n_shards = int(n_shards)
+        self.rows_per_shard = int(rows_per_shard)
+        self.alpha = float(alpha)
+        self.beta = float(beta)
+        self.hysteresis = float(hysteresis)
+        self.max_moves = int(max_moves)
+        self._loads: List[_ShardLoad] = [_ShardLoad()
+                                         for _ in range(self.n_shards)]
+        self._shard_of: Dict[int, int] = {}
+        self._size_of: Dict[int, int] = {}
+        self.placements = 0
+        self.rejects = 0
+        self.moves_planned = 0
+
+    # ------------------------------------------------------------- cost
+
+    def cost(self, n_participants: int) -> float:
+        c = size_class(n_participants)
+        return self.alpha * c + self.beta * c * c
+
+    # -------------------------------------------------------- placement
+
+    def shard_of(self, conf_id: int) -> Optional[int]:
+        return self._shard_of.get(int(conf_id))
+
+    def conferences_on(self, shard: int) -> List[int]:
+        return sorted(c for c, s in self._shard_of.items()
+                      if s == int(shard))
+
+    def place(self, conf_id: int, n_participants: int,
+              avoid=()) -> Optional[int]:
+        """Assign a NEW conference to the least-loaded shard with row
+        headroom; returns the shard, or None when no shard can hold it
+        (the caller refuses the join with a typed `capacity` reason).
+        Shards in `avoid` (e.g. currently burning their error budget)
+        are skipped unless they are the only ones with room.
+        Re-placing a known conference resizes it in place instead."""
+        conf_id = int(conf_id)
+        if conf_id in self._shard_of:
+            self.resize(conf_id, n_participants)
+            return self._shard_of[conf_id]
+        n = int(n_participants)
+        avoid = {int(a) for a in avoid}
+        best = None
+        for only_clean in (True, False) if avoid else (False,):
+            for s in range(self.n_shards):
+                if only_clean and s in avoid:
+                    continue
+                if self._loads[s].rows + n > self.rows_per_shard:
+                    continue
+                if (best is None
+                        or self._loads[s].cost < self._loads[best].cost):
+                    best = s  # strict <: ties stay on the lowest index
+            if best is not None:
+                break
+        if best is None:
+            self.rejects += 1
+            return None
+        self._assign(conf_id, best, n)
+        self.placements += 1
+        return best
+
+    def rebuild(self, assignments) -> None:
+        """Reset accounting to match reality (checkpoint recovery: the
+        restored bridge's rows are authoritative, not whatever the
+        placer believed before the kill).  `assignments` iterates
+        (conf_id, shard, n_participants)."""
+        self._loads = [_ShardLoad() for _ in range(self.n_shards)]
+        self._shard_of.clear()
+        self._size_of.clear()
+        for conf_id, shard, n in assignments:
+            self._assign(int(conf_id), int(shard), int(n))
+
+    def _assign(self, conf_id: int, shard: int, n: int) -> None:
+        self._shard_of[conf_id] = shard
+        self._size_of[conf_id] = n
+        ld = self._loads[shard]
+        ld.cost += self.cost(n)
+        ld.rows += n
+        ld.confs += 1
+
+    def resize(self, conf_id: int, n_participants: int) -> None:
+        """A participant joined/left an existing conference: update the
+        shard's accounting (the conference does not move here; a move
+        is only ever a `plan_rebalance` decision)."""
+        conf_id = int(conf_id)
+        shard = self._shard_of[conf_id]
+        old = self._size_of[conf_id]
+        new = int(n_participants)
+        ld = self._loads[shard]
+        ld.cost += self.cost(new) - self.cost(old)
+        ld.rows += new - old
+        self._size_of[conf_id] = new
+
+    def try_grow(self, conf_id: int, delta: int = 1) -> bool:
+        """Admit `delta` more participants into a placed conference if
+        its shard has row headroom; False = the join must be refused
+        (the conference cannot straddle onto another shard)."""
+        conf_id = int(conf_id)
+        shard = self._shard_of[conf_id]
+        if self._loads[shard].rows + delta > self.rows_per_shard:
+            return False
+        self.resize(conf_id, self._size_of[conf_id] + delta)
+        return True
+
+    def shrink(self, conf_id: int, delta: int = 1) -> None:
+        """A participant left; releases the conference when empty."""
+        conf_id = int(conf_id)
+        n = self._size_of[conf_id] - delta
+        if n <= 0:
+            self.release(conf_id)
+        else:
+            self.resize(conf_id, n)
+
+    def release(self, conf_id: int) -> None:
+        conf_id = int(conf_id)
+        shard = self._shard_of.pop(conf_id, None)
+        if shard is None:
+            return
+        n = self._size_of.pop(conf_id)
+        ld = self._loads[shard]
+        ld.cost -= self.cost(n)
+        ld.rows -= n
+        ld.confs -= 1
+
+    # -------------------------------------------------------- rebalance
+
+    def loads(self) -> List[Tuple[float, int, int]]:
+        """Per-shard (cost, rows, conferences) — /debug + metrics."""
+        return [(ld.cost, ld.rows, ld.confs) for ld in self._loads]
+
+    def plan_rebalance(self) -> List[PlacementMove]:
+        """Propose up to `max_moves` conference moves that shrink the
+        max-shard cost.  Pure planning: accounting updates when the
+        caller confirms each move landed (`apply_move`), because a move
+        is a staged lifecycle event that can still roll back."""
+        moves: List[PlacementMove] = []
+        # plan against a scratch copy so multi-move plans compose
+        cost = [ld.cost for ld in self._loads]
+        rows = [ld.rows for ld in self._loads]
+        placed = dict(self._shard_of)
+        mean = sum(cost) / self.n_shards
+        for _ in range(self.max_moves):
+            hot = max(range(self.n_shards), key=lambda s: (cost[s], -s))
+            cold = min(range(self.n_shards), key=lambda s: (cost[s], s))
+            if cost[hot] <= self.hysteresis * max(mean, 1e-9):
+                break
+            # smallest conference on the hot shard that fits the cold
+            # one and actually improves the imbalance
+            cands = sorted((self._size_of[c], c)
+                           for c, s in placed.items() if s == hot)
+            moved = False
+            for n, c in cands:
+                if rows[cold] + n > self.rows_per_shard:
+                    continue
+                delta = self.cost(n)
+                if cost[cold] + delta >= cost[hot]:
+                    continue  # would just swap who is hot
+                moves.append(PlacementMove(c, hot, cold, n))
+                cost[hot] -= delta
+                rows[hot] -= n
+                cost[cold] += delta
+                rows[cold] += n
+                placed[c] = cold
+                moved = True
+                break
+            if not moved:
+                break
+        self.moves_planned += len(moves)
+        return moves
+
+    def apply_move(self, move: PlacementMove) -> None:
+        """Commit one planned move into the accounting (called after
+        the lifecycle barrier actually landed the row migration)."""
+        conf_id = int(move.conf_id)
+        if self._shard_of.get(conf_id) != move.src:
+            raise ValueError(f"conference {conf_id} not on shard "
+                             f"{move.src}")
+        n = self._size_of[conf_id]
+        self.release(conf_id)
+        self._assign(conf_id, move.dst, n)
+
+    # ---------------------------------------------------- observability
+
+    def register_metrics(self, registry, prefix: str = "placement") -> None:
+        registry.register_counters(self, (
+            ("placements", "conferences placed onto shards"),
+            ("rejects", "placements refused for shard capacity"),
+            ("moves_planned", "rebalance moves proposed"),
+        ), prefix=prefix)
+        registry.register_multi(
+            f"{prefix}_shard_cost",
+            lambda: [({"shard": str(s)}, ld.cost)
+                     for s, ld in enumerate(self._loads)],
+            help_="size-class cost model load per shard")
+        registry.register_multi(
+            f"{prefix}_shard_rows",
+            lambda: [({"shard": str(s)}, float(ld.rows))
+                     for s, ld in enumerate(self._loads)],
+            help_="participant rows resident per shard")
+
+    def status(self) -> dict:
+        return {
+            "n_shards": self.n_shards,
+            "rows_per_shard": self.rows_per_shard,
+            "shards": [{"shard": s, "cost": ld.cost, "rows": ld.rows,
+                        "confs": ld.confs}
+                       for s, ld in enumerate(self._loads)],
+            "conferences": {str(c): s
+                            for c, s in sorted(self._shard_of.items())},
+        }
+
+
+class ShardRowAllocator:
+    """Contiguous per-shard row ranges over the dense stream table.
+
+    Shard s owns rows [s*rows_per, (s+1)*rows_per): a conference placed
+    on shard s draws all its rows from that range, which is what makes
+    the table's device layout shard-resident (row partition boundaries
+    coincide with shard boundaries, so `P(AXIS)` sharding of any
+    row-indexed array puts a conference's state wholly on its chip).
+    """
+
+    def __init__(self, capacity: int, n_shards: int):
+        if capacity % n_shards:
+            raise ValueError(f"capacity {capacity} not divisible by "
+                             f"{n_shards} shards")
+        self.capacity = int(capacity)
+        self.n_shards = int(n_shards)
+        self.rows_per = self.capacity // self.n_shards
+        # descending free stacks: pop() hands out lowest row first
+        self._free: List[List[int]] = [
+            list(range((s + 1) * self.rows_per - 1,
+                       s * self.rows_per - 1, -1))
+            for s in range(self.n_shards)]
+
+    def shard_of_row(self, sid: int) -> int:
+        return int(sid) // self.rows_per
+
+    def free_rows(self, shard: int) -> int:
+        return len(self._free[int(shard)])
+
+    def alloc_many(self, shard: int, k: int) -> List[int]:
+        free = self._free[int(shard)]
+        if len(free) < k:
+            raise RuntimeError(
+                f"shard {shard} row range exhausted ({len(free)} free, "
+                f"{k} wanted)")
+        return [free.pop() for _ in range(int(k))]
+
+    def free_many(self, sids: Sequence[int]) -> None:
+        for sid in sids:
+            sid = int(sid)
+            self._free[self.shard_of_row(sid)].append(sid)
+            self._free[self.shard_of_row(sid)].sort(reverse=True)
+
+    def reserve(self, sids: Sequence[int]) -> None:
+        """Claim specific rows (checkpoint restore)."""
+        want = {int(s) for s in sids}
+        for s in range(self.n_shards):
+            self._free[s] = [r for r in self._free[s] if r not in want]
+
+
+# ------------------------------------------------------ steady-state tick
+
+def shard_local_mix(mesh: Mesh, n_conf_per_shard: int):
+    """Mix-minus for conference-affinity layouts: ZERO collectives.
+
+    pcm int16 [B, F], active bool [B], conf int32 [B] — all sharded on
+    the batch axis, `conf` numbering conferences WITHIN each shard
+    (0..n_conf_per_shard).  Because a conference never straddles
+    shards, the cross-participant sum is a shard-local `segment_sum`
+    over the shard's own conference rows; contrast `sharded_mix_minus`
+    which pays a cross-chip psum to mix one participant-sharded
+    conference.
+    """
+
+    def _mix(pcm, active, conf):
+        p = pcm.astype(jnp.int32)
+        contrib = jnp.where(active[:, None], p, 0)
+        seg = jax.ops.segment_sum(contrib, conf,
+                                  num_segments=n_conf_per_shard)
+        mixed = jnp.clip(seg[conf] - contrib,
+                         I16_MIN, I16_MAX).astype(jnp.int16)
+        return mixed, audio_levels(p, active)
+
+    row = P(AXIS)
+    mat = P(AXIS, None)
+    return jax.jit(shard_map(
+        _mix, mesh=mesh, in_specs=(mat, row, row),
+        out_specs=(mat, row), check_vma=False))
+
+
+def _affinity_step_body(n_conf_per_shard: int, tag_len: int):
+    """The shard-local tick body shared by `affinity_tick` (wrapped in
+    `shard_map`) and `affinity_step_ref` (plain jit): unprotect →
+    segment-sum mix-minus → protect.  One definition so the mesh tick
+    and its single-device parity/benchmark reference cannot drift."""
+
+    def _step(data, length, off, rk, iv, mid, roc, pcm, active, conf,
+              odata, olength, ooff, ork, oiv, omid, oroc):
+        dec, dec_len, auth_ok = kernel.srtp_unprotect(
+            data, length, off, rk, iv, mid, roc, tag_len, True)
+        p = pcm.astype(jnp.int32)
+        contrib = jnp.where(active[:, None], p, 0)
+        seg = jax.ops.segment_sum(contrib, conf,
+                                  num_segments=n_conf_per_shard)
+        mixed = jnp.clip(seg[conf] - contrib,
+                         I16_MIN, I16_MAX).astype(jnp.int16)
+        levels = audio_levels(p, active)
+        enc, enc_len = kernel.srtp_protect(
+            odata, olength, ooff, ork, oiv, omid, oroc, tag_len, True)
+        return dec, dec_len, auth_ok, mixed, levels, enc, enc_len
+
+    return _step
+
+
+def affinity_tick(mesh: Mesh, n_conf_per_shard: int, tag_len: int = 10):
+    """The whole steady-state tick under conference affinity: one
+    `shard_map` running SRTP-unprotect → shard-local segment-sum
+    mix-minus → SRTP-protect, with zero cross-chip collectives (the
+    `mesh-collective` jitlint gate proves this stays true).
+
+    Every array is sharded on the batch/row axis; `conf` [B] numbers
+    conferences within each shard.  Because each shard's rows are a
+    contiguous range owned by `ShardRowAllocator`, the host never
+    reshuffles rows to launch this — batches arrive shard-major.
+
+    Successor of `sharded_media_step` (kept as the participant-sharded
+    escape hatch): same signature family, minus the psum.
+    """
+    _step = _affinity_step_body(n_conf_per_shard, tag_len)
+    row = P(AXIS)
+    mat = P(AXIS, None)
+    k3 = P(AXIS, None, None)
+    in_specs = (mat, row, row, k3, mat, k3, row,
+                mat, row, row,
+                mat, row, row, k3, mat, k3, row)
+    out_specs = (mat, row, row, mat, row, mat, row)
+    return jax.jit(shard_map(
+        _step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=False))
+
+
+def affinity_step_ref(n_conf_per_shard: int, tag_len: int = 10):
+    """Single-device twin of `affinity_tick`: the SAME shard-local body
+    under plain `jax.jit`, no mesh.  Two consumers: parity assertions
+    (the mesh tick must be bit-identical to this, shard by shard) and
+    the `mesh_agg_pps_ratio` perf-gate scenario, which times one
+    shard's workload on one device — legitimate as a per-shard proxy
+    precisely because the body has zero collectives, so shards share
+    no data and no synchronization."""
+    return jax.jit(_affinity_step_body(n_conf_per_shard, tag_len))

@@ -30,6 +30,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from libjitsi_tpu.mesh.compat import shard_map
+
 from libjitsi_tpu.conference.mixer import I16_MAX, I16_MIN, audio_levels
 from libjitsi_tpu.transform.srtp import kernel
 
@@ -75,7 +77,7 @@ def sharded_mix_minus_2d(mesh: Mesh):
         return out, audio_levels(pcm, active)
 
     spec_r = P((DCN_AXIS, AXIS))
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         _mix, mesh=mesh, in_specs=(P((DCN_AXIS, AXIS), None), spec_r),
         out_specs=(P((DCN_AXIS, AXIS), None), spec_r), check_vma=False,
     ))
@@ -98,7 +100,7 @@ def sharded_srtp_protect(mesh: Mesh, tag_len: int = 10, encrypt: bool = True):
     row = P(AXIS)
     specs = (P(AXIS, None), row, row, P(AXIS, None, None), P(AXIS, None),
              P(AXIS, None, None), row)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         fn, mesh=mesh, in_specs=specs, out_specs=(P(AXIS, None), row),
         check_vma=False,
     ))
@@ -122,26 +124,36 @@ def sharded_mix_minus(mesh: Mesh):
         out = jnp.clip(total - contrib, I16_MIN, I16_MAX).astype(jnp.int16)
         return out, audio_levels(pcm, active)
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         _mix, mesh=mesh, in_specs=(P(AXIS, None), P(AXIS)),
         out_specs=(P(AXIS, None), P(AXIS)), check_vma=False,
     ))
 
 
 def sharded_bridge_mix(mesh: Mesh):
-    """Whole-bridge multi-conference mixing sharded over the mesh.
+    """Whole-bridge multi-conference mixing sharded over the mesh —
+    the DENSE-RECTANGLE special case of the conference-affinity idea.
 
     pcm int16 [C, N, F] / active bool [C, N] sharded on the CONFERENCE
-    axis: conferences are independent, so each chip mixes its shard with
-    zero collectives — the bridge scales linearly in chips the way
-    stream-data-parallel SRTP does.  (Contrast sharded_mix_minus, which
-    shards one conference's PARTICIPANTS and pays a psum; use that only
-    when a single conference outgrows a chip.)
+    axis: conferences are independent, so each chip mixes its shard
+    with zero collectives — the bridge scales linearly in chips the
+    way stream-data-parallel SRTP does.  It requires every conference
+    padded to one fixed size N, which real churn never gives you; the
+    production path is `mesh/placement.py`: `ConferencePlacer` pins
+    whole conferences to shards over the RAGGED row layout and
+    `affinity_tick` mixes them with a shard-local `segment_sum` — same
+    zero-collective property, no padding.  Start there.
+
+    `sharded_mix_minus` / `sharded_media_step` remain the explicit
+    giant-conference escape hatches: they shard one conference's
+    PARTICIPANTS and pay a cross-chip psum every tick (the
+    `mesh-collective` lint gate sanctions exactly those sites).  Reach
+    for them only when a single conference outgrows a chip's rows.
     """
 
     from libjitsi_tpu.conference.mixer import mix_minus_many
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         lambda pcm, active: mix_minus_many(pcm, active),
         mesh=mesh, in_specs=(P(AXIS, None, None), P(AXIS, None)),
         out_specs=(P(AXIS, None, None), P(AXIS, None)), check_vma=False,
@@ -184,7 +196,7 @@ def sharded_media_step(mesh: Mesh, tag_len: int = 10):
                 mat, row,
                 mat, row, row, key3, mat, key3, row)
     out_specs = (mat, row, row, mat, row, mat, row)
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         _step, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         check_vma=False,
     ))
@@ -209,7 +221,7 @@ def sharded_gcm_fanout(mesh: Mesh, aad_const: int = 12):
                                           aad_const=aad_const)
         return out, out_len
 
-    return jax.jit(jax.shard_map(
+    return jax.jit(shard_map(
         _fan, mesh=mesh,
         in_specs=(P(None, None), P(None), P(AXIS, None, None),
                   P(AXIS, None, None), P(AXIS, None, None)),

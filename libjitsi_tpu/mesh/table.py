@@ -45,6 +45,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from libjitsi_tpu.mesh.compat import shard_map
+
 from libjitsi_tpu.kernels import registry as _registry
 from libjitsi_tpu.transform.srtp import kernel
 from libjitsi_tpu.transform.srtp.context import SrtpStreamTable, _uniform_off
@@ -72,8 +74,9 @@ class _LazyArray:
     def _materialize(self) -> np.ndarray:
         if self._np is None:
             a = np.asarray(self._dev)
-            a = (a.reshape(-1, *a.shape[2:]) if a.ndim > 1 else a)[
-                self._inv]
+            a = a.reshape(-1, *a.shape[2:]) if a.ndim > 1 else a
+            if self._inv is not None:   # None: affine plan, wire order
+                a = a[self._inv]
             if self._dtype is not None:
                 a = a.astype(self._dtype)
             self._np = a
@@ -168,6 +171,16 @@ class ShardedRowsMixin:
                               self.n_dev)
         local = local_rows(plan, ids, self.capacity, self.rows_per,
                            self.n_dev)
+        if plan.affine:
+            # identity routing: lane gather is a reshape, and the
+            # output scatter is skipped entirely (inv=None)
+            outs = fn(*tabs, jnp.asarray(local),
+                      *(jnp.asarray(np.asarray(a).reshape(
+                            plan.slot.shape[0], plan.per,
+                            *np.asarray(a).shape[1:]))
+                        for a in lane_args),
+                      *(jnp.asarray(e) for e in extra_args))
+            return tuple(_LazyArray(o, None) for o in outs)
         outs = fn(*tabs, jnp.asarray(local),
                   *(jnp.asarray(np.asarray(a)[plan.slot])
                     for a in lane_args),
@@ -196,13 +209,32 @@ class _OwnerPlan:
     Fully vectorized — no Python loop over devices (VERDICT r4 weak #6:
     the loop showed at 64k-batch x 8-device shapes)."""
 
-    __slots__ = ("slot", "inv", "per")
+    __slots__ = ("slot", "inv", "per", "affine")
 
     def __init__(self, stream: np.ndarray, capacity: int, rows_per: int,
                  n_dev: int):
         s = np.clip(stream, 0, capacity - 1)
         n = len(s)
         owner = s // rows_per
+        # Affine fast path (conference-affinity placement's steady
+        # state, mesh/placement.py): the batch already arrives
+        # shard-major with equal per-shard counts — rows are drawn from
+        # contiguous per-shard ranges, so no argsort, no scattered
+        # writes, and crucially NO pad-lane skew (random routing pads
+        # every device to the hottest device's pow2 lane count, which
+        # is where the mesh's 2x-slowdown came from).  Identity
+        # routing: slot is a reshape, inv is arange.
+        cnt = n // n_dev if n_dev else 0
+        self.affine = bool(
+            n and cnt >= 4 and n == cnt * n_dev
+            and (cnt & (cnt - 1)) == 0
+            and np.array_equal(owner,
+                               np.repeat(np.arange(n_dev), cnt)))
+        if self.affine:
+            self.per = cnt
+            self.slot = np.arange(n, dtype=np.int64).reshape(n_dev, cnt)
+            self.inv = np.arange(n, dtype=np.int64)
+            return
         order = np.argsort(owner, kind="stable")
         counts = np.bincount(owner, minlength=n_dev)
         top = int(counts.max()) if n else 1
@@ -666,7 +698,7 @@ class ShardedSrtpTable(ShardedRowsMixin, SrtpStreamTable):
             in_specs = (row3, row3, lanes, row3, lanes, lanes, row3,
                         lanes)
         n_out = 2 if "unprotect" not in op else 3
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             _run, mesh=self.mesh, in_specs=in_specs,
             out_specs=(row3, lanes) if n_out == 2
             else (row3, lanes, lanes), check_vma=False))
@@ -701,7 +733,7 @@ class ShardedSrtpTable(ShardedRowsMixin, SrtpStreamTable):
                 return tuple(o[None] for o in out)
 
             in_specs = (row3, row3, lanes, row3, lanes, lanes, row3)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             _run, mesh=self.mesh, in_specs=in_specs,
             out_specs=(row3, lanes, lanes) if unprot else (row3, lanes),
             check_vma=False))
@@ -746,6 +778,6 @@ class ShardedSrtpTable(ShardedRowsMixin, SrtpStreamTable):
                     return tuple(o[None] for o in out)
                 in_specs = (row3, row3, lanes, row3, lanes, row3, lanes)
             out_specs = (row3, lanes)
-        return jax.jit(jax.shard_map(
+        return jax.jit(shard_map(
             _run, mesh=self.mesh, in_specs=in_specs,
             out_specs=out_specs, check_vma=False))

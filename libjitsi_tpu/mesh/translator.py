@@ -23,6 +23,8 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from libjitsi_tpu.mesh.compat import shard_map
+
 from libjitsi_tpu.mesh.table import ShardedRowsMixin
 from libjitsi_tpu.sfu.translator import RtpTranslator
 from libjitsi_tpu.transform.srtp import kernel
@@ -108,7 +110,7 @@ class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
 
         row3 = P(self._axes, None, None)
         lanes = P(self._axes, None)
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             _run, mesh=self.mesh,
             in_specs=(row3, row3, lanes,
                       P(self._axes, None, None, None),
@@ -133,7 +135,7 @@ class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
 
         row3 = P(self._axes, None, None)
         lanes = P(self._axes, None)
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             _run, mesh=self.mesh,
             in_specs=(row3, row3, lanes, row3, lanes, lanes, row3),
             out_specs=(row3, lanes), check_vma=False))
@@ -158,7 +160,7 @@ class ShardedRtpTranslator(ShardedRowsMixin, RtpTranslator):
 
         row3 = P(self._axes, None, None)
         lanes = P(self._axes, None)
-        fn = jax.jit(jax.shard_map(
+        fn = jax.jit(shard_map(
             _run, mesh=self.mesh,
             in_specs=(row3, row3, lanes, row3, lanes, lanes, row3,
                       lanes),

@@ -56,7 +56,7 @@ _log = get_logger("lifecycle")
 #: every reason `request_join` can refuse with (typed: metrics, flight
 #: events and callers all share these strings)
 ADMIT_REASONS = ("capacity", "backlog", "duplicate", "fast_burn",
-                 "stalled", "shedding", "host_bound")
+                 "stalled", "shedding", "host_bound", "shard_burn")
 
 
 @dataclass
@@ -97,10 +97,16 @@ class StreamLifecycleManager:
             flight = (supervisor.flight if supervisor is not None
                       else getattr(bridge, "flight", None))
         self.flight = flight if flight is not None else FlightRecorder()
-        # join queue: (ssrc, rx_key, tx_key, name) host-side only until
-        # poll() stages a batch
+        # join queue: (ssrc, rx_key, tx_key, name, conference)
+        # host-side only until poll() stages a batch
         self._join_q: deque = deque()
         self._queued_ssrcs: set = set()
+        # conference-affinity placement (mesh/placement.py): None until
+        # enable_placement — the single-conference bridge needs none
+        self.placer = None
+        self._rows_per_shard = 0
+        self._move_inflight: Optional[dict] = None
+        self.moves_applied = 0
         self._staged: List[int] = []     # staged sids awaiting commit
         self._evict_q: List[int] = []
         # counters (all registered in register_metrics)
@@ -121,6 +127,52 @@ class StreamLifecycleManager:
                 supervisor.pending_lifecycle = None
         if metrics is not None:
             self.register_metrics(metrics)
+
+    # ------------------------------------------------------- placement
+
+    def enable_placement(self, n_shards: int, placer=None) -> None:
+        """Turn on conference-affinity sharding (mesh/placement.py):
+        joins carry a `conference` id, whole conferences are assigned
+        to shards at join time, rows are drawn from the conference's
+        shard range, and rebalance moves run through the commit
+        barrier.  `n_shards` must divide the registry capacity (shard
+        ranges are contiguous row blocks)."""
+        from libjitsi_tpu.mesh.placement import ConferencePlacer
+        capacity = self.bridge.registry.capacity
+        if capacity % n_shards:
+            raise ValueError(f"capacity {capacity} not divisible by "
+                             f"{n_shards} shards")
+        self._rows_per_shard = capacity // n_shards
+        if placer is None:
+            placer = ConferencePlacer(
+                n_shards, rows_per_shard=self._rows_per_shard)
+        elif placer.rows_per_shard > self._rows_per_shard:
+            raise ValueError("placer rows_per_shard exceeds the "
+                             "registry's shard range")
+        self.placer = placer
+        # shard-major dispatch: contiguous shard sid ranges mean a
+        # stable per-batch sort groups each device's rows (io/loop.py)
+        loop = getattr(self.bridge, "loop", None)
+        if loop is not None and hasattr(loop, "enable_shard_major"):
+            loop.enable_shard_major(self._rows_per_shard)
+
+    def _conf_key(self, ssrc: int, conference) -> int:
+        # a placement-enabled join without a conference id is a
+        # singleton conference (keyed off the ssrc, negative so user
+        # conference ids can never collide with it)
+        return int(conference) if conference is not None \
+            else -(int(ssrc) + 2)
+
+    def _free_rows_on(self, shard: int, k: int) -> List[int]:
+        """Up to `k` free registry rows inside `shard`'s range.  The
+        registry stays the single source of truth for row freedom
+        (video tracks and direct add_endpoint also draw from it);
+        placement only constrains WHERE a conference's rows may live."""
+        lo = shard * self._rows_per_shard
+        hi = lo + self._rows_per_shard
+        avail = sorted(s for s in self.bridge.registry._free
+                       if lo <= s < hi)
+        return avail[:k]
 
     # ------------------------------------------------------- admission
 
@@ -143,14 +195,58 @@ class StreamLifecycleManager:
                 return reason
         return None
 
+    def _burning_shards(self) -> set:
+        sup = self.supervisor
+        slo = getattr(sup, "slo", None) if sup is not None else None
+        if slo is None:
+            return set()
+        out: set = set()
+        for spec in getattr(slo, "sliced", ()):
+            if spec.label == "shard":
+                out |= {int(k) for k in slo.burning_slices(spec.name)}
+        return out
+
+    def _place_join(self, ssrc: int, conference) -> Tuple[Optional[int],
+                                                          Optional[str]]:
+        """Placement half of admission: returns (conf_key, reason).
+        A join into an EXISTING conference targets its shard — refused
+        `shard_burn` when that specific shard is burning fast (the
+        conference cannot straddle to a healthy one), `capacity` when
+        the shard's row range is full.  A NEW conference places
+        least-loaded, steering around burning shards."""
+        conf = self._conf_key(ssrc, conference)
+        shard = self.placer.shard_of(conf)
+        if shard is not None:
+            if self.supervisor is not None:
+                ok, r = self.supervisor.admission_decision(shard=shard)
+                if not ok and r == "shard_burn":
+                    return conf, r
+            if not self.placer.try_grow(conf):
+                return conf, "capacity"
+            return conf, None
+        if self.placer.place(conf, 1,
+                             avoid=self._burning_shards()) is None:
+            return conf, "capacity"
+        return conf, None
+
     def request_join(self, ssrc: int, rx_key: Tuple[bytes, bytes],
                      tx_key: Tuple[bytes, bytes],
-                     name: Optional[str] = None) -> Tuple[bool, str]:
+                     name: Optional[str] = None,
+                     conference=None) -> Tuple[bool, str]:
         """Admission decision + queue.  Returns (accepted, reason):
         (True, "queued") or (False, <typed reason>).  Nothing touches
-        the device here — keys install off-tick in poll()."""
+        the device here — keys install off-tick in poll().
+
+        With placement enabled (`enable_placement`), `conference`
+        groups endpoints: the whole conference lives on one shard, its
+        rows are drawn from that shard's range, and forwarding is
+        scoped to it.  A join without a conference id is a singleton
+        conference."""
         ssrc = int(ssrc) & 0xFFFFFFFF
         reason = self._admission_reason(ssrc)
+        conf = None
+        if reason is None and self.placer is not None:
+            conf, reason = self._place_join(ssrc, conference)
         if reason is not None:
             self.admit_rejected[reason] = \
                 self.admit_rejected.get(reason, 0) + 1
@@ -158,7 +254,8 @@ class StreamLifecycleManager:
                                ssrc=ssrc, reason=reason)
             _log.info("admit_reject", ssrc=ssrc, reason=reason)
             return False, reason
-        self._join_q.append((ssrc, tuple(rx_key), tuple(tx_key), name))
+        self._join_q.append((ssrc, tuple(rx_key), tuple(tx_key), name,
+                             conf))
         self._queued_ssrcs.add(ssrc)
         self.flight.record("admit_queued", tick=self.ticks(), ssrc=ssrc)
         return True, "queued"
@@ -174,6 +271,10 @@ class StreamLifecycleManager:
             ssrc = int(ssrc) & 0xFFFFFFFF
             if ssrc in self._queued_ssrcs:          # never installed
                 self._queued_ssrcs.discard(ssrc)
+                if self.placer is not None:
+                    for j in self._join_q:
+                        if j[0] == ssrc and j[4] is not None:
+                            self.placer.shrink(j[4])
                 self._join_q = deque(j for j in self._join_q
                                      if j[0] != ssrc)
                 self.flight.record("admit_cancelled",
@@ -191,9 +292,12 @@ class StreamLifecycleManager:
     def run_between_ticks(self, now=None) -> None:
         """The off-tick half of the plane: commit barrier first (staged
         rows flip live, queued evicts tear down — both between ticks,
-        never inside one), then stage the next install wave."""
+        never inside one), then stage the next install wave, then any
+        placement rebalance moves (also lifecycle events: a conference
+        only ever changes shards here, never mid-tick)."""
         self.commit()
         self.poll()
+        self.rebalance()
 
     def commit(self) -> None:
         """Atomic (w.r.t. the tick) population flip: committed admits
@@ -220,33 +324,126 @@ class StreamLifecycleManager:
             self._evict_q = []
             sids = [s for s in live if s in self.bridge._ssrc_of]
             if sids:
+                conf_of = getattr(self.bridge, "_conf_of", {})
+                gone_confs = [conf_of.get(s) for s in sids]
                 self.bridge.remove_endpoints(sids)
                 self.evicts += len(sids)
                 if self.supervisor is not None:
                     self.supervisor.note_evicted(sids)
+                if self.placer is not None:
+                    for conf in gone_confs:
+                        if conf is not None:
+                            self.placer.shrink(conf)
+                            if self.placer.shard_of(conf) is None:
+                                self._drop_conference_slices(conf)
 
     def poll(self) -> None:
         """Stage the next install wave: batch-limited, slot-limited,
         with the target bucket's shapes warmed BEFORE any new stream
-        can contribute traffic."""
+        can contribute traffic.  Under placement, each join's row is
+        drawn from its conference's shard range (a spec whose shard has
+        no physical row free — out-of-band allocs can fragment a range
+        — re-queues for a later wave rather than straddling)."""
         n = min(len(self._join_q), self.cfg.install_batch,
                 self.bridge.registry.free_slots)
         if n <= 0:
             return
-        specs = [self._join_q.popleft() for _ in range(n)]
+        popped = [self._join_q.popleft() for _ in range(n)]
+        if self.placer is None:
+            specs, sids, confs = popped, None, None
+        else:
+            by_shard: Dict[int, list] = {}
+            for spec in popped:
+                shard = self.placer.shard_of(spec[4])
+                by_shard.setdefault(shard, []).append(spec)
+            specs, sids, confs = [], [], []
+            requeue: list = []
+            for shard in sorted(by_shard):
+                group = by_shard[shard]
+                rows = self._free_rows_on(shard, len(group))
+                for spec, row in zip(group, rows):
+                    specs.append(spec)
+                    sids.append(row)
+                    confs.append(spec[4])
+                requeue.extend(group[len(rows):])
+            for spec in reversed(requeue):
+                self._join_q.appendleft(spec)
+            if not specs:
+                return
         for spec in specs:
             self._queued_ssrcs.discard(spec[0])
-        self._ensure_warm(len(self.bridge._ssrc_of) + n)
-        sids = self.bridge.stage_endpoints(specs)
-        self.key_installs += n
-        self._staged.extend(sids)
-        for sid, spec in zip(sids, specs):
+        self._ensure_warm(len(self.bridge._ssrc_of) + len(specs))
+        specs4 = [tuple(spec[:4]) for spec in specs]
+        if self.placer is None:
+            # kwarg-free call: bridge fakes/older bridges keep working
+            out_sids = self.bridge.stage_endpoints(specs4)
+        else:
+            out_sids = self.bridge.stage_endpoints(
+                specs4, sids=sids, conferences=confs)
+        self.key_installs += len(specs)
+        self._staged.extend(out_sids)
+        for sid, spec in zip(out_sids, specs):
             self.flight.record("key_install", tick=self.ticks(),
                                sid=sid, ssrc=spec[0])
 
     @property
     def key_installs_pending(self) -> int:
         return len(self._join_q) + len(self._staged)
+
+    # ------------------------------------------------ placement moves
+
+    def rebalance(self) -> int:
+        """Execute the placer's rebalance plan as lifecycle events:
+        each move relocates one whole conference's rows to the
+        destination shard's range via `migrate_endpoints` (bit-exact
+        SRTP/translator state, between ticks, behind the same drain
+        barrier commits use).  A conference with members still queued
+        or staged skips its move — moving half a conference would
+        straddle it, the one invariant this module exists to hold."""
+        if self.placer is None:
+            return 0
+        done = 0
+        conf_of = getattr(self.bridge, "_conf_of", {})
+        for mv in self.placer.plan_rebalance():
+            members = [s for s, c in conf_of.items()
+                       if c == mv.conf_id]
+            sids = sorted(s for s in members
+                          if s in self.bridge._ssrc_of
+                          and s not in self.bridge._staged)
+            if not sids or len(sids) != len(members):
+                continue  # mid-install conference: move next window
+            if any(j[4] == mv.conf_id for j in self._join_q):
+                continue
+            rows = self._free_rows_on(mv.dst, len(sids))
+            if len(rows) < len(sids):
+                continue  # destination range fragmented; replan later
+            mapping = dict(zip(sids, rows))
+            self._move_inflight = {"conf": int(mv.conf_id),
+                                   "src": mv.src, "dst": mv.dst,
+                                   "mapping": dict(mapping)}
+            self.flight.record("placement_move_begin",
+                               tick=self.ticks(), conf=mv.conf_id,
+                               src=mv.src, dst=mv.dst, rows=len(sids))
+            self.bridge.migrate_endpoints(mapping)
+            self.placer.apply_move(mv)
+            self._move_inflight = None
+            self.moves_applied += 1
+            done += 1
+            self.flight.record("placement_move", tick=self.ticks(),
+                               conf=mv.conf_id, src=mv.src, dst=mv.dst,
+                               rows=len(sids))
+            _log.info("placement_move", conf=mv.conf_id, src=mv.src,
+                      dst=mv.dst, rows=len(sids))
+        return done
+
+    def _drop_conference_slices(self, conf) -> None:
+        slo = getattr(self.supervisor, "slo", None) \
+            if self.supervisor is not None else None
+        if slo is None:
+            return
+        for spec in getattr(slo, "sliced", ()):
+            if spec.label == "conference":
+                slo.drop_slice(spec.name, str(conf))
 
     # ----------------------------------------------- bucketed warmup
 
@@ -324,13 +521,20 @@ class StreamLifecycleManager:
     def snapshot(self) -> dict:
         """In-flight admit state for the supervisor checkpoint: queued
         joins carry their keys (host-side only so far); staged sids'
-        keys already ride the bridge snapshot."""
-        return {
-            "queued": [(ssrc, rx, tx, name)
-                       for ssrc, rx, tx, name in self._join_q],
+        keys already ride the bridge snapshot.  With placement enabled
+        the in-flight move (if any) rides too, so recovery can tell a
+        completed move from a rolled-back one."""
+        snap = {
+            "queued": [tuple(j) for j in self._join_q],
             "staged": [(sid, self.bridge._ssrc_of.get(sid))
                        for sid in self._staged],
         }
+        if self.placer is not None:
+            snap["placement"] = {
+                "n_shards": self.placer.n_shards,
+                "move_inflight": self._move_inflight,
+            }
+        return snap
 
     def _reconcile(self, pend: dict) -> None:
         """Post-`recover()` reconciliation: every in-flight admit either
@@ -344,6 +548,9 @@ class StreamLifecycleManager:
         * queued joins: never touched the device; they re-enter the
           queue and install through the normal off-tick pipeline.
         """
+        pl = pend.get("placement")
+        if pl is not None and self.placer is None:
+            self.enable_placement(int(pl["n_shards"]))
         for sid, ssrc in pend.get("staged", []):
             sid = int(sid)
             if (sid in self.bridge._ssrc_of
@@ -357,8 +564,51 @@ class StreamLifecycleManager:
                 self.flight.record("admit_rollback", tick=self.ticks(),
                                    sid=sid, ssrc=ssrc)
                 _log.info("admit_rollback", sid=sid)
-        for ssrc, rx, tx, name in pend.get("queued", []):
-            self.request_join(ssrc, rx, tx, name=name)
+        if self.placer is not None:
+            self._reconcile_placement(pl or {})
+        for spec in pend.get("queued", []):
+            ssrc, rx, tx, name = spec[:4]
+            conf = spec[4] if len(spec) > 4 else None
+            # solo (negative) conference keys re-derive from the ssrc
+            self.request_join(ssrc, rx, tx, name=name,
+                              conference=conf if (conf is None
+                                                  or conf >= 0) else None)
+
+    def _reconcile_placement(self, pl: dict) -> None:
+        """Rebuild placement accounting from the RESTORED rows — the
+        bridge's row layout is authoritative, never the placer's
+        pre-kill beliefs.  `migrate_endpoints` is host-atomic between
+        ticks, so a kill during a placement move restores either the
+        fully-pre-move or fully-post-move layout; this proves which one
+        landed (completed vs rolled back) and asserts the invariant
+        placement exists for: no conference straddles a shard range."""
+        members: Dict[int, list] = {}
+        for sid, conf in self.bridge._conf_of.items():
+            if sid in self.bridge._ssrc_of:
+                members.setdefault(int(conf), []).append(int(sid))
+        assignments = []
+        for conf, sids in sorted(members.items()):
+            shards = {s // self._rows_per_shard for s in sids}
+            if len(shards) != 1:
+                raise AssertionError(
+                    f"conference {conf} straddles shards {sorted(shards)} "
+                    f"after recovery — torn placement")
+            assignments.append((conf, shards.pop(), len(sids)))
+        self.placer.rebuild(assignments)
+        mv = pl.get("move_inflight")
+        if mv:
+            conf = int(mv["conf"])
+            landed = self.placer.shard_of(conf)
+            outcome = ("completed" if landed == int(mv["dst"])
+                       else "rolled_back")
+            if outcome == "completed":
+                self.moves_applied += 1
+            self.flight.record("placement_move_recovered",
+                               tick=self.ticks(), conf=conf,
+                               outcome=outcome, src=mv["src"],
+                               dst=mv["dst"])
+            _log.info("placement_move_recovered", conf=conf,
+                      outcome=outcome)
 
     # --------------------------------------------------- observability
 
@@ -369,6 +619,8 @@ class StreamLifecycleManager:
             ("key_installs", "streams whose keys installed off-tick"),
             ("datapath_recompiles",
              "compile events inside tick windows (invariant: 0)"),
+            ("moves_applied",
+             "placement rebalance moves executed at the barrier"),
         ), prefix=prefix)
         registry.register_scalar(
             f"{prefix}_key_installs_pending",

@@ -250,6 +250,11 @@ class SfuBridge:
         # BWE transport row per stream row: GCC estimates per TRANSPORT
         # (5-tuple), so a sender's video layer rows feed its primary row
         self._transport_of = np.arange(capacity, dtype=np.int64)
+        # conference scoping (mesh/placement.py): sid -> conference id.
+        # Endpoints with a conference id forward only within it; rows
+        # without one (direct add_endpoint) form one shared mesh, which
+        # keeps the single-conference bridge behavior unchanged.
+        self._conf_of: Dict[int, int] = {}
 
     # ---------------------------------------------------------- endpoints
     def add_endpoint(self, ssrc: int, rx_key: Tuple[bytes, bytes],
@@ -348,6 +353,7 @@ class SfuBridge:
             self._rx_keys.pop(sid, None)
             self._tx_keys.pop(sid, None)
             self._recv_bw.pop(sid, None)
+            self._conf_of.pop(sid, None)
             # a staged-but-never-committed row: throw its held media
             # away (the endpoint left before its admit flipped live)
             if sid in self._staged:
@@ -394,7 +400,8 @@ class SfuBridge:
             _log.info("endpoint_leave", sid=sid)
 
     # ---------------------------------------------------- lifecycle plane
-    def stage_endpoints(self, specs) -> List[int]:
+    def stage_endpoints(self, specs, sids=None,
+                        conferences=None) -> List[int]:
         """Off-tick half of a batched admit: allocate rows, install BOTH
         SRTP tables and the translator legs in ONE vectorized
         `add_streams` pass each, map the SSRCs (media racing the admit
@@ -403,6 +410,10 @@ class SfuBridge:
         until `commit_endpoints` flips them live between ticks.
 
         specs: iterable of (ssrc, (rx_mk, rx_ms), (tx_mk, tx_ms), name).
+        `sids` pins specific rows (the lifecycle plane's
+        conference-affinity path: rows drawn from the conference's
+        shard range by `ShardRowAllocator`); `conferences` scopes each
+        endpoint's forwarding to its conference id.
         Returns the allocated sids in spec order.
         """
         specs = list(specs)
@@ -412,7 +423,17 @@ class SfuBridge:
             if ssrc in self._ssrc_of.values():
                 raise ValueError(f"ssrc {ssrc:#x} already joined")
         self._quiesce_fanout()
-        sids = [self.registry.alloc(self) for _ in specs]
+        if sids is None:
+            sids = [self.registry.alloc(self) for _ in specs]
+        else:
+            sids = [int(s) for s in sids]
+            if len(sids) != len(specs):
+                raise ValueError("sids/specs length mismatch")
+            self.registry.reserve_many(sids, self)
+        if conferences is not None:
+            for sid, conf in zip(sids, conferences):
+                if conf is not None:
+                    self._conf_of[sid] = int(conf)
         arr = np.asarray(sids, dtype=np.int64)
         rx_mks = np.stack([np.frombuffer(rx[0], np.uint8)
                            for _, rx, _, _ in specs])
@@ -456,6 +477,77 @@ class SfuBridge:
             self.loop.release_stream(sid)
             _log.info("endpoint_join", sid=sid,
                       ssrc=self._ssrc_of.get(sid))
+
+    def migrate_endpoints(self, mapping: Dict[int, int]) -> None:
+        """Move live endpoints to new rows BIT-EXACT — the execution
+        half of a placement rebalance (mesh/placement.py): both SRTP
+        tables' per-row crypto state (keys, rollover counters, replay
+        windows, kdr epochs), the translator leg material, SSRC demux,
+        addresses and conference scoping all relocate unchanged, so a
+        conference migrating to another shard cannot tear (a packet
+        keyed before the move authenticates identically after it).
+        Transient learning state (BWE, RTCP reception, recovery
+        trackers) resets and re-learns from traffic, same as it does
+        across a checkpoint restore.
+
+        Callers run this BETWEEN ticks (the lifecycle plane sequences
+        it behind the commit barrier); the pipeline drain + fan-out
+        quiesce here make that safe even standalone.  Rows serving
+        video tracks or still staged/DTLS-pending refuse to move.
+        """
+        mapping = {int(s): int(d) for s, d in mapping.items()}
+        mapping = {s: d for s, d in mapping.items() if s != d}
+        if not mapping:
+            return
+        src = sorted(mapping)
+        dst = [mapping[s] for s in src]
+        if len(set(dst)) != len(dst) or set(src) & set(dst):
+            raise ValueError("overlapping migration mapping")
+        for s in src:
+            if s not in self._ssrc_of:
+                raise ValueError(f"sid {s} not live")
+            if s in self._staged or s in self._dtls.pending:
+                raise ValueError(f"sid {s} is mid-install")
+            if s in self._video or any(
+                    t.sender_sid == s or s in t.fwd
+                    for t in set(self._video.values())):
+                raise ValueError(f"sid {s} serves a video track")
+        drain = getattr(self.loop, "drain", None)
+        if drain is not None:
+            drain()
+        self._quiesce_fanout()
+        self.registry.reserve_many(dst, self)
+        self.rx_table.move_rows(src, dst)
+        self.tx_table.move_rows(src, dst)
+        self.translator.move_receivers(src, dst)
+        for s, d in zip(src, dst):
+            ssrc = self._ssrc_of.pop(s)
+            self.registry.unmap_ssrc(ssrc)
+            self.registry.map_ssrc(ssrc, d)
+            self._ssrc_of[d] = ssrc
+            self._rx_keys[d] = self._rx_keys.pop(s)
+            self._tx_keys[d] = self._tx_keys.pop(s)
+            if s in self._recv_bw:
+                self._recv_bw[d] = self._recv_bw.pop(s)
+            if s in self._conf_of:
+                self._conf_of[d] = self._conf_of.pop(s)
+            self.loop.addr_ip[d] = self.loop.addr_ip[s]
+            self.loop.addr_port[d] = self.loop.addr_port[s]
+            self.loop.addr_ip[s] = 0
+            self.loop.addr_port[s] = 0
+            name = self.loop.metrics.stream_names.get(s)
+            self.loop.metrics.set_stream_name(d, name)
+            self.loop.metrics.set_stream_name(s, None)
+            self._bwe_fed[s] = False
+            self._bwe_fed[d] = False
+            self.registry.release(s)
+        self.bwe.reset_rows(src)
+        self.recovery.forget_legs(src)
+        for s in src:
+            self.rtcp_term.forget_receiver(s)
+        self._rebuild_routes()
+        for s, d in zip(src, dst):
+            _log.info("endpoint_migrated", src=s, dst=d)
 
     def _sid_of_ssrc(self, ssrc: int) -> Optional[int]:
         """Reverse of `_ssrc_of` (recovery's sid resolver): uplink
@@ -652,8 +744,18 @@ class SfuBridge:
         flight) stay out until their commit barrier."""
         sids = [s for s in sorted(self._ssrc_of)
                 if s not in self._dtls.pending and s not in self._staged]
-        for s in sids:
-            self.translator.connect(s, [r for r in sids if r != s])
+        if self._conf_of:
+            # conference-scoped mesh: a sender fans out only within its
+            # conference (rows without an id share the -1 group)
+            groups: Dict[int, list] = {}
+            for s in sids:
+                groups.setdefault(self._conf_of.get(s, -1), []).append(s)
+            for grp in groups.values():
+                for s in grp:
+                    self.translator.connect(s, [r for r in grp if r != s])
+        else:
+            for s in sids:
+                self.translator.connect(s, [r for r in sids if r != s])
 
     # --------------------------------------------------------------- tick
     def _on_media(self, batch: PacketBatch, _ok) -> None:
@@ -985,6 +1087,8 @@ class SfuBridge:
             "tx_keys": dict(self._tx_keys),
             "recv_bw": {s: bw for s, bw in self._recv_bw.items()
                         if s in keyed},
+            "conf_of": {s: c for s, c in self._conf_of.items()
+                        if s in keyed},
             "addr_ip": self.loop.addr_ip.copy(),
             "addr_port": self.loop.addr_port.copy(),
         }
@@ -1025,6 +1129,8 @@ class SfuBridge:
         bridge._rx_keys = dict(snap["rx_keys"])
         bridge._tx_keys = dict(snap["tx_keys"])
         bridge._recv_bw = dict(snap["recv_bw"])
+        bridge._conf_of = {int(s): int(c) for s, c in
+                           snap.get("conf_of", {}).items()}
         sids = sorted(snap["ssrc_of"])
         bridge.registry.reserve_many(sids, bridge)
         for sid in sids:

@@ -408,15 +408,27 @@ class BridgeSupervisor:
         for sid in sids:
             self._evicted.discard(int(sid))
 
-    def admission_decision(self):
+    def admission_decision(self, shard=None):
         """Burn-aware admission control for the lifecycle plane:
         `(ok, reason)` where reason is a typed string.  Joins are
         refused while the error budget is burning fast, while the phase
         ledger says the tick is host-bound under overload (installing
         more streams feeds the bottleneck), or while streams are
-        actively being shed (admitting during shedding thrashes)."""
+        actively being shed (admitting during shedding thrashes).
+
+        With conference-affinity sharding, pass the TARGET `shard`: a
+        join is also refused (`shard_burn`) when a per-shard sliced SLO
+        says that specific shard is burning fast — the other shards
+        keep admitting, which is the point of slicing (a fleet-wide
+        gate would brown out all 8 chips for one hot one)."""
         if self._slo_state() == "fast_burn":
             return False, "fast_burn"
+        if shard is not None and self.slo is not None:
+            for spec in getattr(self.slo, "sliced", ()):
+                if (spec.label == "shard"
+                        and self.slo.slice_state(spec.name, shard)
+                        == "fast_burn"):
+                    return False, "shard_burn"
         if self.watchdog.state == "stalled":
             return False, "stalled"
         if self._shed_set:

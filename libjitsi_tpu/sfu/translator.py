@@ -171,6 +171,41 @@ class RtpTranslator:
         for s, rr in list(self._routes.items()):
             self._routes[s] = rr[rr != rid]
 
+    def move_receivers(self, src_rids, dst_rids) -> None:
+        """Relocate receiver legs to new rows bit-exact (placement
+        rebalance).  Per-leg state is pure key material — schedules,
+        GHASH matrices, salts — so the move is an array copy; routes
+        referencing the old rows are rewritten in place (the bridge
+        rebuilds routes after a migration anyway, but a translator used
+        standalone must not keep stale rows routed)."""
+        src = np.asarray(src_rids, dtype=np.int64)
+        dst = np.asarray(dst_rids, dtype=np.int64)
+        if src.size != dst.size:
+            raise ValueError("src/dst length mismatch")
+        if src.size == 0:
+            return
+        if not self.active[src].all():
+            raise ValueError("cannot move inactive receiver rows")
+        if self.active[dst].any():
+            raise ValueError("destination receiver rows occupied")
+        self._rk[dst] = self._rk[src]
+        self._mid[dst] = self._mid[src]
+        if self._gcm:
+            self._gm[dst] = self._gm[src]
+        self._salt[dst] = self._salt[src]
+        self.active[dst] = True
+        remap = {int(s): int(d) for s, d in zip(src, dst)}
+        for s_sid, rr in list(self._routes.items()):
+            self._routes[s_sid] = np.asarray(
+                [remap.get(int(r), int(r)) for r in rr], dtype=rr.dtype)
+        self.active[src] = False
+        self._rk[src] = 0
+        self._mid[src] = 0
+        if self._gcm:
+            self._gm[src] = 0
+        self._salt[src] = 0
+        self._dev = None
+
     # ------------------------------------------------------------ routing
     def connect(self, sender_sid: int, receiver_ids: Sequence[int]) -> None:
         """Declare that `sender_sid`'s media goes to these receivers

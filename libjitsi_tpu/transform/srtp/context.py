@@ -704,6 +704,51 @@ class SrtpStreamTable:
         self._epoch_rtcp[sids] = 0
         self._dev = None
 
+    def move_rows(self, src_sids, dst_sids) -> None:
+        """Relocate live streams to new rows BIT-EXACT — the crypto half
+        of a placement rebalance (mesh/placement.py): a conference
+        migrating to another shard carries every row's keys, rollover
+        counters, replay windows and kdr epochs unchanged, so no packet
+        in flight before the move authenticates differently after it.
+
+        One copy-on-write episode for the whole batch, and the source
+        rows are torn down through `remove_streams`'s zeroing discipline
+        (a vacated row must not keep departed key material).  Callers
+        sequence this between ticks behind the lifecycle commit barrier.
+        """
+        src = np.asarray(src_sids, dtype=np.int64)
+        dst = np.asarray(dst_sids, dtype=np.int64)
+        if src.size != dst.size:
+            raise ValueError("src/dst length mismatch")
+        if src.size == 0:
+            return
+        if not self.active[src].all():
+            raise ValueError("cannot move inactive rows")
+        if self.active[dst].any():
+            raise ValueError("destination rows occupied")
+        self._cow_tables()
+        for tab in (self._rk_rtp, self._rk_rtcp, self._mid_rtp,
+                    self._mid_rtcp, self._salt_rtp, self._salt_rtcp,
+                    self.tx_ext, self.rx_max, self.rx_mask,
+                    self.rtcp_tx_index, self.rtcp_rx_max,
+                    self.rtcp_rx_mask, self.auth_fail,
+                    self.replay_reject, self.kdr, self._epoch_rtp,
+                    self._epoch_rtcp):
+            tab[dst] = tab[src]
+        if self._gcm:
+            self._gm_rtp[dst] = self._gm_rtp[src]
+            self._gm_rtcp[dst] = self._gm_rtcp[src]
+        if self._f8:
+            self._rk_f8_rtp[dst] = self._rk_f8_rtp[src]
+            self._rk_f8_rtcp[dst] = self._rk_f8_rtcp[src]
+        for s, d in zip(src, dst):
+            m = self._masters.pop(int(s), None)
+            if m is not None:
+                self._masters[int(d)] = m
+        self.active[dst] = True
+        # masters already relocated; remove_streams zeroes the rest
+        self.remove_streams(src)
+
     def _device(self):
         if self._dev is None:
             aux_rtp = self._gm_rtp if self._gcm else self._mid_rtp

@@ -25,8 +25,8 @@ global flight ring; current burn rates export as
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Dict, Iterable, List, Optional, Tuple
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -117,6 +117,33 @@ def default_slos(tick_budget_s: float = 0.02) -> List[SloSpec]:
     ]
 
 
+@dataclass(frozen=True)
+class SlicedSloSpec:
+    """One objective evaluated PER SLICE — per shard, per conference —
+    instead of fleet-wide (the slicing PR 5 left open; it only makes
+    sense once conference-affinity sharding makes 'shard 3 is burning'
+    an actionable statement, see mesh/placement.py).
+
+    `reader` yields ``(slice_key, good_cum, bad_cum)`` cumulative
+    totals each tick; slices appear lazily on first report and decay
+    back to `ok` when they stop reporting (windows fill with zeros).
+    `label` names the metric label axis ("shard", "conference") the
+    burn gauges export under.
+    """
+
+    name: str
+    objective: float
+    label: str
+    reader: Callable[[], Iterable[Tuple[str, float, float]]]
+    description: str = ""
+
+    def __post_init__(self):
+        if not 0.0 < self.objective < 1.0:
+            raise ValueError("objective must be in (0, 1)")
+        if not self.label:
+            raise ValueError("sliced SLO needs a label axis")
+
+
 class SloEngine:
     """Evaluates SloSpecs over tick-ring windows; call `on_tick()` once
     per supervisor tick (the supervisor does when wired)."""
@@ -139,6 +166,11 @@ class SloEngine:
         self._rings: Dict[str, Dict[str, TickWindowRing]] = {}
         self._last: Dict[str, Tuple[float, float]] = {}
         self._state: Dict[str, str] = {}
+        # sliced specs: per-(spec, slice) rings/state, slices lazy
+        self.sliced: List[SlicedSloSpec] = []
+        self._srings: Dict[str, Dict[str, Dict[str, TickWindowRing]]] = {}
+        self._slast: Dict[str, Dict[str, Tuple[float, float]]] = {}
+        self._sstate: Dict[str, Dict[str, str]] = {}
         self.ticks = 0
         self.alerts_total = 0
         for spec in specs:
@@ -153,6 +185,34 @@ class SloEngine:
                                   buckets=self.window_buckets)
             for label, seconds in self.windows}
         self._state[spec.name] = "ok"
+
+    def add_sliced(self, spec: SlicedSloSpec) -> None:
+        if (spec.name in self._srings
+                or spec.name in self._rings):
+            raise ValueError(f"duplicate SLO `{spec.name}`")
+        self.sliced.append(spec)
+        self._srings[spec.name] = {}
+        self._slast[spec.name] = {}
+        self._sstate[spec.name] = {}
+
+    def drop_slice(self, name: str, key: str) -> None:
+        """Forget one slice entirely (a conference ended, a shard
+        drained): its rings, state and baseline totals go — otherwise
+        slice state grows monotonically under churn."""
+        self._srings.get(name, {}).pop(key, None)
+        self._slast.get(name, {}).pop(key, None)
+        self._sstate.get(name, {}).pop(key, None)
+
+    def _slice_rings(self, name: str, key: str) -> Dict[str, TickWindowRing]:
+        rings = self._srings[name].get(key)
+        if rings is None:
+            rings = {
+                label: TickWindowRing(seconds / self.tick_period_s,
+                                      buckets=self.window_buckets)
+                for label, seconds in self.windows}
+            self._srings[name][key] = rings
+            self._sstate[name][key] = "ok"
+        return rings
 
     # ------------------------------------------------------------ reads
 
@@ -194,6 +254,48 @@ class SloEngine:
             for ring in rings.values():
                 ring.push(d_good, d_bad)
             self._evaluate(spec)
+        for spec in self.sliced:
+            self._tick_sliced(spec)
+
+    def _tick_sliced(self, spec: SlicedSloSpec) -> None:
+        seen = set()
+        for key, good, bad in spec.reader():
+            key = str(key)
+            seen.add(key)
+            rings = self._slice_rings(spec.name, key)
+            last = self._slast[spec.name].get(key, (0.0, 0.0))
+            d_good = max(float(good) - last[0], 0.0)
+            d_bad = max(float(bad) - last[1], 0.0)
+            self._slast[spec.name][key] = (float(good), float(bad))
+            for ring in rings.values():
+                ring.push(d_good, d_bad)
+            self._evaluate_slice(spec, key)
+        # slices the reader stopped reporting decay toward ok instead
+        # of freezing at their last burn
+        for key in self._srings[spec.name].keys() - seen:
+            for ring in self._srings[spec.name][key].values():
+                ring.push(0.0, 0.0)
+            self._evaluate_slice(spec, key)
+
+    def _evaluate_slice(self, spec: SlicedSloSpec, key: str) -> None:
+        burns = self.slice_burn_rates(spec.name, key)
+        if (burns["1m"] >= self.fast_burn
+                and burns["5m"] >= self.fast_burn):
+            new = "fast_burn"
+        elif (burns["30m"] >= self.slow_burn
+                and burns["6h"] >= self.slow_burn):
+            new = "slow_burn"
+        else:
+            new = "ok"
+        old = self._sstate[spec.name][key]
+        if new != old:
+            self._sstate[spec.name][key] = new
+            self.alerts_total += 1
+            if self.flight is not None:
+                self.flight.record(
+                    "slo_alert", tick=self.ticks, slo=spec.name,
+                    state=new, prev=old, **{spec.label: key},
+                    burn={w: round(b, 3) for w, b in burns.items()})
 
     def _evaluate(self, spec: SloSpec) -> None:
         burns = self.burn_rates(spec.name)
@@ -227,6 +329,29 @@ class SloEngine:
             out[label] = (bad / total) / budget if total > 0 else 0.0
         return out
 
+    def slice_burn_rates(self, name: str, key: str) -> Dict[str, float]:
+        budget = 1.0 - next(s.objective for s in self.sliced
+                            if s.name == name)
+        out: Dict[str, float] = {}
+        for label, ring in self._srings[name][key].items():
+            good, bad = ring.totals()
+            total = good + bad
+            out[label] = (bad / total) / budget if total > 0 else 0.0
+        return out
+
+    def slice_state(self, name: str, key) -> str:
+        """One slice's burn state ("ok" for a never-seen slice: a brand
+        new conference/shard has no burn history to hold against it)."""
+        return self._sstate.get(name, {}).get(str(key), "ok")
+
+    def burning_slices(self, name: str,
+                       level: str = "fast_burn") -> List[str]:
+        """Slice keys at or above `level` — the admission/overload
+        query: which shard (conference) is actually burning."""
+        rank = _STATE_RANK.index(level)
+        return sorted(k for k, st in self._sstate.get(name, {}).items()
+                      if _STATE_RANK.index(st) >= rank)
+
     def state(self, name: Optional[str] = None) -> str:
         """One SLO's state, or the worst across all (the supervisor
         stamps this on every ladder_escalate event)."""
@@ -256,6 +381,16 @@ class SloEngine:
                            for label, ring in
                            self._rings[s.name].items()},
             } for s in self.specs],
+            "sliced": [{
+                "name": s.name,
+                "label": s.label,
+                "objective": s.objective,
+                "description": s.description,
+                "slices": {key: {
+                    "state": self._sstate[s.name][key],
+                    "burn": self.slice_burn_rates(s.name, key),
+                } for key in sorted(self._srings[s.name])},
+            } for s in self.sliced],
         }
 
     # ---------------------------------------------------- observability
@@ -270,6 +405,20 @@ class SloEngine:
             yield ({"slo": spec.name},
                    float(_STATE_CODE[self._state[spec.name]]))
 
+    def _slice_burn_samples(self):
+        for spec in self.sliced:
+            for key in sorted(self._srings[spec.name]):
+                for label, rate in self.slice_burn_rates(
+                        spec.name, key).items():
+                    yield ({"slo": spec.name, "window": label,
+                            spec.label: key}, rate)
+
+    def _slice_state_samples(self):
+        for spec in self.sliced:
+            for key, st in sorted(self._sstate[spec.name].items()):
+                yield ({"slo": spec.name, spec.label: key},
+                       float(_STATE_CODE[st]))
+
     def register_metrics(self, registry: MetricsRegistry) -> None:
         registry.register_multi(
             "slo_burn_rate", self._burn_samples,
@@ -281,3 +430,10 @@ class SloEngine:
             "slo_alerts_total", lambda: self.alerts_total,
             help_="SLO state transitions emitted as slo_alert events",
             kind="counter")
+        registry.register_multi(
+            "slo_slice_burn_rate", self._slice_burn_samples,
+            help_="error-budget burn rate per sliced SLO per "
+                  "shard/conference per window")
+        registry.register_multi(
+            "slo_slice_state", self._slice_state_samples,
+            help_="per-slice burn state: 0 ok, 1 slow_burn, 2 fast_burn")

@@ -401,3 +401,102 @@ def test_recover_with_half_installed_streams_completes_or_rolls_back(
     assert int(bridge2.rx_table.rx_max[sid80]) >= 0, \
         "recovered staged stream's media did not decode"
     bridge2.close()
+
+def test_kill_during_placement_move_completes_or_rolls_back(tmp_path):
+    """Kill mid-rebalance: `migrate_endpoints` is host-atomic between
+    ticks, so a checkpoint racing a placement move captures either the
+    fully-pre-move or fully-post-move row layout, plus the in-flight
+    move marker.  Recovery must resolve the move to a WHOLE state —
+    rolled back (conference intact on the source shard, the move simply
+    re-plans) or completed (conference intact on the destination shard,
+    counted as applied) — and never a conference straddling two shard
+    ranges.  Both arms, one universe each."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    bridge = SfuBridge(cfg, port=0, capacity=16, recv_window_ms=0)
+    sup = BridgeSupervisor(bridge, SupervisorConfig(deadline_ms=1000.0))
+    lc = StreamLifecycleManager(bridge, supervisor=sup)
+    lc._warm_bucket = 1 << 30
+    lc.enable_placement(4)
+    k = 0
+    for conf in (1, 2, 3, 4, 5):        # conf 5 doubles onto shard 0
+        for _ in range(2):
+            assert lc.request_join(0x500 + k, *_keys(k),
+                                   conference=conf)[0]
+            k += 1
+    lc.poll()
+    lc.commit()
+    assert lc.admits == k and lc.placer.shard_of(5) == 0
+    for sid, conf in list(bridge._conf_of.items()):
+        if conf in (2, 3, 4):
+            lc.request_leave(sid=sid)
+    lc.commit()                          # shard 0 now hot: move pending
+
+    # ---- arm 1: crash BEFORE the migration landed -> ROLLED BACK
+    movers = sorted(s for s, c in bridge._conf_of.items() if c == 1)
+    mapping = {s: s + lc._rows_per_shard for s in movers}
+    lc._move_inflight = {"conf": 1, "src": 0, "dst": 1,
+                         "mapping": dict(mapping)}
+    ckpt_a = str(tmp_path / "midmove_premigrate.ckpt")
+    sup.save_checkpoint(ckpt_a)
+    bridge.close()                       # the crash
+
+    sup2 = BridgeSupervisor.recover(cfg, ckpt_a, SfuBridge, port=0,
+                                    supervisor_config=sup.cfg,
+                                    recv_window_ms=0)
+    bridge2 = sup2.bridge
+    lc2 = StreamLifecycleManager(bridge2, supervisor=sup2)
+    lc2._warm_bucket = 1 << 30
+    # rolled back: conference 1 whole on its SOURCE shard
+    assert lc2.placer.shard_of(1) == 0
+    assert lc2.moves_applied == 0
+    ev = [e for e in sup2.flight.dump_all()["global"]
+          if e["kind"] == "placement_move_recovered"]
+    assert ev and ev[-1]["outcome"] == "rolled_back"
+    rows_per = lc2._rows_per_shard
+    by_conf = {}
+    for sid, conf in bridge2._conf_of.items():
+        by_conf.setdefault(conf, set()).add(sid // rows_per)
+    assert all(len(shards) == 1 for shards in by_conf.values()), \
+        f"torn conference after recovery: {by_conf}"
+    # the move is not lost, just un-landed: the next window re-plans it
+    assert lc2.rebalance() == 1
+    assert lc2.placer.shard_of(1) == 1
+
+    # ---- arm 2: crash AFTER the migration landed, BEFORE the
+    # placer/bookkeeping caught up -> COMPLETED
+    conf5_rows = sorted(s for s, c in bridge2._conf_of.items()
+                        if c == 5)
+    mapping = {s: s + 2 * rows_per for s in conf5_rows}  # shard 0 -> 2
+    bridge2.migrate_endpoints(mapping)
+    lc2._move_inflight = {"conf": 5, "src": 0, "dst": 2,
+                          "mapping": dict(mapping)}
+    ckpt_b = str(tmp_path / "midmove_postmigrate.ckpt")
+    sup2.save_checkpoint(ckpt_b)
+    bridge2.close()                      # the crash
+
+    sup3 = BridgeSupervisor.recover(cfg, ckpt_b, SfuBridge, port=0,
+                                    supervisor_config=sup2.cfg,
+                                    recv_window_ms=0)
+    bridge3 = sup3.bridge
+    lc3 = StreamLifecycleManager(bridge3, supervisor=sup3)
+    lc3._warm_bucket = 1 << 30
+    # completed: conference 5 whole on its DESTINATION shard, counted
+    assert lc3.placer.shard_of(5) == 2
+    assert lc3.moves_applied == 1
+    ev = [e for e in sup3.flight.dump_all()["global"]
+          if e["kind"] == "placement_move_recovered"]
+    assert ev and ev[-1]["outcome"] == "completed"
+    for sid, conf in bridge3._conf_of.items():
+        assert sid in bridge3._ssrc_of
+    by_conf = {}
+    for sid, conf in bridge3._conf_of.items():
+        by_conf.setdefault(conf, set()).add(sid // rows_per)
+    assert all(len(shards) == 1 for shards in by_conf.values()), \
+        f"torn conference after recovery: {by_conf}"
+    # whole-state invariant across every row the crashes touched
+    for sid in range(bridge3.capacity):
+        assert ((sid in bridge3._ssrc_of) == (sid in bridge3._tx_keys)
+                == bool(bridge3.rx_table.active[sid]))
+    bridge3.close()

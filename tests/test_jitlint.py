@@ -926,3 +926,127 @@ def test_hotalloc_repo_io_modules_are_clean():
         with open(path) as fh:
             ctx = FileContext(path, f"libjitsi_tpu/io/{mod}", fh.read())
         assert check_hotpath_alloc(ctx) == [], mod
+
+
+# ------------------------------------------------------ mesh-collective
+
+from libjitsi_tpu.analysis.checkers.meshcollective import (  # noqa: E402
+    check_mesh_collectives)
+
+_PLACEMENT_STUB = """
+SANCTIONED_COLLECTIVE_SITES = (
+    ("libjitsi_tpu/mesh/sharded.py", "sharded_mix_minus"),
+)
+"""
+
+
+def _mesh_index(src, relpath="libjitsi_tpu/mesh/sharded.py"):
+    return {
+        "libjitsi_tpu/mesh/placement.py": ctx_of(
+            _PLACEMENT_STUB, "libjitsi_tpu/mesh/placement.py"),
+        relpath: ctx_of(src, relpath),
+    }
+
+
+def test_mesh_collective_unsanctioned_psum_fires():
+    """Seeded from the PR 10 failure class: a psum creeping back into
+    a steady-state mesh tick silently re-couples every chip and voids
+    the mesh_agg_pps_ratio extrapolation."""
+    src = """
+    import jax
+
+    def my_new_mixer(mesh):
+        def _mix(pcm):
+            return jax.lax.psum(pcm, "streams")
+        return _mix
+    """
+    found = check_mesh_collectives(_mesh_index(src))
+    assert rules_of(found) == ["mesh-collective"]
+    assert "psum" in found[0].message
+
+
+def test_mesh_collective_sanctioned_site_clean():
+    """The giant-conference escape hatch named in
+    SANCTIONED_COLLECTIVE_SITES keeps its psum (nested defs count:
+    the collective lives in the shard_map body closure)."""
+    src = """
+    import jax
+
+    def sharded_mix_minus(mesh):
+        def _mix(pcm):
+            return jax.lax.psum(pcm, "streams")
+        return _mix
+    """
+    assert check_mesh_collectives(_mesh_index(src)) == []
+
+
+def test_mesh_collective_bare_names_and_kin_fire():
+    src = """
+    from jax.lax import all_gather, ppermute
+
+    def fan_in(x):
+        y = all_gather(x, "streams")
+        return ppermute(y, "streams", [(0, 1)])
+    """
+    found = check_mesh_collectives(_mesh_index(src))
+    assert len(found) == 2
+    assert all(f.rule == "mesh-collective" for f in found)
+
+
+def test_mesh_collective_scope_is_mesh_only():
+    """FP guard: collectives outside mesh/ are someone else's policy."""
+    src = """
+    import jax
+
+    def f(x):
+        return jax.lax.psum(x, "d")
+    """
+    idx = {"libjitsi_tpu/conference/mixer.py":
+           ctx_of(src, "libjitsi_tpu/conference/mixer.py")}
+    assert check_mesh_collectives(idx) == []
+
+
+def test_mesh_collective_segment_sum_clean():
+    """FP guard: the shard-local segment_sum mixer is the POINT of the
+    affinity layout; it must never be confused with a collective."""
+    src = """
+    import jax
+
+    def shard_local(pcm, conf):
+        return jax.ops.segment_sum(pcm, conf, num_segments=8)
+    """
+    assert check_mesh_collectives(
+        _mesh_index(src, "libjitsi_tpu/mesh/local.py")) == []
+
+
+def test_mesh_collective_placement_itself_never_sanctioned():
+    """A collective in placement.py fires even inside a function whose
+    name appears in the sanction list — the list sanctions sites in
+    OTHER files, and the placement tick regressing is exactly the bug."""
+    src = """
+    import jax
+
+    SANCTIONED_COLLECTIVE_SITES = (
+        ("libjitsi_tpu/mesh/sharded.py", "sharded_mix_minus"),
+    )
+
+    def sharded_mix_minus(x):
+        return jax.lax.psum(x, "streams")
+    """
+    idx = {"libjitsi_tpu/mesh/placement.py":
+           ctx_of(src, "libjitsi_tpu/mesh/placement.py")}
+    found = check_mesh_collectives(idx)
+    assert rules_of(found) == ["mesh-collective"]
+
+
+def test_mesh_collective_real_tree_clean():
+    """The shipped mesh/ package holds the zero-collective invariant:
+    only the sanctioned participant-sharded escape hatches remain."""
+    idx = {}
+    mesh_dir = os.path.join(PKG, "mesh")
+    for fn in sorted(os.listdir(mesh_dir)):
+        if fn.endswith(".py"):
+            rel = f"libjitsi_tpu/mesh/{fn}"
+            with open(os.path.join(mesh_dir, fn)) as fh:
+                idx[rel] = FileContext(rel, rel, fh.read())
+    assert check_mesh_collectives(idx) == []

@@ -71,6 +71,47 @@ def test_judge_ceiling_is_absolute():
                            ceiling=0.35)[0] == "below_floor"
 
 
+def test_judge_floor_is_absolute():
+    """The mesh_agg_pps_ratio bar: a hard floor is judged BEFORE any
+    baseline-relative tolerance, so re-baselining on a degraded run
+    can never ratchet the bar away (mirror of the ceiling)."""
+    # above the floor and near baseline: ok
+    assert perf_gate.judge(6.5, 6.4, 0.6, floor=4.0)[0] == "ok"
+    # below the floor fails even within tolerance of a drifted-down
+    # baseline (3.5 is well inside 0.6 tolerance of 4.2)
+    s, detail = perf_gate.judge(3.5, 4.2, 0.6, floor=4.0)
+    assert s == "regression" and "floor" in detail
+    # the floor binds even without a baseline value
+    assert perf_gate.judge(3.5, None, 0.6, floor=4.0)[0] == "regression"
+    # below_floor (the TIMER floor) still wins over the absolute bar
+    assert perf_gate.judge("below_floor: x", 6.4, 0.6,
+                           floor=4.0)[0] == "below_floor"
+
+
+def test_compare_passes_floor_through():
+    baseline = {"m": {"value": 6.4, "tolerance": 0.6,
+                      "higher_is_better": True, "floor": 4.0}}
+    failures, rows = perf_gate.compare({"m": 3.9}, baseline)
+    assert [name for name, _ in failures] == ["m"]
+    assert "floor" in rows[0][2]
+    # healthy value passes both the floor and the baseline check
+    failures, rows = perf_gate.compare({"m": 7.2}, baseline)
+    assert failures == [] and rows[0][1] == "ok"
+
+
+def test_write_baseline_pins_mesh_agg_floor(tmp_path):
+    """--write-baseline must re-emit the 4.0 floor on
+    mesh_agg_pps_ratio — the cannot-ratchet bar survives honest
+    re-baselining."""
+    path = tmp_path / "b.json"
+    doc = perf_gate.write_baseline(
+        str(path), {"mesh_agg_pps_ratio": 6.9, "loop_echo_pps": 1000.0})
+    assert doc["mesh_agg_pps_ratio"]["floor"] == 4.0
+    assert doc["mesh_agg_pps_ratio"]["higher_is_better"] is True
+    on_disk = json.loads(path.read_text())
+    assert on_disk["mesh_agg_pps_ratio"]["floor"] == 4.0
+
+
 def test_compare_passes_ceiling_through():
     baseline = {"h": {"value": 0.5, "tolerance": 0.6,
                       "higher_is_better": False, "ceiling": 0.35}}

@@ -1,0 +1,454 @@
+"""Conference-affinity placement (PR 10): the placer's invariants
+(never straddles, deterministic, hysteresis rebalance), the shard row
+allocator, the zero-collective shard-local kernels against numpy and
+the single-device reference, and the lifecycle integration — shard-
+ranged row draw, shard-burn admission, and the rebalance move that
+relocates a whole conference bit-exactly through the commit barrier.
+"""
+
+import numpy as np
+import jax
+import pytest
+
+import libjitsi_tpu
+from libjitsi_tpu.mesh import make_media_mesh
+from libjitsi_tpu.mesh.placement import (ConferencePlacer,
+                                         PlacementMove,
+                                         ShardRowAllocator,
+                                         shard_local_mix, size_class)
+from libjitsi_tpu.mesh.parity import assert_affinity_parity
+from libjitsi_tpu.service.lifecycle import StreamLifecycleManager
+from libjitsi_tpu.service.sfu_bridge import SfuBridge
+from libjitsi_tpu.service.supervisor import (BridgeSupervisor,
+                                             SupervisorConfig)
+from libjitsi_tpu.utils.metrics import MetricsRegistry
+from libjitsi_tpu.utils.slo import SloEngine, SlicedSloSpec
+
+
+# ------------------------------------------------------------- placer
+
+def test_size_class_ladder():
+    assert size_class(1) == 4
+    assert size_class(4) == 4
+    assert size_class(5) == 8
+    assert size_class(200) == 256
+    assert size_class(5000) == 5000     # giant: costed at true size
+
+
+def test_never_straddles_under_random_churn():
+    """The module's one invariant, property-tested: through any mix of
+    places, grows, shrinks and releases, every conference maps to
+    exactly one shard and per-shard row accounting stays exact."""
+    rng = np.random.default_rng(42)
+    p = ConferencePlacer(4, rows_per_shard=32)
+    alive = {}                                   # conf -> n
+    next_conf = 0
+    for _ in range(600):
+        op = rng.integers(0, 4)
+        if op == 0 or not alive:
+            shard = p.place(next_conf, int(rng.integers(1, 9)))
+            if shard is not None:
+                alive[next_conf] = p._size_of[next_conf]
+            next_conf += 1
+        elif op == 1:
+            conf = int(rng.choice(list(alive)))
+            if p.try_grow(conf):
+                alive[conf] += 1
+        elif op == 2:
+            conf = int(rng.choice(list(alive)))
+            p.shrink(conf)
+            alive[conf] -= 1
+            if alive[conf] <= 0:
+                del alive[conf]
+        else:
+            conf = int(rng.choice(list(alive)))
+            p.release(conf)
+            del alive[conf]
+        # invariant 1: one shard per conference, never more
+        for conf in alive:
+            assert p.shard_of(conf) is not None
+        # invariant 2: accounting is exactly the sum of its members
+        rows = [0] * p.n_shards
+        for conf, n in alive.items():
+            rows[p.shard_of(conf)] += n
+        assert rows == [ld.rows for ld in p._loads]
+        assert all(ld.rows <= p.rows_per_shard for ld in p._loads)
+
+
+def test_identical_join_order_places_identically():
+    seq = [(c, 1 + (c * 7) % 6) for c in range(40)]
+    a = ConferencePlacer(8, rows_per_shard=16)
+    b = ConferencePlacer(8, rows_per_shard=16)
+    for conf, n in seq:
+        assert a.place(conf, n) == b.place(conf, n)
+    assert a._shard_of == b._shard_of
+
+
+def test_place_least_loaded_ties_low_and_avoid_steers():
+    p = ConferencePlacer(3, rows_per_shard=8)
+    assert p.place(1, 2) == 0               # all empty: lowest index
+    assert p.place(2, 2) == 1
+    assert p.place(3, 2) == 2
+    # avoid steers a new conference off the tied-lowest shard
+    assert p.place(4, 2, avoid={0}) == 1
+    # avoided shards are still used when they are the only room left
+    p2 = ConferencePlacer(1, rows_per_shard=8)
+    assert p2.place(1, 2, avoid={0}) == 0
+
+
+def test_reject_when_full_is_typed_and_counted():
+    p = ConferencePlacer(2, rows_per_shard=4)
+    assert p.place(1, 4) == 0
+    assert p.place(2, 4) == 1
+    assert p.place(3, 1) is None
+    assert p.rejects == 1
+    # grow past the shard range is refused, never straddled
+    assert not p.try_grow(1)
+    assert p.shard_of(1) == 0 and p._size_of[1] == 4
+
+
+def test_shrink_releases_empty_and_frees_room():
+    p = ConferencePlacer(2, rows_per_shard=4)
+    p.place(1, 2)
+    p.shrink(1)
+    assert p.shard_of(1) == 0
+    p.shrink(1)
+    assert p.shard_of(1) is None
+    assert p.loads()[0] == (0.0, 0, 0)
+
+
+def test_plan_rebalance_respects_hysteresis_then_moves():
+    p = ConferencePlacer(4, rows_per_shard=4, max_moves=4)
+    for conf, shard in ((1, 0), (2, 1), (3, 2), (4, 3)):
+        assert p.place(conf, 2) == shard
+    assert p.place(5, 2) == 0               # doubles up on shard 0
+    assert p.plan_rebalance() == []         # balanced enough? no: hot
+    # ... shard 0 carries 2x the mean but every move would just swap
+    # who is hot (all conferences equal) — the planner must see that
+    for conf in (2, 3, 4):
+        p.release(conf)
+    moves = p.plan_rebalance()              # now shards 1-3 are empty
+    assert len(moves) == 1
+    mv = moves[0]
+    assert mv.src == 0 and mv.dst == 1 and mv.conf_id == 1
+    # planning never mutates accounting; apply_move commits it
+    assert p.shard_of(1) == 0
+    p.apply_move(mv)
+    assert p.shard_of(1) == 1
+    assert p._loads[0].confs == 1 and p._loads[1].confs == 1
+
+
+def test_apply_move_rejects_stale_plan():
+    p = ConferencePlacer(2, rows_per_shard=4)
+    p.place(1, 2)
+    with pytest.raises(ValueError):
+        p.apply_move(PlacementMove(1, 1, 0, 2))
+
+
+def test_rebuild_matches_incremental_accounting():
+    p = ConferencePlacer(4, rows_per_shard=16)
+    for conf in range(10):
+        p.place(conf, 1 + conf % 5)
+    q = ConferencePlacer(4, rows_per_shard=16)
+    q.rebuild((c, p.shard_of(c), p._size_of[c]) for c in range(10))
+    assert q._shard_of == p._shard_of
+    assert q.loads() == p.loads()
+
+
+# ------------------------------------------------------ row allocator
+
+def test_row_allocator_contiguous_ranges():
+    a = ShardRowAllocator(16, 4)
+    rows = a.alloc_many(2, 3)
+    assert rows == [8, 9, 10]               # lowest rows of shard 2
+    assert all(a.shard_of_row(r) == 2 for r in rows)
+    assert a.free_rows(2) == 1
+    with pytest.raises(RuntimeError):
+        a.alloc_many(2, 2)
+    a.free_many([9, 8])
+    assert a.alloc_many(2, 2) == [8, 9]
+    a2 = ShardRowAllocator(16, 4)
+    a2.reserve([0, 1, 4])
+    assert a2.alloc_many(0, 1) == [2]
+    assert a2.alloc_many(1, 1) == [5]
+
+
+# ------------------------------------------ zero-collective kernels
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_media_mesh(jax.devices()[:8])
+
+
+def test_shard_local_mix_matches_numpy(mesh):
+    """Segment-sum mix-minus on the mesh vs a per-shard numpy model:
+    each shard mixes only its own conferences — nothing crosses."""
+    n_dev, per_shard, n_conf = 8, 8, 2
+    B, F = n_dev * per_shard, 40
+    rng = np.random.default_rng(9)
+    pcm = rng.integers(-5000, 5000, (B, F)).astype(np.int16)
+    active = rng.random(B) < 0.8
+    conf = ((np.arange(B) // 4) % n_conf).astype(np.int32)
+    got_mix, got_lvl = shard_local_mix(mesh, n_conf)(pcm, active, conf)
+    got_mix = np.asarray(got_mix)
+    p = pcm.astype(np.int64)
+    contrib = np.where(active[:, None], p, 0)
+    for s in range(n_dev):
+        sl = slice(s * per_shard, (s + 1) * per_shard)
+        for c in range(n_conf):
+            rows = np.nonzero(conf[sl] == c)[0] + s * per_shard
+            total = contrib[rows].sum(axis=0)
+            want = np.clip(total[None, :] - contrib[rows],
+                           -32768, 32767)
+            np.testing.assert_array_equal(got_mix[rows], want)
+
+
+def test_affinity_tick_parity_with_single_device_reference(mesh):
+    """The full steady-state tick (unprotect -> segment-sum mix ->
+    protect) on the mesh is bit-identical, shard by shard, to the same
+    body run alone on one device — the structural zero-collective
+    proof (shared harness with the driver dryrun and the perf gate)."""
+    assert_affinity_parity(mesh, 8, b_shard=8, part=4)
+
+
+# ------------------------------------------------ lifecycle integration
+
+def _keys(k):
+    return ((bytes([k & 0xFF]) * 16, bytes([(k + 1) & 0xFF]) * 14),
+            (bytes([(k + 2) & 0xFF]) * 16, bytes([(k + 3) & 0xFF]) * 14))
+
+
+def _universe(capacity=16, n_shards=4, slo=None, supervised=True):
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    bridge = SfuBridge(cfg, port=0, capacity=capacity, recv_window_ms=0)
+    sup = None
+    if supervised:
+        sup = BridgeSupervisor(bridge,
+                               SupervisorConfig(deadline_ms=1000.0),
+                               slo=slo)
+    lc = StreamLifecycleManager(bridge, supervisor=sup)
+    lc._warm_bucket = 1 << 30           # warmup cadence tested elsewhere
+    lc.enable_placement(n_shards)
+    return bridge, sup, lc
+
+
+def _settle(sup, lc, admits, t=100.0):
+    for _ in range(64):
+        if lc.admits >= admits:
+            return t
+        sup.tick(now=t)
+        t += 0.02
+    raise AssertionError(f"settle: admits={lc.admits}, want {admits}")
+
+
+def test_joins_draw_rows_from_their_conference_shard():
+    bridge, sup, lc = _universe()
+    for i, conf in enumerate((7, 7, 8, 8, 7)):
+        rx, tx = _keys(i)
+        assert lc.request_join(0x100 + i, rx, tx, conference=conf)[0]
+    _settle(sup, lc, 5)
+    rows_per = lc._rows_per_shard
+    conf_rows = {}
+    for sid, conf in bridge._conf_of.items():
+        conf_rows.setdefault(conf, []).append(sid)
+    assert set(conf_rows) == {7, 8}
+    assert len(conf_rows[7]) == 3 and len(conf_rows[8]) == 2
+    for conf, sids in conf_rows.items():
+        shard = lc.placer.shard_of(conf)
+        assert shard is not None
+        lo = shard * rows_per
+        assert all(lo <= s < lo + rows_per for s in sids), \
+            f"conference {conf} straddles shard ranges: {sids}"
+    bridge.close()
+
+
+def test_solo_joins_are_singleton_conferences():
+    bridge, sup, lc = _universe()
+    assert lc.request_join(0x200, *_keys(0))[0]
+    assert lc.request_join(0x201, *_keys(1))[0]
+    _settle(sup, lc, 2)
+    confs = set(bridge._conf_of.values())
+    assert len(confs) == 2
+    assert all(c < 0 for c in confs)    # solo keys: never user ids
+    bridge.close()
+
+
+def test_conference_cannot_grow_past_its_shard_range():
+    bridge, sup, lc = _universe(capacity=8, n_shards=2)  # 4 rows/shard
+    for i in range(4):
+        assert lc.request_join(0x300 + i, *_keys(i), conference=1)[0]
+    _settle(sup, lc, 4)
+    ok, why = lc.request_join(0x310, *_keys(9), conference=1)
+    assert not ok and why == "capacity"
+    # a NEW conference still fits: the other shard has the room
+    ok, why = lc.request_join(0x311, *_keys(10), conference=2)
+    assert ok, why
+    _settle(sup, lc, 5)
+    assert lc.placer.shard_of(2) == 1
+    bridge.close()
+
+
+def test_shard_burn_refuses_joins_and_steers_new_conferences():
+    slo = SloEngine(MetricsRegistry())
+    slo.add_sliced(SlicedSloSpec(
+        name="shard_auth", objective=0.99, label="shard",
+        reader=lambda: ()))
+    bridge, sup, lc = _universe(capacity=8, n_shards=2, slo=slo)
+    assert lc.request_join(0x400, *_keys(0), conference=1)[0]  # shard 0
+    assert lc.request_join(0x401, *_keys(1), conference=2)[0]  # shard 1
+    _settle(sup, lc, 2)
+    assert lc.placer.shard_of(1) == 0 and lc.placer.shard_of(2) == 1
+    # shard 0 starts burning its error budget fast
+    slo._sstate["shard_auth"]["0"] = "fast_burn"
+    ok, reason = sup.admission_decision(shard=0)
+    assert not ok and reason == "shard_burn"
+    assert sup.admission_decision(shard=1)[0]
+    # join into the conference PINNED to the burning shard: refused
+    # (it cannot straddle to a healthy one), reason typed + counted
+    ok, reason = lc.request_join(0x402, *_keys(2), conference=1)
+    assert not ok and reason == "shard_burn"
+    assert lc.admit_rejected["shard_burn"] == 1
+    # a new conference steers around the burning shard even though
+    # placement cost alone would tie to it
+    assert lc.request_join(0x403, *_keys(3), conference=3)[0]
+    assert lc.placer.shard_of(3) == 1
+    bridge.close()
+
+
+def test_rebalance_moves_whole_conference_bit_exact():
+    """The tentpole end-to-end: imbalance -> plan -> migrate through
+    the commit barrier.  The moved conference's SRTP state (keys,
+    salts, replay windows, rollover counters) must land bit-identical
+    on the destination rows and the source rows must be fully torn
+    down.  Driven without a supervisor so each pipeline stage
+    (commit/poll/rebalance) is observable in isolation."""
+    bridge, _sup, lc = _universe(capacity=16, n_shards=4,
+                                 supervised=False)
+    ssrc = 0x500
+    joins = {1: 2, 2: 2, 3: 2, 4: 2, 5: 2}   # conf -> members
+    k = 0
+    for conf, n in joins.items():
+        for _ in range(n):
+            assert lc.request_join(ssrc + k, *_keys(k),
+                                   conference=conf)[0]
+            k += 1
+    lc.poll()
+    lc.commit()
+    assert lc.admits == k
+    # layout: confs 1..4 on shards 0..3, conf 5 doubled onto shard 0
+    assert lc.placer.shard_of(5) == 0
+    movers = sorted(s for s, c in bridge._conf_of.items() if c == 1)
+    # give the movers non-trivial replay/rollover state: bit-exact
+    # means THIS survives, not just virgin zeros
+    bridge.rx_table.rx_max[movers] = [100_000, 200_000]
+    bridge.rx_table.rx_mask[movers] = \
+        np.array([0xDEAD, 0xBEEF], dtype=np.uint64)
+    bridge.tx_table.tx_ext[movers] = [70_001, 80_001]
+    before = {
+        "ssrc": [bridge._ssrc_of[s] for s in movers],
+        "rk": bridge.rx_table._rk_rtp[movers].copy(),
+        "salt": bridge.rx_table._salt_rtp[movers].copy(),
+        "rx_max": bridge.rx_table.rx_max[movers].copy(),
+        "rx_mask": bridge.rx_table.rx_mask[movers].copy(),
+        "tx_ext": bridge.tx_table.tx_ext[movers].copy(),
+    }
+    # drain confs 2..4 so shard 0 is hot against an empty field
+    for sid, conf in list(bridge._conf_of.items()):
+        if conf in (2, 3, 4):
+            lc.request_leave(sid=sid)
+    lc.commit()
+    assert lc.evicts == 6
+    moved = lc.rebalance()
+    assert moved == 1 and lc.moves_applied == 1
+    assert lc.placer.shard_of(1) == 1
+    new_rows = sorted(s for s, c in bridge._conf_of.items() if c == 1)
+    rows_per = lc._rows_per_shard
+    assert all(rows_per <= s < 2 * rows_per for s in new_rows)
+    # bit-exact: every per-row plane rode along unchanged
+    assert [bridge._ssrc_of[s] for s in new_rows] == before["ssrc"]
+    np.testing.assert_array_equal(
+        bridge.rx_table._rk_rtp[new_rows], before["rk"])
+    np.testing.assert_array_equal(
+        bridge.rx_table._salt_rtp[new_rows], before["salt"])
+    np.testing.assert_array_equal(
+        bridge.rx_table.rx_max[new_rows], before["rx_max"])
+    np.testing.assert_array_equal(
+        bridge.rx_table.rx_mask[new_rows], before["rx_mask"])
+    np.testing.assert_array_equal(
+        bridge.tx_table.tx_ext[new_rows], before["tx_ext"])
+    # source rows fully torn down and recyclable
+    for s in movers:
+        assert s not in bridge._ssrc_of
+        assert not bridge.rx_table.active[s]
+        assert s in bridge.registry._free
+    ev = [e for e in lc.flight.dump_all()["global"]
+          if e["kind"] == "placement_move"]
+    assert ev and ev[-1]["conf"] == 1
+    # once balanced, the planner stays quiet (hysteresis)
+    assert lc.rebalance() == 0
+    bridge.close()
+
+
+def test_queued_or_staged_conference_skips_its_move():
+    """Moving half a conference would straddle it — a conference with
+    members still queued or staged must sit out the rebalance window
+    and move whole in a later one."""
+    bridge, _sup, lc = _universe(capacity=16, n_shards=4,
+                                 supervised=False)
+    k = 0
+    for conf, n in ((1, 1), (2, 2), (3, 2), (4, 2), (5, 2)):
+        for _ in range(n):
+            assert lc.request_join(0x600 + k, *_keys(k),
+                                   conference=conf)[0]
+            k += 1
+    lc.poll()
+    lc.commit()
+    assert lc.admits == k
+    assert lc.placer.shard_of(1) == 0 and lc.placer.shard_of(5) == 0
+    for sid, conf in list(bridge._conf_of.items()):
+        if conf in (2, 3, 4):
+            lc.request_leave(sid=sid)
+    # a member of the would-move conference joins again: QUEUED
+    assert lc.request_join(0x700, *_keys(99), conference=1)[0]
+    lc.commit()                          # evicts land; shard 0 is hot
+    assert lc.rebalance() == 0           # queued member: move waits
+    lc.poll()                            # member now STAGED
+    assert lc.rebalance() == 0           # still not whole: waits again
+    lc.commit()                          # member live
+    assert lc.rebalance() == 1           # whole again: move proceeds
+    assert lc.placer.shard_of(1) == 1
+    rows = [s for s, c in bridge._conf_of.items() if c == 1]
+    rows_per = lc._rows_per_shard
+    assert len(rows) == 2
+    assert all(rows_per <= s < 2 * rows_per for s in rows)
+    bridge.close()
+
+
+def test_tick_bracket_stays_clean_under_placement_churn():
+    """Acceptance criterion: placement-enabled churn lands zero NEW
+    data-path recompiles inside tick brackets (the compile-cache guard
+    active on every supervisor tick).  The first wave may pay one-time
+    warmup of the idle-tick path; churn after it must add nothing."""
+    bridge, sup, lc = _universe(capacity=8, n_shards=2)
+    t = 100.0
+    warmed = None
+    for wave in range(3):
+        base = 0x800 + 16 * wave
+        for i in range(3):
+            assert lc.request_join(base + i, *_keys(base + i),
+                                   conference=wave + 1)[0]
+        t = _settle(sup, lc, 3 * (wave + 1), t=t)
+        for sid, conf in list(bridge._conf_of.items()):
+            if conf == wave + 1:
+                lc.request_leave(sid=sid)
+        for _ in range(6):
+            sup.tick(now=t)
+            t += 0.02
+        if warmed is None:
+            warmed = lc.datapath_recompiles
+    assert lc.datapath_recompiles == warmed
+    bridge.close()
