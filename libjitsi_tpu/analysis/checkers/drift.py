@@ -387,6 +387,23 @@ def check_perf_baseline(baseline_keys: Set[str],
     return msgs
 
 
+def check_baseline_meta(meta: dict) -> List[str]:
+    """Pure check of the baseline's ``_meta`` block: the `git` stamp
+    must be an abbreviated-or-full lowercase hex commit hash.  A
+    baseline stamped "unknown" (or hand-edited prose) can't be traced
+    to the commit whose numbers it froze — `--write-baseline` stamps
+    HEAD automatically, so anything else means the file was edited by
+    hand or written outside a checkout."""
+    git = (meta or {}).get("git", "")
+    if not re.fullmatch(r"[0-9a-f]{7,40}", str(git)):
+        return [
+            f"PERF_BASELINE.json _meta.git `{git}` is not a commit "
+            "hash — the baseline cannot be traced to the revision it "
+            "measured (re-run scripts/perf_gate.py --write-baseline "
+            "from a checkout)"]
+    return []
+
+
 def _perf_gate_scenario_ids(script_path: str) -> Optional[Set[str]]:
     """String keys of the module-level ``SCENARIOS = {...}`` literal in
     scripts/perf_gate.py (AST only, never imported: the gate pulls in
@@ -435,15 +452,15 @@ def _perf_baseline_findings(index: Dict[str, FileContext]
                         col=0, message="PERF_BASELINE.json is not "
                         "valid JSON — the perf gate cannot load it",
                         snippet="PERF_BASELINE.json", symbol="")]
+    msgs = check_baseline_meta(doc.get("_meta", {}))
     scenario_ids = _perf_gate_scenario_ids(
         os.path.join(root, "scripts", "perf_gate.py"))
-    if scenario_ids is None:
-        return []
-    baseline_keys = {k for k in doc if not k.startswith("_")}
+    if scenario_ids is not None:
+        baseline_keys = {k for k in doc if not k.startswith("_")}
+        msgs.extend(check_perf_baseline(baseline_keys, scenario_ids))
     return [Finding(rule=RULE, path="PERF_BASELINE.json", line=1,
                     col=0, message=msg, snippet=msg, symbol="")
-            for msg in check_perf_baseline(baseline_keys,
-                                           scenario_ids)]
+            for msg in msgs]
 
 
 def check_metrics_drift(index: Dict[str, FileContext]) -> List[Finding]:

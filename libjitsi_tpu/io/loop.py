@@ -147,6 +147,18 @@ class MediaLoop:
         self.inbound_drop = np.zeros(registry.capacity, dtype=bool)
         self.inbound_dropped = np.zeros(registry.capacity, dtype=np.int64)
         self.inbound_dropped_total = 0
+        # fanout-only rows (broadcast listeners): uplink RTP is dropped
+        # — the row only RECEIVES the shared bus — but RTCP (receiver
+        # reports, NACKs) still flows, which is why this is a separate
+        # mask from `inbound_drop` (quarantine silences both)
+        self.fanout_only = np.zeros(registry.capacity, dtype=bool)
+        self._fanout_only_n = 0
+        self.fanout_rtp_dropped = 0
+        self.metrics.register_scalar(
+            "loop_fanout_rtp_dropped",
+            lambda: self.fanout_rtp_dropped,
+            help_="uplink RTP packets dropped on fanout-only "
+                  "(broadcast listener) rows", kind="counter")
         # unknown-SSRC accounting: the warning is interval-suppressed
         # (at most one log line per `unknown_warn_interval` ticks, with
         # the suppressed count carried on the next line) — a flood of
@@ -200,6 +212,16 @@ class MediaLoop:
         if rows_per_shard <= 0:
             raise ValueError("rows_per_shard must be positive")
         self.rows_per_shard = int(rows_per_shard)
+
+    def set_fanout_only(self, sid: int, on: bool = True) -> None:
+        """Mark/unmark a row fanout-only (broadcast listener / speaker
+        role flip).  Flipped only between ticks by the lifecycle commit
+        barrier — a promotion takes effect for whole ticks, never mid
+        batch."""
+        sid = int(sid)
+        if bool(self.fanout_only[sid]) != bool(on):
+            self.fanout_only[sid] = bool(on)
+            self._fanout_only_n += 1 if on else -1
 
     # ------------------------------------------------------------- holds
     def hold_stream(self, sid: int, max_packets: int = 64) -> None:
@@ -380,6 +402,15 @@ class MediaLoop:
                 rtp_rows = rtp_rows[~held]
         if len(rtcp_rows) and self._hold_q:
             rtcp_rows = rtcp_rows[~self._hold_mask[sids[rtcp_rows]]]
+
+        # fanout-only rows: drop listener uplink RTP (their media never
+        # enters the mix); RTCP rows pass untouched so loss recovery on
+        # the downlink keeps working
+        if len(rtp_rows) and self._fanout_only_n:
+            fo = self.fanout_only[sids[rtp_rows]]
+            if fo.any():
+                self.fanout_rtp_dropped += int(fo.sum())
+                rtp_rows = rtp_rows[~fo]
 
         # shard-major dispatch seam: group the batch by owning shard so
         # the mesh table's affine fast path can place rows with a

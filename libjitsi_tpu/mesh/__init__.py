@@ -15,6 +15,11 @@ from libjitsi_tpu.mesh.placement import (  # noqa: F401
     shard_local_mix,
     size_class,
 )
+from libjitsi_tpu.mesh.hierarchy import (  # noqa: F401
+    broadcast_bus_fanout,
+    broadcast_step_ref,
+    listener_fanout_protect,
+)
 from libjitsi_tpu.mesh.sharded import (  # noqa: F401
     make_media_mesh,
     make_multihost_mesh,

@@ -1,0 +1,113 @@
+"""Hierarchical two-level mixing for broadcast conferences.
+
+A webinar/town-hall conference (a handful of speakers, thousands of
+listeners) breaks the conference-affinity contract on purpose: its
+LISTENER rows may straddle shards, because listeners contribute no
+audio and every listener of a conference receives the *same* mix.
+The two-level tick exploits both facts:
+
+1. **Speaker level (home shard).**  A broadcast conference's speaker
+   rows never straddle shards — they live on the conference's home
+   shard and are mixed there with the same segment-sum mix-minus as
+   `mesh/placement.py`'s `shard_local_mix` (full mix-minus: each
+   speaker hears everyone but itself).  Non-home shards hold no active
+   speaker rows for that conference, so their partial sums are zero.
+2. **Bus fan-out (the ONE collective).**  The per-conference mixed bus
+   — a tiny ``[n_conf, frame]`` matrix — is summed across shards and
+   replicated to every shard with a single ``psum`` per tick
+   (registered in ``SANCTIONED_COLLECTIVE_SITES``; the
+   ``mesh-collective`` jitlint gate keeps it the only one).  Listeners
+   are *fanout-only* rows: no per-row mix-minus, just the shared bus,
+   re-protected per listener leg through the existing zero-collective
+   `sharded_gcm_fanout` path.
+
+Contrast the participant-sharded escape hatch (`sharded_mix_minus`):
+it materializes a mix-minus row for every participant and pays its
+psum over participant-sharded contributions — per-listener work the
+broadcast shape never needs.  The `bcast_fanout_pps` perf-gate
+scenario keeps that comparison honest (hard floor, ≥3x).
+
+`broadcast_step_ref` is the same body under plain `jit` on one device
+(the cross-shard psum degenerates to identity because a single device
+holds all rows); int32 addition is associative, so psum-of-partial-sums
+is bit-exact versus the flat sum — `mesh/parity.py`'s
+`assert_hierarchy_parity` asserts it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from libjitsi_tpu.conference.mixer import I16_MAX, I16_MIN, audio_levels
+from libjitsi_tpu.mesh.compat import shard_map
+
+AXIS = "streams"
+
+
+def _broadcast_body(n_conf: int, total_of):
+    """Shared two-level tick body: segment-sum speaker partials →
+    cross-shard bus total (`total_of`: psum on the mesh, identity on
+    one device) → speaker mix-minus + shared listener bus.  One
+    definition for both `broadcast_bus_fanout` and
+    `broadcast_step_ref` so the mesh tick and its parity/benchmark
+    reference cannot drift.
+
+    pcm int16 [B, F] speaker rows, active bool [B], conf int32 [B]
+    (GLOBAL broadcast-conference index, 0..n_conf) → (speaker mix-minus
+    int16 [B, F], bus int16 [n_conf, F], levels uint8 [B]).
+    """
+
+    def _step(pcm, active, conf):
+        p = pcm.astype(jnp.int32)
+        contrib = jnp.where(active[:, None], p, 0)
+        seg = jax.ops.segment_sum(contrib, conf, num_segments=n_conf)
+        bus = total_of(seg)
+        spk = jnp.clip(bus[conf] - contrib,
+                       I16_MIN, I16_MAX).astype(jnp.int16)
+        shared = jnp.clip(bus, I16_MIN, I16_MAX).astype(jnp.int16)
+        return spk, shared, audio_levels(p, active)
+
+    return _step
+
+
+def broadcast_bus_fanout(mesh: Mesh, n_conf: int):
+    """The hierarchical steady-state tick: speaker rows sharded on the
+    batch axis, per-conference buses psum-fanned to EVERY shard in one
+    collective (out_spec ``P(None, None)`` = replicated), where the
+    fanout-only listener path re-protects them via
+    `sharded_gcm_fanout`.  Exactly one cross-chip collective per tick.
+    """
+
+    def _total(seg):
+        # the ONE sanctioned cross-chip collective of the broadcast
+        # tick: [n_conf, F] summed over shards AND replicated back
+        return jax.lax.psum(seg, AXIS)
+
+    _step = _broadcast_body(n_conf, _total)
+    return jax.jit(shard_map(
+        _step, mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS), P(AXIS)),
+        out_specs=(P(AXIS, None), P(None, None), P(AXIS)),
+        check_vma=False))
+
+
+def broadcast_step_ref(n_conf: int):
+    """Single-device twin of `broadcast_bus_fanout`: the same body
+    under plain `jax.jit` over the FULL row array (a single device
+    already holds every shard's rows, so the cross-shard total is the
+    segment sum itself).  Consumers: `assert_hierarchy_parity` and the
+    `bcast_fanout_pps` perf-gate scenario."""
+    return jax.jit(_broadcast_body(n_conf, lambda seg: seg))
+
+
+def listener_fanout_protect(mesh: Mesh, aad_const: int = 12):
+    """The listener leg of the broadcast tick: the replicated bus
+    payloads are sealed once per listener through the batched
+    `sharded_gcm_fanout` path — legs sharded over chips, zero
+    collectives (the bus already arrived replicated via the tick's one
+    psum)."""
+    from libjitsi_tpu.mesh.sharded import sharded_gcm_fanout
+
+    return sharded_gcm_fanout(mesh, aad_const=aad_const)

@@ -290,3 +290,62 @@ def assert_affinity_parity(mesh, n_devices: int, b_shard: int = 32,
                                   np.asarray(want_a)):
                 raise AssertionError(
                     f"affinity tick != per-shard reference on shard {s}")
+
+
+# ------------------------------------------------- hierarchical broadcast
+
+def build_broadcast_workload(n_devices: int, rows_per_shard: int,
+                             n_conf: int, rng, frame: int = 160):
+    """Argument tuple for `broadcast_bus_fanout`/`broadcast_step_ref`:
+    each broadcast conference's ACTIVE speaker rows live only on its
+    home shard (conference c homes on shard c % n_devices); all other
+    rows are inactive padding — exactly the layout `ConferencePlacer.
+    place_broadcast` produces for the speaker leg."""
+    batch = n_devices * rows_per_shard
+    pcm = rng.integers(-2000, 2000, (batch, frame)).astype(np.int16)
+    active = np.zeros(batch, dtype=bool)
+    conf = np.zeros(batch, dtype=np.int32)
+    for c in range(n_conf):
+        home = c % n_devices
+        # a handful of speaker rows in the home shard's row range
+        k = int(rng.integers(2, min(8, rows_per_shard // n_conf) + 1))
+        base = home * rows_per_shard + (c // n_devices) * 8
+        rows = np.arange(base, base + k)
+        active[rows] = True
+        conf[rows] = c
+    return pcm, active, conf
+
+
+def assert_hierarchy_parity(mesh, n_devices: int,
+                            rows_per_shard: int = 32, n_conf: int = 4,
+                            frame: int = 160, seed: int = 17) -> None:
+    """`broadcast_bus_fanout` on the mesh must be bit-identical to
+    `broadcast_step_ref` on one device: the speaker-shard segment-sum
+    mix is exact, and the one-psum bus fan-out is exact because int32
+    addition is associative — psum-of-per-shard-partial-sums equals the
+    flat sum.  Any second collective, any listener-side mix, or any
+    float path sneaking in would break bit equality."""
+    import jax
+
+    from libjitsi_tpu.mesh.hierarchy import (broadcast_bus_fanout,
+                                             broadcast_step_ref)
+
+    rng = np.random.default_rng(seed)
+    args = build_broadcast_workload(n_devices, rows_per_shard, n_conf,
+                                    rng, frame=frame)
+    got = broadcast_bus_fanout(mesh, n_conf)(*args)
+    jax.block_until_ready(got[0])
+    want = broadcast_step_ref(n_conf)(*args)
+    names = ("speaker mix-minus", "bus", "levels")
+    for got_a, want_a, name in zip(got, want, names):
+        if not np.array_equal(np.asarray(got_a), np.asarray(want_a)):
+            raise AssertionError(
+                f"hierarchical tick {name} != single-device reference")
+    # the bus really is the per-conference speaker sum (numpy oracle)
+    pcm, active, conf = args
+    for c in range(n_conf):
+        rows = active & (conf == c)
+        flat = np.clip(pcm[rows].astype(np.int64).sum(axis=0),
+                       -32768, 32767).astype(np.int16)
+        if not np.array_equal(np.asarray(got[1])[c], flat):
+            raise AssertionError(f"bus {c} != numpy speaker sum")

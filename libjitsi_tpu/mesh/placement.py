@@ -59,6 +59,11 @@ SANCTIONED_COLLECTIVE_SITES: Tuple[Tuple[str, str], ...] = (
     ("libjitsi_tpu/mesh/sharded.py", "sharded_mix_minus"),
     ("libjitsi_tpu/mesh/sharded.py", "sharded_mix_minus_2d"),
     ("libjitsi_tpu/mesh/sharded.py", "sharded_media_step"),
+    # the broadcast bus: one tiny [n_conf, F] psum per tick fans the
+    # speaker-shard mix to every listener shard (mesh/hierarchy.py) —
+    # the hierarchical replacement for participant-sharding a
+    # broadcast-scale conference
+    ("libjitsi_tpu/mesh/hierarchy.py", "broadcast_bus_fanout"),
 )
 
 #: participant counts a conference is padded to for cost/warmup
@@ -127,20 +132,38 @@ class ConferencePlacer:
                                          for _ in range(self.n_shards)]
         self._shard_of: Dict[int, int] = {}
         self._size_of: Dict[int, int] = {}
+        # broadcast conferences: conf_id -> {shard: n_listener_rows}.
+        # Speaker rows stay in _shard_of/_size_of (home shard, never
+        # straddle); listener rows MAY straddle and are costed linearly
+        # (fanout-only rows have no mix-minus, so no quadratic term).
+        self._bcast_listeners: Dict[int, Dict[int, int]] = {}
         self.placements = 0
         self.rejects = 0
         self.moves_planned = 0
 
     # ------------------------------------------------------------- cost
 
+    #: per-row cost of a fanout-only listener relative to `alpha` — no
+    #: mix-minus row, no fan-out legs back into the mix, just one
+    #: shared-bus re-protect; linear, never quadratic
+    LISTENER_COST: float = 1.0 / 8.0
+
     def cost(self, n_participants: int) -> float:
         c = size_class(n_participants)
         return self.alpha * c + self.beta * c * c
+
+    def listener_cost(self, n_rows: int) -> float:
+        return self.alpha * self.LISTENER_COST * int(n_rows)
 
     # -------------------------------------------------------- placement
 
     def shard_of(self, conf_id: int) -> Optional[int]:
         return self._shard_of.get(int(conf_id))
+
+    def size_of(self, conf_id: int) -> int:
+        """Placed participant rows (for a broadcast conference: its
+        SPEAKER rows; listeners are tracked in `listener_count`)."""
+        return self._size_of.get(int(conf_id), 0)
 
     def conferences_on(self, shard: int) -> List[int]:
         return sorted(c for c, s in self._shard_of.items()
@@ -179,16 +202,28 @@ class ConferencePlacer:
         self.placements += 1
         return best
 
-    def rebuild(self, assignments) -> None:
+    def rebuild(self, assignments, broadcast=()) -> None:
         """Reset accounting to match reality (checkpoint recovery: the
         restored bridge's rows are authoritative, not whatever the
         placer believed before the kill).  `assignments` iterates
-        (conf_id, shard, n_participants)."""
+        (conf_id, shard, n_participants); `broadcast` iterates
+        (conf_id, {shard: n_listener_rows}) for the listener legs of
+        broadcast conferences (their speaker rows ride
+        `assignments`)."""
         self._loads = [_ShardLoad() for _ in range(self.n_shards)]
         self._shard_of.clear()
         self._size_of.clear()
+        self._bcast_listeners.clear()
         for conf_id, shard, n in assignments:
             self._assign(int(conf_id), int(shard), int(n))
+        for conf_id, per in broadcast:
+            self._bcast_listeners[int(conf_id)] = {}
+            for shard, n in per.items():
+                p = self._bcast_listeners[int(conf_id)]
+                p[int(shard)] = int(n)
+                ld = self._loads[int(shard)]
+                ld.cost += self.listener_cost(int(n))
+                ld.rows += int(n)
 
     def _assign(self, conf_id: int, shard: int, n: int) -> None:
         self._shard_of[conf_id] = shard
@@ -233,6 +268,10 @@ class ConferencePlacer:
 
     def release(self, conf_id: int) -> None:
         conf_id = int(conf_id)
+        for shard, n in self._bcast_listeners.pop(conf_id, {}).items():
+            ld = self._loads[shard]
+            ld.cost -= self.listener_cost(n)
+            ld.rows -= n
         shard = self._shard_of.pop(conf_id, None)
         if shard is None:
             return
@@ -241,6 +280,90 @@ class ConferencePlacer:
         ld.cost -= self.cost(n)
         ld.rows -= n
         ld.confs -= 1
+
+    # -------------------------------------------------------- broadcast
+
+    def place_broadcast(self, conf_id: int, n_speakers: int,
+                        n_listeners: int = 0,
+                        avoid=()) -> Optional[int]:
+        """Place a BROADCAST conference: the speaker rows get a home
+        shard exactly like a normal conference (never straddle); the
+        `n_listeners` fanout-only rows then spread over ALL shards by
+        row headroom.  Returns the home shard, or None when either leg
+        cannot be satisfied (nothing is partially placed)."""
+        conf_id = int(conf_id)
+        if conf_id in self._shard_of:
+            raise ValueError(f"conference {conf_id} already placed")
+        home = self.place(conf_id, n_speakers, avoid=avoid)
+        if home is None:
+            return None
+        self._bcast_listeners[conf_id] = {}
+        for _ in range(int(n_listeners)):
+            if self.grow_listeners(conf_id) is None:
+                self.release(conf_id)
+                self.rejects += 1
+                return None
+        return home
+
+    def is_broadcast(self, conf_id: int) -> bool:
+        return int(conf_id) in self._bcast_listeners
+
+    def listener_shards(self, conf_id: int) -> Dict[int, int]:
+        """{shard: resident listener rows} for a broadcast conference."""
+        return dict(self._bcast_listeners.get(int(conf_id), {}))
+
+    def listener_count(self, conf_id: int) -> int:
+        return sum(self._bcast_listeners.get(int(conf_id), {}).values())
+
+    def grow_listeners(self, conf_id: int, delta: int = 1,
+                       avoid=(), shard: Optional[int] = None
+                       ) -> Optional[int]:
+        """Admit `delta` more fanout-only listener rows onto whichever
+        shard has row headroom (least-loaded first, lowest index ties;
+        straddling is the point).  `shard` pins a specific shard (a
+        demoted speaker's row stays physically where it is).  Returns
+        the chosen shard or None when no shard can hold them."""
+        conf_id = int(conf_id)
+        if conf_id not in self._bcast_listeners:
+            raise ValueError(f"conference {conf_id} is not broadcast")
+        delta = int(delta)
+        avoid = {int(a) for a in avoid}
+        best = None
+        if shard is not None:
+            best = int(shard)
+        else:
+            for only_clean in (True, False) if avoid else (False,):
+                for s in range(self.n_shards):
+                    if only_clean and s in avoid:
+                        continue
+                    if self._loads[s].rows + delta > self.rows_per_shard:
+                        continue
+                    if (best is None or self._loads[s].cost
+                            < self._loads[best].cost):
+                        best = s
+                if best is not None:
+                    break
+        if best is None:
+            return None
+        per = self._bcast_listeners[conf_id]
+        per[best] = per.get(best, 0) + delta
+        ld = self._loads[best]
+        ld.cost += self.listener_cost(delta)
+        ld.rows += delta
+        return best
+
+    def shrink_listeners(self, conf_id: int, shard: int,
+                         delta: int = 1) -> None:
+        conf_id, shard = int(conf_id), int(shard)
+        per = self._bcast_listeners[conf_id]
+        n = per[shard] - int(delta)
+        ld = self._loads[shard]
+        ld.cost -= self.listener_cost(int(delta))
+        ld.rows -= int(delta)
+        if n <= 0:
+            del per[shard]
+        else:
+            per[shard] = n
 
     # -------------------------------------------------------- rebalance
 
@@ -266,8 +389,11 @@ class ConferencePlacer:
                 break
             # smallest conference on the hot shard that fits the cold
             # one and actually improves the imbalance
+            # broadcast conferences never move: their speaker rows are
+            # pinned home and their listener rows already straddle
             cands = sorted((self._size_of[c], c)
-                           for c, s in placed.items() if s == hot)
+                           for c, s in placed.items()
+                           if s == hot and c not in self._bcast_listeners)
             moved = False
             for n, c in cands:
                 if rows[cold] + n > self.rows_per_shard:
@@ -327,6 +453,10 @@ class ConferencePlacer:
                        for s, ld in enumerate(self._loads)],
             "conferences": {str(c): s
                             for c, s in sorted(self._shard_of.items())},
+            "broadcast": {str(c): {"home": self._shard_of.get(c),
+                                   "listeners": dict(sorted(per.items()))}
+                          for c, per in
+                          sorted(self._bcast_listeners.items())},
         }
 
 

@@ -97,8 +97,11 @@ class StreamLifecycleManager:
             flight = (supervisor.flight if supervisor is not None
                       else getattr(bridge, "flight", None))
         self.flight = flight if flight is not None else FlightRecorder()
-        # join queue: (ssrc, rx_key, tx_key, name, conference)
-        # host-side only until poll() stages a batch
+        # join queue: (ssrc, rx_key, tx_key, name, conference, role,
+        # shard) — host-side only until poll() stages a batch.  `role`/
+        # `shard` are None except for broadcast-conference joins
+        # ("speaker"/"listener"; listeners carry their assigned shard,
+        # which may differ from the conference's home shard)
         self._join_q: deque = deque()
         self._queued_ssrcs: set = set()
         # conference-affinity placement (mesh/placement.py): None until
@@ -115,9 +118,23 @@ class StreamLifecycleManager:
         self.key_installs = 0
         self.datapath_recompiles = 0
         self.admit_rejected: Dict[str, int] = {}
+        # broadcast conferences (mesh/hierarchy.py): conf ->
+        # {"speakers": set of sids, "join_good"/"join_bad": cumulative
+        # listener-join outcomes feeding the label="conference" burn
+        # slice}; listener sids tracked separately for the fanout-only
+        # warmup ladder and the bcast_listeners gauge
+        self._bcast: Dict[int, dict] = {}
+        self._listener_sids: set = set()
+        self._role_flips: List[Tuple[int, int, str]] = []
+        self.speaker_promotions = 0
+        self.speaker_demotions = 0
         # population bucket whose shapes are warm; row classes warmed
         self._warm_bucket = 0
         self._warm_rows: set = set()
+        # fanout-only listener rows warm a ladder of their own: no
+        # uplink RTP classes, just fan-out legs + RTCP
+        self._warm_lbucket = 0
+        self._warm_lrows: set = set()
         self._tick_compiles0: Optional[int] = None
         if supervisor is not None:
             supervisor.lifecycle = self
@@ -155,6 +172,94 @@ class StreamLifecycleManager:
         loop = getattr(self.bridge, "loop", None)
         if loop is not None and hasattr(loop, "enable_shard_major"):
             loop.enable_shard_major(self._rows_per_shard)
+
+    # ------------------------------------------------------- broadcast
+
+    def declare_broadcast(self, conference, objective: float = 0.999
+                          ) -> int:
+        """Declare `conference` a BROADCAST conference (webinar shape:
+        a handful of speakers, fanout-only listeners).  Requires
+        placement: the speaker rows get a home shard (never straddle),
+        listener rows spread over every shard (`mesh/hierarchy.py`'s
+        two-level tick mixes speakers on the home shard and fans the
+        bus out in one sanctioned collective).  Joins then default to
+        role="listener"; speakers join with role="speaker" or are
+        promoted later (`promote_speaker`, a commit-barrier event).
+        Registers the label="conference" listener-join burn slice on
+        the supervisor's SLO engine the first time.  Returns the home
+        shard."""
+        if self.placer is None:
+            raise RuntimeError("broadcast conferences need placement "
+                               "(enable_placement first)")
+        conf = int(conference)
+        if conf in self._bcast:
+            return self.placer.shard_of(conf)
+        home = self.placer.place_broadcast(
+            conf, 0, avoid=self._burning_shards())
+        if home is None:
+            raise RuntimeError("no shard can home the broadcast "
+                               "conference")
+        self._bcast[conf] = {"speakers": set(),
+                             "join_good": 0, "join_bad": 0}
+        if hasattr(self.bridge, "set_broadcast_speakers"):
+            self.bridge.set_broadcast_speakers(conf, ())
+        self._register_conference_slo(objective)
+        self.flight.record("broadcast_declared", tick=self.ticks(),
+                           conf=conf, home=home)
+        _log.info("broadcast_declared", conf=conf, home=home)
+        return home
+
+    def _register_conference_slo(self, objective: float) -> None:
+        slo = getattr(self.supervisor, "slo", None) \
+            if self.supervisor is not None else None
+        if slo is None:
+            return
+        if any(s.name == "bcast_listener_join"
+               for s in getattr(slo, "sliced", ())):
+            return
+        from libjitsi_tpu.utils.slo import SlicedSloSpec
+
+        def _reader():
+            for conf, st in self._bcast.items():
+                yield (str(conf), float(st["join_good"]),
+                       float(st["join_bad"]))
+
+        slo.add_sliced(SlicedSloSpec(
+            "bcast_listener_join", objective=objective,
+            label="conference", reader=_reader,
+            description="broadcast listener joins admitted vs refused, "
+                        "per conference"))
+
+    def _place_bcast_join(self, conf: int, role: str
+                          ) -> Tuple[Optional[int], Optional[str]]:
+        """(shard, reason) for a join into a broadcast conference.
+        Speakers grow the home shard (never straddle); listeners land
+        on any shard with row headroom, steering around burning ones."""
+        home = self.placer.shard_of(conf)
+        if role == "speaker":
+            if self.supervisor is not None:
+                ok, r = self.supervisor.admission_decision(shard=home)
+                if not ok and r == "shard_burn":
+                    return None, r
+            if not self.placer.try_grow(conf):
+                return None, "capacity"
+            return home, None
+        shard = self.placer.grow_listeners(
+            conf, avoid=self._burning_shards())
+        if shard is None:
+            return None, "capacity"
+        return shard, None
+
+    def promote_speaker(self, conference, sid: int) -> None:
+        """Queue a listener→speaker role flip; applied at the next
+        commit barrier (routes rebuild, fanout-only mask clears, the
+        row migrates to the home shard if it lives elsewhere) — never
+        mid-tick."""
+        self._role_flips.append((int(conference), int(sid), "speaker"))
+
+    def demote_speaker(self, conference, sid: int) -> None:
+        """Queue a speaker→listener role flip (commit-barrier event)."""
+        self._role_flips.append((int(conference), int(sid), "listener"))
 
     def _conf_key(self, ssrc: int, conference) -> int:
         # a placement-enabled join without a conference id is a
@@ -232,7 +337,8 @@ class StreamLifecycleManager:
     def request_join(self, ssrc: int, rx_key: Tuple[bytes, bytes],
                      tx_key: Tuple[bytes, bytes],
                      name: Optional[str] = None,
-                     conference=None) -> Tuple[bool, str]:
+                     conference=None,
+                     role: Optional[str] = None) -> Tuple[bool, str]:
         """Admission decision + queue.  Returns (accepted, reason):
         (True, "queued") or (False, <typed reason>).  Nothing touches
         the device here — keys install off-tick in poll().
@@ -241,13 +347,26 @@ class StreamLifecycleManager:
         groups endpoints: the whole conference lives on one shard, its
         rows are drawn from that shard's range, and forwarding is
         scoped to it.  A join without a conference id is a singleton
-        conference."""
+        conference.  Joins into a declared BROADCAST conference default
+        to role="listener" (fanout-only row on any shard); pass
+        role="speaker" to join the mixed speaker set on the home
+        shard."""
         ssrc = int(ssrc) & 0xFFFFFFFF
         reason = self._admission_reason(ssrc)
-        conf = None
+        conf = shard = None
+        bcast = False
         if reason is None and self.placer is not None:
-            conf, reason = self._place_join(ssrc, conference)
+            conf = self._conf_key(ssrc, conference)
+            bcast = conf in self._bcast
+            if bcast:
+                role = role or "listener"
+                shard, reason = self._place_bcast_join(conf, role)
+            else:
+                role = None
+                conf, reason = self._place_join(ssrc, conference)
         if reason is not None:
+            if bcast and role == "listener":
+                self._bcast[conf]["join_bad"] += 1
             self.admit_rejected[reason] = \
                 self.admit_rejected.get(reason, 0) + 1
             self.flight.record("admit_reject", tick=self.ticks(),
@@ -255,7 +374,7 @@ class StreamLifecycleManager:
             _log.info("admit_reject", ssrc=ssrc, reason=reason)
             return False, reason
         self._join_q.append((ssrc, tuple(rx_key), tuple(tx_key), name,
-                             conf))
+                             conf, role if bcast else None, shard))
         self._queued_ssrcs.add(ssrc)
         self.flight.record("admit_queued", tick=self.ticks(), ssrc=ssrc)
         return True, "queued"
@@ -273,7 +392,15 @@ class StreamLifecycleManager:
                 self._queued_ssrcs.discard(ssrc)
                 if self.placer is not None:
                     for j in self._join_q:
-                        if j[0] == ssrc and j[4] is not None:
+                        if j[0] != ssrc or j[4] is None:
+                            continue
+                        if j[5] == "listener":
+                            self.placer.shrink_listeners(j[4], j[6])
+                        elif j[5] == "speaker":
+                            self.placer.resize(
+                                j[4], max(self.placer.size_of(j[4]) - 1,
+                                          0))
+                        else:
                             self.placer.shrink(j[4])
                 self._join_q = deque(j for j in self._join_q
                                      if j[0] != ssrc)
@@ -302,7 +429,7 @@ class StreamLifecycleManager:
     def commit(self) -> None:
         """Atomic (w.r.t. the tick) population flip: committed admits
         and processed evicts both land here, between ticks."""
-        if self._staged or self._evict_q:
+        if self._staged or self._evict_q or self._role_flips:
             # pipeline drain barrier: a deep-pipelined loop may still
             # hold in-flight reverse work referencing rows about to be
             # evicted/recycled — collapse it before the population flips
@@ -316,9 +443,22 @@ class StreamLifecycleManager:
             self.admits += len(sids)
             if self.supervisor is not None:
                 self.supervisor.note_admitted(sids)
+            touched: set = set()
             for sid in sids:
+                conf = getattr(self.bridge, "_conf_of", {}).get(sid)
+                st = self._bcast.get(conf)
+                if st is not None:
+                    if sid in self._listener_sids:
+                        st["join_good"] += 1
+                    elif sid in st["speakers"]:
+                        touched.add(conf)
                 self.flight.record("admit_commit", tick=self.ticks(),
                                    sid=sid)
+            # newly committed speakers reshape routing: one
+            # set_broadcast_speakers per touched conference rebuilds
+            # routes and fanout-only masks at the barrier
+            for conf in sorted(touched):
+                self._push_speakers(conf)
         if self._evict_q:
             live = dict.fromkeys(self._evict_q)  # de-dup, keep order
             self._evict_q = []
@@ -331,11 +471,107 @@ class StreamLifecycleManager:
                 if self.supervisor is not None:
                     self.supervisor.note_evicted(sids)
                 if self.placer is not None:
-                    for conf in gone_confs:
-                        if conf is not None:
+                    touched = set()
+                    bcast_gone = set()
+                    for sid, conf in zip(sids, gone_confs):
+                        if conf is None:
+                            continue
+                        st = self._bcast.get(conf)
+                        if st is None:
                             self.placer.shrink(conf)
                             if self.placer.shard_of(conf) is None:
                                 self._drop_conference_slices(conf)
+                            continue
+                        bcast_gone.add(conf)
+                        if sid in self._listener_sids:
+                            self._listener_sids.discard(sid)
+                            self.placer.shrink_listeners(
+                                conf, sid // self._rows_per_shard)
+                        elif sid in st["speakers"]:
+                            st["speakers"].discard(sid)
+                            self.placer.resize(
+                                conf,
+                                max(self.placer.size_of(conf) - 1, 0))
+                            touched.add(conf)
+                    # a broadcast conference only releases when its last
+                    # member leaves (0 speakers with listeners still
+                    # attached is a legitimate state)
+                    for conf in sorted(bcast_gone):
+                        if any(c == conf for s, c in conf_of.items()
+                               if s in self.bridge._ssrc_of):
+                            continue
+                        self.placer.release(conf)
+                        self._drop_conference_slices(conf)
+                        self._bcast.pop(conf, None)
+                        touched.discard(conf)
+                        if hasattr(self.bridge, "clear_broadcast"):
+                            self.bridge.clear_broadcast(conf)
+                    for conf in sorted(touched):
+                        self._push_speakers(conf)
+        self._apply_role_flips()
+
+    def _push_speakers(self, conf: int) -> None:
+        if hasattr(self.bridge, "set_broadcast_speakers"):
+            self.bridge.set_broadcast_speakers(
+                conf, tuple(sorted(self._bcast[conf]["speakers"])))
+
+    def _apply_role_flips(self) -> None:
+        """Commit-barrier application of queued promote/demote events:
+        routes rebuild, fanout-only masks flip and (for a promotion off
+        the home shard) the row migrates home — all between ticks, all
+        on pre-warmed shapes, so a role flip compiles nothing."""
+        if not self._role_flips:
+            return
+        flips, self._role_flips = self._role_flips, []
+        touched: set = set()
+        for conf, sid, role in flips:
+            st = self._bcast.get(conf)
+            if st is None or sid not in self.bridge._ssrc_of:
+                continue
+            if role == "speaker":
+                if sid in st["speakers"]:
+                    continue
+                home = self.placer.shard_of(conf)
+                cur = sid // self._rows_per_shard
+                if cur != home:
+                    rows = self._free_rows_on(home, 1)
+                    if not rows or not self.placer.try_grow(conf):
+                        self.flight.record(
+                            "speaker_flip_refused", tick=self.ticks(),
+                            conf=conf, sid=sid, reason="capacity")
+                        continue
+                    self.bridge.migrate_endpoints({sid: rows[0]})
+                    self.placer.shrink_listeners(conf, cur)
+                    self._listener_sids.discard(sid)
+                    sid = rows[0]
+                else:
+                    if not self.placer.try_grow(conf):
+                        self.flight.record(
+                            "speaker_flip_refused", tick=self.ticks(),
+                            conf=conf, sid=sid, reason="capacity")
+                        continue
+                    self.placer.shrink_listeners(conf, cur)
+                    self._listener_sids.discard(sid)
+                st["speakers"].add(sid)
+                self.speaker_promotions += 1
+            else:
+                if sid not in st["speakers"]:
+                    continue
+                st["speakers"].discard(sid)
+                self.placer.resize(
+                    conf, max(self.placer.size_of(conf) - 1, 0))
+                # the demoted row stays physically put: it re-books as
+                # a listener row on its current shard
+                self.placer.grow_listeners(
+                    conf, shard=sid // self._rows_per_shard)
+                self._listener_sids.add(sid)
+                self.speaker_demotions += 1
+            touched.add(conf)
+            self.flight.record("speaker_flip", tick=self.ticks(),
+                               conf=conf, sid=sid, role=role)
+            _log.info("speaker_flip", conf=conf, sid=sid, role=role)
+        for conf in sorted(touched):
+            self._push_speakers(conf)
 
     def poll(self) -> None:
         """Stage the next install wave: batch-limited, slot-limited,
@@ -354,7 +590,10 @@ class StreamLifecycleManager:
         else:
             by_shard: Dict[int, list] = {}
             for spec in popped:
-                shard = self.placer.shard_of(spec[4])
+                # broadcast listeners carry their own assigned shard
+                # (may straddle off the conference's home shard)
+                shard = spec[6] if spec[5] == "listener" \
+                    else self.placer.shard_of(spec[4])
                 by_shard.setdefault(shard, []).append(spec)
             specs, sids, confs = [], [], []
             requeue: list = []
@@ -372,7 +611,16 @@ class StreamLifecycleManager:
                 return
         for spec in specs:
             self._queued_ssrcs.discard(spec[0])
-        self._ensure_warm(len(self.bridge._ssrc_of) + len(specs))
+        n_listen = sum(1 for spec in specs if spec[5] == "listener")
+        # listeners warm their OWN fanout-only ladder; they never
+        # contribute uplink RTP, so they stay out of the RTP-class
+        # population estimate entirely
+        self._ensure_warm(len(self.bridge._ssrc_of)
+                          - len(self._listener_sids)
+                          + len(specs) - n_listen)
+        if n_listen or self._listener_sids:
+            self._ensure_warm_listeners(
+                len(self._listener_sids) + n_listen)
         specs4 = [tuple(spec[:4]) for spec in specs]
         if self.placer is None:
             # kwarg-free call: bridge fakes/older bridges keep working
@@ -383,6 +631,10 @@ class StreamLifecycleManager:
         self.key_installs += len(specs)
         self._staged.extend(out_sids)
         for sid, spec in zip(out_sids, specs):
+            if spec[5] == "listener":
+                self._listener_sids.add(int(sid))
+            elif spec[5] == "speaker":
+                self._bcast[spec[4]]["speakers"].add(int(sid))
             self.flight.record("key_install", tick=self.ticks(),
                                sid=sid, ssrc=spec[0])
 
@@ -491,6 +743,40 @@ class StreamLifecycleManager:
                   row_classes=sorted(self._warm_rows))
         self._warm_bucket = bucket
 
+    def _ensure_warm_listeners(self, population: int) -> None:
+        """The fanout-only twin of `_ensure_warm`: listener rows never
+        contribute uplink RTP, so their ladder skips the RTP row
+        classes entirely and warms only the fan-out expansion (the
+        shared bus re-protected once per listener leg) and RTCP shapes.
+        A 4096-listener broadcast therefore warms a handful of fanout
+        classes instead of dragging the RTP ladder to its ceiling —
+        and listener churn inside a bucket still compiles nothing."""
+        bucket = _next_pow2(max(self.cfg.min_bucket, population))
+        if bucket <= self._warm_lbucket:
+            return
+        max_rows = min(bucket, ROW_CLASSES[-1])
+        above = [rc for rc in ROW_CLASSES if rc > max_rows]
+        cover = above[0] if above else ROW_CLASSES[-1]
+        want = [rc for rc in ROW_CLASSES
+                if rc <= cover and rc not in self._warm_lrows]
+        if not want and ROW_CLASSES[0] not in self._warm_lrows:
+            want = [ROW_CLASSES[0]]
+        tr = getattr(self.bridge, "translator", None)
+        for rc in want:
+            if tr is not None and hasattr(tr, "warmup_fanout"):
+                tr.warmup_fanout(rc,
+                                 payload_len=self.cfg.warm_payload_len)
+            if hasattr(self.bridge.rx_table, "warmup_rtcp"):
+                self.bridge.rx_table.warmup_rtcp(rc)
+                self.bridge.tx_table.warmup_rtcp(rc)
+            self._warm_lrows.add(rc)
+        self.flight.record("listener_bucket_warm", tick=self.ticks(),
+                           bucket=bucket,
+                           rows=sorted(self._warm_lrows))
+        _log.info("listener_bucket_warm", bucket=bucket,
+                  row_classes=sorted(self._warm_lrows))
+        self._warm_lbucket = bucket
+
     # --------------------------------------------- data-path compile proof
 
     def tick_begin(self) -> None:
@@ -534,6 +820,14 @@ class StreamLifecycleManager:
                 "n_shards": self.placer.n_shards,
                 "move_inflight": self._move_inflight,
             }
+        if self._bcast:
+            snap["broadcast"] = {
+                str(conf): {"home": self.placer.shard_of(conf),
+                            "speakers": sorted(st["speakers"]),
+                            "join_good": st["join_good"],
+                            "join_bad": st["join_bad"]}
+                for conf, st in self._bcast.items()}
+            snap["listener_sids"] = sorted(self._listener_sids)
         return snap
 
     def _reconcile(self, pend: dict) -> None:
@@ -551,6 +845,20 @@ class StreamLifecycleManager:
         pl = pend.get("placement")
         if pl is not None and self.placer is None:
             self.enable_placement(int(pl["n_shards"]))
+        for conf_s, st in pend.get("broadcast", {}).items():
+            self._bcast[int(conf_s)] = {
+                "speakers": {int(s) for s in st["speakers"]},
+                "join_good": int(st["join_good"]),
+                "join_bad": int(st["join_bad"]),
+            }
+        self._bcast_homes = {int(c): int(st["home"])
+                             for c, st in
+                             pend.get("broadcast", {}).items()
+                             if st.get("home") is not None}
+        self._listener_sids = {int(s)
+                               for s in pend.get("listener_sids", [])}
+        if self._bcast:
+            self._register_conference_slo(0.999)
         for sid, ssrc in pend.get("staged", []):
             sid = int(sid)
             if (sid in self.bridge._ssrc_of
@@ -561,6 +869,9 @@ class StreamLifecycleManager:
             else:
                 if sid in self.bridge._ssrc_of:
                     self.bridge.remove_endpoints([sid])
+                self._listener_sids.discard(sid)
+                for st in self._bcast.values():
+                    st["speakers"].discard(sid)
                 self.flight.record("admit_rollback", tick=self.ticks(),
                                    sid=sid, ssrc=ssrc)
                 _log.info("admit_rollback", sid=sid)
@@ -569,10 +880,12 @@ class StreamLifecycleManager:
         for spec in pend.get("queued", []):
             ssrc, rx, tx, name = spec[:4]
             conf = spec[4] if len(spec) > 4 else None
+            role = spec[5] if len(spec) > 5 else None
             # solo (negative) conference keys re-derive from the ssrc
             self.request_join(ssrc, rx, tx, name=name,
                               conference=conf if (conf is None
-                                                  or conf >= 0) else None)
+                                                  or conf >= 0) else None,
+                              role=role)
 
     def _reconcile_placement(self, pl: dict) -> None:
         """Rebuild placement accounting from the RESTORED rows — the
@@ -586,15 +899,51 @@ class StreamLifecycleManager:
         for sid, conf in self.bridge._conf_of.items():
             if sid in self.bridge._ssrc_of:
                 members.setdefault(int(conf), []).append(int(sid))
+        live = set(self.bridge._ssrc_of)
+        self._listener_sids &= live
+        for st in self._bcast.values():
+            st["speakers"] &= live
+        homes = getattr(self, "_bcast_homes", {})
         assignments = []
+        broadcast = []
         for conf, sids in sorted(members.items()):
+            if conf in self._bcast:
+                # broadcast conferences legitimately straddle on their
+                # LISTENER rows; only the speaker rows are pinned home
+                speakers = self._bcast[conf]["speakers"]
+                spk = [s for s in sids if s in speakers]
+                spk_shards = {s // self._rows_per_shard for s in spk}
+                home = homes.get(conf)
+                if home is None:
+                    home = (spk_shards.pop() if len(spk_shards) == 1
+                            else 0)
+                elif spk_shards - {home}:
+                    raise AssertionError(
+                        f"broadcast conference {conf} speaker rows "
+                        f"off home shard {home} after recovery — "
+                        f"torn placement")
+                assignments.append((conf, home, len(spk)))
+                per: Dict[int, int] = {}
+                for s in sids:
+                    if s not in speakers:
+                        sh = s // self._rows_per_shard
+                        per[sh] = per.get(sh, 0) + 1
+                broadcast.append((conf, per))
+                continue
             shards = {s // self._rows_per_shard for s in sids}
             if len(shards) != 1:
                 raise AssertionError(
                     f"conference {conf} straddles shards {sorted(shards)} "
                     f"after recovery — torn placement")
             assignments.append((conf, shards.pop(), len(sids)))
-        self.placer.rebuild(assignments)
+        # a declared broadcast conference with no live members yet must
+        # still hold its home-shard reservation across recovery
+        for conf, home in sorted(homes.items()):
+            if conf not in members:
+                assignments.append((conf, home, 0))
+                broadcast.append((conf, {}))
+        self.placer.rebuild(assignments, broadcast=broadcast)
+        self._bcast_homes = {}
         mv = pl.get("move_inflight")
         if mv:
             conf = int(mv["conf"])
@@ -632,6 +981,15 @@ class StreamLifecycleManager:
         registry.register_multi(
             f"{prefix}_admit_rejected", self._rejected_samples,
             help_="admissions refused, by typed reason", kind="counter")
+        registry.register_scalar(
+            "bcast_listeners", lambda: float(len(self._listener_sids)),
+            help_="fanout-only listener rows live across all "
+                  "broadcast conferences")
+        registry.register_scalar(
+            "speaker_promotions_total",
+            lambda: float(self.speaker_promotions),
+            help_="listener-to-speaker role flips applied at the "
+                  "commit barrier", kind="counter")
 
     def _rejected_samples(self):
         return [({"reason": r}, float(c))

@@ -255,6 +255,11 @@ class SfuBridge:
         # without one (direct add_endpoint) form one shared mesh, which
         # keeps the single-conference bridge behavior unchanged.
         self._conf_of: Dict[int, int] = {}
+        # broadcast conferences (mesh/hierarchy.py): conference id ->
+        # current speaker sids.  Speakers fan out to every member;
+        # every other member is a fanout-only listener row (routes to
+        # nobody, uplink RTP masked off in the loop).
+        self._bcast_speakers: Dict[int, set] = {}
 
     # ---------------------------------------------------------- endpoints
     def add_endpoint(self, ssrc: int, rx_key: Tuple[bytes, bytes],
@@ -353,7 +358,10 @@ class SfuBridge:
             self._rx_keys.pop(sid, None)
             self._tx_keys.pop(sid, None)
             self._recv_bw.pop(sid, None)
-            self._conf_of.pop(sid, None)
+            conf = self._conf_of.pop(sid, None)
+            if conf is not None and conf in self._bcast_speakers:
+                self._bcast_speakers[conf].discard(sid)
+                self.loop.set_fanout_only(sid, False)
             # a staged-but-never-committed row: throw its held media
             # away (the endpoint left before its admit flipped live)
             if sid in self._staged:
@@ -470,6 +478,13 @@ class SfuBridge:
         self._quiesce_fanout()
         for sid in sids:
             self._staged.discard(sid)
+            conf = self._conf_of.get(sid)
+            if conf is not None and conf in self._bcast_speakers:
+                # joining a broadcast conference: fanout-only unless in
+                # the current speaker set (role flips ride the same
+                # barrier later)
+                self.loop.set_fanout_only(
+                    sid, sid not in self._bcast_speakers[conf])
         self._rebuild_routes()
         for sid in sids:
             for track in set(self._video.values()):
@@ -477,6 +492,33 @@ class SfuBridge:
             self.loop.release_stream(sid)
             _log.info("endpoint_join", sid=sid,
                       ssrc=self._ssrc_of.get(sid))
+
+    def set_broadcast_speakers(self, conference: int, sids) -> None:
+        """Declare/update a broadcast conference's speaker set and
+        rebuild its routes: speakers fan out to every member, all other
+        members become fanout-only listener rows.  Called by the
+        lifecycle plane BETWEEN ticks (a promotion/demotion is a
+        commit-barrier event, never a mid-tick one); the fan-out
+        quiesce makes the standalone call safe too."""
+        conference = int(conference)
+        speakers = {int(s) for s in sids}
+        if self._bcast_speakers.get(conference) == speakers:
+            return
+        self._quiesce_fanout()
+        self._bcast_speakers[conference] = speakers
+        for sid, conf in self._conf_of.items():
+            if conf == conference:
+                self.loop.set_fanout_only(sid, sid not in speakers)
+        self._rebuild_routes()
+
+    def clear_broadcast(self, conference: int) -> None:
+        """Drop a conference's broadcast routing (back to full mesh)."""
+        if self._bcast_speakers.pop(int(conference), None) is not None:
+            for sid, conf in self._conf_of.items():
+                if conf == int(conference):
+                    self.loop.set_fanout_only(sid, False)
+            self._quiesce_fanout()
+            self._rebuild_routes()
 
     def migrate_endpoints(self, mapping: Dict[int, int]) -> None:
         """Move live endpoints to new rows BIT-EXACT — the execution
@@ -531,6 +573,14 @@ class SfuBridge:
                 self._recv_bw[d] = self._recv_bw.pop(s)
             if s in self._conf_of:
                 self._conf_of[d] = self._conf_of.pop(s)
+                conf = self._conf_of[d]
+                if conf in self._bcast_speakers:
+                    spk = self._bcast_speakers[conf]
+                    if s in spk:
+                        spk.discard(s)
+                        spk.add(d)
+                    self.loop.set_fanout_only(s, False)
+                    self.loop.set_fanout_only(d, d not in spk)
             self.loop.addr_ip[d] = self.loop.addr_ip[s]
             self.loop.addr_port[d] = self.loop.addr_port[s]
             self.loop.addr_ip[s] = 0
@@ -750,9 +800,21 @@ class SfuBridge:
             groups: Dict[int, list] = {}
             for s in sids:
                 groups.setdefault(self._conf_of.get(s, -1), []).append(s)
-            for grp in groups.values():
-                for s in grp:
-                    self.translator.connect(s, [r for r in grp if r != s])
+            for conf, grp in groups.items():
+                speakers = self._bcast_speakers.get(conf)
+                if speakers is None:
+                    for s in grp:
+                        self.translator.connect(
+                            s, [r for r in grp if r != s])
+                else:
+                    # broadcast conference: only speakers have legs —
+                    # a speaker fans out to every other member; the
+                    # listeners are fanout-only rows with no route of
+                    # their own (their uplink is masked in the loop)
+                    for s in grp:
+                        self.translator.connect(
+                            s, [r for r in grp if r != s]
+                            if s in speakers else [])
         else:
             for s in sids:
                 self.translator.connect(s, [r for r in sids if r != s])
@@ -1089,6 +1151,8 @@ class SfuBridge:
                         if s in keyed},
             "conf_of": {s: c for s, c in self._conf_of.items()
                         if s in keyed},
+            "bcast_speakers": {c: sorted(s) for c, s in
+                               self._bcast_speakers.items()},
             "addr_ip": self.loop.addr_ip.copy(),
             "addr_port": self.loop.addr_port.copy(),
         }
@@ -1131,6 +1195,13 @@ class SfuBridge:
         bridge._recv_bw = dict(snap["recv_bw"])
         bridge._conf_of = {int(s): int(c) for s, c in
                            snap.get("conf_of", {}).items()}
+        bridge._bcast_speakers = {
+            int(c): {int(s) for s in spk}
+            for c, spk in snap.get("bcast_speakers", {}).items()}
+        for sid, conf in bridge._conf_of.items():
+            if conf in bridge._bcast_speakers:
+                bridge.loop.set_fanout_only(
+                    sid, sid not in bridge._bcast_speakers[conf])
         sids = sorted(snap["ssrc_of"])
         bridge.registry.reserve_many(sids, bridge)
         for sid in sids:

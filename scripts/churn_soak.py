@@ -421,6 +421,186 @@ def run_soak(duration_s: float = 30.0, ramp_s: float = 6.0,
     return report
 
 
+def run_broadcast_soak(duration_s: float = 20.0, ramp_s: float = 8.0,
+                       dt: float = 0.02, n_speakers: int = 8,
+                       n_listeners: int = 4096,
+                       join_rate_hz=None, mean_hold_s: float = 10.0,
+                       n_shards: int = 8, capacity=None,
+                       flip_every_ticks: int = 200,
+                       join_p99_bound_s: float = 0.25, seed: int = 0,
+                       verbose: bool = True, report_path=None) -> dict:
+    """Broadcast-conference churn soak: one declared broadcast
+    conference (`n_speakers` on the home shard, fanout-only listeners
+    straddling all shards) under Poisson listener join/leave at the
+    conference's steady population, with periodic speaker
+    promote/demote flips riding the same commit barrier.  Asserts:
+
+    - ZERO compile events inside tick windows once the ramp is over —
+      listener churn rides the fanout-only warmup ladder and role
+      flips ride pre-warmed shapes;
+    - listener-join p99 (request_join -> committed live, model time)
+      stays under `join_p99_bound_s` — the off-tick install pipeline
+      keeps up with broadcast-scale churn;
+    - the conference's `bcast_listener_join` slice stays healthy (no
+      refused listener joins at steady state) and the loop's
+      fanout-only mask tracks the live listener set exactly.
+
+    No probe media rides this soak — end-to-end loss under churn is
+    the plain soak's job; this one isolates the lifecycle plane at
+    broadcast scale."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    if capacity is None:
+        capacity = max(512, 2 * n_listeners)
+    if capacity % n_shards:
+        capacity += n_shards - capacity % n_shards
+    if join_rate_hz is None:
+        # stationary population: joins/s x mean hold = listener count
+        join_rate_hz = n_listeners / mean_hold_s
+    cfg = libjitsi_tpu.configuration_service()
+    bridge = SfuBridge(cfg, port=0, capacity=capacity, recv_window_ms=0)
+    reg = bridge.loop.metrics
+    sup = BridgeSupervisor(
+        bridge, SupervisorConfig(deadline_ms=1000.0), metrics=reg)
+    lc = StreamLifecycleManager(bridge, supervisor=sup, metrics=reg)
+    lc.enable_placement(n_shards)
+    conf = 1
+    lc.declare_broadcast(conf)
+    now = 100.0
+    t0_wall = time.perf_counter()
+
+    for k in range(n_speakers):
+        ok, why = lc.request_join(0x100 + k, _keys(k),
+                                  _keys(k + 2), conference=conf,
+                                  role="speaker")
+        assert ok, f"speaker admission refused: {why}"
+    while lc.admits < n_speakers:
+        sup.tick(now=now)
+        now += dt
+
+    cm = ChurnModel(join_rate_hz, mean_hold_s, seed=seed)
+    drv = np.random.default_rng(seed + 2)
+    next_ssrc = 0x10000
+    alive: list = []
+    waiting: dict = {}                  # ssrc -> request model-time
+    latencies: list = []
+    flips = 0
+
+    def _join_listener(ssrc):
+        nonlocal next_ssrc
+        ok_j, _why = lc.request_join(
+            ssrc, _keys(ssrc & 0xFF), _keys((ssrc + 2) & 0xFF),
+            conference=conf)
+        if ok_j:
+            alive.append(ssrc)
+            waiting[ssrc] = now
+        return ok_j
+
+    ramp_ticks = int(round(ramp_s / dt))
+    window_ticks = int(round(duration_s / dt))
+    w0 = {}
+    for t in range(ramp_ticks + window_ticks):
+        in_window = t >= ramp_ticks
+        if t == ramp_ticks:
+            w0 = dict(recompiles=lc.datapath_recompiles,
+                      admits=lc.admits, evicts=lc.evicts,
+                      join_bad=lc._bcast[conf]["join_bad"])
+        if not in_window and len(alive) < n_listeners:
+            # ramp: fill toward the target population, batch-paced so
+            # the queue never trips the backlog bar
+            room = lc.cfg.max_pending - lc.key_installs_pending - 1
+            for _ in range(min(room, lc.cfg.install_batch,
+                               n_listeners - len(alive))):
+                _join_listener(next_ssrc)
+                next_ssrc += 1
+        if in_window:
+            joins, leaves = cm.step(dt, now, len(alive))
+            for _ in range(joins):
+                _join_listener(next_ssrc)
+                next_ssrc += 1
+            if leaves and alive:
+                committed = set(bridge._ssrc_of.values())
+                pool = [s for s in alive if s in committed]
+                drv.shuffle(pool)
+                for ssrc in pool[:leaves]:
+                    lc.request_leave(ssrc=ssrc)
+                    alive.remove(ssrc)
+                    waiting.pop(ssrc, None)
+            if flip_every_ticks and t % flip_every_ticks == 0:
+                # speaker churn rides the same barrier: promote a
+                # random committed listener, demote a random speaker
+                spk = sorted(lc._bcast[conf]["speakers"])
+                lst = sorted(s for s in lc._listener_sids
+                             if s in bridge._ssrc_of
+                             and s not in bridge._staged)
+                if spk and lst:
+                    lc.promote_speaker(conf, lst[drv.integers(len(lst))])
+                    lc.demote_speaker(conf, spk[drv.integers(len(spk))])
+                    flips += 1
+        sup.tick(now=now)
+        if waiting:
+            # committed means LIVE, not merely staged: a staged row
+            # sits in _ssrc_of already but only flips at the barrier
+            committed = {s for sid, s in bridge._ssrc_of.items()
+                         if sid not in bridge._staged}
+            for ssrc in [s for s in waiting if s in committed]:
+                latencies.append(now - waiting.pop(ssrc))
+        now += dt
+
+    window_recompiles = lc.datapath_recompiles - w0["recompiles"]
+    window_join_bad = lc._bcast[conf]["join_bad"] - w0["join_bad"]
+    join_p99 = float(np.percentile(latencies, 99)) if latencies else 0.0
+    live_listeners = sum(1 for s in lc._listener_sids
+                         if s in bridge._ssrc_of
+                         and s not in bridge._staged)
+    mask_n = int(bridge.loop.fanout_only.sum())
+    events = (lc.admits - w0["admits"]) + (lc.evicts - w0["evicts"])
+
+    report = {
+        "mode": "broadcast",
+        "model_time_s": round(ramp_s + duration_s, 3),
+        "window_s": duration_s,
+        "wall_s": round(time.perf_counter() - t0_wall, 3),
+        "capacity_rows": capacity,
+        "n_shards": n_shards,
+        "speakers": n_speakers,
+        "listener_target": n_listeners,
+        "listener_population": len(lc._listener_sids),
+        "listener_shards": lc.placer.listener_shards(conf),
+        "window_events": events,
+        "events_per_sec": round(events / duration_s, 1),
+        "window_join_refused": window_join_bad,
+        "join_p99_s": round(join_p99, 4),
+        "join_samples": len(latencies),
+        "speaker_flips": flips,
+        "speaker_promotions": lc.speaker_promotions,
+        "speaker_demotions": lc.speaker_demotions,
+        "priming_recompiles": w0["recompiles"],
+        "window_recompiles": window_recompiles,
+        "warm_bucket": lc._warm_bucket,
+        "warm_listener_bucket": lc._warm_lbucket,
+        "fanout_only_rows": mask_n,
+        # ---- invariants
+        "ok_zero_datapath_recompiles": window_recompiles == 0,
+        "ok_join_p99": (len(latencies) > 0
+                        and join_p99 <= join_p99_bound_s),
+        "ok_no_refused_listeners": window_join_bad == 0,
+        "ok_fanout_mask_tracks_listeners": mask_n == live_listeners,
+        "ok_population": (len(lc._listener_sids)
+                          >= 0.5 * n_listeners),
+    }
+    bridge.close()
+    libjitsi_tpu.stop()
+    if verbose:
+        print("---- broadcast churn soak report ----")
+        for k, v in report.items():
+            print(f"{k:32s} {v}")
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    return report
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--duration", type=float, default=30.0,
@@ -443,7 +623,34 @@ def main() -> int:
                     help="write the JSON report here")
     ap.add_argument("--smoke", action="store_true",
                     help="fast tier-1 configuration (~3 s model time)")
+    ap.add_argument("--broadcast", action="store_true",
+                    help="broadcast-conference mode: Poisson listener "
+                         "churn on one hierarchical conference")
+    ap.add_argument("--listeners", type=int, default=4096,
+                    help="broadcast mode: steady listener population")
+    ap.add_argument("--speakers", type=int, default=8)
+    ap.add_argument("--shards", type=int, default=8)
+    ap.add_argument("--join-p99", type=float, default=0.25,
+                    help="broadcast mode: listener-join p99 bound, "
+                         "model seconds")
     args = ap.parse_args()
+    if args.broadcast:
+        kw = dict(duration_s=args.duration, ramp_s=args.ramp,
+                  mean_hold_s=args.hold, n_speakers=args.speakers,
+                  n_listeners=args.listeners, n_shards=args.shards,
+                  join_p99_bound_s=args.join_p99, seed=args.seed,
+                  report_path=args.report)
+        if args.smoke:
+            kw.update(duration_s=3.0, ramp_s=2.0, n_listeners=192,
+                      mean_hold_s=2.0, capacity=512)
+        report = run_broadcast_soak(**kw)
+        failed = [k for k, v in report.items()
+                  if k.startswith("ok_") and not v]
+        if failed:
+            print(f"INVARIANT FAILURES: {failed}", file=sys.stderr)
+            return 1
+        print("all broadcast churn invariants held")
+        return 0
     kw = dict(duration_s=args.duration, ramp_s=args.ramp,
               join_rate_hz=args.join_rate, mean_hold_s=args.hold,
               capacity=args.capacity, probes=args.probes,

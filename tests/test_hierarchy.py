@@ -1,0 +1,332 @@
+"""Hierarchical two-level mixing (PR 11): the broadcast tick is
+bit-exact versus the single-device reference, carries EXACTLY ONE
+cross-chip collective, the placer's broadcast size class keeps speaker
+rows on the home shard while listener rows straddle with linear cost
+and atomic rollback, and the fanout-only listener mask drops uplink
+RTP at the loop while letting RTCP through for downlink recovery."""
+
+import struct
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import libjitsi_tpu
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.io import UdpEngine
+from libjitsi_tpu.mesh import make_media_mesh
+from libjitsi_tpu.mesh.hierarchy import (broadcast_bus_fanout,
+                                         broadcast_step_ref)
+from libjitsi_tpu.mesh.parity import assert_hierarchy_parity
+from libjitsi_tpu.mesh.placement import ConferencePlacer
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.service.lifecycle import StreamLifecycleManager
+from libjitsi_tpu.service.sfu_bridge import SfuBridge
+from libjitsi_tpu.service.supervisor import (BridgeSupervisor,
+                                             SupervisorConfig)
+from libjitsi_tpu.transform.srtp import SrtpStreamTable
+
+
+# ------------------------------------------------- mesh: tick parity
+
+@pytest.fixture(scope="module")
+def mesh():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return make_media_mesh(jax.devices()[:8])
+
+
+def test_hierarchy_tick_parity_with_single_device_reference(mesh):
+    """Speaker mix-minus, per-conference bus and levels from the
+    two-level mesh tick are bit-identical to `broadcast_step_ref` on
+    one device and to a numpy oracle (int32 associativity makes
+    psum-of-partials exact)."""
+    assert_hierarchy_parity(mesh, 8)
+
+
+def test_broadcast_tick_has_exactly_one_collective(mesh):
+    """The structural half of the `bcast_fanout_pps` story: the traced
+    broadcast tick contains ONE psum (the bus fan-out) and no other
+    cross-chip collective of any flavor."""
+    n_conf, B, F = 3, 64, 160
+    fn = broadcast_bus_fanout(mesh, n_conf)
+    jaxpr = str(jax.make_jaxpr(fn)(
+        jnp.zeros((B, F), jnp.int16), jnp.zeros(B, bool),
+        jnp.zeros(B, jnp.int32)))
+    assert jaxpr.count("psum") == 1, jaxpr
+    for other in ("all_gather", "all_to_all", "ppermute",
+                  "reduce_scatter", "pmax", "pmin"):
+        assert other not in jaxpr, f"unexpected collective {other}"
+
+
+def test_bus_is_replicated_and_listener_leg_needs_no_gather(mesh):
+    """out_spec P(None, None): every shard sees the SAME full bus, so
+    the listener re-protect leg can read it locally — the reason the
+    tick stays at one collective."""
+    n_conf, B, F = 2, 64, 16
+    rng = np.random.default_rng(3)
+    pcm = rng.integers(-1000, 1000, (B, F)).astype(np.int16)
+    active = np.ones(B, dtype=bool)
+    conf = (np.arange(B) % n_conf).astype(np.int32)
+    _spk, bus, _lvl = broadcast_bus_fanout(mesh, n_conf)(pcm, active,
+                                                         conf)
+    assert bus.shape == (n_conf, F)
+    # replicated: each device's copy of the bus is the global total
+    _rspk, rbus, _rlvl = broadcast_step_ref(n_conf)(pcm, active, conf)
+    np.testing.assert_array_equal(np.asarray(bus), np.asarray(rbus))
+
+
+# ------------------------------------------- placer: broadcast class
+
+def test_place_broadcast_spreads_listeners_and_costs_linearly():
+    p = ConferencePlacer(4, rows_per_shard=8)
+    home = p.place_broadcast(1, n_speakers=2, n_listeners=12)
+    assert home == 0
+    assert p.is_broadcast(1)
+    assert p.size_of(1) == 2                  # speakers only
+    assert p.listener_count(1) == 12
+    shards = p.listener_shards(1)
+    assert sum(shards.values()) == 12
+    assert len(shards) > 1, "listeners must be allowed to straddle"
+    # accounting: rows exact, listener cost linear (alpha/8 per row)
+    rows = [ld for (_c, ld, _n) in p.loads()]
+    assert sum(rows) == 2 + 12
+    cost = sum(c for (c, _r, _n) in p.loads())
+    assert cost == pytest.approx(
+        p.cost(2) + p.listener_cost(12))
+    assert p.listener_cost(12) == pytest.approx(
+        12 * p.alpha * ConferencePlacer.LISTENER_COST)
+
+
+def test_place_broadcast_rolls_back_atomically_when_full():
+    """If the listener leg cannot be satisfied, NOTHING stays placed —
+    no half-placed home shard, accounting back to zero."""
+    p = ConferencePlacer(2, rows_per_shard=4)
+    assert p.place_broadcast(9, n_speakers=2, n_listeners=100) is None
+    assert not p.is_broadcast(9)
+    assert p.shard_of(9) is None
+    assert all(r == 0 and c == 0.0 for (c, r, _n) in p.loads())
+    assert p.rejects >= 1
+
+
+def test_grow_listeners_least_loaded_pinned_and_shrink():
+    p = ConferencePlacer(3, rows_per_shard=8)
+    p.place_broadcast(5, n_speakers=3)        # home=0 carries 3 rows
+    assert p.grow_listeners(5) in (1, 2)      # steers off the home
+    assert p.grow_listeners(5, shard=0) == 0  # pin: demoted speaker
+    assert p.listener_shards(5).get(0) == 1
+    with pytest.raises(ValueError):
+        p.grow_listeners(7)                   # not a broadcast conf
+    p.shrink_listeners(5, 0)
+    assert 0 not in p.listener_shards(5)      # empty shard entry gone
+    before = p.listener_count(5)
+    assert before == 1
+
+
+def test_release_drains_listener_rows_and_rebuild_restores():
+    p = ConferencePlacer(4, rows_per_shard=8)
+    p.place_broadcast(3, n_speakers=2, n_listeners=10)
+    snapshot = (p.shard_of(3), p.listener_shards(3))
+    p.release(3)
+    assert all(r == 0 and c == 0.0 for (c, r, _n) in p.loads())
+    assert not p.is_broadcast(3)
+    # checkpoint-recovery path: rebuild(broadcast=) reproduces the
+    # exact same loads the live placer had
+    q = ConferencePlacer(4, rows_per_shard=8)
+    q.rebuild([(3, snapshot[0], 2)], broadcast=[(3, snapshot[1])])
+    assert q.is_broadcast(3)
+    assert q.listener_shards(3) == snapshot[1]
+    assert sum(r for (_c, r, _n) in q.loads()) == 12
+
+
+def test_plan_rebalance_never_moves_broadcast_conferences():
+    """A broadcast conference's listener rows straddle by design; the
+    rebalancer must not try to 'fix' that by moving the conference."""
+    p = ConferencePlacer(2, rows_per_shard=64, hysteresis=1.0)
+    p.place_broadcast(1, n_speakers=8, n_listeners=0)   # heavy, shard 0
+    p.place(2, 2)                                       # light, shard 1
+    moves = p.plan_rebalance()
+    assert all(m.conf_id != 1 for m in moves)
+
+
+# ------------------------- loop: fanout-only mask + bridge routing
+
+def _keys(k):
+    return ((bytes([k & 0xFF]) * 16, bytes([(k + 1) & 0xFF]) * 14),
+            (bytes([(k + 2) & 0xFF]) * 16, bytes([(k + 3) & 0xFF]) * 14))
+
+
+def _universe(capacity=16, n_shards=4):
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    bridge = SfuBridge(cfg, port=0, capacity=capacity, recv_window_ms=0)
+    sup = BridgeSupervisor(bridge, SupervisorConfig(deadline_ms=1000.0))
+    lc = StreamLifecycleManager(bridge, supervisor=sup)
+    lc._warm_bucket = 1 << 30
+    lc._warm_lbucket = 1 << 30
+    lc.enable_placement(n_shards)
+    return bridge, sup, lc
+
+
+def _settle(sup, lc, admits, t=100.0):
+    for _ in range(64):
+        if lc.admits >= admits:
+            return t
+        sup.tick(now=t)
+        t += 0.02
+    raise AssertionError(f"settle: admits={lc.admits}, want {admits}")
+
+
+def _pump(sup, now, want):
+    got = 0
+    for i in range(200):
+        got += sup.tick(now=now)["rx"]
+        if got >= want:
+            break
+        if i > 3:
+            time.sleep(0.001)
+    return got
+
+
+def _send_rtp(engine, port, ssrc, seq=1):
+    rx, _tx = _keys(ssrc & 0xFF)
+    prot = SrtpStreamTable(capacity=1)
+    prot.add_stream(0, *rx)
+    b = rtp_header.build([bytes([seq & 0xFF]) * 80], [seq], [160 * seq],
+                         [ssrc], [0], stream=[0])
+    pb = prot.protect_rtp(b)
+    engine.send_batch(PacketBatch.from_payloads([pb.to_bytes(0)]),
+                      "127.0.0.1", port)
+
+
+def test_fanout_only_listener_rtp_dropped_rtcp_passes():
+    """The loop-level contract of a fanout-only row: uplink RTP is
+    dropped before the reverse chain (counted in fanout_rtp_dropped),
+    while RTCP from the same row still reaches on_rtcp so downlink
+    loss recovery keeps working.  Speaker RTP is untouched."""
+    bridge, sup, lc = _universe()
+    lc.declare_broadcast(77)
+    spk_ssrc, lis_ssrc = 0x10, 0x20
+    assert lc.request_join(spk_ssrc, *_keys(spk_ssrc), conference=77,
+                           role="speaker")[0]
+    assert lc.request_join(lis_ssrc, *_keys(lis_ssrc),
+                           conference=77)[0]          # defaults listener
+    _settle(sup, lc, 2)
+    sid_of = {s: k for k, s in bridge._ssrc_of.items()}
+    spk_sid = sid_of[spk_ssrc]
+    lis_sid = sid_of[lis_ssrc]
+    assert not bridge.loop.fanout_only[spk_sid]
+    assert bridge.loop.fanout_only[lis_sid]
+
+    rtcp_seen = []
+    inner = bridge.loop.on_rtcp
+
+    def spy(batch, ok):
+        rtcp_seen.extend(int(s) for s in batch.stream)
+        return inner(batch, ok) if inner is not None else None
+
+    bridge.loop.on_rtcp = spy
+    engine = UdpEngine(port=0)
+    try:
+        # listener uplink RTP: dropped at the mask, never decrypted
+        drops0 = bridge.loop.fanout_rtp_dropped
+        _send_rtp(engine, bridge.port, lis_ssrc)
+        _pump(sup, 200.0, 1)
+        assert bridge.loop.fanout_rtp_dropped == drops0 + 1
+        # speaker uplink RTP: passes the mask untouched
+        _send_rtp(engine, bridge.port, spk_ssrc)
+        _pump(sup, 200.1, 1)
+        assert bridge.loop.fanout_rtp_dropped == drops0 + 1
+        # listener RTCP (minimal RR, PT=201): passes to on_rtcp
+        rr = struct.pack("!BBH I I", 0x80, 201, 1, lis_ssrc, 0)
+        engine.send_batch(PacketBatch.from_payloads([rr]),
+                          "127.0.0.1", bridge.port)
+        _pump(sup, 200.2, 1)
+        assert lis_sid in rtcp_seen
+    finally:
+        engine.close()
+        bridge.close()
+
+
+def test_set_broadcast_speakers_scopes_routes_to_speakers():
+    """Fan-out routing: listeners receive every speaker's media but
+    forward nothing of their own; clear_broadcast restores the full
+    mesh."""
+    bridge, sup, lc = _universe()
+    lc.declare_broadcast(5)
+    ssrcs = (0x30, 0x31, 0x40, 0x41)        # 2 speakers, 2 listeners
+    for i, ssrc in enumerate(ssrcs):
+        role = "speaker" if i < 2 else "listener"
+        assert lc.request_join(ssrc, *_keys(ssrc), conference=5,
+                               role=role)[0]
+    _settle(sup, lc, 4)
+    sid_of = {s: k for k, s in bridge._ssrc_of.items()}
+    sid = {s: sid_of[s] for s in ssrcs}
+    speakers = {sid[0x30], sid[0x31]}
+
+    def routes(s):
+        return {int(x) for x in bridge.translator._routes.get(s, ())}
+
+    for s in sid.values():
+        if s in speakers:
+            # a speaker forwards to every OTHER member of the conf
+            assert routes(s) == set(sid.values()) - {s}
+        else:
+            assert routes(s) == set(), "listener rows are fanout-only"
+    bridge.clear_broadcast(5)
+    for s in sid.values():
+        assert routes(s) == set(sid.values()) - {s}
+    bridge.close()
+
+
+def test_promote_demote_ride_the_commit_barrier():
+    """Role flips are commit-barrier events: a promoted off-home
+    listener's row MIGRATES to the home shard and sheds its fanout-only
+    mask; a demoted speaker stays physically put but re-books as a
+    listener row; both leave speaker_flip events in the flight
+    recorder and bump the promotion/demotion counters."""
+    bridge, sup, lc = _universe(capacity=16, n_shards=4)
+    home = lc.declare_broadcast(9)
+    ssrcs = (0x50, 0x60, 0x61, 0x62)        # 1 speaker, 3 listeners
+    for i, ssrc in enumerate(ssrcs):
+        role = "speaker" if i == 0 else "listener"
+        assert lc.request_join(ssrc, *_keys(ssrc), conference=9,
+                               role=role)[0]
+    _settle(sup, lc, 4)
+    sid_of = {s: k for k, s in bridge._ssrc_of.items()}
+    rows_per = lc._rows_per_shard
+    off_home = next(s for s in ssrcs[1:]
+                    if sid_of[s] // rows_per != home)
+    old_sid = sid_of[off_home]
+
+    lc.promote_speaker(9, old_sid)
+    t = _settle(sup, lc, 4)                  # flips apply on commit
+    for _ in range(8):
+        sup.tick(now=t)
+        t += 0.02
+    sid_of = {s: k for k, s in bridge._ssrc_of.items()}
+    new_sid = sid_of[off_home]
+    assert new_sid != old_sid, "promotion must migrate the row home"
+    assert new_sid // rows_per == home
+    assert not bridge.loop.fanout_only[new_sid]
+    assert lc.speaker_promotions == 1
+    assert old_sid not in lc._listener_sids
+
+    lc.demote_speaker(9, new_sid)
+    for _ in range(8):
+        sup.tick(now=t)
+        t += 0.02
+    assert sid_of == {s: k for k, s in bridge._ssrc_of.items()}, \
+        "demotion must not move the row"
+    assert bridge.loop.fanout_only[new_sid]
+    assert lc.speaker_demotions == 1
+    assert new_sid in lc._listener_sids
+    flips = sorted((e for ring in
+                    lc.flight.dump_all()["streams"].values()
+                    for e in ring if e["kind"] == "speaker_flip"),
+                   key=lambda e: e["seq"])
+    assert [f["role"] for f in flips] == ["speaker", "listener"]
+    bridge.close()

@@ -577,8 +577,8 @@ def _perf_tree(tmp_path, baseline_keys, scenario_ids):
     """Fake repo: PERF_BASELINE.json + scripts/perf_gate.py + one
     indexed file whose path anchors the disk walk-up."""
     tmp_path.joinpath("PERF_BASELINE.json").write_text(json.dumps(
-        {"_meta": {"note": "x"}, **{k: {"value": 1.0}
-                                    for k in baseline_keys}}))
+        {"_meta": {"git": "0123abc"}, **{k: {"value": 1.0}
+                                         for k in baseline_keys}}))
     sdir = tmp_path / "scripts"
     sdir.mkdir()
     body = "\n".join(f'def _s{i}():\n    return 1.0'
@@ -638,6 +638,40 @@ def test_drift_perf_baseline_pure_helper_and_real_files_agree():
         real_keys = {k for k in json.load(fh) if not k.startswith("_")}
     assert real_ids, "SCENARIOS literal not found in perf_gate.py"
     assert check_perf_baseline(real_keys, real_ids) == []
+
+
+def test_drift_baseline_meta_git_must_be_a_hash(tmp_path):
+    """A baseline whose _meta.git is not a commit hash (the PR 11
+    failure class: `--write-baseline` once left a stale hand-edited
+    stamp) fires exactly one finding; a real hash is clean."""
+    from libjitsi_tpu.analysis.checkers.drift import check_baseline_meta
+
+    assert check_baseline_meta({"git": "0123abc"}) == []
+    assert check_baseline_meta({"git": "c041577" + "0" * 33}) == []
+    for bad in ({"git": "unknown"}, {"git": ""}, {}, None,
+                {"git": "v1.2.3"}, {"git": "0123ABC"}):
+        msgs = check_baseline_meta(bad)
+        assert len(msgs) == 1 and "_meta.git" in msgs[0]
+    # end to end through the walk-up: the fixture tree with a mangled
+    # stamp yields the finding on PERF_BASELINE.json
+    index = _perf_tree(tmp_path, baseline_keys={"loop_x"},
+                       scenario_ids={"loop_x"})
+    doc = json.loads(tmp_path.joinpath("PERF_BASELINE.json").read_text())
+    doc["_meta"]["git"] = "unknown"
+    tmp_path.joinpath("PERF_BASELINE.json").write_text(json.dumps(doc))
+    found = [f for f in check_metrics_drift(index)
+             if f.path == "PERF_BASELINE.json"]
+    assert len(found) == 1 and "_meta.git" in found[0].message
+
+
+def test_drift_real_baseline_meta_is_a_fresh_hash():
+    """The checked-in baseline's stamp must be a real hash — the
+    --write-baseline path stamps HEAD automatically now."""
+    from libjitsi_tpu.analysis.checkers.drift import check_baseline_meta
+
+    with open(os.path.join(REPO, "PERF_BASELINE.json")) as fh:
+        meta = json.load(fh).get("_meta", {})
+    assert check_baseline_meta(meta) == []
 
 
 # ------------------------------------------------- pragmas and baseline
@@ -1037,6 +1071,60 @@ def test_mesh_collective_placement_itself_never_sanctioned():
            ctx_of(src, "libjitsi_tpu/mesh/placement.py")}
     found = check_mesh_collectives(idx)
     assert rules_of(found) == ["mesh-collective"]
+
+
+_HIERARCHY_STUB = """
+SANCTIONED_COLLECTIVE_SITES = (
+    ("libjitsi_tpu/mesh/sharded.py", "sharded_mix_minus"),
+    ("libjitsi_tpu/mesh/hierarchy.py", "broadcast_bus_fanout"),
+)
+"""
+
+
+def _hierarchy_index(src):
+    rel = "libjitsi_tpu/mesh/hierarchy.py"
+    return {
+        "libjitsi_tpu/mesh/placement.py": ctx_of(
+            _HIERARCHY_STUB, "libjitsi_tpu/mesh/placement.py"),
+        rel: ctx_of(src, rel),
+    }
+
+
+def test_mesh_collective_second_psum_in_hierarchy_fires():
+    """TP, seeded from the PR 11 temptation: a helper in hierarchy.py
+    adding its OWN collective (say, gathering listener levels) breaks
+    the one-collective-per-tick contract even though the file already
+    hosts a sanctioned psum."""
+    src = """
+    import jax
+
+    def broadcast_bus_fanout(mesh, n_conf):
+        def _total(seg):
+            return jax.lax.psum(seg, "streams")
+        return _total
+
+    def listener_level_rollup(mesh):
+        def _roll(lvl):
+            return jax.lax.all_gather(lvl, "streams")
+        return _roll
+    """
+    found = check_mesh_collectives(_hierarchy_index(src))
+    assert rules_of(found) == ["mesh-collective"]
+    assert "all_gather" in found[0].message
+
+
+def test_mesh_collective_sanctioned_bus_fanout_clean():
+    """FP guard: the registered broadcast fan-out site keeps its one
+    psum (nested closure depth included)."""
+    src = """
+    import jax
+
+    def broadcast_bus_fanout(mesh, n_conf):
+        def _total(seg):
+            return jax.lax.psum(seg, "streams")
+        return _total
+    """
+    assert check_mesh_collectives(_hierarchy_index(src)) == []
 
 
 def test_mesh_collective_real_tree_clean():

@@ -468,3 +468,25 @@ def test_churn_soak_invariants():
     assert not failed, (failed, report)
     assert report["window_recompiles"] == 0
     assert report["window_admits"] > 0 and report["window_evicts"] > 0
+
+
+@pytest.mark.slow
+def test_broadcast_churn_soak_invariants():
+    """Small-config twin of `churn_soak.py --broadcast`: Poisson
+    listener churn plus periodic speaker flips on a broadcast
+    conference must hold zero data-path recompiles in the steady
+    window, refuse no listener, keep the fanout-only mask in lockstep
+    with the live listener set, and bound listener-join p99."""
+    spec = importlib.util.spec_from_file_location("churn_soak", _SOAK)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    report = mod.run_broadcast_soak(
+        duration_s=3.0, ramp_s=2.0, n_speakers=4, n_listeners=192,
+        mean_hold_s=2.0, n_shards=8, capacity=512,
+        flip_every_ticks=50, seed=0, verbose=False)
+    failed = {k: v for k, v in report.items()
+              if k.startswith("ok_") and not v}
+    assert not failed, (failed, report)
+    assert report["window_recompiles"] == 0
+    assert report["speaker_flips"] > 0
+    assert report["join_p99_s"] > 0.0

@@ -1,0 +1,229 @@
+"""Top-K active-speaker ranker properties (conference/speaker.py):
+K=1 degenerates bit-for-bit to the classic dominant-speaker
+trajectory, hysteresis keeps the member set from flapping under
+oscillating levels, ties resolve deterministically (lowest sid wins
+promotion, highest sid loses demotion), and membership churn is
+bounded to one swap per tick once the set is full."""
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.conference.speaker import (DominantSpeakerIdentification,
+                                             SILENCE_LEVEL)
+
+
+class _ClassicDSI:
+    """Verbatim inline copy of the pre-top-K dominant-speaker
+    algorithm — the oracle the K=1 degeneracy property compares
+    against (kept here on purpose: the shipping class must match THIS
+    trajectory, not whatever it evolves into)."""
+
+    def __init__(self, capacity, speech_threshold=0.12, margin=1.15):
+        self.capacity = capacity
+        self.speech_threshold = speech_threshold
+        self.margin = margin
+        self.immediate = np.zeros(capacity)
+        self.medium = np.zeros(capacity)
+        self.long = np.zeros(capacity)
+        self.active = np.zeros(capacity, dtype=bool)
+        self.dominant = -1
+
+    def add_participant(self, sid):
+        self.active[sid] = True
+        self.immediate[sid] = self.medium[sid] = self.long[sid] = 0.0
+
+    def remove_participant(self, sid):
+        self.active[sid] = False
+        if self.dominant == sid:
+            self.dominant = -1
+
+    def levels(self, levels):
+        lv = np.full(self.capacity, SILENCE_LEVEL, dtype=np.float64)
+        lv[: len(levels)] = np.asarray(levels, dtype=np.float64)
+        loud = np.clip((70.0 - lv) / 70.0, 0.0, 1.0)
+        loud[~self.active] = 0.0
+        speaking = loud > self.speech_threshold
+        self.immediate += (loud - self.immediate) / 3.0
+        self.medium += (speaking * self.immediate - self.medium) / 10.0
+        self.long += (self.medium - self.long) / 50.0
+        scores = np.where(self.active, self.long, -1.0)
+        best = int(np.argmax(scores))
+        if scores[best] <= 0:
+            return self.dominant
+        if self.dominant < 0 or not self.active[self.dominant]:
+            self.dominant = best
+            return self.dominant
+        cur = self.dominant
+        if best != cur and (
+                self.long[best] > self.margin * self.long[cur]
+                and self.medium[best] > self.margin * self.medium[cur]
+                and self.immediate[best] > self.immediate[cur]):
+            self.dominant = best
+        return self.dominant
+
+
+def _talk(dsi, frames, level_fn):
+    out = []
+    for t in range(frames):
+        out.append(dsi.levels(level_fn(t)))
+    return out
+
+
+def test_k_must_be_positive():
+    with pytest.raises(ValueError):
+        DominantSpeakerIdentification(capacity=4, k=0)
+
+
+def test_k1_degenerates_to_classic_dominant_trajectory():
+    """600 random ticks with joins/leaves: the k=1 ranker's dominant
+    must equal the classic algorithm's at every tick, and its member
+    set must be exactly {dominant}."""
+    rng = np.random.default_rng(7)
+    cap = 12
+    new = DominantSpeakerIdentification(capacity=cap, k=1)
+    old = _ClassicDSI(cap)
+    present = set()
+    for tick in range(600):
+        r = rng.random()
+        if r < 0.05 and len(present) < cap:
+            sid = int(rng.integers(cap))
+            if sid not in present:
+                present.add(sid)
+                new.add_participant(sid)
+                old.add_participant(sid)
+        elif r < 0.08 and present:
+            sid = int(rng.choice(sorted(present)))
+            present.discard(sid)
+            new.remove_participant(sid)
+            old.remove_participant(sid)
+        lv = rng.integers(0, 128, cap)
+        got = new.levels(lv)
+        want = old.levels(lv)
+        assert got == want, f"tick {tick}: new={got} old={want}"
+        if got >= 0:
+            assert new.speakers == (got,)
+        else:
+            assert new.speakers == ()
+
+
+def test_topk_fills_vacancies_and_holds_k_speakers():
+    dsi = DominantSpeakerIdentification(capacity=8, k=3)
+    for sid in range(5):
+        dsi.add_participant(sid)
+
+    def lv(_t):
+        # sids 0..2 loud, 3..4 quiet-ish, rest silent
+        out = np.full(8, SILENCE_LEVEL)
+        out[:3] = 10
+        out[3:5] = 50
+        return out
+
+    _talk(dsi, 100, lv)
+    assert dsi.speakers == (0, 1, 2)
+    assert dsi.dominant == 0          # lowest sid won the first fill
+
+
+def test_hysteresis_no_flap_under_oscillating_levels():
+    """Two participants alternating loud/soft every frame around a
+    steady third: once the k=2 set settles, oscillation that never
+    clears the margin must produce ZERO membership churn."""
+    dsi = DominantSpeakerIdentification(capacity=4, k=2)
+    for sid in range(3):
+        dsi.add_participant(sid)
+
+    def settle(_t):
+        out = np.full(4, SILENCE_LEVEL)
+        out[0] = 10
+        out[1] = 12
+        out[2] = 60                    # barely above threshold
+        return out
+
+    _talk(dsi, 120, settle)
+    assert dsi.speakers == (0, 1)
+    p0, d0 = dsi.promotions, dsi.demotions
+    notifications = []
+    dsi.on_speakers_change = notifications.append
+
+    def flap(t):
+        out = np.full(4, SILENCE_LEVEL)
+        # members oscillate; challenger 2 wobbles but stays well below
+        out[0] = 10 if t % 2 else 20
+        out[1] = 20 if t % 2 else 10
+        out[2] = 55 if t % 2 else 65
+        return out
+
+    _talk(dsi, 200, flap)
+    assert dsi.speakers == (0, 1)
+    assert (dsi.promotions, dsi.demotions) == (p0, d0)
+    assert notifications == []
+
+
+def test_sustained_takeover_does_swap_exactly_once():
+    """A challenger that goes loud FOR GOOD must displace the weakest
+    member — once, not repeatedly."""
+    dsi = DominantSpeakerIdentification(capacity=4, k=2)
+    for sid in range(3):
+        dsi.add_participant(sid)
+    _talk(dsi, 120, lambda t: np.array([10, 12, 80, SILENCE_LEVEL]))
+    assert dsi.speakers == (0, 1)
+    p0 = dsi.promotions
+    _talk(dsi, 300, lambda t: np.array([10, 90, 5, SILENCE_LEVEL]))
+    assert dsi.speakers == (0, 2)     # 2 displaced the now-quiet 1
+    assert dsi.promotions == p0 + 1
+
+
+def test_ties_promote_lowest_sid_and_demote_highest():
+    """Bit-identical levels everywhere: promotion ties go to the
+    LOWEST sid; when a demotion must pick among equally-weak members
+    the HIGHEST sid loses."""
+    dsi = DominantSpeakerIdentification(capacity=8, k=2)
+    for sid in (2, 3, 5):
+        dsi.add_participant(sid)
+    _talk(dsi, 80, lambda t: np.full(8, 30))
+    assert dsi.speakers == (2, 3)     # lowest sids won the fill
+    assert dsi.dominant == 2
+    # now 5 goes clearly loud while 2 and 3 stay tied: the swap must
+    # demote 3 (highest of the tied weak members), never 2
+    lv = np.full(8, 30)
+    lv[5] = 5
+    _talk(dsi, 300, lambda t: lv)
+    assert dsi.speakers == (2, 5)
+
+
+def test_member_leaving_frees_slot_and_notifies():
+    seen = []
+    dsi = DominantSpeakerIdentification(capacity=4, k=2,
+                                        on_speakers_change=seen.append)
+    for sid in range(3):
+        dsi.add_participant(sid)
+    _talk(dsi, 80, lambda t: np.array([10, 15, 40, SILENCE_LEVEL]))
+    assert dsi.speakers == (0, 1)
+    dsi.remove_participant(0)
+    assert dsi.speakers == (1,)
+    assert seen[-1] == (1,)
+    # vacancy refills from the remaining field on the next tick
+    _talk(dsi, 20, lambda t: np.array([SILENCE_LEVEL, 15, 40,
+                                       SILENCE_LEVEL]))
+    assert dsi.speakers == (1, 2)
+
+
+def test_at_most_one_swap_per_tick():
+    """Even when three challengers simultaneously dwarf the members,
+    membership changes by at most one swap per tick."""
+    dsi = DominantSpeakerIdentification(capacity=8, k=2)
+    for sid in range(6):
+        dsi.add_participant(sid)
+    _talk(dsi, 100, lambda t: np.array(
+        [20, 25, SILENCE_LEVEL, SILENCE_LEVEL,
+         SILENCE_LEVEL, SILENCE_LEVEL, SILENCE_LEVEL, SILENCE_LEVEL]))
+    assert dsi.speakers == (0, 1)
+    prev = set(dsi.speakers)
+    churn_per_tick = []
+    for t in range(300):
+        dsi.levels(np.array([70, 75, 5, 6, 7, SILENCE_LEVEL,
+                             SILENCE_LEVEL, SILENCE_LEVEL]))
+        cur = set(dsi.speakers)
+        churn_per_tick.append(len(cur ^ prev))
+        prev = cur
+    assert max(churn_per_tick) <= 2   # one swap = one out + one in
+    assert prev == {2, 3}             # strongest challengers landed
