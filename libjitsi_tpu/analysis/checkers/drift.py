@@ -59,7 +59,8 @@ COUNTER_NAME_RE = re.compile(
     r"(_count|_counts|_frames|_errors|_dropped|_drops|_sent|_served|"
     r"_miss|_misses|_recovered|_rejects|_rejected|_fail|_fails|"
     r"_abandoned|_suppressed|_late|_switches|_restarts|_evicted|"
-    r"_expired|_total)$|^(dropped|lost|forwarded|switches|recovered)")
+    r"_expired|_total|_syscalls|_reaps)$"
+    r"|^(dropped|lost|forwarded|switches|recovered)")
 
 ARRAY_CTORS = {"zeros", "full", "empty", "ones", "array", "tile",
                "arange", "copy"}
@@ -401,6 +402,19 @@ def check_baseline_meta(meta: dict) -> List[str]:
             "hash — the baseline cannot be traced to the revision it "
             "measured (re-run scripts/perf_gate.py --write-baseline "
             "from a checkout)"]
+    # `tree` records working-tree cleanliness at stamp time.  A stamp
+    # taken on a dirty tree points `git` at a commit that is NOT the
+    # code that produced the numbers (how PR 11's gate run left
+    # _meta.git one commit behind the baseline it wrote) —
+    # --write-baseline refuses dirty trees now, so any other value
+    # means the stamp predates the rule or was hand-edited.
+    tree = (meta or {}).get("tree")
+    if tree is not None and tree != "clean":
+        return [
+            f"PERF_BASELINE.json _meta.tree `{tree}` — the baseline "
+            "was stamped on a dirty working tree, so _meta.git does "
+            "not identify the measured code (commit first, then "
+            "re-run scripts/perf_gate.py --write-baseline)"]
     return []
 
 

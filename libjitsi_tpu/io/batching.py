@@ -39,6 +39,7 @@ class AdaptiveBatcher:
         self.loop = loop
         self.engine = loop.engine
         self.slo = slo
+        self._baselines = {}  # per-ring construction-time batch caps
         self.base_window_ms = loop.recv_window_ms
         base = int(getattr(self.engine, "max_batch", 0) or 0)
         self.base_batch = base
@@ -91,9 +92,23 @@ class AdaptiveBatcher:
             window = self.base_window_ms
             if batch != cur or self.loop.recv_window_ms != window:
                 self.recoveries += 1
-        self.engine.max_batch = batch
+        self._set_caps(batch)
         if window is not None and not self.window_clamped:
             self.loop.recv_window_ms = window
+
+    def _set_caps(self, batch: int) -> None:
+        """Write the retuned cap to EVERY drain ring, scaled to each
+        ring's own baseline (SO_REUSEPORT siblings may be sized
+        differently from the primary).  The per-ring window itself is
+        structural — sibling rings always poll (0 ms, io/loop.py), so
+        the cap is the knob that bounds their drain width."""
+        self.engine.max_batch = batch
+        for eng in getattr(self.loop, "rings", ())[1:]:
+            base = self._baselines.setdefault(
+                id(eng), int(getattr(eng, "max_batch", 0) or 0))
+            if base and self.base_batch:
+                scaled = max(1, (batch * base) // self.base_batch)
+                eng.max_batch = min(base, scaled)
 
     # ---------------------------------------------------- observability
     def register_metrics(self, registry, prefix: str = "batcher") -> None:

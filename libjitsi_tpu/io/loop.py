@@ -73,6 +73,11 @@ class MediaLoop:
                  flight: Optional[FlightRecorder] = None,
                  phase_sample_every: int = 16):
         self.engine = engine
+        # drain rings: the primary engine plus any SO_REUSEPORT
+        # siblings attached via `add_ring` — each tick drains all of
+        # them (primary blocks for the batching window, siblings poll)
+        # and runs every non-empty batch through the same ingest body
+        self.rings: List[UdpEngine] = [engine]
         self.registry = registry
         self.chain = chain
         # pipeline_depth: how many ticks' reverse-chain work may be in
@@ -192,6 +197,34 @@ class MediaLoop:
         self.ticks = 0
         self.rx_packets = 0
         self.tx_packets = 0
+        # syscall-count telemetry: batches that entered the kernel vs
+        # completions reaped ring-side, summed across drain rings
+        # (delta-accumulated each tick from the engines' own counters,
+        # so attaching/closing rings never skews the totals)
+        self.ingest_syscalls = 0
+        self.ingest_ring_reaps = 0
+        self._ingest_enters_seen = 0
+        self._ingest_reaps_seen = 0
+        self.metrics.register_scalar(
+            "loop_ingest_syscalls",
+            lambda: self.ingest_syscalls,
+            help_="ingest/egress batches that entered the kernel "
+                  "(recvmmsg/sendmmsg calls + io_uring_enter syscalls)",
+            kind="counter")
+        self.metrics.register_scalar(
+            "loop_ingest_ring_reaps",
+            lambda: self.ingest_ring_reaps,
+            help_="io_uring completions reaped ring-side without "
+                  "entering the kernel", kind="counter")
+        self.metrics.register_scalar(
+            "loop_engine_io_uring",
+            lambda: 1.0 if self.engine_mode == "io_uring" else 0.0,
+            help_="1 when the primary drain ring runs the io_uring "
+                  "engine, 0 for recvmmsg — perf numbers must never be "
+                  "compared across modes silently")
+        self.metrics.register_scalar(
+            "loop_ingest_rings", lambda: float(len(self.rings)),
+            help_="attached SO_REUSEPORT drain rings")
         # age (in ticks) of the oldest un-flushed async dispatch; >1
         # means protected bytes sat across a full tick — pipeline depth
         self.dispatch_inflight_ticks = 0
@@ -201,6 +234,34 @@ class MediaLoop:
             metrics=self.metrics, sample_every=phase_sample_every,
             tracer=self.tracer,
             inflight_fn=lambda: self.dispatch_inflight_ticks)
+
+    # ------------------------------------------------------ drain rings
+    @property
+    def engine_mode(self) -> str:
+        """Primary drain ring's engine mode ("io_uring"/"recvmmsg")."""
+        return getattr(self.engine, "engine_mode", "recvmmsg")
+
+    def add_ring(self, engine: UdpEngine) -> None:
+        """Attach an extra drain ring: an SO_REUSEPORT sibling engine
+        on the same port, kernel-sharded by flow hash.  Each tick the
+        primary ring blocks for the batching window, then siblings
+        drain non-blocking (their packets arrived during that wait).
+        When placement makes rings shard-aligned, each ring's batch is
+        already shard-major and the `enable_shard_major` sort becomes a
+        no-op (its sortedness check sees monotone shard ids)."""
+        self.rings.append(engine)
+
+    def _sync_ingest_counters(self) -> None:
+        """Fold the rings' enter/reap counters into the loop's per-tick
+        telemetry (delta-accumulation: ring attach/close can't skew)."""
+        enters = reaps = 0
+        for eng in self.rings:
+            enters += int(getattr(eng, "syscall_enters", 0))
+            reaps += int(getattr(eng, "ring_reaps", 0))
+        self.ingest_syscalls += enters - self._ingest_enters_seen
+        self.ingest_ring_reaps += reaps - self._ingest_reaps_seen
+        self._ingest_enters_seen = enters
+        self._ingest_reaps_seen = reaps
 
     # ---------------------------------------------------- dispatch order
     def enable_shard_major(self, rows_per_shard: int) -> None:
@@ -268,7 +329,21 @@ class MediaLoop:
         try:
             return self._tick_inner()
         finally:
+            self._sync_ingest_counters()
             self.perf.end_tick()
+
+    def _recv_ring(self, eng, window_ms, use_view):
+        """One ring's batching window -> (batch, sip, sport, ats, token)."""
+        if self.use_kernel_ts:
+            recv = (eng.recv_batch_ts_view if use_view
+                    else eng.recv_batch_ts)
+            batch, sip, sport, ats = recv(window_ms)
+        else:
+            recv = (eng.recv_batch_view if use_view
+                    else eng.recv_batch)
+            batch, sip, sport = recv(window_ms)
+            ats = None
+        return batch, sip, sport, ats, getattr(batch, "arena_token", None)
 
     def _tick_inner(self) -> int:
         # re-established below only when this tick carries RTP rows; a
@@ -280,28 +355,23 @@ class MediaLoop:
         # deep pipeline: ingress lands in a zero-copy arena view, pinned
         # until the tick's reverse pending materializes; classic depth-1
         # keeps copy semantics (sinks may hold the batch indefinitely)
-        use_view = deep and hasattr(self.engine, "recv_batch_view")
+        use_view = deep and all(hasattr(e, "recv_batch_view")
+                                for e in self.rings)
+        ring_batches = []
         with self.tracer.span("ingress"):
             with self.perf.phase("idle"):    # socket wait dominates here
-                if self.use_kernel_ts:
-                    recv = (self.engine.recv_batch_ts_view if use_view
-                            else self.engine.recv_batch_ts)
-                    batch, sip, sport, ats = recv(self.recv_window_ms)
-                else:
-                    recv = (self.engine.recv_batch_view if use_view
-                            else self.engine.recv_batch)
-                    batch, sip, sport = recv(self.recv_window_ms)
-                    ats = None
-        token = getattr(batch, "arena_token", None)
+                for k, eng in enumerate(self.rings):
+                    # primary ring pays the batching window; sibling
+                    # rings poll — their packets arrived during the wait
+                    ring_batches.append((eng, self._recv_ring(
+                        eng, self.recv_window_ms if k == 0 else 0,
+                        use_view)))
         # arrival stamp: the batching window just closed — everything
         # this tick sends is measured against this instant (per-batch
         # journey; rows within one batch share the stamp)
         self.trace_id += 1
         self._trace_t0 = time.perf_counter()
-        n = batch.batch_size
-        if n:
-            self.pkt_size_hist.observe_array(
-                np.asarray(batch.length)[:n])
+        n = sum(rb[1][0].batch_size for rb in ring_batches)
         self.ticks += 1
         self._note_inflight_age()
         # the recv window just elapsed: anything dispatched on EARLIER
@@ -320,6 +390,20 @@ class MediaLoop:
                 self.drain()
             return 0
         self.rx_packets += n
+        for eng, (batch, sip, sport, ats, token) in ring_batches:
+            if batch.batch_size:
+                self._ingest_batch(eng, batch, sip, sport, ats, token,
+                                   deep)
+        return n
+
+    def _ingest_batch(self, eng, batch, sip, sport, ats, token,
+                      deep) -> None:
+        """Run ONE ring's non-empty batch through the tick body: DTLS
+        split, rtcp-mux demux, holds/fanout/shed masks, shard-major
+        reorder, reverse-chain dispatch.  Shared by every drain ring;
+        DTLS replies and arena pins stay with the ring they came in on."""
+        n = batch.batch_size
+        self.pkt_size_hist.observe_array(np.asarray(batch.length)[:n])
         if self.pcap is not None:
             self.pcap.write_batch(batch)
 
@@ -336,12 +420,11 @@ class MediaLoop:
                     for rep in replies or ():
                         out = PacketBatch.from_payloads([rep],
                                                         batch.capacity)
-                        self.engine.send_batch(out, int(sip[i]),
-                                               int(sport[i]))
+                        eng.send_batch(out, int(sip[i]), int(sport[i]))
             media_rows = np.nonzero(~is_dtls_row)[0]
             if len(media_rows) == 0:
-                self._release_token(token)
-                return n
+                self._release_token(token, eng)
+                return
             sub = PacketBatch(batch.data[media_rows],  # jitlint: disable=hotpath-alloc
                               np.asarray(batch.length)[media_rows],
                               batch.stream[media_rows])
@@ -464,7 +547,7 @@ class MediaLoop:
                     self._rx_inflight.append({
                         "pend": pend, "tick": self.ticks,
                         "origin": self.journey_origin(),
-                        "ats": ats_sel, "token": token,
+                        "ats": ats_sel, "token": token, "eng": eng,
                         "n": rtp.batch_size})
                     token = None          # ownership moved to the entry
                 else:
@@ -504,8 +587,7 @@ class MediaLoop:
                 else:
                     okc = np.ones(rb.batch_size, bool)
                 self.on_rtcp(rb, okc)
-        self._release_token(token)
-        return n
+        self._release_token(token, eng)
 
     # --------------------------------------------------- deep pipeline
     def _note_inflight_age(self) -> None:
@@ -518,9 +600,9 @@ class MediaLoop:
             max((self.ticks - e["tick"] for e in self._rx_inflight),
                 default=0))
 
-    def _release_token(self, token) -> None:
+    def _release_token(self, token, eng=None) -> None:
         if token is not None:
-            self.engine.release_arena(token)
+            (eng if eng is not None else self.engine).release_arena(token)
 
     def _warn_unknown_ssrc(self, count: int) -> None:
         """Interval-suppressed unknown-SSRC warning: at most one log
@@ -569,7 +651,7 @@ class MediaLoop:
         self.perf.note_d2h(rtp.data.nbytes)
         # the original arena bytes were last read inside result() (the
         # failed-row passthrough) — safe to recycle from here on
-        self._release_token(e["token"])
+        self._release_token(e["token"], e.get("eng"))
         if not ok.all():
             _log.warn("reverse_chain_drop", count=int((~ok).sum()),
                       tick=self.ticks)

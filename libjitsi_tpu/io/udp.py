@@ -66,8 +66,90 @@ def _load():
             ctypes.c_int, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_int]
+    if hasattr(lib, "udp_uring_supported"):  # pre-gen-2 builds lack it
+        lib.udp_uring_supported.restype = ctypes.c_int
+        lib.udp_uring_create.restype = ctypes.c_void_p
+        lib.udp_uring_create.argtypes = [ctypes.c_int] * 4
+        lib.udp_uring_arm.restype = ctypes.c_int
+        lib.udp_uring_arm.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p]
+        lib.udp_uring_recv.restype = ctypes.c_int
+        lib.udp_uring_recv.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_void_p]
+        lib.udp_uring_send_idx.restype = ctypes.c_int
+        lib.udp_uring_send_idx.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int]
+        lib.udp_uring_stat.restype = ctypes.c_long
+        lib.udp_uring_stat.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.udp_uring_destroy.restype = None
+        lib.udp_uring_destroy.argtypes = [ctypes.c_void_p]
     _lib = lib
     return lib
+
+
+#: C-side sentinel: every row of the armed arena has been delivered
+_URING_ARENA_EXHAUSTED = -9999
+
+
+def _uring_env_disabled() -> bool:
+    """io_uring force-disabled by environment — the fallback-proof
+    switch (LIBJITSI_TPU_NO_IOURING=1) and the explicit mode pin
+    (LIBJITSI_TPU_ENGINE_MODE=recvmmsg) both count."""
+    if os.environ.get("LIBJITSI_TPU_NO_IOURING", ""):
+        return True
+    mode = os.environ.get("LIBJITSI_TPU_ENGINE_MODE", "").strip().lower()
+    return mode == "recvmmsg"
+
+
+def uring_available() -> bool:
+    """Capability probe: the loaded .so carries the ring engine, the
+    kernel accepts io_uring_setup, and the environment does not force
+    it off.  Cached C-side; cheap to call repeatedly."""
+    if _uring_env_disabled():
+        return False
+    lib = _load()
+    return bool(hasattr(lib, "udp_uring_supported")
+                and lib.udp_uring_supported())
+
+
+def probe_engine_mode() -> str:
+    """The engine mode a fresh ``UdpEngine(engine_mode="auto")`` picks
+    right now.  "auto" resolves to the environment pin
+    (LIBJITSI_TPU_ENGINE_MODE) when set and available, else to the
+    measured default for this box class: **recvmmsg**.  The ring
+    engine is fully built and probe-selectable, but on loopback — the
+    only fabric this box can measure — a sender pays the armed chain's
+    per-packet completion work inline inside its own send syscall, and
+    the 3-run loop-echo median loses ~30% to recvmmsg (the zero-syscall
+    win is real only where softirq context fills the chain, i.e. NIC
+    ingest).  Flipping the default needs a NIC-box median, not vibes.
+    Exported so gates and tooling label measurements with the mode
+    they actually ran."""
+    mode = os.environ.get("LIBJITSI_TPU_ENGINE_MODE", "").strip().lower()
+    if mode == "io_uring" and uring_available():
+        return "io_uring"
+    return "recvmmsg"
+
+
+class _ArenaToken:
+    """Pin receipt handed out with every zero-copy view.  Idempotent:
+    `release_arena` flips `released` on first use, so a double release
+    can never steal a pin that another live view still holds (the old
+    (arena, gen) tuple only caught doubles AFTER the arena re-armed)."""
+
+    __slots__ = ("arena", "gen", "released")
+
+    def __init__(self, arena: "_Arena", gen: int):
+        self.arena = arena
+        self.gen = gen
+        self.released = False
+
+    def __iter__(self):  # legacy (arena, gen) unpacking
+        return iter((self.arena, self.gen))
 
 
 class _Arena:
@@ -107,7 +189,19 @@ class UdpEngine:
     def __init__(self, port: int = 0, bind_ip: str = "0.0.0.0",
                  reuseport: bool = False, capacity: int = DEFAULT_CAPACITY,
                  max_batch: int = 1024, rcvbuf: int = 4 << 20,
-                 kernel_timestamps: bool = False, arenas: int = 4):
+                 kernel_timestamps: bool = False, arenas: int = 4,
+                 engine_mode: str = "auto"):
+        if engine_mode not in ("auto", "io_uring", "recvmmsg"):
+            raise ValueError(f"engine_mode: {engine_mode!r}")
+        # egress stays on sendmmsg even in ring mode unless opted in:
+        # measured on this class of box, one sendmmsg beats N SENDMSG
+        # SQEs (~127 vs ~226 us per 64-pkt burst — the kernel's
+        # per-SQE sendmsg path repays per-op async bookkeeping the
+        # batch syscall never touches), while ring INGEST holds even on
+        # loopback and sheds the per-window syscall entirely on real
+        # NICs where softirq context fills the armed chain
+        self.uring_egress = bool(
+            os.environ.get("LIBJITSI_TPU_URING_EGRESS", ""))
         lib = _load()
         self.capacity = capacity
         #: live batching knob — recv windows honor the CURRENT value
@@ -142,6 +236,66 @@ class UdpEngine:
         #: pipeline holding views longer than the ring depth
         self.arena_grows = 0
         self._alias_arena(self._ring[0])
+        #: kernel entries made from Python (one per recvmmsg/sendmmsg
+        #: native call); the io_uring engine's own enter count adds in
+        #: via the `syscall_enters` property
+        self._py_enters = 0
+        self._u = None  # io_uring engine handle (None => recvmmsg)
+        self._uring_arena: Optional[_Arena] = None
+        # mode resolution: "auto" follows the probe (env pin or the
+        # measured recvmmsg default — see probe_engine_mode); an
+        # explicit "io_uring" request takes the ring whenever the
+        # capability probe passes, and degrades loudly when it can't
+        want_uring = (engine_mode == "io_uring"
+                      or (engine_mode == "auto"
+                          and probe_engine_mode() == "io_uring"))
+        self.engine_mode = "recvmmsg"
+        if want_uring and uring_available():
+            # ring sized to one arena: arming an arena is one chain of
+            # `rows` linked recvs, so steady state reaps ring-side
+            self._u = lib.udp_uring_create(
+                fd, self._rows, 0, int(self.kernel_timestamps))
+            if self._u:
+                self.engine_mode = "io_uring"
+                self._uring_arm(self._ring[0])
+        if engine_mode == "io_uring" and self.engine_mode != "io_uring":
+            from libjitsi_tpu.utils.logging import get_logger
+
+            # explicit request degraded: must not be silent (mirrors
+            # the kernel_timestamps contract above)
+            get_logger("io.udp").warn(
+                "io_uring_unavailable_fallback", port=self.port)
+
+    def _uring_arm(self, a: _Arena) -> None:
+        """Hand a whole (unpinned) arena to the kernel as ONE linked
+        chain of recvs.  The gen bump invalidates any stale token from
+        the arena's previous occupancy — same contract as the recvmmsg
+        path's per-window bump, at arena granularity."""
+        a.gen += 1
+        rc = _load().udp_uring_arm(
+            self._u, a.buf.ctypes.data, self._rows, self.capacity,
+            a.len.ctypes.data, a.sip.ctypes.data, a.sport.ctypes.data,
+            a.ats.ctypes.data)
+        if rc < 0:
+            raise OSError(-rc, os.strerror(-rc))
+        self._uring_arena = a
+        self._alias_arena(a)
+
+    @property
+    def syscall_enters(self) -> int:
+        """Batches that entered the kernel: native recvmmsg/sendmmsg
+        calls plus actual io_uring_enter syscalls (ring-side reaps and
+        in-kernel chain cascades cost zero)."""
+        if self._u is not None:
+            return self._py_enters + int(_load().udp_uring_stat(self._u, 0))
+        return self._py_enters
+
+    @property
+    def ring_reaps(self) -> int:
+        """Completions reaped ring-side without entering the kernel."""
+        if self._u is not None:
+            return int(_load().udp_uring_stat(self._u, 1))
+        return 0
 
     def _alias_arena(self, a: _Arena) -> None:
         # legacy aliases: the most recently used arena's raw arrays
@@ -165,10 +319,17 @@ class UdpEngine:
 
     def release_arena(self, token) -> None:
         """Drop the pin a `recv_batch_view` placed; `token` is the
-        batch's `arena_token`.  Safe to call twice (generation-checked)."""
+        batch's `arena_token`.  Idempotent — a second release of the
+        same token is a no-op, it can never steal another view's pin."""
         if token is None:
             return
-        a, gen = token
+        if isinstance(token, _ArenaToken):
+            if token.released:
+                return
+            token.released = True
+            a, gen = token.arena, token.gen
+        else:  # legacy (arena, gen) tuple: generation-checked only
+            a, gen = token
         if a.gen == gen and a.pins > 0:
             a.pins -= 1
 
@@ -190,14 +351,33 @@ class UdpEngine:
                         sleep=_time.sleep if sleep is None else sleep)
 
     def _recv_arena(self, timeout_ms: int, want_ts: bool):
-        """Receive one batching window into a fresh (unpinned) arena.
-        Returns (arena, n); the arena's gen is already bumped so any
-        stale token from its previous occupancy is invalidated."""
+        """Receive one batching window.  Returns (arena, lo, n): the
+        window's packets live in arena rows [lo, lo+n).  recvmmsg mode
+        scatters into a fresh (unpinned) arena at lo=0; io_uring mode
+        delivers the next completed prefix of the armed arena, so lo
+        advances across windows until the arena is exhausted.  Either
+        way the arena's gen was bumped when its occupancy began, so any
+        stale token from a previous occupancy is invalidated."""
+        lib = _load()
+        limit = max(1, min(int(self.max_batch), self._rows))
+        if self._u is not None:
+            start = ctypes.c_int32(0)
+            n = lib.udp_uring_recv(self._u, limit, timeout_ms,
+                                   ctypes.byref(start))
+            if n == _URING_ARENA_EXHAUSTED:
+                # every row delivered => the kernel holds no reference;
+                # re-arm through the ring (grow-never-reuse: a pinned
+                # arena is skipped, the ring grows if all are pinned)
+                self._uring_arm(self._next_arena())
+                n = lib.udp_uring_recv(self._u, limit, timeout_ms,
+                                       ctypes.byref(start))
+            if n < 0:
+                raise OSError(-n, os.strerror(-n))
+            return self._uring_arena, int(start.value), n
         a = self._next_arena()
         a.gen += 1
         self._alias_arena(a)
-        lib = _load()
-        limit = max(1, min(int(self.max_batch), self._rows))
+        self._py_enters += 1
         if want_ts:
             n = lib.udp_recv_batch_ts(
                 self._fd, a.buf.ctypes.data, self.capacity, limit,
@@ -210,7 +390,7 @@ class UdpEngine:
                 a.sport.ctypes.data, timeout_ms)
         if n < 0:
             raise OSError(-n, os.strerror(-n))
-        return a, n
+        return a, 0, n
 
     def recv_batch(self, timeout_ms: int = 1
                    ) -> Tuple[PacketBatch, np.ndarray, np.ndarray]:
@@ -222,12 +402,13 @@ class UdpEngine:
         semantics: callers may hold the batch indefinitely.  Hot paths
         use `recv_batch_view` instead.
         """
-        a, n = self._recv_arena(timeout_ms, want_ts=False)
-        batch = PacketBatch(a.buf[:n].copy(),  # jitlint: disable=hotpath-alloc
-                            a.len[:n].copy(),
+        a, lo, n = self._recv_arena(timeout_ms, want_ts=False)
+        hi = lo + n
+        batch = PacketBatch(a.buf[lo:hi].copy(),  # jitlint: disable=hotpath-alloc
+                            a.len[lo:hi].copy(),
                             np.full(n, -1, dtype=np.int32))
         # jitlint: disable=hotpath-alloc — copy-semantics API by contract
-        return batch, a.sip[:n].copy(), a.sport[:n].copy()
+        return batch, a.sip[lo:hi].copy(), a.sport[lo:hi].copy()
 
     def recv_batch_view(self, timeout_ms: int = 1
                         ) -> Tuple[PacketBatch, np.ndarray, np.ndarray]:
@@ -236,13 +417,14 @@ class UdpEngine:
         The arena stays pinned (never re-handed to the kernel) until
         the caller passes that token to `release_arena` — exactly once
         per returned batch."""
-        a, n = self._recv_arena(timeout_ms, want_ts=False)
-        batch = PacketBatch(a.buf[:n], a.len[:n],
+        a, lo, n = self._recv_arena(timeout_ms, want_ts=False)
+        hi = lo + n
+        batch = PacketBatch(a.buf[lo:hi], a.len[lo:hi],
                             np.full(n, -1, dtype=np.int32))
         if n > 0:
             a.pins += 1
-            batch.arena_token = (a, a.gen)
-        return batch, a.sip[:n], a.sport[:n]
+            batch.arena_token = _ArenaToken(a, a.gen)
+        return batch, a.sip[lo:hi], a.sport[lo:hi]
 
     def recv_batch_ts(self, timeout_ms: int = 1
                       ) -> Tuple[PacketBatch, np.ndarray, np.ndarray,
@@ -252,26 +434,28 @@ class UdpEngine:
         enabled, else a per-batch syscall-time fallback).  Feed these to
         the GCC inter-arrival filters — userspace arrival times carry
         scheduler jitter the kernel stamp does not."""
-        a, n = self._recv_arena(timeout_ms, want_ts=True)
-        batch = PacketBatch(a.buf[:n].copy(),  # jitlint: disable=hotpath-alloc
-                            a.len[:n].copy(),
+        a, lo, n = self._recv_arena(timeout_ms, want_ts=True)
+        hi = lo + n
+        batch = PacketBatch(a.buf[lo:hi].copy(),  # jitlint: disable=hotpath-alloc
+                            a.len[lo:hi].copy(),
                             np.full(n, -1, dtype=np.int32))
         # jitlint: disable=hotpath-alloc — copy-semantics API by contract
-        return (batch, a.sip[:n].copy(), a.sport[:n].copy(),
-                a.ats[:n].copy())  # jitlint: disable=hotpath-alloc
+        return (batch, a.sip[lo:hi].copy(), a.sport[lo:hi].copy(),
+                a.ats[lo:hi].copy())  # jitlint: disable=hotpath-alloc
 
     def recv_batch_ts_view(self, timeout_ms: int = 1
                            ) -> Tuple[PacketBatch, np.ndarray, np.ndarray,
                                       np.ndarray]:
         """Zero-copy `recv_batch_ts` (see `recv_batch_view` for the
         arena-pinning contract)."""
-        a, n = self._recv_arena(timeout_ms, want_ts=True)
-        batch = PacketBatch(a.buf[:n], a.len[:n],
+        a, lo, n = self._recv_arena(timeout_ms, want_ts=True)
+        hi = lo + n
+        batch = PacketBatch(a.buf[lo:hi], a.len[lo:hi],
                             np.full(n, -1, dtype=np.int32))
         if n > 0:
             a.pins += 1
-            batch.arena_token = (a, a.gen)
-        return batch, a.sip[:n], a.sport[:n], a.ats[:n]
+            batch.arena_token = _ArenaToken(a, a.gen)
+        return batch, a.sip[lo:hi], a.sport[lo:hi], a.ats[lo:hi]
 
     @staticmethod
     def _c_u8(arr: np.ndarray) -> np.ndarray:
@@ -297,9 +481,17 @@ class UdpEngine:
             batch.length, dtype=np.int32)
         ips = np.ascontiguousarray(ips)  # jitlint: disable=hotpath-alloc
         ports = np.ascontiguousarray(ports)  # jitlint: disable=hotpath-alloc
-        sent = _load().udp_send_batch(
-            self._fd, data.ctypes.data, data.shape[1], lens.ctypes.data,
-            ips.ctypes.data, ports.ctypes.data, n)
+        if self._u is not None and self.uring_egress:
+            # NULL idx = identity: all rows, gather egress via the ring
+            sent = _load().udp_uring_send_idx(
+                self._u, data.ctypes.data, data.shape[1],
+                lens.ctypes.data, ips.ctypes.data, ports.ctypes.data,
+                None, n)
+        else:
+            self._py_enters += 1
+            sent = _load().udp_send_batch(
+                self._fd, data.ctypes.data, data.shape[1],
+                lens.ctypes.data, ips.ctypes.data, ports.ctypes.data, n)
         if sent < 0:
             raise OSError(-sent, os.strerror(-sent))
         return sent
@@ -336,14 +528,28 @@ class UdpEngine:
         ports = np.ascontiguousarray(np.broadcast_to(  # jitlint: disable=hotpath-alloc
             np.asarray(dst_port, dtype=np.uint16), (n,)))
         idx = np.ascontiguousarray(rows)  # jitlint: disable=hotpath-alloc
-        sent = lib.udp_send_batch_idx(
-            self._fd, data.ctypes.data, data.shape[1], lens.ctypes.data,
-            ips.ctypes.data, ports.ctypes.data, idx.ctypes.data, n)
+        if self._u is not None and self.uring_egress:
+            sent = lib.udp_uring_send_idx(
+                self._u, data.ctypes.data, data.shape[1],
+                lens.ctypes.data, ips.ctypes.data, ports.ctypes.data,
+                idx.ctypes.data, n)
+        else:
+            self._py_enters += 1
+            sent = lib.udp_send_batch_idx(
+                self._fd, data.ctypes.data, data.shape[1],
+                lens.ctypes.data, ips.ctypes.data, ports.ctypes.data,
+                idx.ctypes.data, n)
         if sent < 0:
             raise OSError(-sent, os.strerror(-sent))
         return sent
 
     def close(self) -> None:
+        if self._u is not None:
+            # cancels any armed recvs and drains before the arenas can
+            # be collected — MUST precede closing the socket fd
+            _load().udp_uring_destroy(self._u)
+            self._u = None
+            self._uring_arena = None
         if self._fd >= 0:
             _load().udp_close(self._fd)
             self._fd = -1

@@ -18,6 +18,17 @@
 set -e
 cd "$(dirname "$0")"
 
+# io_uring detection: prefer the kernel UAPI header (liburing is NOT
+# required — the engine speaks raw io_uring_setup/enter syscalls).
+# Without the header, the same .so still builds with every udp_uring_*
+# entry point stubbed to ENOSYS; the Python probe then keeps the
+# recvmmsg engine with a bit-identical accept set.
+URING_FLAGS=""
+if [ -e /usr/include/linux/io_uring.h ] || \
+   [ -e /usr/include/liburing.h ]; then
+  URING_FLAGS="-DHAVE_IO_URING"
+fi
+
 # C++ OpenSSL differential oracle (no dev headers in the image: the
 # .cpp declares the stable EVP ABI; link the versioned lib directly)
 build_oracle() {
@@ -27,18 +38,19 @@ build_oracle() {
 
 case "${1:-}" in
   tsan)
-    g++ -O1 -g -Wall -fsanitize=thread -shared -fPIC \
+    g++ -O1 -g -Wall $URING_FLAGS -fsanitize=thread -shared -fPIC \
         -o libudp_engine_tsan.so udp_engine.cpp
     echo "built $(pwd)/libudp_engine_tsan.so" ;;
   asan)
-    g++ -O1 -g -Wall -fsanitize=address -shared -fPIC \
+    g++ -O1 -g -Wall $URING_FLAGS -fsanitize=address -shared -fPIC \
         -o libudp_engine_asan.so udp_engine.cpp
     echo "built $(pwd)/libudp_engine_asan.so" ;;
   oracle)
     build_oracle
     echo "built $(pwd)/libcrypto_oracle.so" ;;
   *)
-    g++ -O2 -Wall -shared -fPIC -o libudp_engine.so udp_engine.cpp
+    g++ -O2 -Wall $URING_FLAGS -shared -fPIC \
+        -o libudp_engine.so udp_engine.cpp
     # oracle is best-effort here: a box without libcrypto.so.3 still
     # gets the UDP engine (tests needing the oracle build it
     # explicitly via `build.sh oracle` and fail loudly there)

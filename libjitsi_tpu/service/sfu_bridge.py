@@ -155,10 +155,14 @@ class SfuBridge:
                  pipelined: bool = False,
                  pipeline_depth: int = 1,
                  mesh=None,
-                 recovery_config: Optional[RecoveryConfig] = None):
+                 recovery_config: Optional[RecoveryConfig] = None,
+                 engine_mode: str = "auto",
+                 ingest_rings: int = 1):
         self.capacity = capacity
         self.profile = profile
         self.ast_ext_id = abs_send_time_ext_id
+        self.engine_mode = engine_mode
+        self.ingest_rings = max(1, int(ingest_rings))
         self.pipelined = pipelined or pipeline_depth > 1
         self._pending_fanout: list = []
         self._media_ran = False
@@ -202,7 +206,9 @@ class SfuBridge:
         self.flight = None
         self.loop = MediaLoop(
             UdpEngine(port=port, max_batch=4 * capacity,
-                      kernel_timestamps=kernel_timestamps),
+                      kernel_timestamps=kernel_timestamps,
+                      engine_mode=engine_mode,
+                      reuseport=self.ingest_rings > 1),
             self.registry, on_media=self._on_media,
             on_rtcp=self._on_rtcp,
             on_dtls=lambda d, a: self._dtls.on_dtls(d, a), chain=None,
@@ -212,6 +218,15 @@ class SfuBridge:
             # turns on pipelined replies/fan-out (loop.pipelined)
             pipeline_depth=pipeline_depth)
         self.port = self.loop.engine.port
+        # SO_REUSEPORT multi-queue: sibling drain rings on the SAME
+        # port, kernel-sharded by flow hash; each tick drains every
+        # ring (io/loop.py) and the AdaptiveBatcher governs their caps
+        for _ in range(self.ingest_rings - 1):
+            self.loop.add_ring(UdpEngine(
+                port=self.port, reuseport=True,
+                max_batch=4 * capacity,
+                kernel_timestamps=kernel_timestamps,
+                engine_mode=engine_mode))
         self._ssrc_of: Dict[int, int] = {}     # sid -> sender ssrc
         # rows keyed by stage_endpoints but not yet committed: demuxed
         # media queues on the hold mask, and the route mesh excludes
@@ -1140,6 +1155,10 @@ class SfuBridge:
             "profile": self.profile.name,
             "sharded": self._mesh is not None,
             "ast_ext_id": self.ast_ext_id,
+            # recover must not silently flip I/O engines: a restart in
+            # the middle of an A/B perf run would contaminate the run
+            "engine_mode": self.engine_mode,
+            "ingest_rings": self.ingest_rings,
             "rx_table": self.rx_table.snapshot(),
             "tx_table": self.tx_table.snapshot(),
             "bwe": self.bwe.snapshot(),
@@ -1169,6 +1188,8 @@ class SfuBridge:
         """
         from libjitsi_tpu.transform.srtp import SrtpStreamTable as _T
 
+        kwargs.setdefault("engine_mode", snap.get("engine_mode", "auto"))
+        kwargs.setdefault("ingest_rings", snap.get("ingest_rings", 1))
         bridge = cls(config, port=port, capacity=snap["capacity"],
                      profile=SrtpProfile[snap["profile"]],
                      abs_send_time_ext_id=snap["ast_ext_id"], **kwargs)
@@ -1229,4 +1250,5 @@ class SfuBridge:
     def close(self) -> None:
         if self._pending_fanout:
             self._flush_fanout()     # the last tick's media still ships
-        self.loop.engine.close()
+        for eng in self.loop.rings:
+            eng.close()

@@ -690,11 +690,21 @@ class BridgeSupervisor:
 
     def phase_attribution(self) -> dict:
         """Host/device attribution summary for /debug/slo: the phase
-        split the escalation ladder is currently judging by."""
+        split the escalation ladder is currently judging by, labeled
+        with the ingest engine mode and its syscall telemetry — a phase
+        share is only comparable against runs of the SAME engine."""
         phase, phase_s, share, bound = self._phase_attr()
-        return {"bound": bound, "phase": phase,
-                "phase_share": round(share, 4),
-                "phases": dict(self.last_phases)}
+        out = {"bound": bound, "phase": phase,
+               "phase_share": round(share, 4),
+               "phases": dict(self.last_phases)}
+        loop = getattr(self.bridge, "loop", None)
+        if loop is not None:
+            out["engine_mode"] = getattr(loop, "engine_mode", "recvmmsg")
+            out["ingest_syscalls"] = int(
+                getattr(loop, "ingest_syscalls", 0))
+            out["ingest_ring_reaps"] = int(
+                getattr(loop, "ingest_ring_reaps", 0))
+        return out
 
     def health(self) -> dict:
         """Liveness summary for probes / logs."""

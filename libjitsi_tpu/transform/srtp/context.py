@@ -62,15 +62,45 @@ def _protect_rtp_dev(tab_rk, tab_mid, stream, data, length, payload_off, iv,
         f8_round_keys=None if tab_f8 is None else tab_f8[stream])
 
 
-@functools.partial(
-    jax.jit, static_argnames=("tag_len", "encrypt", "off_const"))
-def _unprotect_rtp_dev(tab_rk, tab_mid, stream, data, length, payload_off, iv,
-                       roc, tag_len: int, encrypt: bool, off_const=None,
-                       tab_f8=None):
+def _unprotect_rtp_impl(tab_rk, tab_mid, stream, data, length, payload_off,
+                        iv, roc, tag_len: int, encrypt: bool, off_const=None,
+                        tab_f8=None):
     return kernel.srtp_unprotect(
         data, length, payload_off, tab_rk[stream], iv, tab_mid[stream], roc,
         tag_len, encrypt, payload_off_const=off_const,
         f8_round_keys=None if tab_f8 is None else tab_f8[stream])
+
+
+_unprotect_rtp_dev = jax.jit(
+    _unprotect_rtp_impl, static_argnames=("tag_len", "encrypt", "off_const"))
+
+# donated twin for the ingest seam: the H2D staging buffer minted from
+# the recv arena (`jnp.asarray(batch.data)`) is consumed exactly once,
+# so donating it lets XLA alias the decrypted output into the staged
+# input instead of allocating a second batch-width buffer — the last
+# host-side copy of the ingest leg, attributed by the PhaseProfiler's
+# h2d_transfer phase.  Selected only off-CPU (`_donate_ingest`):
+# the CPU backend ignores donation with a per-call warning.
+_unprotect_rtp_dev_donated = jax.jit(
+    _unprotect_rtp_impl, static_argnames=("tag_len", "encrypt", "off_const"),
+    donate_argnums=(3,))
+
+
+def _donate_ingest() -> bool:
+    """Donate the arena-backed packet buffer through the jit boundary
+    only where it buys a device allocation back (non-CPU backends; on
+    CPU XLA ignores the donation hint).  LIBJITSI_TPU_FORCE_DONATE=1
+    forces the donated twins on for CPU-tier soak/parity runs."""
+    import os
+    if os.environ.get("LIBJITSI_TPU_FORCE_DONATE", ""):
+        return True
+    return jax.default_backend() != "cpu"
+
+
+def _unprotect_rtp_dev_call(*args, **kwargs):
+    fn = (_unprotect_rtp_dev_donated if _donate_ingest()
+          else _unprotect_rtp_dev)
+    return fn(*args, **kwargs)
 
 
 def _uniform_off(payload_off, width: int) -> "int | None":
@@ -125,12 +155,26 @@ def _protect_gcm_dev(tab_rk, tab_gm, stream, data, length, aad_len, iv12,
         aad_const=aad_const)
 
 
-@functools.partial(jax.jit, static_argnames=("aad_const",))
-def _unprotect_gcm_dev(tab_rk, tab_gm, stream, data, length, aad_len, iv12,
-                       aad_const=None):
+def _unprotect_gcm_impl(tab_rk, tab_gm, stream, data, length, aad_len, iv12,
+                        aad_const=None):
     return gcm_kernel.gcm_unprotect(
         data, length, aad_len, tab_rk[stream], tab_gm[stream], iv12,
         aad_const=aad_const)
+
+
+_unprotect_gcm_dev = jax.jit(
+    _unprotect_gcm_impl, static_argnames=("aad_const",))
+
+# donated twin — see _unprotect_rtp_dev_donated
+_unprotect_gcm_dev_donated = jax.jit(
+    _unprotect_gcm_impl, static_argnames=("aad_const",),
+    donate_argnums=(3,))
+
+
+def _unprotect_gcm_dev_call(*args, **kwargs):
+    fn = (_unprotect_gcm_dev_donated if _donate_ingest()
+          else _unprotect_gcm_dev)
+    return fn(*args, **kwargs)
 
 
 @functools.partial(jax.jit, static_argnames=("aad_const",))
@@ -215,8 +259,8 @@ def _gcm_rtp_unprotect_grouped(tab_rk, tab_gm, stream, data, length,
 
 def _gcm_rtp_unprotect_per_row(tab_rk, tab_gm, stream, data, length,
                                off, iv12, grid, us, inv, aad_const):
-    return _unprotect_gcm_dev(tab_rk, tab_gm, stream, data, length, off,
-                              iv12, aad_const=aad_const)
+    return _unprotect_gcm_dev_call(tab_rk, tab_gm, stream, data, length,
+                                   off, iv12, aad_const=aad_const)
 
 
 from libjitsi_tpu.kernels import registry as _registry  # noqa: E402
@@ -968,7 +1012,7 @@ class SrtpStreamTable:
                 jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
                 jnp.asarray(gr), jnp.asarray(us, dtype=jnp.int32),
                 jnp.asarray(inv), aad_const)
-        return _unprotect_gcm_dev(
+        return _unprotect_gcm_dev_call(
             tab_rk, tab_gm, jnp.asarray(stream, dtype=jnp.int32),
             jnp.asarray(batch.data), jnp.asarray(length),
             jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
@@ -992,7 +1036,7 @@ class SrtpStreamTable:
         """AES-F8 RTP unprotect device call (see _f8_rtp_protect_call);
         returns (data, media_len, auth_ok)."""
         tab_rk, tab_mid, _, _ = self._device()
-        return _unprotect_rtp_dev(
+        return _unprotect_rtp_dev_call(
             tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
             jnp.asarray(batch.data), jnp.asarray(length),
             jnp.asarray(hdr.payload_off), jnp.asarray(iv),
@@ -1020,7 +1064,7 @@ class SrtpStreamTable:
         _cm_rtp_protect_call); returns (data, media_len, auth_ok)."""
         p = self.policy
         tab_rk, tab_mid, _, _ = self._device()
-        return _unprotect_rtp_dev(
+        return _unprotect_rtp_dev_call(
             tab_rk, tab_mid, jnp.asarray(stream, dtype=jnp.int32),
             jnp.asarray(batch.data), jnp.asarray(length),
             jnp.asarray(hdr.payload_off), jnp.asarray(iv),

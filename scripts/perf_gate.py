@@ -701,6 +701,30 @@ def _git_sha() -> str:
         return "unknown"
 
 
+def _git_dirty_files() -> list:
+    """Tracked files with uncommitted changes (staged or not), minus
+    the gate's own outputs — a prior gate run leaving BENCH_DETAIL or
+    the trend file modified must not block an honest re-baseline."""
+    own = {"PERF_BASELINE.json", "BENCH_DETAIL.json", "PERF_TREND.jsonl"}
+    try:
+        out = subprocess.run(
+            ["git", "status", "--porcelain", "--untracked-files=no"],
+            cwd=REPO, capture_output=True, text=True, timeout=10,
+        ).stdout
+    except Exception:
+        return []           # not a checkout: nothing to refuse on
+    return [line[3:].strip() for line in out.splitlines()
+            if line.strip() and line[3:].strip() not in own]
+
+
+def _engine_mode() -> str:
+    try:
+        from libjitsi_tpu.io.udp import probe_engine_mode
+        return probe_engine_mode()
+    except Exception:
+        return "unknown"
+
+
 def append_trend(path: str, results: dict) -> None:
     row = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
            "git": _git_sha(), "results": results}
@@ -720,6 +744,14 @@ def write_baseline(path: str, results: dict,
     doc = {"_meta": {
         "written": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "git": _git_sha(),
+        # cleanliness at stamp time: main() refuses dirty trees (see
+        # there), so "dirty" can only mean PERF_GATE_ALLOW_DIRTY=1 —
+        # and the jitlint drift checker flags it
+        "tree": ("dirty" if os.environ.get("PERF_GATE_ALLOW_DIRTY")
+                 and _git_dirty_files() else "clean"),
+        # ingest engine the numbers were measured with — perf numbers
+        # must never be compared across engine modes silently
+        "engine_mode": _engine_mode(),
         "note": "fast perf-gate baseline; re-baseline honestly "
                 "(quiet machine, explain the delta in the commit)"}}
     for name, entry in (old or {}).items():
@@ -780,6 +812,25 @@ def main(argv=None) -> int:
         if unknown:
             print(f"perf_gate: unknown scenarios {sorted(unknown)}")
             return 2
+    if args.write_baseline and not os.environ.get(
+            "PERF_GATE_ALLOW_DIRTY"):
+        # refuse to stamp a dirty tree: _meta.git must identify the
+        # code that produced the numbers (PR 11's gate run left
+        # _meta.git one commit behind the baseline it wrote).  The
+        # check runs BEFORE measuring so a refusal costs seconds, not
+        # a full suite.  PERF_GATE_ALLOW_DIRTY=1 overrides — and the
+        # stamp then carries _meta.tree="dirty", which jitlint flags.
+        dirty = _git_dirty_files()
+        if dirty:
+            print("perf_gate: REFUSING --write-baseline on a dirty "
+                  f"working tree ({len(dirty)} modified: "
+                  f"{', '.join(dirty[:5])}"
+                  f"{', ...' if len(dirty) > 5 else ''}) — commit "
+                  "first so _meta.git identifies the measured code, "
+                  "or set PERF_GATE_ALLOW_DIRTY=1 to stamp "
+                  "_meta.tree=dirty")
+            return 2
+    print(f"perf_gate: engine_mode={_engine_mode()}", flush=True)
     print("perf_gate: running scenarios...", flush=True)
     results = run_scenarios(names)
     if args.write_baseline:

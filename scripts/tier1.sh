@@ -10,6 +10,12 @@
 cd "$(dirname "$0")/.."
 echo "== jitlint gate =="
 python scripts/lint.py libjitsi_tpu || { echo "TIER1 FAIL: jitlint gate"; exit 1; }
+echo "== io engine probe =="
+env JAX_PLATFORMS=cpu python -c "
+from libjitsi_tpu.io.udp import probe_engine_mode, uring_available
+print('engine_mode=' + probe_engine_mode(),
+      'io_uring_available=' + str(uring_available()).lower())
+" || { echo "TIER1 FAIL: engine probe"; exit 1; }
 echo "== observability smoke =="
 env JAX_PLATFORMS=cpu python scripts/obs_smoke.py --ticks 40 || { echo "TIER1 FAIL: obs smoke"; exit 1; }
 echo "== perf gate (fast smoke) =="

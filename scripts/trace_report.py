@@ -221,7 +221,11 @@ def capture_loop_echo(log_dir: str) -> dict:
     with trace(log_dir):
         perf_gate._run_loop_echo(n_pkts=64, cycles=8, pipeline_depth=3)
     report = build_report(load_events(find_trace_file(log_dir)))
+    from libjitsi_tpu.io.udp import probe_engine_mode
     return {"loop_echo_pps": done / net, "phases": phases,
+            # the ingest engine the capture ran with: before/after
+            # occupancy comparisons are only valid within one mode
+            "engine_mode": probe_engine_mode(),
             "host_share": perf_mod.host_share(phases),
             "bound": perf_mod.classify_bound(phases),
             "trace": report}
@@ -251,6 +255,8 @@ def main(argv=None) -> int:
                   f"({100 * secs / total:5.1f} %)")
         print(f"  host share (host / host+device): "
               f"{100 * doc['host_share']:.1f} %  -> {doc['bound']}-bound")
+        print(f"  engine mode: {doc['engine_mode']} (compare captures "
+              f"within one mode only)")
         print(f"  loop_echo_pps (every-tick fenced — attribution "
               f"overhead depresses this vs the perf-gate number): "
               f"{doc['loop_echo_pps']}")

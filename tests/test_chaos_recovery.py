@@ -235,6 +235,35 @@ def test_depth3_pipeline_accept_set_is_bit_exact_across_kill(tmp_path):
         "kill/recover mid-pipeline lost or duplicated acceptances"
 
 
+def test_ingest_engine_mode_does_not_change_accept_set(monkeypatch):
+    """ISSUE 12 fallback proof: the ingest engine is a transport
+    detail.  With io_uring force-disabled (LIBJITSI_TPU_NO_IOURING=1)
+    the recvmmsg engine accepts a bit-identical set on the depth-3
+    faulted wire vs the auto-probed default — and, on a box that can
+    run the ring, the io_uring engine matches too."""
+    from libjitsi_tpu.io.udp import uring_available
+
+    wire = _make_wire()
+    monkeypatch.setenv("LIBJITSI_TPU_NO_IOURING", "1")
+    accepted_off, bridge_off, _ = _run_universe(wire, pipeline_depth=3)
+    bridge_off.close()
+
+    monkeypatch.delenv("LIBJITSI_TPU_NO_IOURING")
+    accepted_auto, bridge_auto, _ = _run_universe(wire,
+                                                  pipeline_depth=3)
+    bridge_auto.close()
+    assert accepted_auto == accepted_off, \
+        "force-disabling io_uring changed the accept set"
+
+    if uring_available():
+        monkeypatch.setenv("LIBJITSI_TPU_ENGINE_MODE", "io_uring")
+        accepted_ring, bridge_ring, _ = _run_universe(
+            wire, pipeline_depth=3)
+        bridge_ring.close()
+        assert accepted_ring == accepted_off, \
+            "ring-engine ingest changed the accept set"
+
+
 def test_quarantine_isolates_auth_storm_then_readmits():
     libjitsi_tpu.stop()
     libjitsi_tpu.init()

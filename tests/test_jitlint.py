@@ -664,6 +664,58 @@ def test_drift_baseline_meta_git_must_be_a_hash(tmp_path):
     assert len(found) == 1 and "_meta.git" in found[0].message
 
 
+def test_drift_baseline_meta_dirty_tree_fires():
+    """A baseline stamped on a dirty working tree points _meta.git at
+    a commit that is NOT the measured code (the PR 11 failure class);
+    `tree: "clean"` and absent-key (pre-rule) stamps are clean."""
+    from libjitsi_tpu.analysis.checkers.drift import check_baseline_meta
+
+    ok = {"git": "0123abc"}
+    assert check_baseline_meta(dict(ok, tree="clean")) == []
+    assert check_baseline_meta(ok) == []        # pre-rule baseline
+    msgs = check_baseline_meta(dict(ok, tree="dirty"))
+    assert len(msgs) == 1 and "_meta.tree" in msgs[0]
+    # the git-hash rule still wins when both are wrong
+    msgs = check_baseline_meta({"git": "unknown", "tree": "dirty"})
+    assert len(msgs) == 1 and "_meta.git" in msgs[0]
+
+
+def test_drift_syscall_and_reap_counters_in_scope():
+    """ISSUE 12's ingest telemetry suffixes (`_syscalls`, `_reaps`)
+    are counter-shaped: a class growing an unregistered one next to a
+    registered sibling fires; registering both via the reading-lambda
+    form is clean."""
+    src = """
+    class Loop:
+        def __init__(self):
+            self.ingest_syscalls = 0
+            self.ingest_ring_reaps = 0
+
+        def sync(self):
+            self.ingest_syscalls += 1
+            self.ingest_ring_reaps += 1
+
+        def register_metrics(self, registry):
+            registry.register_scalar(
+                "loop_ingest_syscalls",
+                lambda: self.ingest_syscalls, kind="counter")
+    """
+    ctx = ctx_of(src)
+    found = check_metrics_drift({ctx.relpath: ctx})
+    assert len(found) == 1
+    assert "ingest_ring_reaps" in found[0].message
+
+    covered = src.replace(
+        'kind="counter")',
+        'kind="counter")\n'
+        '            registry.register_scalar(\n'
+        '                "loop_ingest_ring_reaps",\n'
+        '                lambda: self.ingest_ring_reaps,'
+        ' kind="counter")')
+    ctx = ctx_of(covered)
+    assert check_metrics_drift({ctx.relpath: ctx}) == []
+
+
 def test_drift_real_baseline_meta_is_a_fresh_hash():
     """The checked-in baseline's stamp must be a real hash — the
     --write-baseline path stamps HEAD automatically now."""

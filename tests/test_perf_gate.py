@@ -156,6 +156,10 @@ def stub_gate(monkeypatch, tmp_path):
     monkeypatch.setattr(
         "libjitsi_tpu.utils.compile_cache.enable_compile_cache",
         lambda *a, **k: None)
+    # hermetic: the stub gate's exit contract must not depend on the
+    # developer's actual working-tree state
+    monkeypatch.setattr(perf_gate, "_git_dirty_files", lambda: [])
+    monkeypatch.delenv("PERF_GATE_ALLOW_DIRTY", raising=False)
     base = tmp_path / "base.json"
     trend = tmp_path / "trend.jsonl"
     return base, trend
@@ -197,6 +201,34 @@ def test_gate_injected_slowdown_exits_nonzero(stub_gate, monkeypatch,
     monkeypatch.setenv("PERF_GATE_INJECT_SLOW",
                        "install_streams_per_sec=1000")
     assert perf_gate.main(_args(base, trend, "--no-trend")) == 0
+
+
+def test_write_baseline_refuses_dirty_tree(stub_gate, monkeypatch,
+                                           capsys):
+    """ISSUE 12 hygiene: --write-baseline on a dirty tree would stamp
+    _meta.git at a commit that is not the measured code (how PR 11's
+    baseline landed one commit behind).  The gate refuses; the escape
+    hatch stamps `_meta.tree: "dirty"` so the drift checker flags the
+    file until an honest clean-tree run replaces it."""
+    base, trend = stub_gate
+    monkeypatch.setattr(perf_gate, "_git_dirty_files",
+                        lambda: ["libjitsi_tpu/io/udp.py"])
+    assert perf_gate.main(_args(base, trend, "--write-baseline")) == 2
+    out = capsys.readouterr().out
+    assert "dirty" in out and "libjitsi_tpu/io/udp.py" in out
+    assert not base.exists()
+
+    monkeypatch.setenv("PERF_GATE_ALLOW_DIRTY", "1")
+    assert perf_gate.main(_args(base, trend, "--write-baseline")) == 0
+    doc = json.loads(base.read_text())
+    assert doc["_meta"]["tree"] == "dirty"
+
+    monkeypatch.delenv("PERF_GATE_ALLOW_DIRTY")
+    monkeypatch.setattr(perf_gate, "_git_dirty_files", lambda: [])
+    assert perf_gate.main(_args(base, trend, "--write-baseline")) == 0
+    doc = json.loads(base.read_text())
+    assert doc["_meta"]["tree"] == "clean"
+    assert doc["_meta"]["engine_mode"] in ("recvmmsg", "io_uring")
 
 
 def test_gate_usage_errors_exit_two(stub_gate):
