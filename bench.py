@@ -289,6 +289,12 @@ def _watchdog():
     os._exit(0)
 
 
+class SkipSection(Exception):
+    """Raised by a section to record a clean skip (e.g. an optional
+    dependency is absent) instead of an error entry — str(exc) is the
+    reason recorded as ``status: "skipped: <reason>"``."""
+
+
 def section(name: str, min_cost_s: float, box_s: float, fn):
     """Run one bench section inside a time box.
 
@@ -310,6 +316,10 @@ def section(name: str, min_cost_s: float, box_s: float, fn):
         SECTIONS[name] = {"status": "ok",
                           "elapsed_s": round(time.monotonic() - t0, 1)}
         return out
+    except SkipSection as e:  # clean refusal, not a degradation
+        SECTIONS[name] = {"status": f"skipped: {e}",
+                          "elapsed_s": round(time.monotonic() - t0, 1)}
+        return None
     except Exception as e:  # recorded, never fatal
         SECTIONS[name] = {
             "status": f"error: {type(e).__name__}: {e}"[:300],
@@ -411,7 +421,14 @@ def cpu_pps(deadline: float) -> None:
     import hmac as pyhmac
     import hashlib
 
-    from cryptography.hazmat.primitives.ciphers import Cipher, algorithms, modes
+    # lazy + gated like control/dtls.py's _openssl(): the container may
+    # not ship `cryptography`, and an absent optional baseline is a
+    # skip, not a degradation record
+    try:
+        from cryptography.hazmat.primitives.ciphers import (
+            Cipher, algorithms, modes)
+    except ImportError:
+        raise SkipSection("missing-dep")
 
     rng = np.random.default_rng(4)
     n = 2000

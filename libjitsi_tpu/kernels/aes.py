@@ -222,18 +222,21 @@ def aes_encrypt_table(round_keys, blocks):
 
 # Selectable encrypt core (the reference's `.srtp.crypto.Aes`
 # benchmark-and-pick idea at the kernel level): "table" (S-box gather)
-# or "bitsliced" (gather-free Boolean circuit,
-# kernels/aes_bitsliced.py).  Round-5 fetch-verified measurement on the
-# real v5e chip (prior rounds' timings were tunnel artifacts — see
-# BASELINE.md): bitsliced runs the 720k-block keystream load 8.6x
-# faster than the table core (~6.7M vs ~0.78M blocks/s), because the
-# per-byte S-box gathers that a CPU loves are the worst case for the
-# TPU's vector unit, while the Boolean circuit is pure lane-parallel
-# bit math.  Default: bitsliced on TPU backends, table on CPU (where
-# XLA:CPU's gather is cheap and the CPU test suite compiles the table
-# core fastest).  The choice is read at TRACE time, so switch before
-# the first jit of the consuming kernels (env LIBJITSI_TPU_AES_CORE or
-# set_core(); set_core clears jax caches so later compiles re-pick).
+# or a "bitsliced" variant (gather-free Boolean circuits,
+# kernels/aes_bitsliced.py).  Selection order in get_core():
+#   1. LIBJITSI_TPU_AES_CORE / set_core() — explicit pin, wins always;
+#   2. the measured record (AES_CORES.json via
+#      kernels/registry.py:measured_aes_core): per-backend chained
+#      above-floor numbers from the bench_aes_cores protocol, picked
+#      by blocks/s among status=="ok" cores only — below_floor and
+#      budget-skipped entries are refusals, never evidence;
+#   3. heuristic fallback when no record covers the backend: table on
+#      CPU (XLA:CPU's gather is cheap), composite-field tower bitslice
+#      on accelerators (per-byte S-box gathers are the vector unit's
+#      worst case, pure lane-parallel bit math its best).
+# The choice is read at TRACE time, so switch before the first jit of
+# the consuming kernels (set_core clears jax caches so later compiles
+# re-pick).
 import os as _os
 
 _CORES = ("table", "bitsliced", "bitsliced_tower", "bitsliced32")
@@ -258,20 +261,25 @@ def get_core() -> str:
     if _CORE_NAME is None:
         # resolved lazily so importing this module never forces a
         # backend init (conftest flips platforms before first use).
-        # TPU default: the composite-field (tower) bitsliced circuit —
-        # fetch-verified fastest credible core on v5e (~1.6x the
-        # addition-chain bitslice, which is itself 8-37x the gather
-        # table core).  The packed-word bitsliced32's r05 record of
-        # 231.6M blocks/s (20x tower) is floor-noise — a single-launch
-        # timing whose net span sat inside the scalar-fetch floor's own
-        # jitter (VERDICT r5 Weak #1) — and is NOT evidence; the
-        # chained re-measurement (scripts/bench_aes_cores.py) puts
-        # bitsliced32 at ~3.5x tower on CPU, but it has no above-floor
-        # TPU number yet, so it stays opt-in via set_core until one
-        # exists.  CPU keeps the table core (chained: 2.0M blocks/s,
-        # ~11x bitsliced32 there — gathers are cheap on CPU).
-        _CORE_NAME = ("table" if jax.default_backend() == "cpu"
-                      else "bitsliced_tower")
+        # Measured pick first: AES_CORES.json holds per-backend
+        # chained above-floor blocks/s (the only timing protocol that
+        # survived round 5 — single-launch spans sit inside the
+        # scalar-fetch floor's jitter and emit junk, see BASELINE.md),
+        # and measured_aes_core returns the fastest status=="ok" core
+        # for this backend or None when none exists.  Heuristic
+        # fallback mirrors what the measurements have shown so far:
+        # table on CPU (chained: gathers are cheap there), the
+        # composite-field tower bitslice elsewhere (fetch-verified
+        # fastest credible core on v5e; bitsliced32 has no above-floor
+        # TPU number, so it can only win via a future measured record).
+        from libjitsi_tpu.kernels import registry as _registry
+
+        measured = _registry.measured_aes_core()
+        if measured is not None:
+            _CORE_NAME = measured
+        else:
+            _CORE_NAME = ("table" if jax.default_backend() == "cpu"
+                          else "bitsliced_tower")
     return _CORE_NAME
 
 

@@ -279,6 +279,138 @@ def gcm_unprotect_grouped(data, length, aad_len, round_keys, gmat_g,
     return dec, mlen, auth_ok
 
 
+# --- keystream-cache fast path ---------------------------------------------
+#
+# SRTP-GCM's per-packet AES work is fully determined by (session key,
+# ssrc, packet index): the CTR keystream and the E(K, J0) tag mask can
+# be computed before the packet exists.  The cached kernels below take
+# that material pre-gathered per row (transform/srtp/keystream.py owns
+# the window bookkeeping) and run only the irreducibly online half —
+# the payload XOR and the ciphertext-dependent GHASH.  No round keys
+# cross the jit boundary at all on this path.
+
+def _cached_width(cap: int, aad_const: int, ks_bytes: int) -> int:
+    """GHASH width for the cached path: the cache's hit test guarantees
+    ct_len <= ks_bytes, so the Horner round count is bounded by the
+    keystream window's byte depth, not the packet buffer's padded
+    capacity — at the default 256-byte window that is ~18 rounds
+    instead of ~96 for a 1504-byte buffer."""
+    return min(_ghash_width(cap), _ceil16(aad_const) + _ceil16(ks_bytes) + 16)
+
+
+def _xor_cached(data, ks, offset: int, ct_len):
+    """XOR a cached keystream row into [offset, offset+ct_len) with the
+    same static pad-shift as `_xor_window_uniform`.  `ks` is [B, KS]
+    with KS possibly smaller than the packet width — the cache's hit
+    test guarantees ct_len <= KS per row, so the right zero-pad is
+    never reached by an inside column."""
+    width = data.shape[1]
+    ks = jnp.asarray(ks, dtype=jnp.uint8)
+    pad = max(0, width - offset - ks.shape[1])
+    ks_aligned = jnp.pad(ks, ((0, 0), (offset, pad)))[:, :width]
+    col = jnp.arange(width, dtype=jnp.int32)[None, :]
+    ln = jnp.asarray(ct_len, dtype=jnp.int32)[:, None]
+    inside = (col >= offset) & (col < offset + ln)
+    return jnp.where(inside, data ^ ks_aligned, data)
+
+
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def gcm_protect_cached(data, length, ks, ek_j0, gmat, aad_const: int):
+    """`gcm_protect` with the AES plane precomputed: ks [B, KS] uint8 is
+    the CTR keystream starting at inc32(J0); ek_j0 [B, 16] the cached
+    E(K, J0) tag masks; gmat [B, 128, 128] per-row GHASH matrices.
+    Only the uniform-AAD shape exists — the cache serves all-or-nothing
+    batches whose headers agree on one payload offset.  Bit-exact with
+    `gcm_protect` by construction: the GHASH-input builder and tag
+    scatter are the same code, and CTR keystream ⊕ data is the same
+    bytes regardless of when the keystream was generated."""
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    ct_len = length - aad_const
+    enc = _xor_cached(data, ks, aad_const, ct_len)
+    width = _cached_width(data.shape[1], aad_const, ks.shape[1])
+    gin, nblk = _build_ghash_input_uniform(enc, aad_const, ct_len, width)
+    s = ghash(gmat, gin, nblk, width // 16)
+    tag = jnp.bitwise_xor(s, jnp.asarray(ek_j0, dtype=jnp.uint8))
+    out = _scatter_tag(enc, length, tag)
+    return out, length + TAG_LEN
+
+
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def gcm_unprotect_cached(data, length, ks, ek_j0, gmat, aad_const: int):
+    """`gcm_unprotect` on cached keystream/tag-mask rows.  Returns
+    (data', length - 16, auth_ok); decrypt always runs (branch-free)."""
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    mlen = length - TAG_LEN
+    ct_len = mlen - aad_const
+    width = _cached_width(data.shape[1], aad_const, ks.shape[1])
+    gin, nblk = _build_ghash_input_uniform(data, aad_const, ct_len, width)
+    s = ghash(gmat, gin, nblk, width // 16)
+    want = jnp.bitwise_xor(s, jnp.asarray(ek_j0, dtype=jnp.uint8))
+    stored = _gather_span(data, mlen, TAG_LEN)
+    auth_ok = jnp.all(stored == want, axis=1)
+    dec = _xor_cached(data, ks, aad_const, ct_len)
+    return dec, mlen, auth_ok
+
+
+def _cached_grouped_digest(gmat_g, enc, ct_len, grid_rows, inv_pos,
+                           width: int, aad_const: int, packed: bool):
+    """Grouped-GHASH digest for the cached path (same grid/inverse
+    plumbing as `_grouped_tag`, minus the AES tag-mask encrypt).
+    `packed` selects the AND/popcount GF(2) matvec over the int8 MXU
+    matmul — both are registered as providers and the registry's
+    benchmark-and-pick keeps the faster one per backend."""
+    from libjitsi_tpu.kernels.ghash import (ghash_grouped,
+                                            ghash_grouped_packed)
+
+    gin, nblk = _build_ghash_input_uniform(enc, aad_const, ct_len, width)
+    g, p = grid_rows.shape
+    safe = jnp.clip(grid_rows.reshape(-1), 0, enc.shape[0] - 1)
+    gin_g = gin[safe].reshape(g, p, width)
+    nblk_g = jnp.where(grid_rows >= 0, nblk[safe].reshape(g, p), 0)
+    fn = ghash_grouped_packed if packed else ghash_grouped
+    s = fn(gmat_g, gin_g, nblk_g, width // 16)
+    return s.reshape(g * p, 16)[inv_pos]
+
+
+@functools.partial(jax.jit, static_argnames=("aad_const", "packed"))
+def gcm_protect_cached_grouped(data, length, ks, ek_j0, gmat_g,
+                               grid_rows, inv_pos, aad_const: int,
+                               packed: bool = False):
+    """`gcm_protect_cached` with stream-grouped GHASH (gmat_g is per
+    GROUP, read once per stream instead of once per row)."""
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    ct_len = length - aad_const
+    enc = _xor_cached(data, ks, aad_const, ct_len)
+    width = _cached_width(data.shape[1], aad_const, ks.shape[1])
+    s_rows = _cached_grouped_digest(gmat_g, enc, ct_len, grid_rows,
+                                    inv_pos, width, aad_const, packed)
+    tag = jnp.bitwise_xor(s_rows, jnp.asarray(ek_j0, dtype=jnp.uint8))
+    out = _scatter_tag(enc, length, tag)
+    return out, length + TAG_LEN
+
+
+@functools.partial(jax.jit, static_argnames=("aad_const", "packed"))
+def gcm_unprotect_cached_grouped(data, length, ks, ek_j0, gmat_g,
+                                 grid_rows, inv_pos, aad_const: int,
+                                 packed: bool = False):
+    """`gcm_unprotect_cached` with stream-grouped GHASH."""
+    data = jnp.asarray(data, dtype=jnp.uint8)
+    length = jnp.asarray(length, dtype=jnp.int32)
+    mlen = length - TAG_LEN
+    ct_len = mlen - aad_const
+    width = _cached_width(data.shape[1], aad_const, ks.shape[1])
+    s_rows = _cached_grouped_digest(gmat_g, data, ct_len, grid_rows,
+                                    inv_pos, width, aad_const, packed)
+    want = jnp.bitwise_xor(s_rows, jnp.asarray(ek_j0, dtype=jnp.uint8))
+    stored = _gather_span(data, mlen, TAG_LEN)
+    auth_ok = jnp.all(stored == want, axis=1)
+    dec = _xor_cached(data, ks, aad_const, ct_len)
+    return dec, mlen, auth_ok
+
+
 @functools.partial(jax.jit, static_argnames=("aad_const",))
 def gcm_protect_fanout(data, length, round_keys, gmat, iv12,
                        aad_const: int = 12):

@@ -425,6 +425,23 @@ class StreamLifecycleManager:
         self.commit()
         self.poll()
         self.rebalance()
+        self.fill_keystream()
+
+    def _keystream_caches(self):
+        for name in ("rx_table", "tx_table"):
+            cache = getattr(getattr(self.bridge, name, None),
+                            "_ks_cache", None)
+            if cache is not None:
+                yield cache
+
+    def fill_keystream(self) -> None:
+        """Off-tick keystream pregeneration: top up the GCM caches'
+        sliding windows AFTER the commit barrier (so a rekey's
+        invalidation has already landed and the refill keys are the
+        live ones).  All compile shapes here are fixed-chunk, so this
+        phase never recompiles the data path."""
+        for cache in self._keystream_caches():
+            cache.fill()
 
     def commit(self) -> None:
         """Atomic (w.r.t. the tick) population flip: committed admits
@@ -990,6 +1007,35 @@ class StreamLifecycleManager:
             lambda: float(self.speaker_promotions),
             help_="listener-to-speaker role flips applied at the "
                   "commit barrier", kind="counter")
+        # keystream pregeneration cache (transform/srtp/keystream.py):
+        # summed across the rx/tx tables' caches; all zero until
+        # enable_keystream_cache is called on a GCM bridge
+        registry.register_scalar(
+            "srtp_keystream_hits",
+            lambda: float(sum(c.hits for c in self._keystream_caches())),
+            help_="packets served from the pregenerated keystream "
+                  "window (fast-path protect/unprotect)",
+            kind="counter")
+        registry.register_scalar(
+            "srtp_keystream_misses",
+            lambda: float(sum(c.misses
+                              for c in self._keystream_caches())),
+            help_="packets that fell back to the stock GCM path "
+                  "(window miss, reorder, rekey, non-uniform batch)",
+            kind="counter")
+        registry.register_scalar(
+            "srtp_keystream_evictions",
+            lambda: float(sum(c.evictions
+                              for c in self._keystream_caches())),
+            help_="pregenerated keystream slots discarded unused "
+                  "(window slide, rekey invalidation, SSRC change)",
+            kind="counter")
+        registry.register_scalar(
+            "srtp_keystream_fill_seconds",
+            lambda: float(sum(c.fill_seconds
+                              for c in self._keystream_caches())),
+            help_="cumulative off-tick wall time spent generating "
+                  "keystream (the cache-fill phase)", kind="counter")
 
     def _rejected_samples(self):
         return [({"reason": r}, float(c))

@@ -704,6 +704,25 @@ class BridgeSupervisor:
                 getattr(loop, "ingest_syscalls", 0))
             out["ingest_ring_reaps"] = int(
                 getattr(loop, "ingest_ring_reaps", 0))
+        caches = [c for name in ("rx_table", "tx_table")
+                  for c in (getattr(getattr(self.bridge, name, None),
+                                    "_ks_cache", None),)
+                  if c is not None]
+        if caches:
+            # off-tick phases don't appear in the tick's phase split —
+            # keystream pregeneration runs at the lifecycle barrier, so
+            # its cost is attributed here as a separate ledger line
+            served = sum(c.hits for c in caches)
+            missed = sum(c.misses for c in caches)
+            out["off_tick"] = {
+                "keystream_fill_seconds": round(
+                    sum(c.fill_seconds for c in caches), 6),
+                "keystream_filled_slots": int(
+                    sum(c.filled_slots for c in caches)),
+                "keystream_hit_rate": round(
+                    served / (served + missed), 4)
+                if served + missed else None,
+            }
         return out
 
     def health(self) -> dict:

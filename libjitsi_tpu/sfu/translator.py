@@ -77,6 +77,16 @@ def _fanout_protect_gcm(tab_rk, tab_gm, recv, data, length, aad_len, iv12,
         aad_const=aad_const)
 
 
+@jax.jit
+def _fanout_packet_major(out_gp, out_len_p):
+    """Leg-major [G, P, W] -> packet-major [P, G, W], lengths broadcast
+    to [P, G].  Runs at the class-PADDED shape so the flip compiles
+    once per class combo; the raw-shape crop is host-side numpy in
+    PendingTranslate.result()."""
+    out = jnp.transpose(out_gp, (1, 0, 2))
+    return out, jnp.broadcast_to(out_len_p[:, None], out.shape[:2])
+
+
 class RtpTranslator:
     """Decrypt-once / re-encrypt-N fan-out over a receiver key table.
 
@@ -265,9 +275,11 @@ class RtpTranslator:
                 plen = np.full(p, 12 + payload_len, dtype=np.int32)
                 iv = np.zeros((rows, p, 12), dtype=np.uint8)
                 for aad in (12, 20):
-                    out_gp, _ = self._gcm_uniform_fanout_call(
+                    out_gp, out_len_p = self._gcm_uniform_fanout_call(
                         recv, pdata, plen, iv, aad)
-                    np.asarray(out_gp)
+                    out_pm, _ = _fanout_packet_major(
+                        jnp.asarray(out_gp), jnp.asarray(out_len_p))
+                    np.asarray(out_pm)
 
     def _device(self):
         if self._dev is None:
@@ -328,8 +340,9 @@ class RtpTranslator:
                 batch.capacity:
             raise ValueError("fan-out rows need tag headroom in capacity")
 
+        pg = None
         if self._gcm:
-            out, out_len = self._translate_gcm(
+            out, out_len, pg = self._translate_gcm(
                 batch, rows, recvs, src, recv, data, length,
                 hdr, payload_off, ssrc, idx)
         else:
@@ -360,7 +373,7 @@ class RtpTranslator:
             out, out_len = self._cm_fanout_call(
                 recv[rr_idx], pdata, length[rr_idx],
                 payload_off[rr_idx], iv[rr_idx], idx[rr_idx])
-        return PendingTranslate(out, out_len, recv, batch.capacity)
+        return PendingTranslate(out, out_len, recv, batch.capacity, pg=pg)
 
     def _cm_fanout_call(self, recv, data, length, payload_off, iv, idx):
         """AES-CM fan-out device call — the mesh translator
@@ -440,15 +453,15 @@ class RtpTranslator:
                 pssrc[None, :], pidx[None, :])
             out_gp, out_len_p = self._gcm_uniform_fanout_call(
                 rr_p, pdata, plen, iv, int(off0[0]))
-            out_gp = jnp.asarray(out_gp)[:g_real, :p_real]
             # grouped output is leg-major [G, P, W]; the contract is
-            # packet-major rows (p0r0, p0r1, ...) matching `src`/`recv`
-            out = jnp.transpose(out_gp, (1, 0, 2)).reshape(
-                p_real * g_real, out_gp.shape[-1])
-            out_len = jnp.tile(
-                jnp.asarray(out_len_p)[:p_real, None],
-                (1, g_real)).reshape(-1)
-            return out, out_len
+            # packet-major rows (p0r0, p0r1, ...) matching `src`/`recv`.
+            # The flip stays jitted at the class-PADDED shape (one
+            # compile per class combo); cropping to the raw (P, G) is
+            # numpy work at result() time — eager device slices here
+            # compiled per raw shape, which churn varies every tick.
+            out_pm, len_pm = _fanout_packet_major(jnp.asarray(out_gp),
+                                                  jnp.asarray(out_len_p))
+            return out_pm, len_pm, (p_real, g_real)
         rr_idx = _cycle_rows(len(recv))
         if rr_idx is None:
             rr_idx = np.arange(len(recv))
@@ -460,10 +473,11 @@ class RtpTranslator:
         pdata[:, :cw] = data[rr_idx][:, :cw]
         iv = gcm_kernel.srtp_gcm_iv(self._salt[recv[rr_idx]],
                                     ssrc[rr_idx], idx[rr_idx])
-        return self._gcm_fanout_call(recv[rr_idx], pdata,
-                                     length[rr_idx],
-                                     payload_off[rr_idx], iv,
-                                     pdata.shape[-1])
+        out, out_len = self._gcm_fanout_call(recv[rr_idx], pdata,
+                                             length[rr_idx],
+                                             payload_off[rr_idx], iv,
+                                             pdata.shape[-1])
+        return out, out_len, None
 
     def _gcm_uniform_fanout_call(self, rr, pdata, plen, iv, aad_const):
         """Full-mesh per-LEG-matrix fan-out device call: P packets
@@ -499,17 +513,32 @@ class PendingTranslate:
     double-buffering seam, for the SFU's per-leg re-encrypt launch.
     """
 
-    def __init__(self, out, out_len, recv: np.ndarray, capacity: int):
+    def __init__(self, out, out_len, recv: np.ndarray, capacity: int,
+                 pg=None):
         self._out = out
         self._out_len = out_len
         self.recv = recv
         self._capacity = capacity
+        # (p_real, g_real) when `out` is the uniform fan-out's padded
+        # packet-major grid [P_pad, G_pad, W]; None for flat rows
+        self._pg = pg
         self._done: "Tuple[PacketBatch, np.ndarray] | None" = None
 
     def result(self) -> Tuple[PacketBatch, np.ndarray]:
         if self._done is None:
             if self._out is None:
                 wire = PacketBatch.empty(0, self._capacity)
+            elif self._pg is not None:
+                # crop the padded (P, G) grid to the real counts and
+                # flatten packet-major — numpy on the materialized
+                # buffer, so no per-raw-shape device programs
+                p, g = self._pg
+                arr = np.asarray(self._out)[:p, :g]
+                lens = np.asarray(self._out_len,
+                                  dtype=np.int32)[:p, :g]
+                wire = PacketBatch(arr.reshape(p * g, arr.shape[-1]),
+                                   lens.reshape(-1),
+                                   self.recv.astype(np.int32))
             else:
                 # drop the class-padding rows (cycled copies appended
                 # by translate_async to keep the fan-out shapes on the

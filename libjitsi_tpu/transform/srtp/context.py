@@ -273,6 +273,112 @@ _registry.register("gcm_rtp_unprotect", "per_row",
                    _gcm_rtp_unprotect_per_row)
 
 
+# --- keystream-cache fast path (transform/srtp/keystream.py) ---------------
+# On an all-rows window hit the tick pays only the fused XOR + GHASH
+# kernel; the slot gathers ride inside the jit boundary so the cache
+# tables stay device-resident between fills.
+
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def _protect_gcm_cached_dev(ks_tab, ek_tab, slot, tab_gm, stream, data,
+                            length, aad_const: int):
+    return gcm_kernel.gcm_protect_cached(
+        data, length, ks_tab[slot], ek_tab[slot], tab_gm[stream],
+        aad_const=aad_const)
+
+
+@functools.partial(jax.jit, static_argnames=("aad_const",))
+def _unprotect_gcm_cached_dev(ks_tab, ek_tab, slot, tab_gm, stream, data,
+                              length, aad_const: int):
+    return gcm_kernel.gcm_unprotect_cached(
+        data, length, ks_tab[slot], ek_tab[slot], tab_gm[stream],
+        aad_const=aad_const)
+
+
+@functools.partial(jax.jit, static_argnames=("aad_const", "packed"))
+def _protect_gcm_cached_grouped_dev(ks_tab, ek_tab, slot, tab_gm, stream,
+                                    data, length, grid_rows, ustream,
+                                    inv_pos, aad_const: int,
+                                    packed: bool = False):
+    return gcm_kernel.gcm_protect_cached_grouped(
+        data, length, ks_tab[slot], ek_tab[slot], tab_gm[ustream],
+        grid_rows, inv_pos, aad_const=aad_const, packed=packed)
+
+
+@functools.partial(jax.jit, static_argnames=("aad_const", "packed"))
+def _unprotect_gcm_cached_grouped_dev(ks_tab, ek_tab, slot, tab_gm,
+                                      stream, data, length, grid_rows,
+                                      ustream, inv_pos, aad_const: int,
+                                      packed: bool = False):
+    return gcm_kernel.gcm_unprotect_cached_grouped(
+        data, length, ks_tab[slot], ek_tab[slot], tab_gm[ustream],
+        grid_rows, inv_pos, aad_const=aad_const, packed=packed)
+
+
+# Grouped vs per-row vs grouped_packed on the cached path is measured
+# per shape signature like the stock GCM seams — the crossover is not
+# transferable from the stock measurement because the cached kernels
+# carry no AES stage.  "grouped_packed" swaps the GHASH matvec from the
+# int8 MXU matmul to packed-word AND/popcount (kernels/ghash.py): same
+# bits, opposite hardware affinity, so the registry's first-hot-call
+# race decides per backend instead of a comment.
+
+def _gcm_cached_protect_grouped(ks_tab, ek_tab, slot, tab_gm, stream,
+                                data, length, grid, us, inv, aad_const):
+    return _protect_gcm_cached_grouped_dev(
+        ks_tab, ek_tab, slot, tab_gm, stream, data, length, grid, us,
+        inv, aad_const=aad_const)
+
+
+def _gcm_cached_protect_grouped_packed(ks_tab, ek_tab, slot, tab_gm,
+                                       stream, data, length, grid, us,
+                                       inv, aad_const):
+    return _protect_gcm_cached_grouped_dev(
+        ks_tab, ek_tab, slot, tab_gm, stream, data, length, grid, us,
+        inv, aad_const=aad_const, packed=True)
+
+
+def _gcm_cached_protect_per_row(ks_tab, ek_tab, slot, tab_gm, stream,
+                                data, length, grid, us, inv, aad_const):
+    return _protect_gcm_cached_dev(ks_tab, ek_tab, slot, tab_gm, stream,
+                                   data, length, aad_const=aad_const)
+
+
+def _gcm_cached_unprotect_grouped(ks_tab, ek_tab, slot, tab_gm, stream,
+                                  data, length, grid, us, inv, aad_const):
+    return _unprotect_gcm_cached_grouped_dev(
+        ks_tab, ek_tab, slot, tab_gm, stream, data, length, grid, us,
+        inv, aad_const=aad_const)
+
+
+def _gcm_cached_unprotect_grouped_packed(ks_tab, ek_tab, slot, tab_gm,
+                                         stream, data, length, grid, us,
+                                         inv, aad_const):
+    return _unprotect_gcm_cached_grouped_dev(
+        ks_tab, ek_tab, slot, tab_gm, stream, data, length, grid, us,
+        inv, aad_const=aad_const, packed=True)
+
+
+def _gcm_cached_unprotect_per_row(ks_tab, ek_tab, slot, tab_gm, stream,
+                                  data, length, grid, us, inv, aad_const):
+    return _unprotect_gcm_cached_dev(ks_tab, ek_tab, slot, tab_gm,
+                                     stream, data, length,
+                                     aad_const=aad_const)
+
+
+_registry.register("gcm_rtp_protect_cached", "grouped",
+                   _gcm_cached_protect_grouped)
+_registry.register("gcm_rtp_protect_cached", "grouped_packed",
+                   _gcm_cached_protect_grouped_packed)
+_registry.register("gcm_rtp_protect_cached", "per_row",
+                   _gcm_cached_protect_per_row)
+_registry.register("gcm_rtp_unprotect_cached", "grouped",
+                   _gcm_cached_unprotect_grouped)
+_registry.register("gcm_rtp_unprotect_cached", "grouped_packed",
+                   _gcm_cached_unprotect_grouped_packed)
+_registry.register("gcm_rtp_unprotect_cached", "per_row",
+                   _gcm_cached_unprotect_per_row)
+
+
 class SrtpStreamTable:
     """Batched crypto contexts for up to `capacity` streams of one profile."""
 
@@ -335,6 +441,37 @@ class SrtpStreamTable:
         # its replay/counter commit is forced before any state reader
         # or new dispatch can observe a stale window
         self._inflight_unprotect: "PendingUnprotect | None" = None
+        # optional keystream pregeneration cache (GCM only; enabled via
+        # enable_keystream_cache).  None keeps every path stock — the
+        # mesh subclasses override the _gcm_rtp_*_call seams and must
+        # never see a cache consult ahead of them.
+        self._ks_cache = None
+        # device-side (stream, grid) conversions memoized by the batch's
+        # stream pattern: an SFU's batch composition is stable tick over
+        # tick, so the grouping grid and its device arrays are reused
+        # instead of recomputed + re-device_put per batch (the cached
+        # fast path is host-bound without this)
+        self._grid_memo: dict = {}
+
+    def enable_keystream_cache(self, window: int = 64,
+                               ks_bytes: int = 256,
+                               pool: Optional[int] = None,
+                               debug: bool = False):
+        """Attach an off-tick keystream pregeneration cache (GCM only).
+
+        The tick-path protect/unprotect then serves the fused
+        XOR + GHASH kernels on window hit and falls back bit-exactly to
+        the stock path on miss; `fill()` must run between ticks (the
+        lifecycle plane does this for bridge tables).  Returns the
+        cache for direct priming/inspection."""
+        if not self._gcm:
+            raise ValueError(
+                "keystream cache requires an AEAD-GCM profile")
+        from libjitsi_tpu.transform.srtp.keystream import KeystreamCache
+        self._ks_cache = KeystreamCache(self, window=window,
+                                        ks_bytes=ks_bytes, pool=pool,
+                                        debug=debug)
+        return self._ks_cache
 
     def _commit_inflight_unprotect(self) -> None:
         """Ordering barrier for the pipelined receive path: host replay
@@ -373,6 +510,12 @@ class SrtpStreamTable:
         not one per stream (a 10k GCM table is ~340 MB of matrices).
         """
         self._commit_inflight_unprotect()
+        if self._ks_cache is not None:
+            # keys are about to change somewhere in the table: cached
+            # keystream windows may be stale — drop them all (they
+            # refill off-tick; the per-stream served high-water in the
+            # cache survives, preserving never-serve-twice)
+            self._ks_cache.invalidate()
         if not self._aliased:
             self._dev = None
             return
@@ -504,6 +647,8 @@ class SrtpStreamTable:
                 self._masters.pop(int(sid), None)
         self.active[sids] = True
         self._dev = None
+        if self._ks_cache is not None:
+            self._ks_cache.forget(sids)
 
     def _install_session_keys(self, sid: int, ks) -> None:
         """Pack one stream's derived session keys into the device tables
@@ -533,6 +678,8 @@ class SrtpStreamTable:
                                                            np.uint8)
         self._salt_rtcp[sid, p.salt_len:] = 0
         self._dev = None
+        if self._ks_cache is not None:
+            self._ks_cache.forget(sid)
 
     def warmup_rtp(self, batch_size: int, packets_per_stream: int = 4,
                    payload_len: int = 160) -> None:
@@ -549,12 +696,13 @@ class SrtpStreamTable:
                        batch_size // max(packets_per_stream, 1)))
         rng = np.random.default_rng(0)
         sids = np.arange(n)
-        scratch.add_streams(
-            sids, rng.integers(0, 256, (n, self.policy.enc_key_len),
-                               dtype=np.uint8),
-            rng.integers(0, 256, (n, self.policy.salt_len),
-                         dtype=np.uint8))
-        streams = np.repeat(sids, -(-batch_size // n))[:batch_size]
+        mks = rng.integers(0, 256, (n, self.policy.enc_key_len),
+                           dtype=np.uint8)
+        mss = rng.integers(0, 256, (n, self.policy.salt_len),
+                           dtype=np.uint8)
+        scratch.add_streams(sids, mks, mss)
+        pp = -(-batch_size // n)
+        streams = np.repeat(sids, pp)[:batch_size]
         seqs = segment_ranks(streams) + 1
         pls = [b"\x00" * payload_len] * batch_size
         b = rtp_header.build(pls, seqs.tolist(),
@@ -564,6 +712,31 @@ class SrtpStreamTable:
                              stream=streams.tolist())
         wire = scratch.protect_rtp(b)
         scratch.unprotect_rtp(wire)
+        src = self._ks_cache
+        if src is not None and pp < src.window:
+            # cached-path twin: the stock shapes above stay warm (a
+            # cache miss must not compile in a tick), and a primed
+            # scratch cache compiles the fused hit-path kernels plus
+            # the off-tick fill scatter for the same batch shapes.
+            # The rx leg runs on a second table with the same keys —
+            # protect consumes the tx cache's slots, so hitting on
+            # unprotect needs a window of its own.
+            cw = dict(window=src.window, ks_bytes=src.ks_bytes,
+                      pool=src.pool)
+            ssrcs = 0x4000 + sids
+            ctx = scratch.enable_keystream_cache(**cw)
+            ctx.prime(sids, ssrcs)
+            b2 = rtp_header.build(pls, ((seqs + pp) & 0xFFFF).tolist(),
+                                  [0] * batch_size,
+                                  (0x4000 + streams).tolist(),
+                                  [96] * batch_size,
+                                  stream=streams.tolist())
+            wire2 = scratch.protect_rtp(b2)
+            scratch_rx = SrtpStreamTable(self.capacity, self.profile)
+            scratch_rx.add_streams(sids, mks, mss)
+            crx = scratch_rx.enable_keystream_cache(**cw)
+            crx.prime(sids, ssrcs, start=1 + pp)
+            scratch_rx.unprotect_rtp(wire2)
 
     def warmup_rtcp(self, batch_size: int = 1) -> None:
         """Pre-compile the SRTCP protect/unprotect programs for the row
@@ -747,6 +920,8 @@ class SrtpStreamTable:
         self._epoch_rtp[sids] = 0
         self._epoch_rtcp[sids] = 0
         self._dev = None
+        if self._ks_cache is not None:
+            self._ks_cache.forget(sids)
 
     def move_rows(self, src_sids, dst_sids) -> None:
         """Relocate live streams to new rows BIT-EXACT — the crypto half
@@ -790,6 +965,11 @@ class SrtpStreamTable:
             if m is not None:
                 self._masters[int(d)] = m
         self.active[dst] = True
+        if self._ks_cache is not None:
+            # dst inherits src's served high-water: the material is the
+            # same keys under a new row id, and never-serve-twice must
+            # keep holding across the rename
+            self._ks_cache.move(src, dst)
         # masters already relocated; remove_streams zeroes the rest
         self.remove_streams(src)
 
@@ -959,9 +1139,15 @@ class SrtpStreamTable:
         v = idx >> 16
 
         if self._gcm:
-            iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
-            data, length = self._gcm_rtp_protect_call(stream, batch,
-                                                      hdr, iv12)
+            out = (None if self._ks_cache is None
+                   else self._gcm_rtp_protect_cached(stream, batch, hdr,
+                                                     idx))
+            if out is None:
+                iv12 = self._gcm_rtp_iv(self._salt_rtp[stream],
+                                        hdr.ssrc, idx)
+                out = self._gcm_rtp_protect_call(stream, batch, hdr,
+                                                 iv12)
+            data, length = out
         elif self._f8:
             iv = self._f8_rtp_iv(hdr, v)
             data, length = self._f8_rtp_protect_call(stream, batch, hdr,
@@ -1016,6 +1202,85 @@ class SrtpStreamTable:
             tab_rk, tab_gm, jnp.asarray(stream, dtype=jnp.int32),
             jnp.asarray(batch.data), jnp.asarray(length),
             jnp.asarray(hdr.payload_off), jnp.asarray(iv12),
+            aad_const=aad_const)
+
+    def _gcm_grid_dev(self, stream):
+        """(stream_dev, grid_dev-or-None) for this batch's stream
+        pattern, memoized by the pattern bytes.  Purely positional —
+        the grid groups row indices by equal stream values — so rekey /
+        forget / move never invalidate it; only a different batch
+        composition does, and those are rare tick-over-tick.  The memo
+        is keyed by PUBLIC wire data only (stream-id positions), so
+        host branching on it is taint-clean."""
+        pat = stream.tobytes()
+        hit = self._grid_memo.get(pat)
+        if hit is None:
+            sdev = jnp.asarray(stream, dtype=jnp.int32)
+            grid = _gcm_grid(stream)
+            if grid is not None:
+                gr, us, inv = grid
+                grid = (jnp.asarray(gr), jnp.asarray(us, dtype=jnp.int32),
+                        jnp.asarray(inv))
+            if len(self._grid_memo) >= 64:
+                self._grid_memo.clear()
+            hit = self._grid_memo[pat] = (sdev, grid)
+        return hit
+
+    def _gcm_rtp_protect_cached(self, stream, batch, hdr, idx):
+        """Keystream-cache fast path for protect: on an all-rows window
+        hit, run the fused XOR + GHASH kernel on pregenerated keystream
+        and tag-mask rows — no AES launch on the tick.  Returns None on
+        any miss (reorder beyond window, consumed slot, non-uniform
+        AAD, unknown SSRC, oversize payload) and the stock seam runs
+        bit-exactly instead."""
+        aad_const = _uniform_off(hdr.payload_off, batch.capacity)
+        length = np.asarray(batch.length, dtype=np.int64)
+        ct_len = length - (aad_const if aad_const is not None else 0)
+        got = self._ks_cache.claim(stream, hdr.ssrc, idx, ct_len,
+                                   aad_const is not None)
+        if got is None:
+            return None
+        ks_tab, ek_tab, slot = got
+        _, tab_gm, _, _ = self._device()
+        sdev, grid = self._gcm_grid_dev(stream)
+        if grid is not None:
+            gr, us, inv = grid
+            return _registry.call(
+                "gcm_rtp_protect_cached", ks_tab, ek_tab,
+                jnp.asarray(slot), tab_gm, sdev,
+                jnp.asarray(batch.data), jnp.asarray(batch.length),
+                gr, us, inv, aad_const)
+        return _protect_gcm_cached_dev(
+            ks_tab, ek_tab, jnp.asarray(slot), tab_gm, sdev,
+            jnp.asarray(batch.data), jnp.asarray(batch.length),
+            aad_const=aad_const)
+
+    def _gcm_rtp_unprotect_cached(self, stream, batch, hdr, idx, length):
+        """Keystream-cache fast path for unprotect; returns (data,
+        media_len, auth_ok) or None on miss — see
+        `_gcm_rtp_protect_cached`.  The claimed slots are consumed even
+        if authentication later fails: a corrupted packet must not
+        leave its slot claimable by a replayed twin."""
+        aad_const = _uniform_off(hdr.payload_off, batch.capacity)
+        ct_len = (np.asarray(length, dtype=np.int64) - gcm_kernel.TAG_LEN
+                  - (aad_const if aad_const is not None else 0))
+        got = self._ks_cache.claim(stream, hdr.ssrc, idx, ct_len,
+                                   aad_const is not None)
+        if got is None:
+            return None
+        ks_tab, ek_tab, slot = got
+        _, tab_gm, _, _ = self._device()
+        sdev, grid = self._gcm_grid_dev(stream)
+        if grid is not None:
+            gr, us, inv = grid
+            return _registry.call(
+                "gcm_rtp_unprotect_cached", ks_tab, ek_tab,
+                jnp.asarray(slot), tab_gm, sdev,
+                jnp.asarray(batch.data), jnp.asarray(length),
+                gr, us, inv, aad_const)
+        return _unprotect_gcm_cached_dev(
+            ks_tab, ek_tab, jnp.asarray(slot), tab_gm, sdev,
+            jnp.asarray(batch.data), jnp.asarray(length),
             aad_const=aad_const)
 
     def _f8_rtp_protect_call(self, stream, batch, hdr, iv, v):
@@ -1186,9 +1451,15 @@ class SrtpStreamTable:
         idx = self._estimate_rx_indices(stream, hdr.seq)
         v = idx >> 16
         if self._gcm:
-            iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
-            data, mlen, auth_ok = self._gcm_rtp_unprotect_call(
-                stream, batch, hdr, iv12, length)
+            out = (None if self._ks_cache is None
+                   else self._gcm_rtp_unprotect_cached(stream, batch,
+                                                       hdr, idx, length))
+            if out is None:
+                iv12 = self._gcm_rtp_iv(self._salt_rtp[stream],
+                                        hdr.ssrc, idx)
+                out = self._gcm_rtp_unprotect_call(stream, batch, hdr,
+                                                   iv12, length)
+            data, mlen, auth_ok = out
         elif self._f8:
             iv = self._f8_rtp_iv(hdr, v)
             data, mlen, auth_ok = self._f8_rtp_unprotect_call(
@@ -1219,9 +1490,15 @@ class SrtpStreamTable:
         not_replayed = replay.check(self.rx_max, self.rx_mask, stream, idx)
 
         if self._gcm:
-            iv12 = self._gcm_rtp_iv(self._salt_rtp[stream], hdr.ssrc, idx)
-            data, mlen, auth_ok = self._gcm_rtp_unprotect_call(
-                stream, batch, hdr, iv12, length)
+            out = (None if self._ks_cache is None
+                   else self._gcm_rtp_unprotect_cached(stream, batch,
+                                                       hdr, idx, length))
+            if out is None:
+                iv12 = self._gcm_rtp_iv(self._salt_rtp[stream],
+                                        hdr.ssrc, idx)
+                out = self._gcm_rtp_unprotect_call(stream, batch, hdr,
+                                                   iv12, length)
+            data, mlen, auth_ok = out
         elif self._f8:
             iv = self._f8_rtp_iv(hdr, v)
             data, mlen, auth_ok = self._f8_rtp_unprotect_call(
@@ -1581,6 +1858,10 @@ class SrtpStreamTable:
             self._epoch_rtcp = snap["epoch_rtcp"].copy()
             self._masters = dict(snap["masters"])
         self._dev = None
+        if self._ks_cache is not None:
+            # restored keys may differ from every cached epoch: reset
+            # the cache's per-stream history wholesale
+            self._ks_cache.forget(np.arange(self.capacity))
 
 
 class PendingProtect:

@@ -80,6 +80,14 @@ class SrtpTransformEngine(TransformEngine):
         self._rtp = _SrtpRtpTransformer(tx, rx)
         self._rtcp = _SrtpRtcpTransformer(tx, rx)
 
+    def enable_keystream_cache(self, **kwargs):
+        """Attach keystream pregeneration caches to both directions'
+        tables (GCM profiles only) — see
+        `SrtpStreamTable.enable_keystream_cache`.  Returns the
+        (tx, rx) caches; their `fill()` must run between ticks."""
+        return (self.tx.enable_keystream_cache(**kwargs),
+                self.rx.enable_keystream_cache(**kwargs))
+
     @property
     def rtp_transformer(self):
         return self._rtp

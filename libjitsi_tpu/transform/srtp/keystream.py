@@ -1,0 +1,377 @@
+"""Off-tick SRTP-GCM keystream pregeneration cache.
+
+SRTP-GCM's per-packet AES work is fully determined before the packet
+exists: the IV is ``salt ^ (ssrc || roc || seq)`` (RFC 7714 §8.1), so
+for an admitted stream the CTR keystream and the E(K, J0) tag mask for
+the next N packet indices are pure functions of state the table already
+holds.  `KeystreamCache` precomputes them off-tick (riding the
+lifecycle plane's between-ticks window, same zero-data-path-recompile
+discipline as key installs) into a device-resident slot table, and the
+tick-path protect/unprotect serves a fused XOR + GHASH kernel
+(`kernels/gcm.py: gcm_*_cached*`) on window hit — no AES launches on
+the tick at all for cached batches.
+
+Sliding-window layout: each cached stream owns one pool row of
+``window`` slots addressed as a ring (``slot = idx % window``), valid
+while ``base <= idx < base + window``.  ``base`` is predicted off-tick
+as one past the stream's consumption frontier (max of tx index, rx
+high-water, and the cache's own served high-water).
+
+Never-serve-twice argument (the property test's invariant):
+- within a window, a per-slot consumed bitmap is checked under the
+  all-or-nothing batch claim and set before the kernel runs; duplicate
+  slots inside one batch are rejected wholesale;
+- across window slides and whole-cache invalidations, the refill base
+  starts past the per-stream served high-water, which persists until
+  that stream's session keys actually change (`forget`, driven by the
+  table's install/rekey/remove/move seams) — so a given keystream
+  byte sequence (key epoch, ssrc, index) is claimable at most once;
+- a miss (reorder beyond window, ROC estimate disagreement, rekey,
+  consumed slot, SSRC change) falls back to the stock GCM path, which
+  is bit-exact by construction and serves nothing from the cache.
+
+SSRC handling: the GCM IV needs the wire SSRC, which the stream table
+does not store — the cache learns it per row from tick-path headers
+(`observe`; SSRC is public wire data, so host branching on it is
+taint-clean) and only fills rows whose SSRC is known.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from libjitsi_tpu.kernels import gcm as gcm_kernel
+from libjitsi_tpu.kernels.aes import aes_encrypt, ctr_keystream
+
+#: slots per device fill launch — fixed so the off-tick fill compiles
+#: exactly once per cache shape (chunks are padded up to this)
+FILL_CHUNK = 512
+
+
+@functools.partial(jax.jit, static_argnames=("nblocks",))
+def _fill_dev(ks_tab, ek_tab, rk_rows, iv12, slot, nblocks: int):
+    """Scatter freshly generated keystream + tag-mask rows into the
+    cache tables.  Padding entries target the scratch slot (last row),
+    which the serve path never gathers."""
+    j0 = gcm_kernel._j0(jnp.asarray(iv12, dtype=jnp.uint8))
+    ek = aes_encrypt(rk_rows, j0)
+    ks = ctr_keystream(rk_rows, gcm_kernel._inc32(j0), nblocks)
+    return ks_tab.at[slot].set(ks), ek_tab.at[slot].set(ek)
+
+
+class KeystreamCache:
+    """Sliding-window keystream pregeneration for one `SrtpStreamTable`.
+
+    One cache serves one table (i.e. one direction); the pool maps up
+    to ``pool`` stream ids onto rows of ``window`` slots, each slot
+    holding ``ks_bytes`` of CTR keystream plus the 16-byte E(K, J0)
+    tag mask for one packet index.
+    """
+
+    def __init__(self, table, window: int = 64, ks_bytes: int = 256,
+                 pool: Optional[int] = None, debug: bool = False):
+        if not getattr(table, "_gcm", False):
+            raise ValueError("keystream cache requires an AEAD-GCM table")
+        w = int(window)
+        if w < 1 or w & (w - 1):
+            raise ValueError("window must be a power of two")
+        self.table = table
+        self.window = w
+        self.ks_bytes = (int(ks_bytes) + 15) & ~15
+        cap = int(table.capacity)
+        self.pool = int(pool) if pool is not None else min(cap, 128)
+        self.debug = bool(debug)
+        # stream <-> pool-row maps
+        self._row = np.full(cap, -1, dtype=np.int32)
+        self._row_stream = np.full(self.pool, -1, dtype=np.int64)
+        self._free: List[int] = list(range(self.pool - 1, -1, -1))
+        # per-row window state
+        self.base = np.full(self.pool, -1, dtype=np.int64)
+        self.consumed = np.zeros((self.pool, w), dtype=bool)
+        self.ssrc = np.full(self.pool, -1, dtype=np.int64)
+        # per-stream never-reuse state (survives whole-cache
+        # invalidation; reset only when the stream's keys change)
+        self._served_hi = np.full(cap, -1, dtype=np.int64)
+        self._kgen = np.zeros(cap, dtype=np.int64)
+        # counters (exposed as srtp_keystream_* via the lifecycle plane)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.gen = 0
+        self.fill_seconds = 0.0
+        self.filled_slots = 0
+        n = self.pool * w
+        self._scratch_slot = n
+        self._ks_tab = jnp.zeros((n + 1, self.ks_bytes), dtype=jnp.uint8)
+        self._ek_tab = jnp.zeros((n + 1, 16), dtype=jnp.uint8)
+        self._serve_log: Optional[list] = [] if debug else None
+
+    # ------------------------------------------------------------ learn
+
+    def observe(self, stream: np.ndarray, wire_ssrc: np.ndarray) -> None:
+        """Learn per-row SSRCs from tick-path headers and assign pool
+        rows to first-seen streams while the pool lasts.  A row whose
+        SSRC changes is dropped (its window would decrypt nothing)."""
+        stream = np.asarray(stream, dtype=np.int64)
+        wire_ssrc = np.asarray(wire_ssrc, dtype=np.int64)
+        rows = self._row[stream]
+        if self._free and (rows < 0).any():
+            for s in np.unique(stream[rows < 0]):
+                if not self._free:
+                    break
+                s = int(s)
+                if self._row[s] < 0 and self.table.active[s]:
+                    r = self._free.pop()
+                    self._row[s] = r
+                    self._row_stream[r] = s
+            rows = self._row[stream]
+        ok = rows >= 0
+        if not ok.any():
+            return
+        r = rows[ok]
+        v = wire_ssrc[ok]
+        cur = self.ssrc[r]
+        changed = (cur >= 0) & (cur != v)
+        if changed.any():
+            for rc in np.unique(r[changed]):
+                self._drop_window(int(rc))
+        self.ssrc[r] = v
+
+    def _drop_window(self, r: int) -> None:
+        if self.base[r] >= 0:
+            self.evictions += int((~self.consumed[r]).sum())
+        self.base[r] = -1
+        self.consumed[r, :] = False
+
+    # ------------------------------------------------------------ serve
+
+    def claim(self, stream, wire_ssrc, idx, ct_len, aad_ok: bool):
+        """All-or-nothing window claim for one bucketed batch.
+
+        Returns ``(ks_tab, ek_tab, slot)`` device-gather operands when
+        EVERY row hits — the matching slots are marked consumed first,
+        so a slot is never served to two distinct packets (protect or
+        unprotect; in-batch exact-alias rows from size-class padding
+        share one serve) — else None with the miss counter bumped by
+        the batch size."""
+        n = len(stream)
+        if n == 0 or not aad_ok:
+            self.misses += n
+            return None
+        stream = np.asarray(stream, dtype=np.int64)
+        cap = len(self._row)
+        if ((stream < 0) | (stream >= cap)).any():
+            self.misses += n
+            return None
+        wire_ssrc = np.asarray(wire_ssrc, dtype=np.int64)
+        self.observe(stream, wire_ssrc)
+        idx = np.asarray(idx, dtype=np.int64)
+        ct = np.asarray(ct_len, dtype=np.int64)
+        rows = self._row[stream]
+        rows_s = np.clip(rows, 0, self.pool - 1)
+        b = self.base[rows_s]
+        off = idx - b
+        pos = idx % self.window
+        hit = ((rows >= 0) & (b >= 0)
+               & (off >= 0) & (off < self.window)
+               & (ct >= 0) & (ct <= self.ks_bytes)
+               & (self.ssrc[rows_s] == wire_ssrc)
+               & ~self.consumed[rows_s, pos])
+        if not hit.all():
+            self.misses += n
+            return None
+        flat = rows.astype(np.int64) * self.window + pos
+        uniq, first, inv = np.unique(flat, return_index=True,
+                                     return_inverse=True)
+        sel = slice(None)
+        if uniq.size != n:
+            # The same slot twice in one batch.  bucket_by_size pads
+            # size-class sub-batches by CYCLING real rows, so exact
+            # aliases — identical (ssrc, idx, ct) — are the normal
+            # padding case: serve all aliases the one slot (identical
+            # plaintext -> identical ciphertext, exactly what the stock
+            # path emits for pad rows) and consume it once.  Anything
+            # else (an in-batch retransmit with different length) would
+            # pair one keystream with two plaintexts — miss wholesale.
+            rep = first[inv]
+            alias = ((idx == idx[rep]) & (ct == ct[rep])
+                     & (wire_ssrc == wire_ssrc[rep]))
+            if not alias.all():
+                self.misses += n
+                return None
+            sel = np.sort(first)
+        self.consumed[rows, pos] = True
+        np.maximum.at(self._served_hi, stream, idx)
+        self.hits += n
+        if self._serve_log is not None:
+            srv_s, srv_v, srv_i = stream[sel], wire_ssrc[sel], idx[sel]
+            self._serve_log.extend(
+                zip(self._kgen[srv_s].tolist(), srv_s.tolist(),
+                    srv_v.tolist(), srv_i.tolist()))
+        return self._ks_tab, self._ek_tab, flat.astype(np.int32)
+
+    # ------------------------------------------------------------- fill
+
+    def _frontier(self, s: int) -> int:
+        t = self.table
+        return int(max(t.tx_ext[s], t.rx_max[s], self._served_hi[s])) + 1
+
+    def fill(self, max_slots: int = 4096) -> int:
+        """Slide/refill every learned row's window up to the predicted
+        consumption frontier.  Off-tick only: the scatter launch
+        compiles once per cache shape, and chunks are padded to
+        `FILL_CHUNK` so no new shapes appear later.  Returns the number
+        of slots generated."""
+        pairs: List[Tuple[int, int]] = []
+        w = self.window
+        budget = max(int(max_slots), w)
+        for r in np.nonzero(self._row_stream >= 0)[0]:
+            r = int(r)
+            if self.ssrc[r] < 0:
+                continue
+            s = int(self._row_stream[r])
+            if not self.table.active[s]:
+                continue
+            want = self._frontier(s)
+            b = int(self.base[r])
+            if b < 0 or want >= b + w:
+                need = range(want, want + w)
+            elif want > b:
+                need = range(b + w, want + w)
+            else:
+                continue
+            # whole-row granularity: window state only advances together
+            # with its slots' generation (a half-updated row would serve
+            # stale keystream bytes)
+            if pairs and len(pairs) + len(need) > budget:
+                break
+            if b < 0 or want >= b + w:
+                if b >= 0:
+                    self.evictions += int((~self.consumed[r]).sum())
+                self.consumed[r, :] = False
+            else:
+                drop = np.arange(b, want) % w
+                self.evictions += int((~self.consumed[r, drop]).sum())
+                self.consumed[r, drop] = False
+            self.base[r] = want
+            pairs.extend((r, i) for i in need)
+        if pairs:
+            self._generate(pairs)
+        return len(pairs)
+
+    def prime(self, stream, wire_ssrc, start: Optional[int] = None) -> None:
+        """Assign rows, learn SSRCs and fill windows NOW (warmup and
+        steady-state harnesses).  `start` overrides the predicted base
+        for every given stream — needed when priming an rx-side cache
+        for traffic whose indices are already known."""
+        stream = np.asarray(stream, dtype=np.int64)
+        wire_ssrc = np.asarray(wire_ssrc, dtype=np.int64)
+        self.observe(stream, wire_ssrc)
+        if start is None:
+            self.fill(max_slots=len(np.unique(stream)) * self.window)
+            return
+        pairs: List[Tuple[int, int]] = []
+        for s in np.unique(stream):
+            r = int(self._row[int(s)])
+            if r < 0:
+                continue
+            self._drop_window(r)
+            self.base[r] = int(start)
+            pairs.extend((r, i)
+                         for i in range(int(start), int(start) + self.window))
+        if pairs:
+            self._generate(pairs)
+
+    def _generate(self, pairs: List[Tuple[int, int]]) -> None:
+        t0 = time.perf_counter()
+        tbl = self.table
+        nblocks = self.ks_bytes // 16
+        rows = np.asarray([p[0] for p in pairs], dtype=np.int64)
+        idxs = np.asarray([p[1] for p in pairs], dtype=np.int64)
+        streams = self._row_stream[rows]
+        iv12 = gcm_kernel.srtp_gcm_iv(tbl._salt_rtp[streams],
+                                      self.ssrc[rows], idxs)
+        rk_rows = tbl._rk_rtp[streams]
+        slot = (rows * self.window + (idxs % self.window)).astype(np.int32)
+        for lo in range(0, len(slot), FILL_CHUNK):
+            sl = slot[lo:lo + FILL_CHUNK]
+            ivc = iv12[lo:lo + FILL_CHUNK]
+            rkc = rk_rows[lo:lo + FILL_CHUNK]
+            pad = FILL_CHUNK - len(sl)
+            if pad:
+                sl = np.concatenate(
+                    [sl, np.full(pad, self._scratch_slot, np.int32)])
+                ivc = np.concatenate(
+                    [ivc, np.zeros((pad, 12), np.uint8)])
+                rkc = np.concatenate(
+                    [rkc, np.zeros((pad,) + rkc.shape[1:], np.uint8)])
+            self._ks_tab, self._ek_tab = _fill_dev(
+                self._ks_tab, self._ek_tab, jnp.asarray(rkc),
+                jnp.asarray(ivc), jnp.asarray(sl), nblocks)
+        self.filled_slots += len(pairs)
+        self.fill_seconds += time.perf_counter() - t0
+
+    # ------------------------------------------------------ invalidation
+
+    def invalidate(self) -> None:
+        """Whole-cache window drop — called from the table's
+        copy-on-write seam, through which every key mutation funnels.
+        Windows refill off-tick; the per-stream served high-water
+        persists, so a refilled window never re-covers an index this
+        stream already consumed under the same keys."""
+        live = self.base >= 0
+        if live.any():
+            self.evictions += int((~self.consumed[live]).sum())
+        self.base[:] = -1
+        self.consumed[:] = False
+        self.gen += 1
+
+    def forget(self, stream) -> None:
+        """Per-stream key-epoch bump: the stream's session keys changed
+        (install / kdr rekey / removal), so its served high-water resets
+        — the new keys produce different keystream for every index —
+        and its pool row is released."""
+        for s in np.atleast_1d(np.asarray(stream, dtype=np.int64)):
+            s = int(s)
+            if not (0 <= s < len(self._row)):
+                continue
+            self._kgen[s] += 1
+            self._served_hi[s] = -1
+            r = int(self._row[s])
+            if r >= 0:
+                self._drop_window(r)
+                self._row[s] = -1
+                self._row_stream[r] = -1
+                self.ssrc[r] = -1
+                self._free.append(r)
+
+    def move(self, src, dst) -> None:
+        """Row move (placement rebalance): the keys previously at `src`
+        now live at `dst`, so `dst` inherits `src`'s served high-water
+        — the material is the same, and never-twice must keep holding
+        across the rename."""
+        src = np.atleast_1d(np.asarray(src, dtype=np.int64))
+        dst = np.atleast_1d(np.asarray(dst, dtype=np.int64))
+        hi = self._served_hi[src].copy()
+        self.forget(src)
+        self.forget(dst)
+        np.maximum.at(self._served_hi, dst, hi)
+
+    # ----------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        return {
+            "hits": self.hits, "misses": self.misses,
+            "evictions": self.evictions, "gen": self.gen,
+            "filled_slots": self.filled_slots,
+            "fill_seconds": round(self.fill_seconds, 6),
+            "rows_live": int((self._row_stream >= 0).sum()),
+            "window": self.window, "ks_bytes": self.ks_bytes,
+            "pool": self.pool,
+        }

@@ -9,107 +9,33 @@ Meanwhile `kernels/aes.py` said bitsliced32 measured *at parity* with
 the addition-chain bitslice.  Both claims cannot be true, and neither
 was trustworthy.
 
-The fix, applied here and in bench.py's `_time_fn`: run the core k
-times inside ONE jitted program with a data dependence (each
-iteration's ciphertext becomes the next iteration's plaintext), so XLA
-cannot elide any round and the measured span grows with k.  k is
-doubled until the net span is >= FLOOR_MULT x the measured fetch-floor
-jitter; per-block time is then (elapsed - floor) / (k * batch).  A
-core that cannot reach the jitter bar inside the budget reports
-"below_floor", never a number.
+The fix: run the core k times inside ONE jitted program with a data
+dependence (each iteration's ciphertext becomes the next iteration's
+plaintext), so XLA cannot elide any round and the measured span grows
+with k.  k is doubled until the net span is >= FLOOR_MULT x the
+measured fetch-floor jitter; per-block time is then
+(elapsed - floor) / (k * batch).  A core that cannot reach the jitter
+bar inside the budget reports "below_floor", never a number.
+
+The measurement library itself lives in `kernels/registry.py`
+(aes_floor_stats / aes_chained / measure_aes_core[s]) so
+`aes.py:get_core()` can consume a cached record instead of a hardcoded
+default; this script is the CLI wrapper.
 
 Usage:  python scripts/bench_aes_cores.py [--batch 4096] [--budget 60]
-Prints one JSON object; exit 0 on success, 2 on harness error.
+                                          [--write-record]
+Prints one JSON object; `--write-record` additionally merges the
+result into the `_meta`-stamped AES_CORES.json at the repo root (the
+record `kernels/aes.py:get_core()` picks the core from).  Exit 0 on
+success, 2 on harness error.
 """
 
 import argparse
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-import numpy as np  # noqa: E402
-
-FLOOR_MULT = 10.0      # net span must exceed this x floor jitter
-SAMPLES = 5
-
-
-def _floor_stats():
-    """Median + spread (max-min) of the 4-byte verification fetch on a
-    trivial program — the spread is the jitter bar every measurement
-    must clear."""
-    import jax
-    import jax.numpy as jnp
-
-    g = jax.jit(lambda x: jnp.sum(x))
-    x = jnp.arange(8, dtype=jnp.uint32)
-    np.asarray(g(x))                        # compile + prime
-    samples = []
-    for _ in range(9):
-        t0 = time.perf_counter()
-        np.asarray(g(x))
-        samples.append(time.perf_counter() - t0)
-    arr = np.asarray(samples)
-    return float(np.median(arr)), float(arr.max() - arr.min())
-
-
-def _chained(fn, rks, k):
-    """jit( blocks -> checksum(fn applied k times, chained) ).
-
-    The loop-carried value is the block batch itself: round i's output
-    is round i+1's input, so dead-code elimination cannot drop work and
-    the program's span scales with k."""
-    import jax
-    import jax.numpy as jnp
-    from jax import lax
-
-    def body(_i, blk):
-        return fn(rks, blk)
-
-    def prog(blk):
-        out = lax.fori_loop(0, k, body, blk)
-        return jnp.sum(out.astype(jnp.uint32))
-
-    return jax.jit(prog)
-
-
-def measure_core(name, fn, rks, blocks, floor, jitter, deadline):
-    """Blocks/s for one core, or a refusal record.  Doubles the chain
-    length until the net span clears the jitter bar."""
-    b = blocks.shape[0]
-    k = 4
-    while True:
-        if time.monotonic() > deadline:
-            return {"status": "skipped: budget", "chain_k": k}
-        try:
-            g = _chained(fn, rks, k)
-            np.asarray(g(blocks))           # compile + prime
-            spans = []
-            for _ in range(SAMPLES):
-                t0 = time.perf_counter()
-                np.asarray(g(blocks))
-                spans.append(time.perf_counter() - t0)
-                if time.monotonic() > deadline:
-                    break
-        except Exception as e:              # lowering refusal, recorded
-            return {"status": f"error: {type(e).__name__}"}
-        net = float(np.median(spans)) - floor
-        if net >= FLOOR_MULT * jitter:
-            return {
-                "status": "ok",
-                "blocks_per_sec": round(b * k / net, 1),
-                "chain_k": k,
-                "net_span_ms": round(net * 1e3, 3),
-                "floor_jitter_ms": round(jitter * 1e3, 3),
-            }
-        if k >= 1 << 16:
-            # even 65k chained rounds sit inside the floor jitter:
-            # the honest answer is a bound, not a rate
-            return {"status": "below_floor", "chain_k": k,
-                    "net_span_ms": round(net * 1e3, 3)}
-        k *= 2
 
 
 def main():
@@ -117,42 +43,28 @@ def main():
     ap.add_argument("--batch", type=int, default=4096)
     ap.add_argument("--budget", type=float, default=60.0,
                     help="seconds per core")
+    ap.add_argument("--write-record", action="store_true",
+                    help="merge the result into AES_CORES.json (the "
+                         "measured-pick record get_core() reads)")
     args = ap.parse_args()
 
     import jax
-    import jax.numpy as jnp
 
-    from libjitsi_tpu.kernels.aes import (aes_encrypt_table,
-                                          expand_keys_batch)
-    from libjitsi_tpu.kernels.aes_bitsliced import (
-        aes_encrypt_bitsliced, aes_encrypt_bitsliced32,
-        aes_encrypt_bitsliced_tower, aes_encrypt_pallas_bitsliced)
+    from libjitsi_tpu.kernels import registry
 
-    rng = np.random.default_rng(21)
-    rks = jnp.asarray(expand_keys_batch(
-        rng.integers(0, 256, (args.batch, 16), dtype=np.uint8)))
-    blocks = jnp.asarray(
-        rng.integers(0, 256, (args.batch, 16), dtype=np.uint8))
-
-    floor, jitter = _floor_stats()
-    out = {
-        "backend": jax.default_backend(),
-        "batch": args.batch,
-        "fetch_floor_ms": round(floor * 1e3, 3),
-        "floor_jitter_ms": round(jitter * 1e3, 3),
-        "method": ("k chained (data-dependent) encrypts per program; "
-                   f"k doubled until net span >= {FLOOR_MULT}x floor "
-                   "jitter"),
-        "cores": {},
-    }
-    for name, fn in (("xla_table", aes_encrypt_table),
-                     ("xla_bitsliced", aes_encrypt_bitsliced),
-                     ("xla_bitsliced_tower", aes_encrypt_bitsliced_tower),
-                     ("xla_bitsliced32", aes_encrypt_bitsliced32),
-                     ("pallas_bitsliced", aes_encrypt_pallas_bitsliced)):
-        deadline = time.monotonic() + args.budget
-        out["cores"][name] = measure_core(
-            name, fn, rks, blocks, floor, jitter, deadline)
+    if args.write_record:
+        rec = registry.write_aes_record(batch=args.batch,
+                                        budget=args.budget)
+        picked = registry.measured_aes_core()
+    else:
+        rec = registry.measure_aes_cores(batch=args.batch,
+                                         budget=args.budget)
+        picked = None
+    out = dict(rec)
+    out["backend"] = jax.default_backend()
+    if args.write_record:
+        out["record"] = registry.aes_record_path()
+        out["picked_core"] = picked
     print(json.dumps(out, indent=2))
 
 

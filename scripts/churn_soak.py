@@ -52,9 +52,9 @@ from libjitsi_tpu.utils.faults import (  # noqa: E402
     ChurnModel, DiurnalProfile, TalkSpurtModel)
 
 
-def _keys(b: int):
+def _keys(b: int, salt_len: int = 14):
     """Deterministic (master key, master salt) from one byte seed."""
-    return (bytes([b & 0xFF]) * 16, bytes([(b + 1) & 0xFF]) * 14)
+    return (bytes([b & 0xFF]) * 16, bytes([(b + 1) & 0xFF]) * salt_len)
 
 
 class _Probe:
@@ -66,13 +66,15 @@ class _Probe:
     FIRST_SEQ = 1000
 
     def __init__(self, ssrc: int, bridge_port: int, n_probes: int,
-                 seed: int):
+                 seed: int, profile=None):
         self.ssrc = ssrc
-        self.rx_key = _keys(ssrc & 0xFF)
-        self.tx_key = _keys((ssrc + 2) & 0xFF)
-        self.protect = SrtpStreamTable(capacity=1)
+        tkw = {} if profile is None else {"profile": profile}
+        salt_len = 14 if profile is None else profile.policy.salt_len
+        self.rx_key = _keys(ssrc & 0xFF, salt_len)
+        self.tx_key = _keys((ssrc + 2) & 0xFF, salt_len)
+        self.protect = SrtpStreamTable(capacity=1, **tkw)
         self.protect.add_stream(0, *self.rx_key)
-        self.open = SrtpStreamTable(capacity=max(4, n_probes))
+        self.open = SrtpStreamTable(capacity=max(4, n_probes), **tkw)
         self.row_of = {}
         self.engine = UdpEngine(port=0, max_batch=256)
         self.bridge_port = bridge_port
@@ -184,14 +186,34 @@ def run_soak(duration_s: float = 30.0, ramp_s: float = 6.0,
              target_events_per_sec: float = 500.0,
              residual_bound: float = 0.01,
              p99_factor_bound: float = 2.0, seed: int = 0,
+             gcm: bool = False,
              verbose: bool = True, report_path=None) -> dict:
-    """Run the soak; returns the report dict (every `ok_*` must hold)."""
+    """Run the soak; returns the report dict (every `ok_*` must hold).
+
+    `gcm` swaps the whole wire onto AEAD_AES_128_GCM and enables the
+    keystream pregeneration cache on both bridge tables — the same
+    acceptance invariants then cover the cached crypto fast path (in
+    particular ZERO data-path recompiles: fills and fused-hit kernels
+    must ride the pre-warmed ladder, never compile inside a tick)."""
     import jax
+
+    from libjitsi_tpu.transform.srtp.policy import SrtpProfile
 
     libjitsi_tpu.stop()
     libjitsi_tpu.init()
+    profile = SrtpProfile.AEAD_AES_128_GCM if gcm else None
+    salt_len = 14 if profile is None else profile.policy.salt_len
+    bkw = {} if profile is None else {"profile": profile}
     cfg = libjitsi_tpu.configuration_service()
-    bridge = SfuBridge(cfg, port=0, capacity=capacity, recv_window_ms=0)
+    bridge = SfuBridge(cfg, port=0, capacity=capacity, recv_window_ms=0,
+                       **bkw)
+    ks_caches = []
+    if gcm:
+        for t in (bridge.rx_table, bridge.tx_table):
+            # single-chip tables only: the mesh subclasses override the
+            # GCM seams and must never see a cache consult ahead of them
+            if type(t) is SrtpStreamTable:
+                ks_caches.append(t.enable_keystream_cache(window=256))
     reg = bridge.loop.metrics
     sup = BridgeSupervisor(
         bridge,
@@ -205,7 +227,8 @@ def run_soak(duration_s: float = 30.0, ramp_s: float = 6.0,
     t0_wall = time.perf_counter()
 
     # ---- probes join through the lifecycle plane like anyone else
-    plist = [_Probe(0x50 + 11 * k, bridge.port, probes, seed + 10 + k)
+    plist = [_Probe(0x50 + 11 * k, bridge.port, probes, seed + 10 + k,
+                    profile=profile)
              for k in range(probes)]
     for p in plist:
         accepted, why = lc.request_join(p.ssrc, p.rx_key, p.tx_key,
@@ -309,7 +332,8 @@ def run_soak(duration_s: float = 30.0, ramp_s: float = 6.0,
                 ssrc = next_ssrc
                 next_ssrc += 1
                 ok_j, _why = lc.request_join(
-                    ssrc, _keys(ssrc & 0xFF), _keys((ssrc + 2) & 0xFF))
+                    ssrc, _keys(ssrc & 0xFF, salt_len),
+                    _keys((ssrc + 2) & 0xFF, salt_len))
                 if ok_j:
                     alive.append(ssrc)
             if leaves and alive:
@@ -396,6 +420,15 @@ def run_soak(duration_s: float = 30.0, ramp_s: float = 6.0,
         "rtx_cache_miss": bridge.recovery.rtx_cache_miss,
         "retransmitted": bridge.retransmitted,
         "residual_loss_ratio": round(residual, 5),
+        "profile": bridge.profile.name,
+        "keystream_cache": (None if not ks_caches else {
+            "hits": sum(c.hits for c in ks_caches),
+            "misses": sum(c.misses for c in ks_caches),
+            "evictions": sum(c.evictions for c in ks_caches),
+            "filled_slots": sum(c.filled_slots for c in ks_caches),
+            "fill_seconds": round(sum(c.fill_seconds
+                                      for c in ks_caches), 4),
+        }),
         # ---- invariants
         "ok_zero_datapath_recompiles": window_recompiles == 0,
         "ok_protect_p99_bounded":
@@ -623,6 +656,11 @@ def main() -> int:
                     help="write the JSON report here")
     ap.add_argument("--smoke", action="store_true",
                     help="fast tier-1 configuration (~3 s model time)")
+    ap.add_argument("--gcm", action="store_true",
+                    help="AEAD-GCM wire with the keystream "
+                         "pregeneration cache enabled on both bridge "
+                         "tables (zero-recompile acceptance for the "
+                         "cached crypto fast path)")
     ap.add_argument("--broadcast", action="store_true",
                     help="broadcast-conference mode: Poisson listener "
                          "churn on one hierarchical conference")
@@ -658,7 +696,7 @@ def main() -> int:
               target_events_per_sec=args.target_events,
               residual_bound=args.residual_bound,
               p99_factor_bound=args.p99_factor, seed=args.seed,
-              report_path=args.report)
+              gcm=args.gcm, report_path=args.report)
     if args.smoke:
         kw.update(duration_s=2.0, ramp_s=1.0, join_rate_hz=60.0,
                   mean_hold_s=0.5, capacity=128, probes=2,
