@@ -308,23 +308,29 @@ def _scenario_protect_cached():
     off-tick).  Seqs are unique per stream (a GCM requirement the
     AES-CM twin doesn't have) and the window is primed to cover all
     reps.  The scenario asserts zero misses at the end, so a silently
-    degraded cache can never pose as a fast one.  Returns pps."""
+    degraded cache can never pose as a fast one.  One 6-rep chain is
+    only ~15 ms of work and the dispatch path keeps warming for the
+    first ~4 chains (measured: 69k -> 115k pps over 10 passes), so a
+    few UNTIMED warm passes run first and the pps is the MEDIAN over
+    the timed ones (fresh seqs every pass; GCM never reuses an
+    index).  Returns pps."""
     from libjitsi_tpu.rtp import header as rtp_header
     from libjitsi_tpu.transform.srtp import SrtpStreamTable
     from libjitsi_tpu.transform.srtp.policy import SrtpProfile
 
-    n_streams, bsz, reps = 8, 256, 6
+    n_streams, bsz, reps, passes, warm = 8, 256, 6, 5, 3
     per = bsz // n_streams
     rng = np.random.default_rng(11)
     tab = SrtpStreamTable(64, SrtpProfile.AEAD_AES_128_GCM)
     mks = rng.integers(0, 256, (n_streams, 16), dtype=np.uint8)
     mss = rng.integers(0, 256, (n_streams, 12), dtype=np.uint8)
     tab.add_streams(np.arange(n_streams), mks, mss)
-    cache = tab.enable_keystream_cache(window=256)
+    cache = tab.enable_keystream_cache(window=2048)
     cache.prime(np.arange(n_streams), 0x20000 + np.arange(n_streams),
                 start=1)
+    n_batches = (warm + passes) * reps + 1
     batches = []
-    for k in range(reps + 1):
+    for k in range(n_batches):
         streams = np.repeat(np.arange(n_streams), per)
         seqs = np.tile(np.arange(per), n_streams) + k * per + 1
         b = rtp_header.build(
@@ -333,17 +339,23 @@ def _scenario_protect_cached():
             stream=streams.tolist())
         batches.append(b)
     _ = tab.protect_rtp(batches[0])         # compile warmup
-    t0 = time.perf_counter()
-    acc = 0
-    for b in batches[1:]:
-        out = tab.protect_rtp(b)
-        acc += int(np.asarray(out.length)[0])   # force materialization
-    net = time.perf_counter() - t0
-    assert acc >= 0
-    assert cache.misses == 0 and cache.hits == (reps + 1) * bsz, (
+    rates, nets = [], []
+    for p in range(warm + passes):
+        t0 = time.perf_counter()
+        acc = 0
+        for b in batches[1 + p * reps:1 + (p + 1) * reps]:
+            out = tab.protect_rtp(b)
+            acc += int(np.asarray(out.length)[0])  # force materialization
+        net = time.perf_counter() - t0
+        assert acc >= 0
+        if p >= warm:
+            rates.append(reps * bsz / net)
+            nets.append(net)
+    assert cache.misses == 0 and cache.hits == n_batches * bsz, (
         f"cached scenario degraded to the stock path: "
         f"hits={cache.hits} misses={cache.misses}")
-    return floor_check(reps * bsz / net, net)
+    mid = int(np.argsort(rates)[len(rates) // 2])
+    return floor_check(rates[mid], nets[mid])
 
 
 def _scenario_install_streams():
