@@ -16,7 +16,9 @@ from __future__ import annotations
 
 import hashlib
 import os
-from typing import List, Optional, Tuple
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
 
 try:
     from cryptography import x509
@@ -326,6 +328,197 @@ class DtlsSrtpEndpoint:
         return profile, sk, ss, ck, cs
 
 
+class StubDtlsEndpoint:
+    """Dependency-free stand-in for `DtlsSrtpEndpoint` with the same
+    wire surface: `handshake_packets` / `feed` / `tick` / `complete` /
+    `progressed` / `srtp_keys` / `selected_profile`.
+
+    NOT DTLS and NOT secure — keys are a public hash of the two hello
+    randoms.  It exists so the association table, the off-tick
+    handshake plane and the reconnect-storm chaos soak can exercise
+    real datagram flows (cookie round-trips, flight retransmission,
+    address claiming/supersede, key landing) in environments without
+    the `cryptography` package, where `DtlsSrtpEndpoint` raises at
+    construction.  Every record's first byte sits in the RFC 5764
+    demux range [20, 63] so `is_dtls` routing is identical.
+
+    Handshake shape (mirrors the real flights' roles):
+      hello   C->S  small; carries client random + offered profiles
+      verify  S->C  small; cookie challenge (cookie_exchange only) —
+                    like a HelloVerifyRequest it never flips
+                    `progressed`, so spoofed-source hellos still lose
+                    the supersede race in `DtlsAssociationTable._claim`
+      accept  S->C  LARGE (padded cert: crosses the `progressed` line
+                    exactly like a real ServerHello+Certificate flight)
+      finish  C->S  carries the client cert for fingerprint pinning
+      done    S->C  completes the client side
+    """
+
+    _HELLO, _VERIFY, _ACCEPT, _FINISH, _DONE = 58, 59, 60, 61, 62
+    FLIGHT_TIMEOUT_S = 0.25        # initial retransmission timer
+    #: stable 1-byte wire ids (enum declaration order)
+    _PROFILE_ID = {p: i for i, p in enumerate(SrtpProfile)}
+
+    def __init__(self, role: str,
+                 profiles: Optional[List[SrtpProfile]] = None,
+                 cert_der: Optional[bytes] = None,
+                 key_der: Optional[bytes] = None,
+                 remote_fingerprint: Optional[str] = None,
+                 mtu: int = 1200,
+                 cookie_exchange: bool = False):
+        if role not in ("client", "server"):
+            raise ValueError("role must be client or server")
+        self.role = role
+        self.profiles = profiles or [
+            SrtpProfile.AES_CM_128_HMAC_SHA1_80,
+            SrtpProfile.AEAD_AES_128_GCM,
+        ]
+        self._rand = os.urandom(16)
+        self.cert_der = cert_der or (b"stub-cert:" + self._rand)
+        self.local_fingerprint = fingerprint(self.cert_der)
+        self.remote_fingerprint = remote_fingerprint
+        self.peer_cert_der: Optional[bytes] = None
+        self.complete = False
+        self.progressed = False
+        self.retransmits = 0
+        self.cookie_exchange = bool(cookie_exchange)
+        self._cookie = os.urandom(8) if role == "server" else b"\x00" * 8
+        self._peer_rand: Optional[bytes] = None
+        self._profile: Optional[SrtpProfile] = None
+        self._flight: List[bytes] = []
+        self._flight_t = 0.0
+        self._timeout = self.FLIGHT_TIMEOUT_S
+        self._out_bytes = 0
+
+    # ------------------------------------------------------------ records
+    def _hello(self) -> bytes:
+        ids = bytes(self._PROFILE_ID[p] for p in self.profiles)
+        return (bytes([self._HELLO]) + self._rand + self._cookie
+                + bytes([len(ids)]) + ids)
+
+    def _accept(self) -> bytes:
+        cert = self.cert_der
+        body = (bytes([self._ACCEPT]) + self._rand
+                + bytes([self._PROFILE_ID[self._profile]])
+                + len(cert).to_bytes(2, "big") + cert)
+        return body + b"\x00" * max(0, 400 - len(body))  # cert-flight size
+
+    def _set_flight(self, datagrams: List[bytes]) -> List[bytes]:
+        self._flight = list(datagrams)
+        self._flight_t = time.monotonic()
+        self._timeout = self.FLIGHT_TIMEOUT_S
+        return self._note_out(list(datagrams))
+
+    def _note_out(self, out: List[bytes]) -> List[bytes]:
+        self._out_bytes += sum(len(d) for d in out)
+        if self.complete or self._out_bytes > 300:
+            self.progressed = True
+        return out
+
+    def _check_fingerprint(self, cert: bytes) -> None:
+        self.peer_cert_der = cert
+        if self.remote_fingerprint is not None:
+            got = fingerprint(cert)
+            if got != self.remote_fingerprint.upper():
+                raise RuntimeError(
+                    f"DTLS fingerprint mismatch: {got} != "
+                    f"{self.remote_fingerprint} (possible MITM)")
+
+    # -------------------------------------------------------------- pumps
+    def handshake_packets(self) -> List[bytes]:
+        if self.complete:
+            return []
+        if self.role == "client" and not self._flight:
+            return self._set_flight([self._hello()])
+        return self._note_out(list(self._flight))
+
+    def feed(self, datagram: bytes) -> List[bytes]:
+        if not datagram:
+            return []
+        kind = datagram[0]
+        if self.role == "server":
+            if kind == self._HELLO:
+                rand, cookie = datagram[1:17], datagram[17:25]
+                if self.cookie_exchange and cookie != self._cookie:
+                    # stateless challenge: tiny, never "progresses"
+                    return self._note_out(
+                        [bytes([self._VERIFY]) + self._cookie])
+                n = datagram[25]
+                offered = set(datagram[26:26 + n])
+                self._peer_rand = rand
+                self._profile = next(
+                    (p for p in self.profiles
+                     if self._PROFILE_ID[p] in offered),
+                    self.profiles[0])
+                return self._set_flight([self._accept()])
+            if kind == self._FINISH:
+                clen = int.from_bytes(datagram[1:3], "big")
+                self._check_fingerprint(datagram[3:3 + clen])
+                self.complete = True
+                self.progressed = True
+                self._flight = []
+                return self._note_out([bytes([self._DONE])])
+            return []
+        # client
+        if kind == self._VERIFY:
+            self._cookie = datagram[1:9]
+            return self._set_flight([self._hello()])
+        if kind == self._ACCEPT:
+            self._peer_rand = datagram[1:17]
+            pid = datagram[17]
+            self._profile = next(
+                (p for p in self.profiles
+                 if self._PROFILE_ID[p] == pid), self.profiles[0])
+            clen = int.from_bytes(datagram[18:20], "big")
+            self._check_fingerprint(datagram[20:20 + clen])
+            cert = self.cert_der
+            return self._set_flight(
+                [bytes([self._FINISH]) + len(cert).to_bytes(2, "big")
+                 + cert])
+        if kind == self._DONE:
+            self.complete = True
+            self.progressed = True
+            self._flight = []
+        return []
+
+    def tick(self) -> List[bytes]:
+        if self.complete or not self._flight:
+            return []
+        now = time.monotonic()
+        if now - self._flight_t < self._timeout:
+            return []
+        self._flight_t = now
+        self._timeout *= 2.0           # RFC 6347-style doubling backoff
+        self.retransmits += 1
+        return self._note_out(list(self._flight))
+
+    # ---------------------------------------------------------- key export
+    @property
+    def selected_profile(self) -> SrtpProfile:
+        if self._profile is None:
+            raise RuntimeError("no SRTP profile negotiated")
+        return self._profile
+
+    def srtp_keys(self):
+        if not self.complete:
+            raise RuntimeError("handshake not complete")
+        profile = self.selected_profile
+        p = profile.policy
+        kl, sl = p.enc_key_len, p.salt_len
+        cr, sr = ((self._rand, self._peer_rand)
+                  if self.role == "client"
+                  else (self._peer_rand, self._rand))
+        seed = b"stub-dtls-export" + cr + sr
+        blob = (hashlib.sha256(seed).digest()
+                + hashlib.sha256(seed + b"\x01").digest())
+        ck, sk = blob[:kl], blob[kl:2 * kl]
+        cs = blob[2 * kl:2 * kl + sl]
+        ss = blob[2 * kl + sl:2 * (kl + sl)]
+        if self.role == "client":
+            return profile, ck, cs, sk, ss
+        return profile, sk, ss, ck, cs
+
+
 class DtlsAssociationTable:
     """Pending DTLS-SRTP associations for a bridge's media loop.
 
@@ -335,25 +528,51 @@ class DtlsAssociationTable:
     its own tables.  Shared by ConferenceBridge and SfuBridge so the
     association logic exists exactly once.  Reference:
     DtlsPacketTransformer + DtlsControlImpl (SURVEY §3.5).
+
+    Two execution modes:
+
+    * inline (default, `deferred=False`): `on_dtls` runs OpenSSL work
+      and key install synchronously on the calling (tick) thread —
+      the original behavior, kept for bridges without a lifecycle
+      manager.
+    * deferred (`deferred=True`, flipped by the lifecycle plane's
+      HandshakeQueue): `on_dtls` only ENQUEUES the datagram into a
+      bounded inbox and returns nothing; `process(budget)` drains the
+      inbox in bounded batches on the between-ticks window, and key
+      landing goes through the staged commit barrier (the install
+      callback stages; `release_stream` happens at commit).  The tick
+      thread never touches OpenSSL.
     """
 
-    def __init__(self, loop, profile: SrtpProfile, install):
+    def __init__(self, loop, profile: SrtpProfile, install,
+                 deferred: bool = False, inbox_limit: int = 8192,
+                 endpoint_factory=None):
         self.loop = loop
         self.profile = profile
         self.install = install
+        # same-surface endpoint constructor; swap in StubDtlsEndpoint
+        # for environments without the `cryptography` package
+        self.endpoint_factory = endpoint_factory or DtlsSrtpEndpoint
         self.pending = {}              # sid -> DtlsSrtpEndpoint
         self.addr_of = {}              # (ip, port) -> sid
         self.sid_addr = {}             # sid -> (ip, port)  (companion)
         self.rejected = 0              # fingerprint-mismatch teardowns
+        self.deferred = bool(deferred)
+        self.inbox_limit = int(inbox_limit)
+        self._inbox: "deque" = deque()  # (datagram, addr) awaiting drain
+        self.inbox_dropped = 0         # inbox overflow (storm past bound)
+        self.retransmits_total = 0     # flight datagrams resent by tick()
+        self.feeds_total = 0           # OpenSSL feed() calls (any thread)
+        self.handshakes_completed = 0
 
     def join(self, sid: int, role: str = "server",
              remote_fingerprint: Optional[str] = None,
              cookie_exchange: bool = False,
              remote_addr: Optional[Tuple[int, int]] = None
              ) -> "DtlsSrtpEndpoint":
-        ep = DtlsSrtpEndpoint(role, profiles=[self.profile],
-                              remote_fingerprint=remote_fingerprint,
-                              cookie_exchange=cookie_exchange)
+        ep = self.endpoint_factory(role, profiles=[self.profile],
+                                   remote_fingerprint=remote_fingerprint,
+                                   cookie_exchange=cookie_exchange)
         self.pending[sid] = ep
         if remote_addr is not None:
             # signaling-known peer address: bind now, no guessing later
@@ -390,6 +609,17 @@ class DtlsAssociationTable:
 
     def on_dtls(self, datagram: bytes, addr) -> list:
         addr = tuple(addr)
+        if self.deferred:
+            # tick-thread contract: no OpenSSL here — enqueue only.
+            # Replies go out from process() on the between-ticks window.
+            if len(self._inbox) >= self.inbox_limit:
+                self.inbox_dropped += 1
+                return []
+            self._inbox.append((bytes(datagram), addr))
+            return []
+        return self._process_one(datagram, addr)
+
+    def _process_one(self, datagram: bytes, addr) -> list:
         sid = self.addr_of.get(addr)
         if sid is None:
             sid = self._claim(addr)
@@ -400,6 +630,7 @@ class DtlsAssociationTable:
         if ep is None:
             return []
         try:
+            self.feeds_total += 1
             out = ep.feed(datagram)
         except RuntimeError as e:
             # fingerprint mismatch (wrong peer / MITM): drop the
@@ -417,28 +648,83 @@ class DtlsAssociationTable:
             # un-pend BEFORE install: install hooks (e.g. SFU route
             # rebuild) must see this row as keyed
             self.pending.pop(sid, None)
+            self.handshakes_completed += 1
             self.install(sid, ep)
-            self.loop.release_stream(sid)
+            if not self.deferred:
+                # deferred mode stages the keys instead; the commit
+                # barrier releases held early media atomically
+                self.loop.release_stream(sid)
         return out
 
-    def tick(self) -> None:
-        """Drive retransmission timers; resend expired flights."""
+    def process(self, budget: Optional[int] = None) -> int:
+        """Drain up to `budget` queued datagrams (all when None) — the
+        off-tick OpenSSL pass for deferred mode.  Replies gather per
+        peer address: one PacketBatch/send_batch per address per pass,
+        not one per datagram."""
         from libjitsi_tpu.core.packet import PacketBatch
 
+        n = len(self._inbox)
+        if budget is not None:
+            n = min(n, max(0, int(budget)))
+        if n <= 0:
+            return 0
+        by_addr: Dict[Tuple[int, int], List[bytes]] = {}
+        for _ in range(n):
+            datagram, addr = self._inbox.popleft()
+            out = self._process_one(datagram, addr)
+            if out:
+                by_addr.setdefault(addr, []).extend(out)
+        for addr, datagrams in by_addr.items():
+            self.loop.engine.send_batch(
+                PacketBatch.from_payloads(datagrams), addr[0], addr[1])
+        return n
+
+    def tick(self, stride: int = 1, phase: int = 0) -> int:
+        """Flight-retransmission pass: drive RFC 6347 timers and resend
+        expired flights, gathered into one PacketBatch per peer address.
+        `stride`/`phase` let the off-tick drain service only 1/stride of
+        the associations per pass (keyed on sid), spreading a storm's
+        flight timers so retransmissions never resend in lockstep — the
+        jitter that honors exponential client backoff.  Returns the
+        number of datagrams resent."""
+        from libjitsi_tpu.core.packet import PacketBatch
+
+        stride = max(1, int(stride))
+        by_addr: Dict[Tuple[int, int], List[bytes]] = {}
         for sid, ep in list(self.pending.items()):
+            if stride > 1 and (sid % stride) != (phase % stride):
+                continue
             out = ep.tick()
             if not out:
                 continue
             addr = self.sid_addr.get(sid)
             if addr is None:
                 continue
-            for d in out:
-                self.loop.engine.send_batch(
-                    PacketBatch.from_payloads([d]), addr[0], addr[1])
+            by_addr.setdefault(addr, []).extend(out)
+        sent = 0
+        for addr, datagrams in by_addr.items():
+            self.loop.engine.send_batch(
+                PacketBatch.from_payloads(datagrams), addr[0], addr[1])
+            sent += len(datagrams)
+        self.retransmits_total += sent
+        return sent
+
+    @property
+    def backlog(self) -> int:
+        """Queued datagrams + pending associations: the admission-facing
+        depth of the handshake plane."""
+        return len(self._inbox) + len(self.pending)
 
     def forget(self, sid: int) -> None:
         self.pending.pop(sid, None)
         addr = self.sid_addr.pop(sid, None)
         if addr is not None:
             self.addr_of.pop(addr, None)
+            if self._inbox:
+                # purge queued datagrams from the forgotten 5-tuple:
+                # with recycled addresses (forget -> rejoin same
+                # ip:port) a stale ClientHello must never feed the row
+                # that later claims the address
+                self._inbox = deque(
+                    (d, a) for d, a in self._inbox if a != addr)
         self.loop.discard_stream(sid)

@@ -191,30 +191,67 @@ class ZidCache:
 
     In-memory; `snapshot()`/`restore()` give the caller a serializable
     form (the reference's zrtp4j persists its ZidFile likewise).
+
+    BOUNDED: at most `max_entries` peers, least-recently-used evicted
+    first (a reconnect storm from rotating ZIDs must not grow host
+    memory without bound).  A lookup hit or an update refreshes the
+    entry's recency; evictions are counted and the bound rides
+    snapshot/restore.  Evicting a peer costs only key continuity on
+    its NEXT session (it renegotiates from scratch) — never media.
     """
 
-    def __init__(self):
-        self._store: Dict[bytes, Tuple[bytes, Optional[bytes]]] = {}
+    def __init__(self, max_entries: int = 4096):
+        if max_entries < 1:
+            raise ValueError("max_entries must be >= 1")
+        self.max_entries = int(max_entries)
+        self._store: "collections.OrderedDict[bytes, Tuple[bytes, Optional[bytes]]]" \
+            = collections.OrderedDict()
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._store)
 
     def lookup(self, zid: bytes) -> Tuple[Optional[bytes], Optional[bytes]]:
-        return self._store.get(bytes(zid), (None, None))
+        key = bytes(zid)
+        got = self._store.get(key)
+        if got is None:
+            return (None, None)
+        self._store.move_to_end(key)
+        return got
 
     def update(self, zid: bytes, rs_new: bytes) -> None:
         rs1, _ = self.lookup(zid)
         self._store[bytes(zid)] = (bytes(rs_new), rs1)
+        self._store.move_to_end(bytes(zid))
+        while len(self._store) > self.max_entries:
+            self._store.popitem(last=False)
+            self.evictions += 1
 
     def forget(self, zid: bytes) -> None:
         self._store.pop(bytes(zid), None)
 
     def snapshot(self) -> dict:
-        return {z: (rs1, rs2) for z, (rs1, rs2) in self._store.items()}
+        return {"max_entries": self.max_entries,
+                "evictions": self.evictions,
+                # list of (zid, rs1, rs2) in LRU->MRU order so restore
+                # reproduces the eviction order exactly
+                "store": [(z, rs1, rs2)
+                          for z, (rs1, rs2) in self._store.items()]}
 
     @classmethod
     def restore(cls, snap: dict) -> "ZidCache":
-        c = cls()
-        c._store = {bytes(z): (bytes(rs1), None if rs2 is None
-                               else bytes(rs2))
-                    for z, (rs1, rs2) in snap.items()}
+        if "store" not in snap:
+            # legacy unbounded-format snapshot: {zid: (rs1, rs2)}
+            c = cls()
+            for z, (rs1, rs2) in snap.items():
+                c._store[bytes(z)] = (bytes(rs1), None if rs2 is None
+                                      else bytes(rs2))
+            return c
+        c = cls(max_entries=int(snap["max_entries"]))
+        c.evictions = int(snap.get("evictions", 0))
+        for z, rs1, rs2 in snap["store"]:
+            c._store[bytes(z)] = (bytes(rs1),
+                                  None if rs2 is None else bytes(rs2))
         return c
 
 

@@ -414,13 +414,20 @@ class MediaLoop:
         if is_dtls_row.any():
             dtls_rows = np.nonzero(is_dtls_row)[0]
             if self.on_dtls is not None:
+                # deferred association tables enqueue and reply on the
+                # between-ticks drain (replies == []); inline tables'
+                # replies gather into ONE batch per peer address
+                # instead of one send_batch per datagram
+                by_addr: dict = {}
                 for i in dtls_rows:
-                    replies = self.on_dtls(batch.to_bytes(int(i)),
-                                           (int(sip[i]), int(sport[i])))
-                    for rep in replies or ():
-                        out = PacketBatch.from_payloads([rep],
-                                                        batch.capacity)
-                        eng.send_batch(out, int(sip[i]), int(sport[i]))
+                    addr = (int(sip[i]), int(sport[i]))
+                    replies = self.on_dtls(batch.to_bytes(int(i)), addr)
+                    if replies:
+                        by_addr.setdefault(addr, []).extend(replies)
+                for addr, reps in by_addr.items():
+                    out = PacketBatch.from_payloads(reps,
+                                                    batch.capacity)
+                    eng.send_batch(out, addr[0], addr[1])
             media_rows = np.nonzero(~is_dtls_row)[0]
             if len(media_rows) == 0:
                 self._release_token(token, eng)

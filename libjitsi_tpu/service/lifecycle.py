@@ -29,11 +29,21 @@ and overload shedding can "restore" a stream that already left.  One
 
 3. **Burn-aware admission control** — joins are refused with a TYPED
    reason (`fast_burn`, `host_bound`, `shedding`, `stalled`,
-   `capacity`, `backlog`, `duplicate`) exported as
+   `capacity`, `backlog`, `duplicate`, `shard_burn`,
+   `handshake_backlog`) exported as
    `lifecycle_admit_rejected{reason=...}` and flight-recorded, via
    `BridgeSupervisor.admission_decision()`.  Evictions are bookkept as
    `evicted` (distinct from overload `shed`), so the supervisor's LIFO
    unwind never resurrects a departed stream.
+
+4. **Off-tick handshake pipeline** (`HandshakeQueue`) — DTLS joins
+   admit through `request_handshake` (same typed-refusal contract,
+   plus a retry-after hint when the handshake plane is saturated),
+   their OpenSSL work drains in bounded batches on the between-ticks
+   window, and completed keys land via `stage_dtls_keys` -> the same
+   commit barrier as direct-keyed joins: a keyed row becomes live
+   atomically, never mid-tick, and the media tick thread never
+   executes a single OpenSSL call.
 
 Reference: no analog — the reference allocates a MediaStream object
 per join and lets the JVM GC departures; a dense-table runtime must
@@ -42,6 +52,7 @@ manage stream mortality explicitly.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
@@ -53,10 +64,11 @@ from libjitsi_tpu.utils.logging import get_logger
 
 _log = get_logger("lifecycle")
 
-#: every reason `request_join` can refuse with (typed: metrics, flight
-#: events and callers all share these strings)
+#: every reason `request_join`/`request_handshake` can refuse with
+#: (typed: metrics, flight events and callers all share these strings)
 ADMIT_REASONS = ("capacity", "backlog", "duplicate", "fast_burn",
-                 "stalled", "shedding", "host_bound", "shard_burn")
+                 "stalled", "shedding", "host_bound", "shard_burn",
+                 "handshake_backlog")
 
 
 @dataclass
@@ -70,6 +82,134 @@ class LifecycleConfig:
     # est. packets per stream per tick: sizes the row classes a
     # population bucket can drive (warmup_rtp uses the same figure)
     pkts_per_stream: int = 4
+    # ------------------------------------------ handshake plane knobs
+    # datagrams the HandshakeQueue drains per between-ticks window
+    # (the OpenSSL budget — install_batch's twin for handshakes)
+    handshake_batch: int = 64
+    # backlog bound (queued datagrams + pending associations) past
+    # which request_handshake refuses `handshake_backlog`
+    max_handshakes: int = 256
+    # flight retransmission jitter: each off-tick pass services only
+    # 1/stride of the pending associations' RFC 6347 timers, spreading
+    # a storm's flights so retransmissions never fire in lockstep
+    handshake_retx_stride: int = 4
+    # nominal between-ticks cadence used to turn a backlog depth into
+    # the retry-after hint attached to handshake_backlog refusals
+    handshake_retry_tick_s: float = 0.02
+
+
+class HandshakeQueue:
+    """Off-tick DTLS handshake pipeline for one bridge.
+
+    Construction flips the bridge's `DtlsAssociationTable` to deferred
+    ingest — `on_dtls` (tick thread) only enqueues datagrams — and
+    re-points its install callback at the STAGED landing: completed
+    keys go through `stage_dtls_keys` and flip live at the next commit
+    barrier, never mid-tick.  `drain()` runs on the between-ticks
+    window (wired into `run_between_ticks`): one bounded `process`
+    batch of OpenSSL work plus a jittered flight-retransmission pass
+    with gather egress (one PacketBatch per peer per pass).
+
+    ZRTP associations share the same endpoint surface (`feed` /
+    `complete` / `srtp_keys`), so a ZRTP-keyed bridge plugs into this
+    queue unchanged; today's bridges key via DTLS-SRTP.
+    """
+
+    def __init__(self, lc: "StreamLifecycleManager"):
+        self.lc = lc
+        self.bridge = lc.bridge
+        self.cfg = lc.cfg
+        self.table = lc.bridge._dtls
+        self.table.deferred = True
+        # generous inbox: refusal happens at ADMISSION (typed, with a
+        # retry hint), not by silently dropping datagrams of already
+        # admitted associations.  ~2 flights of 6 datagrams per row.
+        self.table.inbox_limit = max(self.table.inbox_limit,
+                                     12 * self.cfg.max_handshakes)
+        self._inline_install = self.table.install
+        self.table.install = self._on_complete
+        # sid -> admission metadata (ssrc/role/fingerprint/cookie/addr
+        # + admit tick): what a checkpoint needs to REQUEUE the
+        # association after recover (OpenSSL state cannot serialize)
+        self.active: Dict[int, dict] = {}
+        self._pass = 0
+        self.off_tick_seconds = 0.0
+        self.completed = 0
+        self.requeued = 0
+
+    @property
+    def depth(self) -> int:
+        """Admission-facing depth: queued datagrams + pending rows."""
+        return self.table.backlog
+
+    def retry_after(self) -> float:
+        """Hint for a refused client: model-time until the drain could
+        plausibly reach it, from the backlog depth and the per-window
+        budget.  Clients honor it with their own exponential backoff
+        on repeated refusals."""
+        passes = 1 + self.depth // max(1, self.cfg.handshake_batch)
+        return round(passes * self.cfg.handshake_retry_tick_s, 4)
+
+    def drain(self) -> int:
+        """The between-ticks pass: bounded OpenSSL work + jittered
+        flight retransmissions.  Wall time accrues to
+        `off_tick_seconds` (the supervisor's phase-attribution ledger
+        line — handshake cost is attributed HERE, never to a tick
+        phase)."""
+        t0 = time.perf_counter()
+        n = self.table.process(self.cfg.handshake_batch)
+        self._pass += 1
+        self.table.tick(stride=max(1, self.cfg.handshake_retx_stride),
+                        phase=self._pass)
+        if self.active:
+            # drop metadata for rows that left the plane sideways
+            # (evicted mid-handshake, fingerprint-rejected)
+            live = self.bridge._ssrc_of
+            self.active = {s: m for s, m in self.active.items()
+                           if s in self.table.pending or s in live}
+        self.off_tick_seconds += time.perf_counter() - t0
+        return n
+
+    def _on_complete(self, sid: int, ep) -> None:
+        """Install callback for the deferred table: land the exported
+        keys STAGED so the commit barrier flips the row live."""
+        meta = self.active.pop(sid, None)
+        if hasattr(self.bridge, "stage_dtls_keys"):
+            # the committed population grows at the next barrier: warm
+            # its bucket NOW (off-tick) so the flip compiles nothing
+            self.lc._ensure_warm(len(self.bridge._ssrc_of)
+                                 - len(self.lc._listener_sids))
+            self.bridge.stage_dtls_keys(sid, ep)
+            self.lc._staged.append(sid)
+            self.lc.key_installs += 1
+        else:
+            # bridge without a staged pipeline: inline install (still
+            # off-tick — we are on the between-ticks window)
+            self._inline_install(sid, ep)
+            self.bridge.loop.release_stream(sid)
+        self.completed += 1
+        self.lc.flight.record(
+            "handshake_complete", tick=self.lc.ticks(), sid=sid,
+            ssrc=(meta or {}).get("ssrc"),
+            profile=ep.selected_profile.name)
+
+    def snapshot(self) -> List[dict]:
+        """Mid-handshake associations for the supervisor checkpoint:
+        OpenSSL state cannot serialize, so each rides as its admission
+        parameters (plus its bound 5-tuple) and REQUEUES as a fresh
+        association after recover — the peer's flight timers drive the
+        new handshake."""
+        out = []
+        for sid, ep in self.table.pending.items():
+            meta = self.active.get(sid, {})
+            out.append({
+                "ssrc": meta.get("ssrc", self.bridge._ssrc_of.get(sid)),
+                "role": meta.get("role", getattr(ep, "role", "server")),
+                "fingerprint": meta.get("fingerprint"),
+                "cookie": bool(meta.get("cookie", False)),
+                "addr": self.table.sid_addr.get(sid),
+            })
+        return out
 
 
 def _next_pow2(n: int) -> int:
@@ -136,6 +276,17 @@ class StreamLifecycleManager:
         self._warm_lbucket = 0
         self._warm_lrows: set = set()
         self._tick_compiles0: Optional[int] = None
+        # off-tick handshake pipeline: attaches only when the bridge
+        # keys rows via a DTLS association table (SfuBridge /
+        # ConferenceBridge); direct-keyed bridges and test fakes get
+        # None and the plane behaves exactly as before
+        self.handshakes: Optional[HandshakeQueue] = None
+        if getattr(bridge, "_dtls", None) is not None:
+            self.handshakes = HandshakeQueue(self)
+        # OpenSSL feed() calls observed INSIDE tick windows (invariant:
+        # 0 once deferred — the reconnect soak gates on it)
+        self.tick_thread_handshake_feeds = 0
+        self._tick_feeds0: Optional[int] = None
         if supervisor is not None:
             supervisor.lifecycle = self
             pend = getattr(supervisor, "pending_lifecycle", None)
@@ -379,6 +530,71 @@ class StreamLifecycleManager:
         self.flight.record("admit_queued", tick=self.ticks(), ssrc=ssrc)
         return True, "queued"
 
+    def request_handshake(self, ssrc: int, role: str = "server",
+                          remote_fingerprint: Optional[str] = None,
+                          cookie_exchange: bool = False,
+                          remote_addr=None,
+                          name: Optional[str] = None
+                          ) -> Tuple[bool, str, float]:
+        """Admission decision + association start for a DTLS-keyed
+        join: the handshake plane's twin of `request_join`.  Returns
+        `(accepted, reason, retry_after_s)` — `(True, "queued", 0.0)`
+        on admit, or a typed refusal; `handshake_backlog` refusals
+        (the plane saturated past `max_handshakes`) carry a non-zero
+        retry-after hint that clients honor with exponential backoff.
+
+        On admit the row allocates and the association starts
+        immediately (`add_endpoint_dtls`): datagrams route to it from
+        the next packet on, but ALL OpenSSL work runs on the
+        between-ticks drain and the keys land via the staged commit
+        barrier — the tick thread never handshakes.  Pass
+        `remote_addr` when signaling knows the peer's 5-tuple; under a
+        storm (many concurrent unbound rows) unknown-address datagrams
+        are dropped rather than guessed onto the wrong row."""
+        hq = self.handshakes
+        if hq is None:
+            raise RuntimeError(
+                "bridge has no DTLS association table; use request_join")
+        ssrc = int(ssrc) & 0xFFFFFFFF
+        reason: Optional[str] = None
+        if (ssrc in self.bridge._ssrc_of.values()
+                or ssrc in self._queued_ssrcs):
+            reason = "duplicate"
+        elif self.bridge.registry.free_slots <= len(self._join_q):
+            reason = "capacity"
+        elif self.supervisor is not None:
+            ok, r = self.supervisor.admission_decision(
+                handshake_backlog=hq.depth,
+                handshake_bound=self.cfg.max_handshakes)
+            if not ok:
+                reason = r
+        elif hq.depth >= self.cfg.max_handshakes:
+            reason = "handshake_backlog"
+        if reason is not None:
+            retry = hq.retry_after() \
+                if reason == "handshake_backlog" else 0.0
+            self.admit_rejected[reason] = \
+                self.admit_rejected.get(reason, 0) + 1
+            self.flight.record("handshake_reject", tick=self.ticks(),
+                               ssrc=ssrc, reason=reason,
+                               retry_after_s=retry)
+            _log.info("handshake_reject", ssrc=ssrc, reason=reason,
+                      retry_after_s=retry)
+            return False, reason, retry
+        sid, _ep = self.bridge.add_endpoint_dtls(
+            ssrc, role=role, remote_fingerprint=remote_fingerprint,
+            cookie_exchange=cookie_exchange, remote_addr=remote_addr)
+        if name is not None:
+            self.bridge.loop.metrics.set_stream_name(sid, name)
+        hq.active[sid] = {
+            "ssrc": ssrc, "role": role,
+            "fingerprint": remote_fingerprint,
+            "cookie": bool(cookie_exchange), "tick": self.ticks(),
+        }
+        self.flight.record("handshake_queued", tick=self.ticks(),
+                           sid=sid, ssrc=ssrc)
+        return True, "queued", 0.0
+
     def request_leave(self, sid: Optional[int] = None,
                       ssrc: Optional[int] = None) -> bool:
         """Queue an evict (by sid or ssrc).  A join still queued
@@ -417,11 +633,15 @@ class StreamLifecycleManager:
     # ------------------------------------------- between-ticks pipeline
 
     def run_between_ticks(self, now=None) -> None:
-        """The off-tick half of the plane: commit barrier first (staged
-        rows flip live, queued evicts tear down — both between ticks,
-        never inside one), then stage the next install wave, then any
-        placement rebalance moves (also lifecycle events: a conference
-        only ever changes shards here, never mid-tick)."""
+        """The off-tick half of the plane: handshake drain first (its
+        completions stage rows that the SAME window's commit flips
+        live), then the commit barrier (staged rows flip live, queued
+        evicts tear down — both between ticks, never inside one), then
+        the next install wave, then any placement rebalance moves
+        (also lifecycle events: a conference only ever changes shards
+        here, never mid-tick)."""
+        if self.handshakes is not None:
+            self.handshakes.drain()
         self.commit()
         self.poll()
         self.rebalance()
@@ -798,8 +1018,20 @@ class StreamLifecycleManager:
 
     def tick_begin(self) -> None:
         self._tick_compiles0 = compile_stats().compile_events
+        self._tick_feeds0 = (self.handshakes.table.feeds_total
+                             if self.handshakes is not None else None)
 
     def tick_end(self) -> None:
+        if self._tick_feeds0 is not None:
+            # the other zero-on-the-tick-thread invariant: with the
+            # deferred table no OpenSSL feed may run inside the tick
+            d = self.handshakes.table.feeds_total - self._tick_feeds0
+            self._tick_feeds0 = None
+            if d > 0:
+                self.tick_thread_handshake_feeds += d
+                self.flight.record("tick_thread_handshake",
+                                   tick=self.ticks(), n=d)
+                _log.warn("tick_thread_handshake", n=d)
         if self._tick_compiles0 is None:
             return
         delta = compile_stats().compile_events - self._tick_compiles0
@@ -832,6 +1064,12 @@ class StreamLifecycleManager:
             "staged": [(sid, self.bridge._ssrc_of.get(sid))
                        for sid in self._staged],
         }
+        if self.handshakes is not None:
+            # mid-handshake associations: keyless, so they ride as
+            # their admission parameters and requeue after recover
+            # (staged handshake rows already carry keys and ride the
+            # "staged" list + bridge snapshot like any other admit)
+            snap["handshakes"] = self.handshakes.snapshot()
         if self.placer is not None:
             snap["placement"] = {
                 "n_shards": self.placer.n_shards,
@@ -903,6 +1141,28 @@ class StreamLifecycleManager:
                               conference=conf if (conf is None
                                                   or conf >= 0) else None,
                               role=role)
+        for rec in pend.get("handshakes", []):
+            # mid-handshake at the kill: the OpenSSL state died with
+            # the process, so the association REQUEUES as a fresh row
+            # (same ssrc, same bound 5-tuple when known) and the
+            # peer's flight timers / signaling re-join drive the new
+            # handshake — completed or requeued, never torn
+            ssrc = rec.get("ssrc")
+            if ssrc is None or self.handshakes is None:
+                continue
+            addr = rec.get("addr")
+            ok, reason, retry = self.request_handshake(
+                ssrc, role=rec.get("role", "server"),
+                remote_fingerprint=rec.get("fingerprint"),
+                cookie_exchange=bool(rec.get("cookie", False)),
+                remote_addr=tuple(addr) if addr is not None else None)
+            if ok:
+                self.handshakes.requeued += 1
+            self.flight.record("handshake_requeue", tick=self.ticks(),
+                               ssrc=ssrc, accepted=ok,
+                               reason=reason, retry_after_s=retry)
+            _log.info("handshake_requeue", ssrc=ssrc, accepted=ok,
+                      reason=reason)
 
     def _reconcile_placement(self, pl: dict) -> None:
         """Rebuild placement accounting from the RESTORED rows — the
@@ -1036,6 +1296,59 @@ class StreamLifecycleManager:
                               for c in self._keystream_caches())),
             help_="cumulative off-tick wall time spent generating "
                   "keystream (the cache-fill phase)", kind="counter")
+        # handshake plane (HandshakeQueue + deferred association
+        # table): all read through self.handshakes so direct-keyed
+        # bridges export zeros instead of raising
+        registry.register_scalar(
+            "handshake_queue_depth",
+            lambda: float(self.handshakes.depth)
+            if self.handshakes is not None else 0.0,
+            help_="queued handshake datagrams + pending associations "
+                  "awaiting the off-tick drain")
+        registry.register_scalar(
+            "dtls_handshakes_active",
+            lambda: float(len(self.handshakes.table.pending))
+            if self.handshakes is not None else 0.0,
+            help_="DTLS associations mid-handshake (allocated, "
+                  "keyless rows)")
+        registry.register_scalar(
+            "dtls_retransmits_total",
+            lambda: float(self.handshakes.table.retransmits_total)
+            if self.handshakes is not None else 0.0,
+            help_="expired-flight datagrams resent by the batched "
+                  "retransmission pass", kind="counter")
+        registry.register_scalar(
+            "dtls_feeds_total",
+            lambda: float(self.handshakes.table.feeds_total)
+            if self.handshakes is not None else 0.0,
+            help_="handshake datagrams fed to endpoints (all on the "
+                  "off-tick drain in deferred mode)", kind="counter")
+        registry.register_scalar(
+            "dtls_inbox_dropped",
+            lambda: float(self.handshakes.table.inbox_dropped)
+            if self.handshakes is not None else 0.0,
+            help_="handshake datagrams dropped at the deferred "
+                  "table's inbox bound (admission refuses first; this "
+                  "staying near 0 proves the bound is generous)",
+            kind="counter")
+        registry.register_scalar(
+            "dtls_handshakes_completed",
+            lambda: float(self.handshakes.completed)
+            if self.handshakes is not None else 0.0,
+            help_="handshakes whose keys landed via the staged "
+                  "commit barrier", kind="counter")
+        registry.register_scalar(
+            "handshake_off_tick_seconds",
+            lambda: float(self.handshakes.off_tick_seconds)
+            if self.handshakes is not None else 0.0,
+            help_="cumulative between-ticks wall time in the "
+                  "handshake drain (OpenSSL + flight resends)",
+            kind="counter")
+        registry.register_scalar(
+            "handshake_tick_thread_feeds",
+            lambda: float(self.tick_thread_handshake_feeds),
+            help_="OpenSSL feed() calls observed inside tick windows "
+                  "(invariant: 0)", kind="counter")
 
     def _rejected_samples(self):
         return [({"reason": r}, float(c))

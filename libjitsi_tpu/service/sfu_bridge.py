@@ -340,6 +340,23 @@ class SfuBridge:
             self._attach_video_receiver(track, sid)
         _log.info("dtls_keys_installed", sid=sid, profile=profile.name)
 
+    def stage_dtls_keys(self, sid: int, ep) -> None:
+        """Staged landing for a completed DTLS handshake (the lifecycle
+        plane's HandshakeQueue): install the exported keys into both
+        SRTP tables + the translator leg for the already-allocated row
+        and leave it STAGED — `commit_endpoints` flips it live between
+        ticks (one route rebuild for the whole batch, held early media
+        replayed atomically).  `_install_dtls` stays as the inline twin
+        for bridges running without a lifecycle manager."""
+        profile, tk, tsalt, rk, rsalt = ep.srtp_keys()
+        self.rx_table.add_stream(sid, rk, rsalt)
+        self.tx_table.add_stream(sid, tk, tsalt)
+        self.translator.add_receiver(sid, tk, tsalt)
+        self._rx_keys[sid] = (rk, rsalt)
+        self._tx_keys[sid] = (tk, tsalt)
+        self._staged.add(sid)
+        _log.info("dtls_keys_staged", sid=sid, profile=profile.name)
+
     def remove_endpoint(self, sid: int) -> None:
         self.remove_endpoints([sid])
 
@@ -1127,7 +1144,9 @@ class SfuBridge:
             # (flushing a batch dispatched THIS tick would kill its
             # overlap window, hence the flag, not an rx check)
             self._flush_fanout()
-        if self._dtls.pending:
+        if self._dtls.pending and not self._dtls.deferred:
+            # inline mode only: with a lifecycle manager attached the
+            # flight pass runs off-tick (HandshakeQueue.drain)
             self._dtls.tick()
         return {"rx": rx, "forwarded": self.forwarded,
                 "retransmitted": self.retransmitted}

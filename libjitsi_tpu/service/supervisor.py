@@ -408,7 +408,8 @@ class BridgeSupervisor:
         for sid in sids:
             self._evicted.discard(int(sid))
 
-    def admission_decision(self, shard=None):
+    def admission_decision(self, shard=None, handshake_backlog=None,
+                           handshake_bound=0):
         """Burn-aware admission control for the lifecycle plane:
         `(ok, reason)` where reason is a typed string.  Joins are
         refused while the error budget is burning fast, while the phase
@@ -420,7 +421,13 @@ class BridgeSupervisor:
         join is also refused (`shard_burn`) when a per-shard sliced SLO
         says that specific shard is burning fast — the other shards
         keep admitting, which is the point of slicing (a fleet-wide
-        gate would brown out all 8 chips for one hot one)."""
+        gate would brown out all 8 chips for one hot one).
+
+        DTLS/ZRTP joins pass the handshake plane's current
+        `handshake_backlog` (queued datagrams + pending associations)
+        and its `handshake_bound`: past the bound the join is refused
+        `handshake_backlog` — the shard_burn-style typed backpressure
+        for reconnect storms (the caller attaches a retry-after hint)."""
         if self._slo_state() == "fast_burn":
             return False, "fast_burn"
         if shard is not None and self.slo is not None:
@@ -429,6 +436,9 @@ class BridgeSupervisor:
                         and self.slo.slice_state(spec.name, shard)
                         == "fast_burn"):
                     return False, "shard_burn"
+        if (handshake_bound and handshake_backlog is not None
+                and handshake_backlog >= handshake_bound):
+            return False, "handshake_backlog"
         if self.watchdog.state == "stalled":
             return False, "stalled"
         if self._shed_set:
@@ -723,6 +733,21 @@ class BridgeSupervisor:
                     served / (served + missed), 4)
                 if served + missed else None,
             }
+        hq = getattr(self.lifecycle, "handshakes", None) \
+            if self.lifecycle is not None else None
+        if hq is not None:
+            # same rule as the keystream ledger: handshake OpenSSL work
+            # runs on the between-ticks window, so the PhaseProfiler's
+            # tick split never contains it — its wall time is attributed
+            # here, and `tick_thread_feeds` must stay 0 (the reconnect
+            # soak gates on it)
+            out.setdefault("off_tick", {}).update({
+                "handshake_drain_seconds": round(hq.off_tick_seconds, 6),
+                "handshake_queue_depth": int(hq.depth),
+                "handshake_tick_thread_feeds": int(
+                    getattr(self.lifecycle,
+                            "tick_thread_handshake_feeds", 0)),
+            })
         return out
 
     def health(self) -> dict:

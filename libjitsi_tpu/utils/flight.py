@@ -44,7 +44,10 @@ EVENT_BASE_KEYS = ("seq", "t", "kind", "sid", "tick")
 #: marks via `mark_priority` directly, from MediaLoop.note_journey)
 PRIORITY_KINDS = frozenset((
     "nack_queued", "rtx_served", "rtx_cache_miss", "fec_sent",
-    "rtx_budget_drop"))
+    "rtx_budget_drop",
+    # a just-keyed row's first packets (held early media replaying
+    # through the commit barrier) are exactly the tail worth keeping
+    "handshake_complete"))
 
 
 class FlightRecorder:

@@ -31,6 +31,7 @@ import argparse
 import json
 import os
 import sys
+import tempfile
 import time
 
 import numpy as np
@@ -38,12 +39,15 @@ import numpy as np
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
 
 import libjitsi_tpu  # noqa: E402
+from libjitsi_tpu.control.dtls import (  # noqa: E402
+    HAVE_CRYPTOGRAPHY, DtlsSrtpEndpoint, StubDtlsEndpoint,
+    generate_certificate)
 from libjitsi_tpu.core.packet import PacketBatch  # noqa: E402
 from libjitsi_tpu.io import UdpEngine  # noqa: E402
 from libjitsi_tpu.rtp import header as rtp_header  # noqa: E402
 from libjitsi_tpu.rtp import rtcp  # noqa: E402
 from libjitsi_tpu.service.lifecycle import (  # noqa: E402
-    StreamLifecycleManager)
+    ADMIT_REASONS, LifecycleConfig, StreamLifecycleManager)
 from libjitsi_tpu.service.sfu_bridge import SfuBridge  # noqa: E402
 from libjitsi_tpu.service.supervisor import (  # noqa: E402
     BridgeSupervisor, SupervisorConfig)
@@ -634,6 +638,449 @@ def run_broadcast_soak(duration_s: float = 20.0, ramp_s: float = 8.0,
     return report
 
 
+class _ReconnectClient:
+    """One reconnecting participant: a loopback UDP socket plus a real
+    OpenSSL DTLS client endpoint.  The driver admits it through
+    `request_handshake`, honors typed refusals by sleeping out the
+    retry-after hint with exponential backoff, and counts it restored
+    only when BOTH sides hold keys and the bridge row is committed
+    live (not merely staged)."""
+
+    def __init__(self, ssrc: int, bridge_port: int, ep_cls,
+                 cert_der, key_der, seed: int):
+        self.ssrc = ssrc
+        self.engine = UdpEngine(port=0, max_batch=64)
+        self.bridge_port = bridge_port
+        self._ep_cls = ep_cls
+        self._cert = (cert_der, key_der)
+        self.ep = None
+        self.state = "idle"            # idle -> pending -> live
+        self.attempts = 0
+        self.retry_at = 0.0
+        self.requested_at = None       # first admission attempt
+        self.refusals = 0
+        self.rng = np.random.default_rng(seed)
+
+    @property
+    def addr(self):
+        return (0x7F000001, self.engine.port)   # 127.0.0.1 as uint32
+
+    def start_handshake(self) -> None:
+        """(Re)start the client side from scratch and send the first
+        flight — on admit, and again after a crash-recover when the
+        server's in-flight association state died with the process."""
+        self.ep = self._ep_cls("client", cert_der=self._cert[0],
+                               key_der=self._cert[1])
+        self._tx(self.ep.handshake_packets())
+
+    def _tx(self, datagrams) -> None:
+        if datagrams:
+            self.engine.send_batch(PacketBatch.from_payloads(datagrams),
+                                   "127.0.0.1", self.bridge_port)
+
+    def pump(self) -> None:
+        """Drain inbound server flights, advance the handshake, drive
+        the RFC 6347 flight retransmission timer."""
+        if self.ep is None or self.state != "pending":
+            return
+        back, _, _ = self.engine.recv_batch(timeout_ms=0)
+        out = []
+        for i in range(back.batch_size):
+            if self.ep.complete:
+                break
+            out.extend(self.ep.feed(back.to_bytes(i)))
+        if not self.ep.complete:
+            out.extend(self.ep.tick())
+        self._tx(out)
+
+    def close(self) -> None:
+        self.engine.close()
+
+
+def _dtls_echo(sender, receiver, tick_fn, seq0: int,
+               rounds: int = 16, need: int = 3) -> int:
+    """SRTP media through the bridge between two DTLS-keyed clients,
+    each side using only its own handshake-exported keys; returns how
+    many of the sender's packets the receiver decrypted."""
+    prof_s, stk, stsalt, _, _ = sender.ep.srtp_keys()
+    tx = SrtpStreamTable(capacity=1, profile=prof_s)
+    tx.add_stream(0, stk, stsalt)
+    prof_r, _, _, rrk, rrsalt = receiver.ep.srtp_keys()
+    rx = SrtpStreamTable(capacity=1, profile=prof_r)
+    rx.add_stream(0, rrk, rrsalt)
+    got, seq = 0, seq0
+    for _ in range(rounds):
+        pkt = rtp_header.build([b"\x5b" * 120] * 2, [seq, seq + 1],
+                               [0, 0], [sender.ssrc] * 2, [96] * 2,
+                               stream=[0, 0])
+        seq += 2
+        sender.engine.send_batch(tx.protect_rtp(pkt), "127.0.0.1",
+                                 sender.bridge_port)
+        tick_fn()
+        back, _, _ = receiver.engine.recv_batch(timeout_ms=0)
+        if back.batch_size == 0:
+            continue
+        hdr = rtp_header.parse(back)
+        keep = [i for i in range(back.batch_size)
+                if int(hdr.ssrc[i]) == sender.ssrc]
+        if not keep:
+            continue
+        sub = PacketBatch(back.data[keep],
+                          np.asarray(back.length)[keep],
+                          np.asarray([0] * len(keep)))
+        _dec, ok = rx.unprotect_rtp(sub)
+        got += int(np.asarray(ok).sum())
+        if got >= need:
+            break
+    return got
+
+
+def _flight_kinds(flight) -> set:
+    dump = flight.dump_all()
+    kinds = {e.get("kind") for e in dump["global"]}
+    for evs in dump["streams"].values():
+        kinds |= {e.get("kind") for e in evs}
+    return kinds
+
+
+def run_reconnect_soak(n_clients: int = 1000, dt: float = 0.02,
+                       max_handshakes: int = 128,
+                       handshake_batch: int = 256,
+                       kill_frac: float = 0.5,
+                       restore_p99_bound_s: float = 10.0,
+                       storm_budget_s: float = 120.0,
+                       capacity=None, seed: int = 0,
+                       verbose: bool = True, report_path=None) -> dict:
+    """Mass-reconnect chaos scenario: `n_clients` real DTLS clients
+    storm one bridge's handshake plane, the bridge is killed mid-storm
+    and recovered from its checkpoint, and every association must come
+    back — completed rows with working keys, staged rows committed or
+    rolled back, in-flight rows requeued at their bound 5-tuple.
+    Acceptance gates (every `ok_*` must hold):
+
+    - time-to-media-restored p99 (recover -> committed live with both
+      sides keyed, model time) under `restore_p99_bound_s`;
+    - ZERO data-path recompiles inside tick windows after priming, on
+      both the original and the recovered bridge;
+    - ZERO handshake work attributed to the tick thread: every OpenSSL
+      feed runs on the between-ticks drain (PhaseProfiler off-tick
+      ledger + the lifecycle feed bracket both say so);
+    - every refusal TYPED (`handshake_backlog` observed, with a
+      retry-after hint clients honor via exponential backoff) and the
+      total refusal count bounded — no refusal storms, no silent drops;
+    - keys land ONLY via the staged commit barrier (stage counts match
+      handshake completions exactly — the inline install path never
+      runs)."""
+    try:                               # one UDP socket per client
+        import resource
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < n_clients + 256:
+            resource.setrlimit(resource.RLIMIT_NOFILE,
+                               (min(hard, n_clients + 512), hard))
+    except Exception:
+        pass
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    if capacity is None:
+        capacity = max(256, 2 * n_clients)
+    cfg = libjitsi_tpu.configuration_service()
+    bridge = SfuBridge(cfg, port=0, capacity=capacity, recv_window_ms=0)
+    reg = bridge.loop.metrics
+    sup = BridgeSupervisor(
+        bridge,
+        SupervisorConfig(deadline_ms=1000.0,
+                         quarantine_auth_threshold=1 << 30,
+                         quarantine_replay_threshold=1 << 30),
+        metrics=reg)
+    lcfg = LifecycleConfig(max_handshakes=max_handshakes,
+                           handshake_batch=handshake_batch)
+    lc = StreamLifecycleManager(bridge, supervisor=sup, metrics=reg,
+                                config=lcfg)
+
+    now = 100.0
+    t0_wall = time.perf_counter()
+    # real OpenSSL endpoints when `cryptography` is installed; the
+    # same-surface stub otherwise (gated dependency — the plane's
+    # datagram flows, admission and recovery logic are identical)
+    if HAVE_CRYPTOGRAPHY:
+        ep_cls = DtlsSrtpEndpoint
+        cert_der, key_der, _fp = generate_certificate("reconnect-soak")
+    else:
+        ep_cls = StubDtlsEndpoint
+        cert_der = key_der = None
+    bridge._dtls.endpoint_factory = ep_cls
+    clients = [_ReconnectClient(0x20000 + k, bridge.port, ep_cls,
+                                cert_der, key_der, seed + 100 + k)
+               for k in range(n_clients)]
+    refused: dict = {}
+
+    def _try_admit(c, lc_cur):
+        if c.requested_at is None:
+            c.requested_at = now
+        ok, reason, retry = lc_cur.request_handshake(
+            c.ssrc, remote_addr=c.addr, name=f"rc-{c.ssrc:#x}")
+        if ok:
+            c.state = "pending"
+            c.attempts = 0
+            c.start_handshake()
+        else:
+            c.refusals += 1
+            refused[reason] = refused.get(reason, 0) + 1
+            c.attempts += 1
+            base = retry if retry > 0 else 0.05
+            # exponential backoff on the server's retry-after hint,
+            # jittered so the retry wave doesn't resynchronize into
+            # the next storm front
+            c.retry_at = now + base * (2 ** min(c.attempts - 1, 6)) \
+                * (1.0 + 0.25 * float(c.rng.random()))
+        return ok
+
+    def _promote(b, cs, lat, base) -> None:
+        committed = {ssrc: sid for sid, ssrc in b._ssrc_of.items()}
+        for c in cs:
+            if (c.state != "pending" or c.ep is None
+                    or not c.ep.complete):
+                continue
+            sid = committed.get(c.ssrc)
+            if (sid is not None and sid in b._tx_keys
+                    and sid not in b._staged):
+                c.state = "live"
+                lat.append(now - (c.requested_at if base is None
+                                  else base))
+
+    # ---- priming: two clients handshake and exchange media BEFORE the
+    # measured window, so first-media compiles land as priming, and the
+    # final post-recover echo rides warm caches
+    for c in clients[:2]:
+        ok, why, _r = lc.request_handshake(
+            c.ssrc, remote_addr=c.addr, name=f"rc-{c.ssrc:#x}")
+        assert ok, f"priming admission refused: {why}"
+        c.state = "pending"
+        c.requested_at = now
+        c.start_handshake()
+    for _ in range(600):
+        sup.tick(now=now)
+        for c in clients[:2]:
+            c.pump()
+        _promote(bridge, clients[:2], [], None)
+        now += dt
+        if all(c.state == "live" for c in clients[:2]):
+            break
+    assert all(c.state == "live" for c in clients[:2]), \
+        "priming handshakes stalled"
+
+    def _tick1():
+        nonlocal now
+        sup.tick(now=now)
+        now += dt
+
+    prime_got = _dtls_echo(clients[0], clients[1], _tick1, seq0=3000)
+    assert prime_got > 0, "priming media never flowed"
+    w0 = dict(recompiles=lc.datapath_recompiles)
+
+    # ---- the storm: everyone else reconnects at once
+    storm_ticks = int(round(storm_budget_s / dt))
+    kill_target = max(2, int(round(kill_frac * n_clients)))
+    latencies_join: list = []
+    peak_depth = 0
+    for _ in range(storm_ticks):
+        for c in clients:
+            if c.state == "idle" and now >= c.retry_at:
+                _try_admit(c, lc)
+        sup.tick(now=now)
+        for c in clients:
+            c.pump()
+        _promote(bridge, clients, latencies_join, None)
+        peak_depth = max(peak_depth, lc.handshakes.depth)
+        now += dt
+        n_live = sum(1 for c in clients if c.state == "live")
+        if n_live >= kill_target and lc.handshakes.depth > 0:
+            break
+    n_live_at_kill = sum(1 for c in clients if c.state == "live")
+    assert lc.handshakes.depth > 0, \
+        "storm drained before the kill point — raise n_clients"
+
+    # ---- kill mid-storm, recover from the checkpoint
+    ckpt = os.path.join(tempfile.gettempdir(),
+                        f"reconnect_soak_{os.getpid()}.ckpt")
+    sup.save_checkpoint(ckpt)
+    pre = dict(feeds=bridge._dtls.feeds_total,
+               retransmits=bridge._dtls.retransmits_total,
+               inbox_dropped=bridge._dtls.inbox_dropped,
+               completed=lc.handshakes.completed,
+               key_installs=lc.key_installs,
+               recompiles=lc.datapath_recompiles,
+               tick_feeds=lc.tick_thread_handshake_feeds,
+               off_tick_s=lc.handshakes.off_tick_seconds,
+               pending=len(bridge._dtls.pending),
+               inbox=len(bridge._dtls._inbox))
+    scrape1 = reg.render()
+    kinds = _flight_kinds(sup.flight)
+    bridge.close()                                 # the crash
+
+    sup2 = BridgeSupervisor.recover(cfg, ckpt, SfuBridge, port=0,
+                                    supervisor_config=sup.cfg,
+                                    recv_window_ms=0)
+    bridge2 = sup2.bridge
+    bridge2._dtls.endpoint_factory = ep_cls     # before reconcile requeues
+    lc2 = StreamLifecycleManager(bridge2, supervisor=sup2,
+                                 metrics=bridge2.loop.metrics,
+                                 config=lcfg)
+    recover_now = now
+    latencies_restore: list = []
+    requeued_ssrcs = {bridge2._ssrc_of[s] for s in bridge2._dtls.pending
+                      if s in bridge2._ssrc_of}
+    keyed_ssrcs = {v for s, v in bridge2._ssrc_of.items()
+                   if s in bridge2._tx_keys}
+    restored_instantly = 0
+    for c in clients:
+        c.bridge_port = bridge2.port
+        if (c.state == "live" and c.ssrc in keyed_ssrcs
+                and c.ep is not None and c.ep.complete):
+            restored_instantly += 1       # keys rode the checkpoint
+            latencies_restore.append(dt)
+            continue
+        if c.ssrc in requeued_ssrcs:
+            # server row survived as a fresh pending association bound
+            # to our 5-tuple: redo the client side against it
+            c.state = "pending"
+            c.start_handshake()
+        elif c.ssrc in keyed_ssrcs:
+            # server completed + keyed but WE never saw the final
+            # flight: only signaling resolves this — leave + rejoin
+            lc2.request_leave(ssrc=c.ssrc)
+            c.state = "idle"
+            c.ep = None
+            c.attempts = 0
+            c.retry_at = recover_now + 5 * dt
+        else:
+            # association didn't survive (requeue refused under
+            # backlog, or never admitted): back to the admission queue
+            c.state = "idle"
+            c.ep = None
+            c.attempts = 0
+            c.retry_at = recover_now
+
+    sup2.tick(now=now)            # commit the reconciled staged rows
+    now += dt
+    torn = [s for s in bridge2._ssrc_of
+            if s not in bridge2._tx_keys
+            and s not in bridge2._dtls.pending]
+
+    # ---- drive the re-handshake wave until everyone is back
+    for _ in range(storm_ticks):
+        if all(c.state == "live" for c in clients):
+            break
+        for c in clients:
+            if c.state == "idle" and now >= c.retry_at:
+                _try_admit(c, lc2)
+        sup2.tick(now=now)
+        for c in clients:
+            c.pump()
+        _promote(bridge2, clients, latencies_restore, recover_now)
+        peak_depth = max(peak_depth, lc2.handshakes.depth)
+        now += dt
+
+    def _tick2():
+        nonlocal now
+        sup2.tick(now=now)
+        now += dt
+
+    all_live = all(c.state == "live" for c in clients)
+    echo_got = (_dtls_echo(clients[0], clients[1], _tick2, seq0=4000)
+                if clients[0].state == clients[1].state == "live"
+                else 0)
+
+    # ---- accounting
+    p99_restore = (float(np.percentile(latencies_restore, 99))
+                   if latencies_restore else float("inf"))
+    p99_join = (float(np.percentile(latencies_join, 99))
+                if latencies_join else 0.0)
+    window_recompiles = ((pre["recompiles"] - w0["recompiles"])
+                         + lc2.datapath_recompiles)
+    feeds_total = pre["feeds"] + bridge2._dtls.feeds_total
+    tick_feeds = pre["tick_feeds"] + lc2.tick_thread_handshake_feeds
+    off_tick_s = pre["off_tick_s"] + lc2.handshakes.off_tick_seconds
+    completed = pre["completed"] + lc2.handshakes.completed
+    key_installs = pre["key_installs"] + lc2.key_installs
+    total_refusals = sum(c.refusals for c in clients)
+    kinds |= _flight_kinds(sup2.flight)
+    attr2 = sup2.phase_attribution().get("off_tick", {})
+
+    report = {
+        "mode": "reconnect",
+        "endpoint_impl": ("openssl" if HAVE_CRYPTOGRAPHY else "stub"),
+        "clients": n_clients,
+        "max_handshakes": max_handshakes,
+        "handshake_batch": handshake_batch,
+        "capacity_rows": capacity,
+        "wall_s": round(time.perf_counter() - t0_wall, 3),
+        "model_time_s": round(now - 100.0, 3),
+        "live_at_kill": n_live_at_kill,
+        "pending_at_kill": pre["pending"],
+        "inbox_at_kill": pre["inbox"],
+        "requeued": lc2.handshakes.requeued,
+        "restored_instantly": restored_instantly,
+        "peak_queue_depth": peak_depth,
+        "handshakes_completed": completed,
+        "key_installs_staged": key_installs,
+        "dtls_feeds_total": feeds_total,
+        "dtls_retransmits_total": (pre["retransmits"]
+                                   + bridge2._dtls.retransmits_total),
+        "inbox_dropped": (pre["inbox_dropped"]
+                          + bridge2._dtls.inbox_dropped),
+        "refusals": dict(refused),
+        "refusals_total": total_refusals,
+        "join_p99_s": round(p99_join, 4),
+        "restore_p99_s": round(p99_restore, 4),
+        "restore_samples": len(latencies_restore),
+        "priming_recompiles": w0["recompiles"],
+        "window_recompiles": window_recompiles,
+        "off_tick_drain_s": round(off_tick_s, 4),
+        "off_tick_ledger": attr2,
+        "torn_rows": torn,
+        "echo_decrypted": echo_got,
+        # ---- invariants
+        "ok_all_restored": all_live,
+        "ok_media_restored_p99": (all_live and len(latencies_restore) > 0
+                                  and p99_restore <= restore_p99_bound_s),
+        "ok_zero_datapath_recompiles": window_recompiles == 0,
+        "ok_no_tick_thread_handshake": (
+            tick_feeds == 0 and feeds_total > 0 and off_tick_s > 0
+            and attr2.get("handshake_tick_thread_feeds", 1) == 0),
+        "ok_typed_refusals": (
+            refused.get("handshake_backlog", 0) > 0
+            and set(refused) <= set(ADMIT_REASONS)
+            and '_admit_rejected{reason="handshake_backlog"' in scrape1
+            and "handshake_reject" in kinds
+            and total_refusals <= n_clients * 40),
+        "ok_commit_barrier_only": (key_installs == completed
+                                   and completed >= n_clients
+                                   and "handshake_complete" in kinds),
+        "ok_reconciled": (not torn
+                          and (pre["pending"] == 0
+                               or "handshake_requeue" in kinds)),
+        "ok_media_flowed": prime_got > 0 and echo_got > 0,
+    }
+    for c in clients:
+        c.close()
+    bridge2.close()
+    libjitsi_tpu.stop()
+    try:
+        os.remove(ckpt)
+    except OSError:
+        pass
+    if verbose:
+        print("---- reconnect storm soak report ----")
+        for k, v in report.items():
+            print(f"{k:32s} {v}")
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    return report
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--duration", type=float, default=30.0,
@@ -664,6 +1111,19 @@ def main() -> int:
     ap.add_argument("--broadcast", action="store_true",
                     help="broadcast-conference mode: Poisson listener "
                          "churn on one hierarchical conference")
+    ap.add_argument("--reconnect", action="store_true",
+                    help="reconnect-storm chaos mode: mass DTLS "
+                         "re-handshakes with a mid-storm kill/recover")
+    ap.add_argument("--clients", type=int, default=1000,
+                    help="reconnect mode: simultaneous DTLS clients")
+    ap.add_argument("--max-handshakes", type=int, default=128,
+                    help="reconnect mode: admission bound on in-flight "
+                         "handshakes (past it: typed refusals)")
+    ap.add_argument("--handshake-batch", type=int, default=256,
+                    help="reconnect mode: per-drain OpenSSL budget")
+    ap.add_argument("--restore-p99", type=float, default=10.0,
+                    help="reconnect mode: time-to-media-restored p99 "
+                         "bound, model seconds")
     ap.add_argument("--listeners", type=int, default=4096,
                     help="broadcast mode: steady listener population")
     ap.add_argument("--speakers", type=int, default=8)
@@ -672,6 +1132,24 @@ def main() -> int:
                     help="broadcast mode: listener-join p99 bound, "
                          "model seconds")
     args = ap.parse_args()
+    if args.reconnect:
+        kw = dict(n_clients=args.clients,
+                  max_handshakes=args.max_handshakes,
+                  handshake_batch=args.handshake_batch,
+                  restore_p99_bound_s=args.restore_p99,
+                  seed=args.seed, report_path=args.report)
+        if args.smoke:
+            kw.update(n_clients=24, max_handshakes=6,
+                      handshake_batch=8, capacity=128,
+                      storm_budget_s=60.0)
+        report = run_reconnect_soak(**kw)
+        failed = [k for k, v in report.items()
+                  if k.startswith("ok_") and not v]
+        if failed:
+            print(f"INVARIANT FAILURES: {failed}", file=sys.stderr)
+            return 1
+        print("all reconnect-storm invariants held")
+        return 0
     if args.broadcast:
         kw = dict(duration_s=args.duration, ramp_s=args.ramp,
                   mean_hold_s=args.hold, n_speakers=args.speakers,

