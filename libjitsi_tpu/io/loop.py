@@ -78,6 +78,11 @@ class MediaLoop:
         # them (primary blocks for the batching window, siblings poll)
         # and runs every non-empty batch through the same ingest body
         self.rings: List[UdpEngine] = [engine]
+        # parallel to `rings`: a sink callable per ring, or None for
+        # the RTP ingest path.  Sink rings (e.g. a cascade trunk
+        # socket) drain with tick cadence in the same ingress span but
+        # hand their datagrams to the sink — they are not RTP
+        self.ring_sinks: List[Optional[Callable]] = [None]
         self.registry = registry
         self.chain = chain
         # pipeline_depth: how many ticks' reverse-chain work may be in
@@ -241,15 +246,23 @@ class MediaLoop:
         """Primary drain ring's engine mode ("io_uring"/"recvmmsg")."""
         return getattr(self.engine, "engine_mode", "recvmmsg")
 
-    def add_ring(self, engine: UdpEngine) -> None:
+    def add_ring(self, engine: UdpEngine,
+                 sink: Optional[Callable] = None) -> None:
         """Attach an extra drain ring: an SO_REUSEPORT sibling engine
         on the same port, kernel-sharded by flow hash.  Each tick the
         primary ring blocks for the batching window, then siblings
         drain non-blocking (their packets arrived during that wait).
         When placement makes rings shard-aligned, each ring's batch is
         already shard-major and the `enable_shard_major` sort becomes a
-        no-op (its sortedness check sees monotone shard ids)."""
+        no-op (its sortedness check sees monotone shard ids).
+
+        With `sink`, the ring is a CONTROL ring (a cascade trunk
+        socket): it drains on the same tick cadence but its datagrams
+        go to `sink(batch, sip, sport)` — never the RTP ingest body —
+        with copy semantics (a sink may hold bytes indefinitely, so
+        no arena views)."""
         self.rings.append(engine)
+        self.ring_sinks.append(sink)
 
     def _sync_ingest_counters(self) -> None:
         """Fold the rings' enter/reap counters into the loop's per-tick
@@ -361,11 +374,23 @@ class MediaLoop:
         with self.tracer.span("ingress"):
             with self.perf.phase("idle"):    # socket wait dominates here
                 for k, eng in enumerate(self.rings):
+                    if self.ring_sinks[k] is not None:
+                        continue             # control ring: drained below
                     # primary ring pays the batching window; sibling
                     # rings poll — their packets arrived during the wait
                     ring_batches.append((eng, self._recv_ring(
                         eng, self.recv_window_ms if k == 0 else 0,
                         use_view)))
+            # control rings (cascade trunk sockets): non-blocking copy
+            # drain in the same ingress span; frames go to the sink,
+            # never the RTP body, and don't count as RTP ingest
+            for k, eng in enumerate(self.rings):
+                sink = self.ring_sinks[k]
+                if sink is None:
+                    continue
+                cb, csip, csport = eng.recv_batch(0)
+                if cb.batch_size:
+                    sink(cb, csip, csport)
         # arrival stamp: the batching window just closed — everything
         # this tick sends is measured against this instant (per-batch
         # journey; rows within one batch share the stamp)

@@ -5,6 +5,11 @@
 giant-conference escape hatches and pay a cross-chip psum per tick;
 the `mesh-collective` lint gate keeps collectives confined to them."""
 
+from libjitsi_tpu.mesh.cascade import (  # noqa: F401
+    CascadeTrunk,
+    TrunkConfig,
+    TrunkRelay,
+)
 from libjitsi_tpu.mesh.placement import (  # noqa: F401
     SANCTIONED_COLLECTIVE_SITES,
     ConferencePlacer,

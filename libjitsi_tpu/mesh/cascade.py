@@ -1,0 +1,614 @@
+"""Bridge-to-bridge cascade trunk (Octo-style relay, SURVEY §2.7).
+
+Production Jitsi scales one conference across multiple bridges by
+cascading them through relay legs; this module is that leg for the
+jax_graft bridge.  A `CascadeTrunk` is one end of a point-to-point
+trunk between two `SfuBridge` instances, carried over the existing
+UDP engine and keyed with its own SRTP context — the relay hop is
+encrypted and authenticated independently of the participant legs it
+carries, so a trunk peer authenticates frames without holding any
+participant key.
+
+Wire format (one datagram per frame; first byte demuxes):
+
+- **media frame** — a trunk-level RTP packet (version bits ``0x80``):
+  ssrc ``TRUNK_SSRC``, its own 16-bit trunk seq space, payload =
+  ``conf:u32be || inner wire bytes``.  The inner bytes are the
+  participant's ORIGINAL SRTP-protected packet, untouched: the far
+  bridge unprotects the trunk layer, then feeds the inner packet to
+  its own ingest path where the participant's row key (synced via the
+  roster plane) authenticates it end-to-end.  The whole trunk packet
+  is protected by the trunk `SrtpStreamTable`.
+- **control frame** — ``0xC5 || kind:u8 || body``.  HEARTBEAT/ACK
+  (liveness + RTT), NACK (trunk-seq loss report), SPEAKERS (top-K
+  speaker set per conference), ROSTER (remote conference membership +
+  admission parameters for failover adoption) carry JSON bodies; FEC
+  carries a packed XOR parity over the last `fec_k` protected media
+  frames.
+
+The trunk payload is the PR 11 **top-K speaker bus**, not raw
+per-participant fan-out: `wants()` admits only the current speaker
+set of a cascaded conference, and SPEAKERS frames propagate ranking
+flips so both bridges restrict the same legs.
+
+Loss recovery spans the extra hop under its OWN deadline budget
+(`TrunkConfig.deadline_budget_s`): the receive side tracks trunk-seq
+gaps (`rtp/loss.LossTracker`), schedules deadline-aware NACKs through
+`sfu/recovery.NackScheduler`, the send side serves RTX from a
+`PacketCache` behind a `TokenBucket`, and XOR FEC groups recover
+single losses without a round trip.  A loss whose deadline passes
+falls through to PLC accounting (`plc_fallthrough_total`) and is
+never re-NACKed — concealment on the destination bridge, not a
+retransmission storm across the trunk.
+
+Liveness reuses the PR 16 admission machinery: heartbeats on a fixed
+cadence, `heartbeat_miss_down` misses flip the trunk ``down`` (the
+`on_down` hook is the `CascadeSupervisor`'s failover trigger), relay
+admission refuses with typed ``trunk_down`` / ``trunk_backlog``
+reasons plus a jittered-exponential retry-after hint, and refused
+senders back off exactly like PR 16's reconnect clients.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from collections import OrderedDict, deque
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from libjitsi_tpu.core.packet import PacketBatch
+from libjitsi_tpu.io import UdpEngine
+from libjitsi_tpu.rtp import header as rtp_header
+from libjitsi_tpu.rtp.loss import LossTracker
+from libjitsi_tpu.sfu.cache import PacketCache
+from libjitsi_tpu.sfu.recovery import (NackScheduler, RecoveryConfig,
+                                       TokenBucket)
+from libjitsi_tpu.transform.srtp import SrtpStreamTable
+from libjitsi_tpu.utils.logging import get_logger
+
+_log = get_logger("mesh.cascade")
+
+#: the trunk's own RTP stream identity (one seq/ROC space per direction)
+TRUNK_SSRC = 0x7B1D6E00
+TRUNK_PT = 0x5D
+
+MAGIC_CONTROL = 0xC5
+KIND_HEARTBEAT = 1
+KIND_HEARTBEAT_ACK = 2
+KIND_NACK = 3
+KIND_SPEAKERS = 4
+KIND_ROSTER = 5
+KIND_FEC = 6
+
+
+@dataclass
+class TrunkConfig:
+    """Knobs for one trunk leg (seconds unless suffixed)."""
+
+    heartbeat_interval_s: float = 0.05
+    heartbeat_miss_down: int = 5       # consecutive misses -> "down"
+    deadline_budget_s: float = 0.12    # trunk-hop NACK/RTX budget
+    rtt_init_s: float = 0.02           # assumed until measured
+    backlog_bound: int = 256           # queued frames past this: refuse
+    retry_base_s: float = 0.05         # trunk_down retry-after base
+    roster_interval_s: float = 0.25    # roster-sync cadence
+    fec_k: int = 4                     # XOR group size; 0 disables
+    nack_budget: int = 16              # trunk seqs NACKed per round
+    rtx_budget_bps: float = 2_000_000.0
+    rtx_burst_bytes: int = 64 << 10
+    rx_window: int = 128               # protected frames kept for FEC
+
+
+class TrunkRelay:
+    """The trunk wire codec + per-direction SRTP/seq/recovery state.
+
+    One instance per trunk end; `CascadeTrunk` owns the socket,
+    liveness and control plane and delegates framing here.  tx and rx
+    directions are keyed independently (`tx_key` protects what we
+    send; `rx_key` opens what the peer sends) so the two ends simply
+    swap the same key pair.
+    """
+
+    def __init__(self, tx_key: Tuple[bytes, bytes],
+                 rx_key: Tuple[bytes, bytes],
+                 cfg: Optional[TrunkConfig] = None):
+        self.cfg = cfg or TrunkConfig()
+        self._tx = SrtpStreamTable(capacity=1)
+        self._tx.add_stream(0, *tx_key)
+        self._rx = SrtpStreamTable(capacity=1)
+        self._rx.add_stream(0, *rx_key)
+        self.tx_seq = 1
+        self.tx_ts = 0
+        self.cache = PacketCache(max_age=2.0)
+        self.rtx_bucket = TokenBucket(self.cfg.rtx_budget_bps,
+                                      self.cfg.rtx_burst_bytes)
+        self.loss = LossTracker()
+        self.nacks = NackScheduler(RecoveryConfig(
+            nack_budget_per_stream=self.cfg.nack_budget,
+            rtt_s=self.cfg.rtt_init_s))
+        # recent PROTECTED rx frames by trunk seq, for FEC recovery
+        self._rx_window: "OrderedDict[int, bytes]" = OrderedDict()
+        # FEC accumulation over PROTECTED tx frames
+        self._fec_group: List[bytes] = []
+        self._fec_base: Optional[int] = None
+
+    # ------------------------------------------------------------ media
+    def frame_media(self, conf: int, inner: bytes,
+                    now: float) -> Optional[Tuple[int, bytes]]:
+        """Wrap + trunk-protect one inner wire packet; returns
+        (trunk_seq, protected frame bytes), or None when the inner
+        packet cannot fit the trunk MTU alongside its framing."""
+        payload = struct.pack(">I", int(conf) & 0xFFFFFFFF) + inner
+        if len(payload) + 64 > 1504:           # header + auth headroom
+            return None
+        seq = self.tx_seq & 0xFFFF
+        b = rtp_header.build([payload], [seq], [self.tx_ts],
+                             [TRUNK_SSRC], [TRUNK_PT], stream=[0])
+        self.tx_seq = (self.tx_seq + 1) & 0xFFFF
+        self.tx_ts += 1
+        wire = self._tx.protect_rtp(b).to_bytes(0)
+        self.cache.insert(TRUNK_SSRC, seq, wire, now=now)
+        if self.cfg.fec_k > 0:
+            if self._fec_base is None:
+                self._fec_base = seq
+            self._fec_group.append(wire)
+        return seq, wire
+
+    def take_fec(self) -> Optional[bytes]:
+        """XOR parity frame over the accumulated group, when full."""
+        if self.cfg.fec_k <= 0 or len(self._fec_group) < self.cfg.fec_k:
+            return None
+        group, self._fec_group = self._fec_group, []
+        base, self._fec_base = self._fec_base, None
+        maxlen = max(len(g) for g in group)
+        xor = np.zeros(maxlen, dtype=np.uint8)
+        lens = []
+        for g in group:
+            a = np.frombuffer(g, dtype=np.uint8)
+            xor[: len(a)] ^= a
+            lens.append(len(g))
+        body = struct.pack(">HBH", base & 0xFFFF, len(group), maxlen)
+        body += struct.pack(f">{len(group)}H", *lens)
+        return bytes([MAGIC_CONTROL, KIND_FEC]) + body + xor.tobytes()
+
+    def on_fec(self, body: bytes) -> Optional[Tuple[int, bytes]]:
+        """Try to recover the single missing frame of an FEC group from
+        the rx window; returns (seq, protected frame) on success."""
+        base, k, maxlen = struct.unpack(">HBH", body[:5])
+        lens = struct.unpack(f">{k}H", body[5:5 + 2 * k])
+        xor = np.frombuffer(body[5 + 2 * k:], dtype=np.uint8).copy()
+        if len(xor) != maxlen:
+            return None
+        missing = [i for i in range(k)
+                   if ((base + i) & 0xFFFF) not in self._rx_window]
+        if len(missing) != 1:
+            return None                    # 0 missing or unrecoverable
+        for i in range(k):
+            seq = (base + i) & 0xFFFF
+            if seq in self._rx_window:
+                a = np.frombuffer(self._rx_window[seq], dtype=np.uint8)
+                xor[: len(a)] ^= a
+        mi = missing[0]
+        return (base + mi) & 0xFFFF, xor[: lens[mi]].tobytes()
+
+    def open_media(self, wire: bytes,
+                   now: float) -> Optional[Tuple[int, int, bytes]]:
+        """Unprotect one trunk media frame -> (trunk_seq, conf, inner
+        wire bytes), tracking loss/NACK/FEC state.  None on auth
+        failure or replay."""
+        hdr_seq = struct.unpack(">H", wire[2:4])[0]
+        batch = PacketBatch.from_payloads([wire], stream=[0])
+        dec, ok = self._rx.unprotect_rtp(batch)
+        if not bool(np.asarray(ok)[0]):
+            return None
+        self._rx_window[hdr_seq] = wire
+        while len(self._rx_window) > self.cfg.rx_window:
+            self._rx_window.popitem(last=False)
+        self.nacks.on_arrival(TRUNK_SSRC, hdr_seq)
+        fresh, _adv = self.loss.observe(hdr_seq)
+        if fresh:
+            self.nacks.on_losses(TRUNK_SSRC, fresh, now,
+                                 deadline=now + self.cfg.deadline_budget_s)
+        hdr = rtp_header.parse(dec)
+        body = dec.to_bytes(0)[int(hdr.payload_off[0]):]
+        conf = struct.unpack(">I", body[:4])[0]
+        return hdr_seq, conf, body[4:]
+
+    def serve_nack(self, seqs, now: float) -> List[bytes]:
+        """Sender side of a trunk NACK: cached frames, RTX-budgeted."""
+        out = []
+        for s in seqs:
+            pkt = self.cache.get(TRUNK_SSRC, int(s))
+            if pkt is not None and self.rtx_bucket.allow(len(pkt), now):
+                out.append(pkt)
+        return out
+
+    def collect(self, now: float) -> Tuple[List[int], List[int]]:
+        """Deadline-aware NACK round: (seqs to NACK now, seqs whose
+        deadline expired unrecovered — the PLC fall-through; those are
+        never re-NACKed)."""
+        nacks, expired = self.nacks.collect(now)
+        return (nacks.get(TRUNK_SSRC, []), expired.get(TRUNK_SSRC, []))
+
+
+class CascadeTrunk:
+    """One end of a bridge-to-bridge trunk: socket, liveness state
+    machine, typed relay admission, and the conference/speaker/roster
+    control plane.  Drive it with `pump(now)` once per supervisor tick
+    (off-tick plane — after the lifecycle commit barrier)."""
+
+    def __init__(self, tx_key: Tuple[bytes, bytes],
+                 rx_key: Tuple[bytes, bytes],
+                 config: Optional[TrunkConfig] = None,
+                 port: int = 0, seed: int = 0):
+        self.cfg = config or TrunkConfig()
+        self.relay = TrunkRelay(tx_key, rx_key, self.cfg)
+        self.engine = UdpEngine(port=port, max_batch=256)
+        self.port = self.engine.port
+        self.peer: Optional[Tuple[str, int]] = None
+        self.state = "idle"               # idle -> up <-> down
+        self.now = 0.0                    # model clock, set by pump()
+        self._rng = np.random.default_rng(seed)
+        self._attached = False            # riding a MediaLoop ring
+        # liveness
+        self.hb_seq = 0
+        self._hb_sent_at: Dict[int, float] = {}
+        self._hb_next = 0.0
+        self._hb_miss_streak = 0
+        self.attempts = 0                 # reconnect attempts while down
+        self.rtt = self.cfg.rtt_init_s
+        # cascaded conferences: conf -> speaker ssrc set (None = all)
+        self._confs: Dict[int, Optional[set]] = {}
+        self.local_roster: Dict[int, list] = {}
+        self.remote_roster: Dict[int, list] = {}
+        self._remote_ssrcs: set = set()    # members homed on the peer
+        self._roster_next = 0.0
+        # backlog while not "up" (flushes on recovery; bounded)
+        self._tx_queue: deque = deque()
+        # hooks (wired by CascadeSupervisor / tests)
+        self.on_down: Optional[Callable[[float], None]] = None
+        self.on_up: Optional[Callable[[float], None]] = None
+        self.on_speakers: Optional[Callable[[int, list], None]] = None
+        self.on_roster: Optional[Callable[[dict], None]] = None
+        self.deliver: Optional[Callable[[int, bytes], None]] = None
+        # counters (all registered in register_metrics)
+        self.heartbeats_total = 0
+        self.relay_frames_total = 0
+        self.relay_bytes_total = 0
+        self.nacks_sent_total = 0
+        self.rtx_served_total = 0
+        self.fec_recovered_total = 0
+        self.plc_fallthrough_total = 0
+        self.refusals_total = 0
+        self.unprotect_drops_total = 0
+        self.oversize_drops_total = 0
+        self._pps_window: deque = deque()  # (now, relay_frames_total)
+        self._rtt_ring = None              # metrics TimingRing when registered
+
+    # ---------------------------------------------------------- liveness
+    def connect(self, peer_ip: str, peer_port: int,
+                now: float = 0.0) -> None:
+        self.peer = (peer_ip, int(peer_port))
+        self.state = "up"                  # optimistic; heartbeats judge
+        self._hb_miss_streak = 0
+        self.attempts = 0
+        self._hb_next = now
+
+    def attach(self, loop) -> None:
+        """Put the trunk socket on the bridge loop's multi-ring drain:
+        trunk datagrams arrive with tick cadence through the same
+        ingress span as media, handed to `on_batch` instead of the RTP
+        path."""
+        loop.add_ring(self.engine, sink=self.on_batch)
+        self._attached = True
+
+    def admit_reason(self) -> Optional[str]:
+        """Typed relay admission (the PR 16 refusal surface): None when
+        the trunk accepts relay work right now."""
+        if self.state != "up":
+            return "trunk_down"
+        if len(self._tx_queue) >= self.cfg.backlog_bound:
+            return "trunk_backlog"
+        return None
+
+    def retry_after(self) -> float:
+        """Jittered-exponential retry-after hint for refused senders,
+        grown with the reconnect attempt count like PR 16's clients."""
+        base = self.cfg.retry_base_s
+        return float(base * (2 ** min(self.attempts, 6))
+                     * (1.0 + 0.25 * float(self._rng.random())))
+
+    # ------------------------------------------------------- conferences
+    def cascade_conference(self, conf: int, speakers=None) -> None:
+        """Mark a conference as cascaded over this trunk.  `speakers`
+        is the top-K speaker ssrc set forming the trunk payload (None
+        relays every member — the degenerate bus of a tiny meeting)."""
+        self._confs[int(conf)] = (None if speakers is None
+                                  else {int(s) for s in speakers})
+
+    def uncascade_conference(self, conf: int) -> None:
+        self._confs.pop(int(conf), None)
+
+    def set_speakers(self, conf: int, ssrcs, now: float = 0.0) -> None:
+        """Local top-K ranking flipped: restrict the trunk payload and
+        propagate the set to the peer (speaker bus, not fan-out)."""
+        conf = int(conf)
+        self._confs[conf] = {int(s) for s in ssrcs}
+        self._send_control(KIND_SPEAKERS,
+                           {"conf": conf,
+                            "ssrcs": sorted(self._confs[conf])})
+
+    def wants(self, conf, ssrc: int) -> bool:
+        if conf is None or int(conf) not in self._confs:
+            return False
+        if int(ssrc) in self._remote_ssrcs:
+            # homed on the PEER: its media reached this bridge via the
+            # trunk in the first place — relaying the locally-accepted
+            # copy back would be an echo loop (each packet ping-ponging
+            # until the replay window kills it)
+            return False
+        speakers = self._confs[int(conf)]
+        return speakers is None or int(ssrc) in speakers
+
+    def claim_member(self, conf: int, ssrc: int) -> None:
+        """Ownership transfer (failover adoption committed): the member
+        is homed HERE now — relay its media again, advertise it in the
+        local roster."""
+        conf, ssrc = int(conf), int(ssrc)
+        self._remote_ssrcs.discard(ssrc)
+        ms = self.remote_roster.get(conf)
+        if ms is not None:
+            ms = [m for m in ms if int(m["ssrc"]) != ssrc]
+            if ms:
+                self.remote_roster[conf] = ms
+            else:
+                self.remote_roster.pop(conf, None)
+
+    def set_roster(self, roster: Dict[int, list]) -> None:
+        """Local conference roster for failover adoption: conf ->
+        [{ssrc, rx, tx, name}] with keys hex-encoded.  Synced to the
+        peer on `roster_interval_s` cadence."""
+        self.local_roster = roster
+        self._roster_next = 0.0            # push on next pump
+
+    # ------------------------------------------------------------- relay
+    def relay_media(self, conf: int, inner: bytes, now: float) -> bool:
+        """Relay one participant wire packet across the trunk; returns
+        False on a typed refusal (caller may consult `admit_reason` /
+        `retry_after`)."""
+        reason = self.admit_reason()
+        if reason == "trunk_backlog" or (reason == "trunk_down"
+                                         and len(self._tx_queue)
+                                         >= self.cfg.backlog_bound):
+            self.refusals_total += 1
+            return False
+        framed = self.relay.frame_media(conf, inner, now)
+        if framed is None:
+            self.oversize_drops_total += 1
+            return False
+        _seq, wire = framed
+        if reason is None:
+            self._send(wire)
+            self.relay_frames_total += 1
+            self.relay_bytes_total += len(wire)
+            fec = self.relay.take_fec()
+            if fec is not None:
+                self._send(fec)
+        else:                              # down but under backlog bound
+            self._tx_queue.append(wire)
+        return True
+
+    def relay_pps(self) -> float:
+        """Relayed frames/s over a sliding ~2 s window (gauge)."""
+        if not self._pps_window:
+            return 0.0
+        t0, n0 = self._pps_window[0]
+        t1, n1 = self._pps_window[-1]
+        return float((n1 - n0) / (t1 - t0)) if t1 > t0 else 0.0
+
+    # -------------------------------------------------------------- pump
+    def pump(self, now: float) -> None:
+        """Per-tick trunk work: drain the socket (when not riding the
+        loop's ring), heartbeat/liveness, NACK rounds, PLC expiry,
+        roster sync, pps window."""
+        self.now = now
+        if not self._attached:
+            batch, sip, sport = self.engine.recv_batch(timeout_ms=0)
+            if batch.batch_size:
+                self.on_batch(batch, sip, sport, now=now)
+        self._liveness(now)
+        nack, expired = self.relay.collect(now)
+        if nack and self.state == "up":
+            self._send_control(KIND_NACK, {"seqs": [int(s) for s in nack]})
+            self.nacks_sent_total += len(nack)
+        if expired:
+            # deadline passed: the destination conceals; never re-NACK
+            self.plc_fallthrough_total += len(expired)
+        if self.local_roster and now >= self._roster_next:
+            self._send_control(KIND_ROSTER, {
+                "confs": {str(c): m for c, m in self.local_roster.items()}})
+            self._roster_next = now + self.cfg.roster_interval_s
+        self._pps_window.append((now, self.relay_frames_total))
+        while (len(self._pps_window) > 2
+               and now - self._pps_window[0][0] > 2.0):
+            self._pps_window.popleft()
+
+    def _liveness(self, now: float) -> None:
+        if self.peer is None:
+            return
+        if now < self._hb_next:
+            return
+        if self.state == "up":
+            self._hb_next = now + self.cfg.heartbeat_interval_s
+        else:
+            self.attempts += 1
+            self._hb_next = now + self.retry_after()
+        # unanswered heartbeats older than one interval are misses
+        stale = [s for s, t in self._hb_sent_at.items()
+                 if now - t > self.cfg.heartbeat_interval_s]
+        for s in stale:
+            del self._hb_sent_at[s]
+        self._hb_miss_streak += len(stale)
+        if (self.state == "up"
+                and self._hb_miss_streak >= self.cfg.heartbeat_miss_down):
+            self.state = "down"
+            _log.info("trunk_down", misses=self._hb_miss_streak)
+            if self.on_down is not None:
+                self.on_down(now)
+        self.hb_seq = (self.hb_seq + 1) & 0xFFFF
+        self._hb_sent_at[self.hb_seq] = now
+        self.heartbeats_total += 1
+        self._send_control(KIND_HEARTBEAT,
+                           {"seq": self.hb_seq, "t": now})
+
+    # ------------------------------------------------------------ ingress
+    def on_batch(self, batch: PacketBatch, _sip=None, _sport=None,
+                 now: Optional[float] = None) -> None:
+        """Ring sink / direct drain: demux every trunk datagram."""
+        now = self.now if now is None else now
+        for i in range(batch.batch_size):
+            self.on_datagram(batch.to_bytes(i), now)
+
+    def on_datagram(self, data: bytes, now: float) -> None:
+        if len(data) < 2:
+            return
+        if data[0] == MAGIC_CONTROL:
+            self._on_control(data[1], data[2:], now)
+            return
+        if (len(data) < 12
+                or int.from_bytes(data[8:12], "big") != TRUNK_SSRC):
+            # not a trunk frame: the local bridge latches a delivered
+            # remote speaker's return address to THIS socket, so its
+            # fanout echoes land here — expected noise, not corruption
+            return
+        opened = self.relay.open_media(data, now)
+        if opened is None:
+            self.unprotect_drops_total += 1
+            return
+        _seq, conf, inner = opened
+        if self.deliver is not None:
+            self.deliver(conf, inner)
+
+    def _on_control(self, kind: int, body: bytes, now: float) -> None:
+        if kind == KIND_FEC:
+            rec = self.relay.on_fec(body)
+            if rec is not None:
+                seq, wire = rec
+                self.fec_recovered_total += 1
+                self.relay.nacks.on_arrival(TRUNK_SSRC, seq)
+                opened = self.relay.open_media(wire, now)
+                if opened is not None and self.deliver is not None:
+                    self.deliver(opened[1], opened[2])
+            return
+        msg = json.loads(body.decode("utf-8"))
+        if kind == KIND_HEARTBEAT:
+            self._send_control(KIND_HEARTBEAT_ACK, msg)
+        elif kind == KIND_HEARTBEAT_ACK:
+            sent = self._hb_sent_at.pop(int(msg["seq"]), None)
+            if sent is not None:
+                self.rtt = max(1e-6, now - sent)
+                self.relay.nacks.cfg.rtt_s = min(
+                    self.rtt, self.cfg.deadline_budget_s / 2)
+                if self._rtt_ring is not None:
+                    self._rtt_ring.record(self.rtt)
+            self._hb_miss_streak = 0
+            if self.state != "up":
+                self.state = "up"
+                self.attempts = 0
+                _log.info("trunk_up", queued=len(self._tx_queue))
+                while self._tx_queue:
+                    self._send(self._tx_queue.popleft())
+                    self.relay_frames_total += 1
+                if self.on_up is not None:
+                    self.on_up(now)
+        elif kind == KIND_NACK:
+            served = self.relay.serve_nack(msg["seqs"], now)
+            for wire in served:
+                self._send(wire)
+            self.rtx_served_total += len(served)
+        elif kind == KIND_SPEAKERS:
+            conf = int(msg["conf"])
+            self._confs[conf] = {int(s) for s in msg["ssrcs"]}
+            if self.on_speakers is not None:
+                self.on_speakers(conf, msg["ssrcs"])
+        elif kind == KIND_ROSTER:
+            self.remote_roster = {int(c): m
+                                  for c, m in msg["confs"].items()}
+            self._remote_ssrcs = {int(m["ssrc"])
+                                  for ms in self.remote_roster.values()
+                                  for m in ms}
+            if self.on_roster is not None:
+                self.on_roster(self.remote_roster)
+
+    # --------------------------------------------------------------- I/O
+    def _send(self, data: bytes) -> None:
+        if self.peer is None:
+            return
+        self.engine.send_batch(PacketBatch.from_payloads([data]),
+                               self.peer[0], self.peer[1])
+
+    def _send_control(self, kind: int, msg: dict) -> None:
+        body = json.dumps(msg, sort_keys=True).encode("utf-8")
+        self._send(bytes([MAGIC_CONTROL, kind]) + body)
+
+    # ----------------------------------------------------------- metrics
+    def register_metrics(self, registry, prefix: str = "trunk") -> None:
+        registry.register_counters(self, [
+            ("heartbeats_total", "trunk heartbeats sent"),
+            ("relay_frames_total", "media frames relayed across trunk"),
+            ("relay_bytes_total", "relayed trunk bytes"),
+            ("nacks_sent_total", "trunk-seq NACKs sent"),
+            ("rtx_served_total", "trunk RTX frames served from cache"),
+            ("fec_recovered_total", "trunk frames recovered via XOR FEC"),
+            ("plc_fallthrough_total",
+             "deadline-expired trunk losses conceded to PLC"),
+            ("refusals_total", "typed trunk relay refusals"),
+            ("unprotect_drops_total", "trunk frames failing SRTP auth"),
+            ("oversize_drops_total", "inner packets over trunk MTU"),
+        ], prefix=prefix)
+        registry.register_scalar(f"{prefix}_relay_pps", self.relay_pps,
+                                 help_="relayed frames/s (sliding 2s)",
+                                 kind="gauge")
+        registry.register_scalar(
+            f"{prefix}_state_up",
+            lambda: 1.0 if self.state == "up" else 0.0,
+            help_="1 while the trunk liveness state is up")
+        registry.register_scalar(
+            f"{prefix}_tx_backlog", lambda: float(len(self._tx_queue)),
+            help_="frames queued while the trunk is down")
+        self._rtt_ring = registry.timing(f"{prefix}_rtt")
+
+    # --------------------------------------------------------- lifecycle
+    def snapshot(self) -> dict:
+        """Control-plane state for the checkpoint spine.  Crypto/seq/
+        recovery state is transient (re-established by live traffic,
+        like the bridge's caches); what must survive a crash is which
+        conferences are cascaded and the last synced rosters."""
+        return {
+            "peer": list(self.peer) if self.peer else None,
+            "confs": {str(c): (sorted(s) if s is not None else None)
+                      for c, s in self._confs.items()},
+            "local_roster": {str(c): m
+                             for c, m in self.local_roster.items()},
+            "remote_roster": {str(c): m
+                              for c, m in self.remote_roster.items()},
+        }
+
+    def restore(self, snap: dict, now: float = 0.0) -> None:
+        if snap.get("peer"):
+            self.connect(snap["peer"][0], int(snap["peer"][1]), now=now)
+        self._confs = {int(c): (set(s) if s is not None else None)
+                       for c, s in snap.get("confs", {}).items()}
+        self.local_roster = {int(c): m for c, m
+                             in snap.get("local_roster", {}).items()}
+        self.remote_roster = {int(c): m for c, m
+                              in snap.get("remote_roster", {}).items()}
+        self._remote_ssrcs = {int(m["ssrc"])
+                              for ms in self.remote_roster.values()
+                              for m in ms}
+
+    def close(self) -> None:
+        self.engine.close()

@@ -140,6 +140,18 @@ class ConferencePlacer:
         self.placements = 0
         self.rejects = 0
         self.moves_planned = 0
+        # bridge-level placement axis (PR 17 cascade): `place` chooses
+        # BRIDGES, not just shards, when `enable_bridges` turns the
+        # axis on.  Same greedy least-loaded cost model one level up:
+        # a conference is homed on one bridge of the cascade, its
+        # shard placement is local to that bridge, and failover
+        # (`evacuate_bridge`) re-homes a dead bridge's conferences
+        # onto the survivors.
+        self.n_bridges = 0
+        self._bridge_of: Dict[int, int] = {}
+        self._bridge_cost: List[float] = []
+        self.bridge_placements = 0
+        self.bridge_evacuations = 0
 
     # ------------------------------------------------------------- cost
 
@@ -201,6 +213,87 @@ class ConferencePlacer:
         self._assign(conf_id, best, n)
         self.placements += 1
         return best
+
+    # -------------------------------------------------- bridge axis
+    def enable_bridges(self, n_bridges: int) -> None:
+        """Turn on the bridge-level placement axis: conferences are
+        homed on one of `n_bridges` cascaded bridges before (and
+        independently of) their shard placement on that bridge."""
+        if n_bridges < 1:
+            raise ValueError("need at least one bridge")
+        self.n_bridges = int(n_bridges)
+        self._bridge_cost = [0.0] * self.n_bridges
+
+    def bridge_of(self, conf_id: int) -> Optional[int]:
+        return self._bridge_of.get(int(conf_id))
+
+    def place_bridge(self, conf_id: int, n_participants: int,
+                     avoid=()) -> Optional[int]:
+        """Home a NEW conference on the least-loaded bridge of the
+        cascade (same cost model as shard placement, one level up).
+        Bridges in `avoid` — dead peers, burning error budgets — are
+        skipped unless no other bridge exists.  Re-placing a known
+        conference returns its current home."""
+        if self.n_bridges < 1:
+            raise RuntimeError("bridge axis not enabled")
+        conf_id = int(conf_id)
+        if conf_id in self._bridge_of:
+            return self._bridge_of[conf_id]
+        c = self.cost(n_participants)
+        avoid = {int(a) for a in avoid}
+        best = None
+        for only_clean in (True, False) if avoid else (False,):
+            for b in range(self.n_bridges):
+                if only_clean and b in avoid:
+                    continue
+                if (best is None
+                        or self._bridge_cost[b] < self._bridge_cost[best]):
+                    best = b
+            if best is not None:
+                break
+        self._bridge_of[conf_id] = best
+        self._bridge_cost[best] += c
+        self.bridge_placements += 1
+        return best
+
+    def adopt_bridge(self, conf_id: int, bridge: int,
+                     n_participants: int) -> None:
+        """Forced re-homing (failover adoption): the survivor takes a
+        dead peer's conference regardless of load."""
+        conf_id = int(conf_id)
+        prev = self._bridge_of.get(conf_id)
+        c = self.cost(n_participants)
+        if prev is not None:
+            self._bridge_cost[prev] = max(
+                0.0, self._bridge_cost[prev] - c)
+        self._bridge_of[conf_id] = int(bridge)
+        self._bridge_cost[int(bridge)] += c
+
+    def evacuate_bridge(self, bridge: int) -> List[int]:
+        """A bridge died: un-home its conferences and return them (the
+        failover plane re-places each via `adopt_bridge` as adoption
+        commits — never implicitly, so a refused adoption leaves the
+        conference un-homed and retryable, not torn)."""
+        bridge = int(bridge)
+        out = sorted(c for c, b in self._bridge_of.items()
+                     if b == bridge)
+        for c in out:
+            del self._bridge_of[c]
+        if bridge < len(self._bridge_cost):
+            self._bridge_cost[bridge] = 0.0
+        self.bridge_evacuations += 1
+        return out
+
+    def release_bridge(self, conf_id: int,
+                       n_participants: int = 0) -> None:
+        conf_id = int(conf_id)
+        b = self._bridge_of.pop(conf_id, None)
+        if b is not None and n_participants:
+            self._bridge_cost[b] = max(
+                0.0, self._bridge_cost[b] - self.cost(n_participants))
+
+    def bridge_loads(self) -> List[float]:
+        return list(self._bridge_cost)
 
     def rebuild(self, assignments, broadcast=()) -> None:
         """Reset accounting to match reality (checkpoint recovery: the
@@ -443,6 +536,18 @@ class ConferencePlacer:
             lambda: [({"shard": str(s)}, float(ld.rows))
                      for s, ld in enumerate(self._loads)],
             help_="participant rows resident per shard")
+        if self.n_bridges:
+            registry.register_counters(self, (
+                ("bridge_placements",
+                 "conferences homed onto cascade bridges"),
+                ("bridge_evacuations",
+                 "dead-bridge evacuations (failover)"),
+            ), prefix=prefix)
+            registry.register_multi(
+                f"{prefix}_bridge_cost",
+                lambda: [({"bridge": str(b)}, c)
+                         for b, c in enumerate(self._bridge_cost)],
+                help_="cost-model load per cascade bridge")
 
     def status(self) -> dict:
         return {
@@ -453,6 +558,8 @@ class ConferencePlacer:
                        for s, ld in enumerate(self._loads)],
             "conferences": {str(c): s
                             for c, s in sorted(self._shard_of.items())},
+            "bridges": {str(c): b
+                        for c, b in sorted(self._bridge_of.items())},
             "broadcast": {str(c): {"home": self._shard_of.get(c),
                                    "listeners": dict(sorted(per.items()))}
                           for c, per in

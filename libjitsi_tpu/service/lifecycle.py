@@ -68,7 +68,7 @@ _log = get_logger("lifecycle")
 #: (typed: metrics, flight events and callers all share these strings)
 ADMIT_REASONS = ("capacity", "backlog", "duplicate", "fast_burn",
                  "stalled", "shedding", "host_bound", "shard_burn",
-                 "handshake_backlog")
+                 "handshake_backlog", "trunk_down", "trunk_backlog")
 
 
 @dataclass
@@ -265,6 +265,11 @@ class StreamLifecycleManager:
         # warmup ladder and the bcast_listeners gauge
         self._bcast: Dict[int, dict] = {}
         self._listener_sids: set = set()
+        # cascaded conferences homed on a REMOTE bridge (mesh/cascade):
+        # conf key -> trunk.  While the trunk is down/backlogged, joins
+        # into these refuse with the trunk's typed reason + retry-after
+        # hint; failover adoption promotes them local and clears this
+        self._remote_conf: Dict[int, object] = {}
         self._role_flips: List[Tuple[int, int, str]] = []
         self.speaker_promotions = 0
         self.speaker_demotions = 0
@@ -408,6 +413,40 @@ class StreamLifecycleManager:
         mid-tick."""
         self._role_flips.append((int(conference), int(sid), "speaker"))
 
+    # ------------------------------------------------------- cascade
+    def mark_remote_conference(self, conference, trunk) -> None:
+        """A cascaded conference homed on the trunk's PEER bridge:
+        local joins are admitted while the trunk is up (they become
+        local legs of the cascade) but refuse with the trunk's typed
+        reason (`trunk_down` / `trunk_backlog`) while it is not."""
+        self._remote_conf[self._conf_key(0, conference)] = trunk
+
+    def promote_remote_conference(self, conference) -> None:
+        """Failover: the conference is now homed HERE (orphan adoption
+        committed) — joins stop consulting the trunk."""
+        key = self._conf_key(0, conference)
+        if self._remote_conf.pop(key, None) is not None:
+            self.flight.record("conf_promoted", tick=self.ticks(),
+                               conf=key)
+
+    def retry_after_hint(self, reason: str, conference=None) -> float:
+        """Seconds a refused caller should wait before retrying (the
+        PR 16 hint surface, extended to trunk refusals): handshake
+        refusals ride the queue's drain estimate, trunk refusals the
+        trunk's jittered-exponential backoff."""
+        if reason == "handshake_backlog" and self.handshakes is not None:
+            return self.handshakes.retry_after
+        if reason in ("trunk_down", "trunk_backlog"):
+            trunk = None
+            if conference is not None:
+                trunk = self._remote_conf.get(
+                    self._conf_key(0, conference))
+            if trunk is None and self._remote_conf:
+                trunk = next(iter(self._remote_conf.values()))
+            if trunk is not None:
+                return float(trunk.retry_after())
+        return self.cfg.handshake_retry_tick_s
+
     def demote_speaker(self, conference, sid: int) -> None:
         """Queue a speaker→listener role flip (commit-barrier event)."""
         self._role_flips.append((int(conference), int(sid), "listener"))
@@ -504,6 +543,15 @@ class StreamLifecycleManager:
         shard."""
         ssrc = int(ssrc) & 0xFFFFFFFF
         reason = self._admission_reason(ssrc)
+        if (reason is None and conference is not None
+                and self._remote_conf):
+            # cascaded conference homed on the trunk's peer: typed
+            # trunk refusal while the trunk is down or backlogged
+            # (None while up — the join becomes a local cascade leg)
+            trunk = self._remote_conf.get(
+                self._conf_key(ssrc, conference))
+            if trunk is not None:
+                reason = trunk.admit_reason()
         conf = shard = None
         bcast = False
         if reason is None and self.placer is not None:

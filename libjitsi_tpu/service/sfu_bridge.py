@@ -275,6 +275,11 @@ class SfuBridge:
         # every other member is a fanout-only listener row (routes to
         # nobody, uplink RTP masked off in the loop).
         self._bcast_speakers: Dict[int, set] = {}
+        # cascade trunks (mesh/cascade.py): conference id -> trunk.
+        # Accepted uplink media from a cascaded conference's current
+        # speaker set is relayed across the trunk (top-K speaker bus,
+        # never raw per-participant fan-out)
+        self._trunks: Dict[int, object] = {}
 
     # ---------------------------------------------------------- endpoints
     def add_endpoint(self, ssrc: int, rx_key: Tuple[bytes, bytes],
@@ -542,6 +547,43 @@ class SfuBridge:
             if conf == conference:
                 self.loop.set_fanout_only(sid, sid not in speakers)
         self._rebuild_routes()
+        tr = self._trunks.get(conference)
+        if tr is not None:
+            # propagate the top-K flip across the trunk: the peer
+            # restricts the same legs (speaker bus, not fan-out)
+            tr.set_speakers(conference,
+                            [self._ssrc_of[s] for s in speakers
+                             if s in self._ssrc_of], now=self._now)
+
+    # ------------------------------------------------------------ cascade
+    def attach_trunk(self, trunk, conference, speakers=None) -> None:
+        """Cascade `conference` over `trunk` (mesh/cascade.py): every
+        accepted uplink packet from the conference's speaker set is
+        relayed across the trunk, and speaker-set flips propagate to
+        the peer bridge.  `speakers` is the initial top-K ssrc set
+        (None relays every member — the degenerate bus)."""
+        self._trunks[int(conference)] = trunk
+        trunk.cascade_conference(int(conference), speakers)
+
+    def detach_trunk(self, conference) -> None:
+        tr = self._trunks.pop(int(conference), None)
+        if tr is not None:
+            tr.uncascade_conference(int(conference))
+
+    def _relay_trunk(self, batch: PacketBatch, rows: np.ndarray,
+                     streams, ssrcs) -> None:
+        """Relay the ORIGINAL protected wire bytes of accepted rows
+        whose (conference, ssrc) rides a trunk's speaker bus.  The
+        inner packet stays untouched — the peer bridge authenticates
+        it with the participant's own row key."""
+        for i, r in enumerate(rows):
+            conf = self._conf_of.get(int(streams[i]))
+            if conf is None:
+                continue
+            tr = self._trunks.get(conf)
+            if tr is not None and tr.wants(conf, int(ssrcs[i])):
+                tr.relay_media(conf, batch.to_bytes(int(r)),
+                               now=self._now)
 
     def clear_broadcast(self, conference: int) -> None:
         """Drop a conference's broadcast routing (back to full mesh)."""
@@ -883,6 +925,10 @@ class SfuBridge:
         with self.loop.tracer.span("recovery"):
             self.recovery.observe_rx(hdr.ssrc, hdr.seq, self._now)
         self._feed_bwe(sub, rows, hdr=hdr)
+        if self._trunks:
+            # cascade relay taps the PROTECTED ingress rows (the trunk
+            # re-wraps them; participant SRTP crosses intact)
+            self._relay_trunk(batch, rows, sub.stream, hdr.ssrc)
         # stamp the bridge's own abs-send-time before the fan-out so
         # every receiver leg can run receive-side GCC on its downlink
         sub, _ = self._ast.rtp_transformer.transform(sub)

@@ -49,6 +49,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.utils.flight import FlightRecorder
 from libjitsi_tpu.utils.health import (ExponentialBackoff, SlidingWindowCounter,
                                        Watchdog, retrying, state_code)
@@ -409,7 +410,7 @@ class BridgeSupervisor:
             self._evicted.discard(int(sid))
 
     def admission_decision(self, shard=None, handshake_backlog=None,
-                           handshake_bound=0):
+                           handshake_bound=0, trunk=None):
         """Burn-aware admission control for the lifecycle plane:
         `(ok, reason)` where reason is a typed string.  Joins are
         refused while the error budget is burning fast, while the phase
@@ -439,6 +440,13 @@ class BridgeSupervisor:
         if (handshake_bound and handshake_backlog is not None
                 and handshake_backlog >= handshake_bound):
             return False, "handshake_backlog"
+        if trunk is not None:
+            # cascade relay admission (mesh/cascade.py): typed
+            # trunk_down / trunk_backlog, same surface as the
+            # handshake plane's backpressure
+            r = trunk.admit_reason()
+            if r is not None:
+                return False, r
         if self.watchdog.state == "stalled":
             return False, "stalled"
         if self._shed_set:
@@ -544,6 +552,12 @@ class BridgeSupervisor:
             # installs) ride the checkpoint so recover() can complete
             # or roll them back instead of leaving half-installed rows
             blob["lifecycle"] = self.lifecycle.snapshot()
+        # cascade control plane (CascadeSupervisor): trunk peer/rosters
+        # and the in-flight adoption queue ride the same atomic file —
+        # a crash mid-failover resumes adoption, never a torn trunk
+        snap_cascade = getattr(self, "cascade_snapshot", None)
+        if snap_cascade is not None:
+            blob["cascade"] = snap_cascade()
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "wb") as f:
             pickle.dump(blob, f, protocol=pickle.HIGHEST_PROTOCOL)
@@ -763,3 +777,445 @@ class BridgeSupervisor:
                 "bound": self._phase_attr()[3],
                 "slo_state": self._slo_state(),
                 "postmortems": len(self.postmortems)}
+
+
+class CascadeSupervisor(BridgeSupervisor):
+    """Supervisor for one end of a bridge-to-bridge cascade
+    (mesh/cascade.py): everything BridgeSupervisor does, plus the trunk
+    control plane and the failover headline — a conference that
+    survives the death of its home bridge.
+
+    Division of labour with CascadeTrunk: the trunk owns the wire
+    (SRTP-keyed relay, heartbeats, NACK/RTX/FEC under the hop's
+    deadline budget, typed `trunk_down`/`trunk_backlog` refusals); this
+    class owns POLICY — which conferences ride the trunk, roster sync
+    from the bridge's committed keyed rows, and orphan adoption when
+    the peer dies:
+
+    * heartbeat loss trips `trunk.on_down` -> `_on_trunk_down`: the
+      peer's conferences are promoted (their typed trunk refusals
+      lift), the placer's bridge axis is evacuated, and every remote
+      roster member is queued for adoption;
+    * adoption rides the NORMAL lifecycle commit barrier —
+      `request_join` -> staged -> committed between ticks; an orphan
+      counts as adopted only once its row resolves committed, and a
+      join refused under pressure re-queues on the PR 16 retry-after
+      hint with exponential escalation (adopt-or-retry, never torn);
+    * the whole adoption queue plus trunk control plane rides the
+      checkpoint spine (`cascade_snapshot`), so a crash mid-failover
+      resumes adoption on recovery instead of stranding half a
+      conference.
+
+    Per-bridge burn: when an SloEngine is attached, a
+    `SlicedSloSpec(label="bridge")` tracks this bridge's trunk media
+    continuity exactly as PR 10's `label="shard"` slices shard burn.
+    """
+
+    #: a queued-but-uncommitted adoption older than this is treated as
+    #: rolled back and re-queued (covers recovery from a checkpoint
+    #: that captured the join before its commit)
+    adopt_commit_timeout_s = 1.0
+    #: roster re-derivation cadence (ticks); pushes only on change
+    roster_sync_ticks = 5
+
+    def __init__(self, bridge, trunk, config=None, metrics=None,
+                 bridge_id: int = 0, peer_bridge_id: int = 1, **kw):
+        super().__init__(bridge, config, metrics=None, **kw)
+        self.trunk = trunk
+        self.bridge_id = int(bridge_id)
+        self.peer_bridge_id = int(peer_bridge_id)
+        trunk.on_down = self._on_trunk_down
+        trunk.on_up = self._on_trunk_up
+        trunk.on_roster = self._on_roster
+        trunk.on_speakers = self._apply_remote_speakers
+        trunk.deliver = self._deliver_remote
+        if hasattr(trunk, "flight"):
+            trunk.flight = self.flight
+        self.trunk_failovers_total = 0
+        self.orphans_adopted = 0
+        self.orphans_requeued = 0
+        self.remote_delivered = 0
+        self.adopting = False            # failover in progress
+        self._now = 0.0                  # model clock from tick()
+        self._adopt_q: deque = deque()   # entries awaiting request_join
+        self._pending_commit: List[dict] = []   # joined, pre-barrier
+        self._conf_outstanding: Dict[int, int] = {}
+        self._remote_marks: set = set()  # confs homed on the peer
+        self._marks_pending = False      # marks awaiting lifecycle
+        if self.slo is not None:
+            self._register_bridge_slo()
+        if metrics is not None:
+            self.register_metrics(metrics)
+
+    # ------------------------------------------------------ wiring
+
+    def cascade_conference(self, conference, speakers=None,
+                           remote: bool = False) -> None:
+        """Put one conference on the trunk.  `remote=False`: homed
+        HERE — local speaker-bus media relays to the peer.
+        `remote=True`: homed on the PEER — local joins consult the
+        trunk's typed admission (the PR 16 refusal surface) and the
+        conference is a failover-adoption candidate."""
+        conf = int(conference)
+        self.bridge.attach_trunk(self.trunk, conf, speakers)
+        if remote:
+            self._remote_marks.add(conf)
+            if self.lifecycle is not None:
+                self.lifecycle.mark_remote_conference(conf, self.trunk)
+            else:
+                self._marks_pending = True
+
+    # -------------------------------------------------------- tick
+
+    def tick(self, now: Optional[float] = None):
+        result = super().tick(now=now)
+        tnow = float(now) if now is not None else self.clock()
+        self._now = tnow
+        lc = self.lifecycle
+        if lc is not None and self._marks_pending:
+            for conf in sorted(self._remote_marks):
+                lc.mark_remote_conference(conf, self.trunk)
+            self._marks_pending = False
+        if self.ticks % self.roster_sync_ticks == 0:
+            self._sync_roster()
+        self.trunk.pump(tnow)
+        if self._adopt_q and lc is not None:
+            self._drain_adoptions(tnow)
+        if self._pending_commit:
+            self._scan_commits(tnow)
+        if (self.adopting and not self._adopt_q
+                and not self._pending_commit):
+            self.adopting = False
+        return result
+
+    def _sync_roster(self) -> None:
+        """Re-derive the local roster from the bridge's COMMITTED keyed
+        rows (staged rows are not yet adoptable) and push on change.
+        This is what makes failover possible at all: the survivor can
+        only re-key orphans it has a roster for."""
+        b = self.bridge
+        roster: Dict[int, list] = {}
+        for sid, conf in sorted(b._conf_of.items()):
+            conf = int(conf)
+            if conf not in getattr(b, "_trunks", {}):
+                continue
+            if sid in b._staged:
+                continue
+            ssrc = b._ssrc_of.get(sid)
+            rx = b._rx_keys.get(sid)
+            tx = b._tx_keys.get(sid)
+            if ssrc is None or rx is None or tx is None:
+                continue
+            if int(ssrc) in self.trunk._remote_ssrcs:
+                # peer-homed member installed here by roster sync: not
+                # ours to advertise (claimed only on failover adoption)
+                continue
+            roster.setdefault(conf, []).append({
+                "ssrc": int(ssrc),
+                "rx": [rx[0].hex(), rx[1].hex()],
+                "tx": [tx[0].hex(), tx[1].hex()],
+            })
+        if roster != self.trunk.local_roster:
+            self.trunk.set_roster(roster)
+
+    # -------------------------------------------------- trunk hooks
+
+    def _deliver_remote(self, conf: int, inner: bytes) -> None:
+        """Re-inject a trunk-delivered participant packet into the
+        local bridge's primary socket: the remote speaker is a regular
+        keyed row here (roster sync installed it), so the inner SRTP
+        authenticates and routes through the stock data path — zero
+        cascade-specific shapes, zero recompiles."""
+        self.trunk.engine.send_batch(
+            PacketBatch.from_payloads([inner]),
+            "127.0.0.1", self.bridge.port)
+        self.remote_delivered += 1
+
+    def _apply_remote_speakers(self, conf: int, ssrcs) -> None:
+        """Speaker bus crossing the trunk: map the peer's active-speaker
+        SSRCs onto local rows and update the broadcast route.  The
+        bridge's no-change early-return breaks the echo loop."""
+        b = self.bridge
+        if conf not in b._bcast_speakers:
+            return
+        sids = [s for s in (b._sid_of_ssrc(int(x)) for x in ssrcs)
+                if s is not None]
+        if sids:
+            b.set_broadcast_speakers(conf, sids)
+
+    def _on_roster(self, roster: dict) -> None:
+        """Peer roster sync: install any not-yet-local member of a
+        cascaded conference as a regular keyed row (that is what lets
+        its trunk-delivered media authenticate), via the same admission
+        queue failover adoption uses — just without the promotion."""
+        b = self.bridge
+        queued = {(e["conf"], int(e["m"]["ssrc"]))
+                  for e in list(self._adopt_q) + self._pending_commit}
+        for conf, members in sorted(roster.items()):
+            conf = int(conf)
+            if (conf not in self.trunk._confs
+                    and conf not in self._remote_marks):
+                continue
+            for m in members:
+                ssrc = int(m["ssrc"])
+                if b._sid_of_ssrc(ssrc) is not None:
+                    continue
+                if (conf, ssrc) in queued:
+                    continue
+                self._adopt_q.append({
+                    "conf": conf, "m": dict(m), "n": len(members),
+                    "attempts": 0, "retry_at": self._now,
+                    "promote": False})
+
+    def _on_trunk_up(self, now: float) -> None:
+        self.flight.record("trunk_up", tick=self.ticks,
+                           peer=self.peer_bridge_id)
+
+    def _on_trunk_down(self, now: float) -> None:
+        """Failover: the peer stopped answering heartbeats.  Promote
+        its conferences (typed trunk refusals lift — joins admit HERE
+        now), evacuate its placement axis, and queue every remote
+        roster member for adoption through the commit barrier."""
+        self.trunk_failovers_total += 1
+        self.adopting = True
+        self.flight.record("trunk_failover", tick=self.ticks,
+                           peer=self.peer_bridge_id)
+        lc = self.lifecycle
+        placer = getattr(lc, "placer", None) if lc is not None else None
+        if placer is not None and getattr(placer, "n_bridges", 0):
+            placer.evacuate_bridge(self.peer_bridge_id)
+        b = self.bridge
+        queued = {(e["conf"], int(e["m"]["ssrc"]))
+                  for e in list(self._adopt_q) + self._pending_commit}
+        for conf, members in sorted(self.trunk.remote_roster.items()):
+            conf = int(conf)
+            if lc is not None:
+                lc.promote_remote_conference(conf)
+            self._remote_marks.discard(conf)
+            fresh = [m for m in members
+                     if b._sid_of_ssrc(int(m["ssrc"])) is None
+                     and (conf, int(m["ssrc"])) not in queued]
+            if not fresh:
+                continue
+            self._conf_outstanding[conf] = (
+                self._conf_outstanding.get(conf, 0) + len(fresh))
+            for m in fresh:
+                self._adopt_q.append({
+                    "conf": conf, "m": dict(m), "n": len(members),
+                    "attempts": 0, "retry_at": float(now),
+                    "promote": True})
+
+    # ----------------------------------------------------- adoption
+
+    def _drain_adoptions(self, now: float) -> None:
+        lc = self.lifecycle
+        n = len(self._adopt_q)
+        for _ in range(n):
+            ent = self._adopt_q.popleft()
+            if float(ent["retry_at"]) > now:
+                self._adopt_q.append(ent)
+                continue
+            m = ent["m"]
+            ssrc = int(m["ssrc"])
+            sid = self.bridge._sid_of_ssrc(ssrc)
+            if sid is not None:
+                self._adopt_done(ent, sid=sid)       # already local
+                continue
+            rx = tuple(bytes.fromhex(h) for h in m["rx"])
+            tx = tuple(bytes.fromhex(h) for h in m["tx"])
+            ok, reason = lc.request_join(ssrc, rx, tx,
+                                         name=m.get("name"),
+                                         conference=ent["conf"])
+            if ok or reason == "duplicate":
+                ent["commit_deadline"] = now + self.adopt_commit_timeout_s
+                self._pending_commit.append(ent)
+                continue
+            # typed refusal: re-queue on the retry-after hint, with the
+            # same exponential escalation a storming client would apply
+            ent["attempts"] = int(ent["attempts"]) + 1
+            ent["retry_at"] = now + (
+                lc.retry_after_hint(reason, conference=ent["conf"])
+                * (2 ** min(ent["attempts"], 6)))
+            self.orphans_requeued += 1
+            self._adopt_q.append(ent)
+
+    def _scan_commits(self, now: float) -> None:
+        """An orphan is adopted when its row resolves COMMITTED (past
+        the barrier), not when the join queues.  A join that never
+        commits (rolled back, or checkpointed pre-commit) re-queues —
+        adopt-or-retry, never a torn row."""
+        b = self.bridge
+        still: List[dict] = []
+        for ent in self._pending_commit:
+            ssrc = int(ent["m"]["ssrc"])
+            sid = b._sid_of_ssrc(ssrc)
+            if sid is not None and sid not in b._staged:
+                self._adopt_done(ent, sid=sid)
+            elif now >= float(ent.get("commit_deadline", 0.0)):
+                ent["attempts"] = int(ent["attempts"]) + 1
+                ent["retry_at"] = now
+                ent.pop("commit_deadline", None)
+                self.orphans_requeued += 1
+                self._adopt_q.append(ent)
+            else:
+                still.append(ent)
+        self._pending_commit = still
+
+    def _adopt_done(self, ent: dict, sid: Optional[int] = None) -> None:
+        conf = int(ent["conf"])
+        if ent.get("promote"):
+            self.orphans_adopted += 1
+            ssrc = int(ent["m"]["ssrc"])
+            self.trunk.claim_member(conf, ssrc)
+            self.flight.record("orphan_adopted", sid=sid,
+                               tick=self.ticks, conf=conf, ssrc=ssrc)
+            # an orphan that was on the conference's top-K speaker bus
+            # resumes speaking HERE: its fresh row landed as a listener
+            # (the broadcast speaker set holds the dead row's sid)
+            spk = self.trunk._confs.get(conf)
+            cur = self.bridge._bcast_speakers.get(conf)
+            if (sid is not None and spk is not None and ssrc in spk
+                    and cur is not None and sid not in cur):
+                self.bridge.set_broadcast_speakers(
+                    conf, sorted(cur | {sid}))
+        left = self._conf_outstanding.get(conf, 0) - 1
+        if left > 0:
+            self._conf_outstanding[conf] = left
+        elif conf in self._conf_outstanding:
+            del self._conf_outstanding[conf]
+            if ent.get("promote"):
+                # the whole conference is committed here: re-home it on
+                # the placer's bridge axis
+                lc = self.lifecycle
+                placer = getattr(lc, "placer", None) \
+                    if lc is not None else None
+                if placer is not None and getattr(placer, "n_bridges", 0):
+                    placer.adopt_bridge(conf, self.bridge_id,
+                                        int(ent.get("n", 1)))
+
+    # ------------------------------------------------- observability
+
+    def _register_bridge_slo(self) -> None:
+        from libjitsi_tpu.utils.slo import SlicedSloSpec
+        tr = self.trunk
+        me = str(self.bridge_id)
+
+        def _read():
+            good = tr.relay_frames_total + self.remote_delivered
+            bad = (tr.plc_fallthrough_total + tr.unprotect_drops_total
+                   + tr.refusals_total)
+            yield (me, float(good), float(bad))
+
+        self.slo.add_sliced(SlicedSloSpec(
+            name="bridge_media", objective=0.999, label="bridge",
+            reader=_read,
+            description="per-bridge trunk media continuity: frames "
+                        "relayed/delivered vs concealed, dropped or "
+                        "refused"))
+
+    def register_metrics(self, registry,
+                         prefix: str = "supervisor") -> None:
+        super().register_metrics(registry, prefix)
+        self.trunk.register_metrics(registry)
+        registry.register_scalar(
+            "trunk_failovers_total",
+            lambda: self.trunk_failovers_total,
+            help_="trunk down transitions that triggered failover",
+            kind="counter")
+        registry.register_scalar(
+            "cascade_orphans_adopted", lambda: self.orphans_adopted,
+            help_="orphaned remote streams committed on this bridge "
+                  "after peer death", kind="counter")
+        registry.register_scalar(
+            "cascade_orphans_requeued", lambda: self.orphans_requeued,
+            help_="adoption attempts re-queued on a typed refusal or "
+                  "rollback", kind="counter")
+        registry.register_scalar(
+            "cascade_remote_delivered", lambda: self.remote_delivered,
+            help_="trunk-delivered remote packets re-injected locally",
+            kind="counter")
+
+    # ------------------------------------------------- checkpointing
+
+    def cascade_snapshot(self) -> dict:
+        """Picked up by BridgeSupervisor.save_checkpoint: the trunk
+        control plane plus every in-flight adoption."""
+        return {
+            "trunk": self.trunk.snapshot(),
+            "adopting": bool(self.adopting),
+            "remote_marks": sorted(self._remote_marks),
+            "adopt_q": [dict(e) for e in self._adopt_q],
+            "pending_commit": [dict(e) for e in self._pending_commit],
+            "conf_outstanding": {int(c): int(n) for c, n
+                                 in self._conf_outstanding.items()},
+            "counters": {
+                "trunk_failovers_total": self.trunk_failovers_total,
+                "orphans_adopted": self.orphans_adopted,
+                "orphans_requeued": self.orphans_requeued,
+            },
+        }
+
+    def restore_cascade(self, cas: dict, now: float = 0.0) -> None:
+        self.trunk.restore(cas.get("trunk", {}), now=now)
+        self.adopting = bool(cas.get("adopting", False))
+        self._remote_marks = {int(c) for c
+                              in cas.get("remote_marks", ())}
+        self._marks_pending = bool(self._remote_marks)
+        self._adopt_q = deque(dict(e) for e in cas.get("adopt_q", ()))
+        # joins checkpointed pre-commit cannot be assumed committed:
+        # give them a fresh deadline; _scan_commits either sees the
+        # reconciled row (adopted) or times out and re-queues
+        self._pending_commit = []
+        for e in cas.get("pending_commit", ()):
+            ent = dict(e)
+            ent["commit_deadline"] = now + self.adopt_commit_timeout_s
+            self._pending_commit.append(ent)
+        self._conf_outstanding = {
+            int(c): int(n)
+            for c, n in cas.get("conf_outstanding", {}).items()}
+        ctr = cas.get("counters", {})
+        self.trunk_failovers_total = int(
+            ctr.get("trunk_failovers_total", 0))
+        self.orphans_adopted = int(ctr.get("orphans_adopted", 0))
+        self.orphans_requeued = int(ctr.get("orphans_requeued", 0))
+        # re-attach cascaded conferences to the restored bridge
+        for conf, speakers in sorted(self.trunk._confs.items()):
+            self.bridge.attach_trunk(
+                self.trunk, conf,
+                sorted(speakers) if speakers is not None else None)
+
+    @classmethod
+    def recover(cls, config, path: str, bridge_cls, trunk=None,
+                port: int = 0, retries: int = 5,
+                backoff_s: float = 0.05,
+                sleep: Callable[[float], None] = time.sleep,
+                supervisor_config: Optional[SupervisorConfig] = None,
+                metrics=None, bridge_id: int = 0,
+                peer_bridge_id: int = 1,
+                **bridge_kwargs) -> "CascadeSupervisor":
+        """Crash-restart with the cascade control plane restored: the
+        caller supplies a fresh CascadeTrunk (sockets don't survive a
+        crash any more than the bridge's do); peer, cascaded
+        conferences, rosters and the adoption queue come back from the
+        checkpoint, so a failover interrupted by the crash RESUMES."""
+        if trunk is None:
+            raise ValueError("CascadeSupervisor.recover needs a trunk")
+        blob = cls.load_checkpoint(path)
+        bridge = retrying(
+            lambda: bridge_cls.restore(config, blob["snap"], port=port,
+                                       **bridge_kwargs),
+            retries=retries, backoff_s=backoff_s, sleep=sleep)
+        sup = cls(bridge, trunk, config=supervisor_config,
+                  metrics=metrics, bridge_id=bridge_id,
+                  peer_bridge_id=peer_bridge_id)
+        sup.ticks = blob["ticks"]
+        sup.pending_lifecycle = blob.get("lifecycle")
+        cas = blob.get("cascade")
+        if cas is not None:
+            sup.restore_cascade(cas)
+        ev = sup.flight.record("recovered", tick=sup.ticks, path=path,
+                               bridge=blob["bridge"])
+        sup.postmortems.append({
+            "trigger": "checkpoint_recover", "tick": sup.ticks,
+            "event": ev, "dump": sup.flight.dump_all()})
+        return sup

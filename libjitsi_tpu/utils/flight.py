@@ -47,7 +47,10 @@ PRIORITY_KINDS = frozenset((
     "rtx_budget_drop",
     # a just-keyed row's first packets (held early media replaying
     # through the commit barrier) are exactly the tail worth keeping
-    "handshake_complete"))
+    "handshake_complete",
+    # a just-adopted orphan (bridge failover, mesh/cascade.py): its
+    # first packets on the surviving bridge are the failover evidence
+    "orphan_adopted"))
 
 
 class FlightRecorder:

@@ -119,10 +119,12 @@ def default_slos(tick_budget_s: float = 0.02) -> List[SloSpec]:
 
 @dataclass(frozen=True)
 class SlicedSloSpec:
-    """One objective evaluated PER SLICE — per shard, per conference —
-    instead of fleet-wide (the slicing PR 5 left open; it only makes
-    sense once conference-affinity sharding makes 'shard 3 is burning'
-    an actionable statement, see mesh/placement.py).
+    """One objective evaluated PER SLICE — per shard, per conference,
+    per bridge — instead of fleet-wide (the slicing PR 5 left open; it
+    only makes sense once conference-affinity sharding makes 'shard 3
+    is burning' an actionable statement, see mesh/placement.py;
+    `label="bridge"` generalizes it to the cascade's bridge axis, see
+    service/supervisor.py CascadeSupervisor).
 
     `reader` yields ``(slice_key, good_cum, bad_cum)`` cumulative
     totals each tick; slices appear lazily on first report and decay

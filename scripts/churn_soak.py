@@ -23,6 +23,11 @@ plane's acceptance invariants:
 Usage:
     JAX_PLATFORMS=cpu python scripts/churn_soak.py            # full
     JAX_PLATFORMS=cpu python scripts/churn_soak.py --smoke    # tier-1
+
+`--reconnect` runs the mass-reconnect storm chaos scenario instead;
+`--broadcast` the top-K listener fan-out soak; `--cascade` the
+two-bridge trunk failover chaos scenario (kill one bridge mid-call,
+the conference survives on the other).
 """
 
 from __future__ import annotations
@@ -44,13 +49,15 @@ from libjitsi_tpu.control.dtls import (  # noqa: E402
     generate_certificate)
 from libjitsi_tpu.core.packet import PacketBatch  # noqa: E402
 from libjitsi_tpu.io import UdpEngine  # noqa: E402
+from libjitsi_tpu.mesh.cascade import (  # noqa: E402
+    CascadeTrunk, TrunkConfig)
 from libjitsi_tpu.rtp import header as rtp_header  # noqa: E402
 from libjitsi_tpu.rtp import rtcp  # noqa: E402
 from libjitsi_tpu.service.lifecycle import (  # noqa: E402
     ADMIT_REASONS, LifecycleConfig, StreamLifecycleManager)
 from libjitsi_tpu.service.sfu_bridge import SfuBridge  # noqa: E402
 from libjitsi_tpu.service.supervisor import (  # noqa: E402
-    BridgeSupervisor, SupervisorConfig)
+    BridgeSupervisor, CascadeSupervisor, SupervisorConfig)
 from libjitsi_tpu.transform.srtp import SrtpStreamTable  # noqa: E402
 from libjitsi_tpu.utils.faults import (  # noqa: E402
     ChurnModel, DiurnalProfile, TalkSpurtModel)
@@ -1081,6 +1088,395 @@ def run_reconnect_soak(n_clients: int = 1000, dt: float = 0.02,
     return report
 
 
+def run_cascade_soak(dt: float = 0.01, n_senders: int = 3,
+                     n_receivers: int = 2,
+                     pre_rounds: int = 30, post_rounds: int = 150,
+                     restore_p99_bound_s: float = 2.0,
+                     refusal_bound: int = 80, seed: int = 0,
+                     verbose: bool = True, report_path=None) -> dict:
+    """Bridge-cascade failover chaos: one conference spans two bridges
+    over a `CascadeTrunk` (mesh/cascade.py), senders homed on bridge A,
+    receivers on bridge B, the trunk carrying the top-K speaker bus.
+    Bridge A is killed mid-call; the conference must survive on B.
+    Acceptance gates (every `ok_*` must hold):
+
+    - media flows sender -> A -> trunk -> B -> receiver before the
+      kill, and the trunk payload is the SPEAKER BUS: a non-speaker's
+      uplink never crosses the trunk;
+    - heartbeat loss flips the trunk down, B promotes the orphaned
+      conference and ADOPTS a roster member it no longer holds a row
+      for (evicted mid-outage) through the normal commit barrier;
+    - time-to-media-restored p99 (bridge-A kill -> speaker decrypted
+      again on B, model time) under `restore_p99_bound_s`;
+    - ZERO data-path recompiles inside tick windows after priming, on
+      both bridges — failover rides warm shapes;
+    - every refusal TYPED (`trunk_down` observed with a retry-after
+      hint the joiner honors via exponential backoff) and bounded;
+    - full reconciliation, never torn: every row on the survivor is
+      committed-with-keys or still staged/queued, the adoption queue
+      drains, and the placer re-homes the conference on the survivor's
+      bridge axis."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    TK = (_keys(0xA0), _keys(0xB0))        # A->B, B->A trunk keys
+    CONF, CONF_COLD = 7, 8
+
+    def mk(bid, pid, txk, rxk):
+        b = SfuBridge(cfg, port=0, capacity=64, recv_window_ms=0)
+        tr = CascadeTrunk(txk, rxk, TrunkConfig(), port=0,
+                          seed=seed + bid)
+        sup = CascadeSupervisor(
+            b, tr, SupervisorConfig(deadline_ms=1000.0),
+            metrics=b.loop.metrics, bridge_id=bid, peer_bridge_id=pid)
+        lc = StreamLifecycleManager(b, supervisor=sup,
+                                    metrics=b.loop.metrics,
+                                    config=LifecycleConfig())
+        # cascade needs the placer: conference ids ride placement, and
+        # failover re-homes conferences on the bridge axis
+        lc.enable_placement(1)
+        lc.placer.enable_bridges(2)
+        tr.attach(b.loop)
+        return b, tr, sup, lc
+
+    bA, tA, supA, lcA = mk(0, 1, TK[0], TK[1])
+    bB, tB, supB, lcB = mk(1, 0, TK[1], TK[0])
+    now = 100.0
+    t0_wall = time.perf_counter()
+    tA.connect("127.0.0.1", tB.port, now=now)
+    tB.connect("127.0.0.1", tA.port, now=now)
+    supA.cascade_conference(CONF)
+    supB.cascade_conference(CONF, remote=True)
+    supB.cascade_conference(CONF_COLD, remote=True)
+    # register the broadcast route on B up front: roster-installed
+    # remote rows land as listeners until the SPEAKERS frame promotes
+    bB.set_broadcast_speakers(CONF, [])
+
+    def tick_both(k=1):
+        nonlocal now
+        for _ in range(k):
+            supA.tick(now=now)
+            supB.tick(now=now)
+            now += dt
+
+    def tick_b(k=1):
+        nonlocal now
+        for _ in range(k):
+            supB.tick(now=now)
+            now += dt
+
+    senders, receivers = [], []
+    for k in range(n_senders):
+        rx, tx = _keys(0x10 + 4 * k), _keys(0x12 + 4 * k)
+        s = dict(ssrc=0x1000 + k, rx=rx, tx=tx, seq=1,
+                 ts=0, eng=UdpEngine(port=0, max_batch=64),
+                 prot=SrtpStreamTable(capacity=1))
+        s["prot"].add_stream(0, *rx)
+        ok, why = lcA.request_join(s["ssrc"], rx, tx,
+                                   name=f"snd{k}", conference=CONF)
+        assert ok, f"sender join refused: {why}"
+        senders.append(s)
+    row_of = {s["ssrc"]: k for k, s in enumerate(senders)}
+    for k in range(n_receivers):
+        rx, tx = _keys(0x80 + 4 * k), _keys(0x82 + 4 * k)
+        r = dict(ssrc=0x2000 + k, rx=rx, tx=tx, got={},
+                 eng=UdpEngine(port=0, max_batch=64),
+                 open=SrtpStreamTable(capacity=n_senders + 1))
+        # one open row PER SENDER (same downlink key): the probe sees
+        # n interleaved seq spaces and needs separate replay windows
+        for j in range(n_senders):
+            r["open"].add_stream(j, *tx)
+        ok, why = lcB.request_join(r["ssrc"], rx, tx,
+                                   name=f"rcv{k}", conference=CONF)
+        assert ok, f"receiver join refused: {why}"
+        receivers.append(r)
+
+    def _send_from(s, port, n=2):
+        pls = [bytes([0x40 + row_of[s["ssrc"]]]) * 120] * n
+        seqs = [(s["seq"] + i) & 0xFFFF for i in range(n)]
+        b = rtp_header.build(pls, seqs,
+                             [s["ts"] + i for i in range(n)],
+                             [s["ssrc"]] * n, [96] * n,
+                             stream=[0] * n)
+        s["seq"] = (s["seq"] + n) & 0xFFFF
+        s["ts"] += n
+        s["eng"].send_batch(s["prot"].protect_rtp(b),
+                            "127.0.0.1", port)
+
+    def _latch(r, port):
+        b = rtp_header.build([b"\x11" * 40], [1], [0], [r["ssrc"]],
+                             [96], stream=[0])
+        t = SrtpStreamTable(capacity=1)
+        t.add_stream(0, *r["rx"])
+        r["eng"].send_batch(t.protect_rtp(b), "127.0.0.1", port)
+
+    def _drain(r, timeout_ms=0):
+        fresh = {}
+        back, _, _ = r["eng"].recv_batch(timeout_ms=timeout_ms)
+        if not back.batch_size:
+            return fresh
+        raw = [back.to_bytes(j) for j in range(back.batch_size)]
+        keep = [w for w in raw
+                if len(w) >= 12
+                and int.from_bytes(w[8:12], "big") in row_of]
+        if not keep:
+            return fresh
+        sub = PacketBatch.from_payloads(
+            keep, stream=[row_of[int.from_bytes(w[8:12], "big")]
+                          for w in keep])
+        _, okm = r["open"].unprotect_rtp(sub)
+        for j, w in enumerate(keep):
+            if bool(okm[j]):
+                ssrc = int.from_bytes(w[8:12], "big")
+                fresh[ssrc] = fresh.get(ssrc, 0) + 1
+                r["got"][ssrc] = r["got"].get(ssrc, 0) + 1
+        return fresh
+
+    # ---- setup: commit joins, sync rosters both ways, trunks up
+    for _ in range(400):
+        tick_both()
+        if (tA.state == tB.state == "up"
+                and all(bB._sid_of_ssrc(s["ssrc"]) is not None
+                        for s in senders)
+                and all(bA._sid_of_ssrc(r["ssrc"]) is not None
+                        for r in receivers)):
+            break
+    assert tA.state == tB.state == "up", "trunk never came up"
+    assert all(bB._sid_of_ssrc(s["ssrc"]) is not None
+               for s in senders), "roster sync never installed senders"
+
+    # ---- top-K speaker bus: all but the last sender speak
+    bus = senders[:-1] if n_senders > 1 else senders[:]
+    bA.set_broadcast_speakers(
+        CONF, [bA._sid_of_ssrc(s["ssrc"]) for s in bus])
+    tick_both(6)
+    spk_on_b = {bB._sid_of_ssrc(s["ssrc"]) for s in bus}
+    speakers_propagated = bB._bcast_speakers.get(CONF) == spk_on_b
+    for r in receivers:
+        _latch(r, bB.port)
+    tick_both(4)
+
+    # ---- priming: media + a speaker flip land every compile before
+    # the measured window
+    def _media_rounds(rounds, legs, port, timeout_ms=0):
+        nonlocal now
+        for _ in range(rounds):
+            for s in legs:
+                _send_from(s, port)
+            tick_both(2)
+            for r in receivers:
+                _drain(r, timeout_ms=timeout_ms)
+
+    _media_rounds(6, bus, bA.port)
+    flipped = senders[1:]                 # drop 0, add the last
+    bA.set_broadcast_speakers(
+        CONF, [bA._sid_of_ssrc(s["ssrc"]) for s in flipped])
+    tick_both(4)
+    _media_rounds(6, flipped, bA.port)
+    w0A, w0B = lcA.datapath_recompiles, lcB.datapath_recompiles
+    for r in receivers:
+        r["got"].clear()
+
+    # ---- measured pre-kill window on the flipped bus
+    bus = flipped
+    bus_ssrcs = [s["ssrc"] for s in bus]
+    _media_rounds(pre_rounds, bus, bA.port)
+    pre_got = {r["ssrc"]: dict(r["got"]) for r in receivers}
+    ok_media_pre = (tA.relay_frames_total > 0
+                    and supB.remote_delivered > 0
+                    and all(r["got"].get(ss, 0) > 0
+                            for r in receivers for ss in bus_ssrcs))
+    # speaker-bus restriction: the non-speaker's uplink is accepted at
+    # A but never crosses the trunk
+    nonspeaker = senders[0]
+    r0 = tA.relay_frames_total
+    for _ in range(5):
+        _send_from(nonspeaker, bA.port)
+        tick_both(2)
+    relay_nonspeaker = tA.relay_frames_total - r0
+    r0 = tA.relay_frames_total
+    for _ in range(5):
+        _send_from(bus[-1], bA.port)
+        tick_both(2)
+    relay_speaker = tA.relay_frames_total - r0
+    ok_speaker_bus = (speakers_propagated and relay_nonspeaker == 0
+                      and relay_speaker > 0)
+    trunk_rtt = float(tA.rtt)
+
+    # ---- kill bridge A mid-call
+    kill_t = now
+    recompiles_a = lcA.datapath_recompiles
+    relayed_at_kill = tA.relay_frames_total
+    bA.close()
+    tA.close()
+    tick_b(4)            # drain any in-flight trunk frames from A
+    # stand-in for the survivor's idle reaper: a quiet remote row is
+    # evicted mid-outage; nothing reinstalls it (its home bridge is
+    # dead), so failover must re-key it from the synced roster — the
+    # orphan-adoption path
+    orphan = bus[0]
+    lcB.request_leave(ssrc=orphan["ssrc"])
+    tick_b(2)
+    assert bB._sid_of_ssrc(orphan["ssrc"]) is None, \
+        "orphan eviction did not take"
+    down_ticks = 0
+    while tB.state != "down" and down_ticks < 400:
+        tick_b()
+        down_ticks += 1
+    detect_s = now - kill_t
+    ok_failover = (tB.state == "down"
+                   and supB.trunk_failovers_total == 1)
+
+    # ---- adoption through the commit barrier
+    for _ in range(400):
+        tick_b()
+        if not supB.adopting and supB.orphans_adopted >= 1:
+            break
+    orphan_sid = bB._sid_of_ssrc(orphan["ssrc"])
+    ok_orphan = (supB.orphans_adopted >= 1
+                 and orphan_sid is not None
+                 and orphan_sid in bB._tx_keys
+                 and orphan["ssrc"] not in tB._remote_ssrcs)
+    # read the adoption evidence out of the flight ring NOW: the
+    # orphan's per-stream ring is bounded and the restore phase's
+    # header sampling would roll the event out
+    kinds = _flight_kinds(supB.flight)
+
+    # ---- typed refusals: a late joiner dials the survivor for a
+    # conference still homed on the dead bridge
+    refused: dict = {}
+    joiner = dict(attempts=0, retry_at=now, admitted=False)
+    jrx, jtx = _keys(0x60), _keys(0x62)
+
+    def _joiner_try():
+        if joiner["admitted"] or now < joiner["retry_at"]:
+            return
+        ok, reason = lcB.request_join(0x3000, jrx, jtx,
+                                      name="late", conference=CONF_COLD)
+        if ok:
+            joiner["admitted"] = True
+            return
+        refused[reason] = refused.get(reason, 0) + 1
+        joiner["attempts"] += 1
+        hint = lcB.retry_after_hint(reason, conference=CONF_COLD)
+        joiner["retry_at"] = now + max(hint, dt) * (
+            2 ** min(joiner["attempts"] - 1, 6))
+
+    for _ in range(40):
+        _joiner_try()
+        tick_b()
+    refusals_while_down = sum(refused.values())
+    # signaling re-homes the cold conference on the survivor: the
+    # typed refusals lift and the joiner's next retry admits
+    lcB.promote_remote_conference(CONF_COLD)
+    for _ in range(200):
+        _joiner_try()
+        tick_b()
+        if joiner["admitted"]:
+            break
+    tick_b(2)
+    ok_typed_refusals = (
+        refused.get("trunk_down", 0) > 0
+        and set(refused) <= set(ADMIT_REASONS)
+        and refusals_while_down <= refusal_bound
+        and joiner["admitted"])
+
+    # ---- media restored on the survivor: speakers redial B
+    for r in receivers:
+        r["got"].clear()
+    restore_t: dict = {}
+    for _ in range(post_rounds):
+        for s in bus:
+            _send_from(s, bB.port, n=1)
+        tick_b()
+        for r in receivers:
+            fresh = _drain(r, timeout_ms=2)
+            for ss in fresh:
+                if ss in bus_ssrcs and ss not in restore_t:
+                    restore_t[ss] = now - kill_t
+    restored = [restore_t.get(ss) for ss in bus_ssrcs]
+    p99_restore = (float(np.percentile(
+        [t for t in restored if t is not None], 99))
+        if any(t is not None for t in restored) else float("inf"))
+    ok_restored = (all(t is not None for t in restored)
+                   and p99_restore <= restore_p99_bound_s)
+
+    # ---- reconciliation: never torn, queues drained, re-homed
+    torn = [sid for sid in bB._ssrc_of
+            if sid not in bB._tx_keys and sid not in bB._staged]
+    ok_reconciled = (not torn and not supB.adopting
+                     and not supB._adopt_q
+                     and not supB._pending_commit
+                     and not supB._conf_outstanding
+                     and lcB.placer.bridge_of(CONF) == 1)
+    window_recompiles = ((recompiles_a - w0A)
+                         + (lcB.datapath_recompiles - w0B))
+    kinds |= _flight_kinds(supB.flight)
+    scrape = bB.loop.metrics.render()
+    ok_metrics = all(m in scrape for m in (
+        "trunk_heartbeats_total", "trunk_relay_pps", "trunk_rtt",
+        "trunk_failovers_total", "cascade_orphans_adopted"))
+
+    report = {
+        "mode": "cascade",
+        "senders": n_senders,
+        "receivers": n_receivers,
+        "wall_s": round(time.perf_counter() - t0_wall, 3),
+        "model_time_s": round(now - 100.0, 3),
+        "trunk_rtt_s": round(trunk_rtt, 4),
+        "relayed_at_kill": relayed_at_kill,
+        "remote_delivered": supB.remote_delivered,
+        "relay_nonspeaker": relay_nonspeaker,
+        "relay_speaker": relay_speaker,
+        "pre_kill_decrypts": {hex(k): v
+                              for k, v in sorted(pre_got.items())},
+        "down_detect_s": round(detect_s, 3),
+        "failovers": supB.trunk_failovers_total,
+        "orphans_adopted": supB.orphans_adopted,
+        "orphans_requeued": supB.orphans_requeued,
+        "refusals": dict(refused),
+        "refusals_while_down": refusals_while_down,
+        "joiner_attempts": joiner["attempts"],
+        "restore_s": {hex(ss): (round(t, 3) if t is not None else None)
+                      for ss, t in zip(bus_ssrcs, restored)},
+        "restore_p99_s": (round(p99_restore, 3)
+                          if p99_restore != float("inf") else None),
+        "priming_recompiles": w0A + w0B,
+        "window_recompiles": window_recompiles,
+        "torn_rows": torn,
+        "flight_kinds": sorted(kinds & {"trunk_failover",
+                                        "orphan_adopted", "trunk_up"}),
+        "conf_bridge_home": lcB.placer.bridge_of(CONF),
+        # ---- invariants
+        "ok_media_flowed": ok_media_pre,
+        "ok_speaker_bus": ok_speaker_bus,
+        "ok_failover_detected": (ok_failover
+                                 and "trunk_failover" in kinds),
+        "ok_orphan_adopted": (ok_orphan
+                              and "orphan_adopted" in kinds),
+        "ok_media_restored_p99": ok_restored,
+        "ok_zero_datapath_recompiles": window_recompiles == 0,
+        "ok_typed_refusals": ok_typed_refusals,
+        "ok_reconciled": ok_reconciled,
+        "ok_metrics_exported": ok_metrics,
+    }
+    for s in senders:
+        s["eng"].close()
+    for r in receivers:
+        r["eng"].close()
+    tB.close()
+    bB.close()
+    libjitsi_tpu.stop()
+    if verbose:
+        print("---- cascade failover soak report ----")
+        for k, v in report.items():
+            print(f"{k:32s} {v}")
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    return report
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--duration", type=float, default=30.0,
@@ -1114,6 +1510,15 @@ def main() -> int:
     ap.add_argument("--reconnect", action="store_true",
                     help="reconnect-storm chaos mode: mass DTLS "
                          "re-handshakes with a mid-storm kill/recover")
+    ap.add_argument("--cascade", action="store_true",
+                    help="bridge-cascade chaos mode: two trunked "
+                         "bridges, one killed mid-call; the conference "
+                         "must survive on the other")
+    ap.add_argument("--cascade-senders", type=int, default=4,
+                    help="cascade mode: senders homed on the doomed "
+                         "bridge")
+    ap.add_argument("--cascade-receivers", type=int, default=3,
+                    help="cascade mode: receivers on the survivor")
     ap.add_argument("--clients", type=int, default=1000,
                     help="reconnect mode: simultaneous DTLS clients")
     ap.add_argument("--max-handshakes", type=int, default=128,
@@ -1149,6 +1554,21 @@ def main() -> int:
             print(f"INVARIANT FAILURES: {failed}", file=sys.stderr)
             return 1
         print("all reconnect-storm invariants held")
+        return 0
+    if args.cascade:
+        kw = dict(n_senders=args.cascade_senders,
+                  n_receivers=args.cascade_receivers,
+                  seed=args.seed, report_path=args.report)
+        if args.smoke:
+            kw.update(n_senders=3, n_receivers=2,
+                      pre_rounds=10, post_rounds=60)
+        report = run_cascade_soak(**kw)
+        failed = [k for k, v in report.items()
+                  if k.startswith("ok_") and not v]
+        if failed:
+            print(f"INVARIANT FAILURES: {failed}", file=sys.stderr)
+            return 1
+        print("all cascade failover invariants held")
         return 0
     if args.broadcast:
         kw = dict(duration_s=args.duration, ramp_s=args.ramp,

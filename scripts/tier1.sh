@@ -24,6 +24,8 @@ echo "== churn smoke (lifecycle plane) =="
 env JAX_PLATFORMS=cpu python scripts/churn_soak.py --smoke || { echo "TIER1 FAIL: churn smoke"; exit 1; }
 echo "== reconnect-storm smoke (handshake plane) =="
 env JAX_PLATFORMS=cpu python scripts/churn_soak.py --reconnect --smoke || { echo "TIER1 FAIL: reconnect smoke"; exit 1; }
+echo "== cascade failover smoke (bridge-to-bridge trunk) =="
+env JAX_PLATFORMS=cpu python scripts/churn_soak.py --cascade --smoke || { echo "TIER1 FAIL: cascade smoke"; exit 1; }
 echo "== core test tier =="
 t0=$SECONDS
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); echo "TIER1_WALL_SECONDS=$((SECONDS - t0))"; exit $rc

@@ -1,0 +1,370 @@
+"""Bridge-to-bridge cascade trunk (mesh/cascade.py).
+
+Unit tier for the trunk leg itself: wire-format roundtrip under the
+trunk's own SRTP layer, typed admission with jittered retry hints,
+heartbeat liveness with down detection and backlog flush on recovery,
+speaker/roster control-plane propagation with the echo-loop guard and
+failover ownership claim, and the loss-recovery span across the hop —
+NACK/RTX under Gilbert–Elliott loss with a residual-loss assertion,
+XOR-FEC single-loss repair, and the deadline discipline (an expired
+loss is conceded to PLC and never re-NACKed).
+
+All trunk pairs here exchange datagrams through an in-memory channel
+(monkeypatched `_send`) so loss is injected deterministically; the
+socket path is covered by the churn_soak `--cascade` scenario and
+tests/test_chaos_recovery.py.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from libjitsi_tpu.mesh.cascade import (CascadeTrunk, MAGIC_CONTROL,
+                                       KIND_NACK, TRUNK_SSRC,
+                                       TrunkConfig, TrunkRelay)
+from libjitsi_tpu.mesh.placement import ConferencePlacer
+from libjitsi_tpu.utils.metrics import MetricsRegistry
+from libjitsi_tpu.utils.slo import SlicedSloSpec, SloEngine
+
+KEY_AB = (b"\xa0" * 16, b"\xa1" * 14)
+KEY_BA = (b"\xb0" * 16, b"\xb1" * 14)
+
+
+def _relay_pair(cfg=None):
+    a = TrunkRelay(KEY_AB, KEY_BA, cfg)
+    b = TrunkRelay(KEY_BA, KEY_AB, cfg)
+    return a, b
+
+
+def _inner(tag: int, n: int = 90) -> bytes:
+    return bytes([0x80, 96]) + bytes([tag]) * n
+
+
+# ------------------------------------------------------------ wire format
+
+def test_trunk_frame_roundtrip():
+    a, b = _relay_pair()
+    seq, wire = a.frame_media(7, _inner(1), now=0.0)
+    got = b.open_media(wire, now=0.0)
+    assert got is not None
+    rseq, conf, inner = got
+    assert rseq == seq and conf == 7 and inner == _inner(1)
+
+
+def test_trunk_layer_authenticates_independently():
+    """A peer holding the WRONG trunk key opens nothing, even though
+    the inner packet is in the clear relative to the trunk layer."""
+    a, _ = _relay_pair()
+    mallory = TrunkRelay(KEY_BA, (b"\xee" * 16, b"\xef" * 14))
+    _seq, wire = a.frame_media(7, _inner(2), now=0.0)
+    assert mallory.open_media(wire, now=0.0) is None
+
+
+def test_trunk_seq_wraps_mod16():
+    a, b = _relay_pair()
+    a.tx_seq = 0xFFFF
+    s1, w1 = a.frame_media(7, _inner(3), now=0.0)
+    s2, w2 = a.frame_media(7, _inner(4), now=0.0)
+    assert (s1, s2) == (0xFFFF, 0)
+    assert b.open_media(w1, now=0.0) is not None
+    assert b.open_media(w2, now=0.0) is not None
+
+
+def test_oversize_inner_refused():
+    a, _ = _relay_pair()
+    assert a.frame_media(7, b"\x80" * 1500, now=0.0) is None
+
+
+# ------------------------------------------------------- typed admission
+
+def test_admit_reason_and_jittered_retry_hint():
+    tr = CascadeTrunk(KEY_AB, KEY_BA, TrunkConfig(), seed=3)
+    try:
+        assert tr.admit_reason() == "trunk_down"      # never connected
+        tr.connect("127.0.0.1", 1, now=0.0)
+        assert tr.admit_reason() is None
+        tr._tx_queue.extend([b"x"] * tr.cfg.backlog_bound)
+        assert tr.admit_reason() == "trunk_backlog"
+        assert not tr.relay_media(7, _inner(5), now=0.0)
+        assert tr.refusals_total == 1
+        # hint escalates with reconnect attempts, jitter bounded +25%
+        base = tr.cfg.retry_base_s
+        for attempts in (0, 3, 9):
+            tr.attempts = attempts
+            lo = base * (2 ** min(attempts, 6))
+            for _ in range(8):
+                assert lo <= tr.retry_after() <= lo * 1.25
+    finally:
+        tr.close()
+
+
+# ---------------------------------------------- liveness + control plane
+
+class _Channel:
+    """Deterministic in-memory wire between two trunks.  `drop(data)`
+    decides per-datagram loss; control frames are also visible for
+    protocol assertions (NACK discipline)."""
+
+    def __init__(self):
+        self.ends = {}
+        self.queues = {"a": [], "b": []}
+        self.nack_log = []                  # (now, [seqs]) B -> A
+        self.dropped = 0
+        self.drop = lambda data: False
+        self.now = 0.0
+
+    def wire(self, ta, tb):
+        self.ends = {"a": ta, "b": tb}
+        ta._send = lambda data: self._push("b", data)
+        tb._send = lambda data: self._push("a", data)
+
+    def _push(self, dst, data):
+        if data[0] == MAGIC_CONTROL and data[1] == KIND_NACK:
+            self.nack_log.append(
+                (self.now, json.loads(data[2:].decode())["seqs"]))
+        if data[0] != MAGIC_CONTROL and self.drop(data):
+            self.dropped += 1
+            return
+        self.queues[dst].append(data)
+
+    def deliver(self, now):
+        self.now = now
+        for name, tr in self.ends.items():
+            q, self.queues[name] = self.queues[name], []
+            for data in q:
+                tr.on_datagram(data, now)
+
+
+def _trunk_pair(cfg=None, seed=0):
+    cfg = cfg or TrunkConfig()
+    ta = CascadeTrunk(KEY_AB, KEY_BA, cfg, seed=seed)
+    tb = CascadeTrunk(KEY_BA, KEY_AB, cfg, seed=seed + 1)
+    ch = _Channel()
+    ch.wire(ta, tb)
+    ta.connect("127.0.0.1", 1, now=0.0)
+    tb.connect("127.0.0.1", 1, now=0.0)
+    return ta, tb, ch
+
+
+def _run(ta, tb, ch, now, steps, dt=0.01, pump_b=True):
+    for _ in range(steps):
+        now += dt
+        ta.pump(now)
+        if pump_b:
+            tb.pump(now)
+        ch.deliver(now)
+    return now
+
+
+def test_heartbeat_down_detection_and_backlog_flush():
+    ta, tb, ch = _trunk_pair()
+    downs, ups = [], []
+    ta.on_down = downs.append
+    ta.on_up = ups.append
+    delivered = []
+    tb.deliver = lambda conf, inner: delivered.append(inner)
+    ta.cascade_conference(7)
+    now = _run(ta, tb, ch, 0.0, 20)
+    assert ta.state == tb.state == "up"
+    assert 0.0 < ta.rtt <= 0.02
+    # partition: B stops answering — A flips down after the miss streak
+    ch.drop = lambda data: True
+    orig = tb._send
+    tb._send = lambda data: None
+    for _ in range(200):
+        now += 0.01
+        ta.pump(now)
+        ch.deliver(now)
+        if ta.state == "down":
+            break
+    assert ta.state == "down" and downs
+    # media while down rides the bounded backlog, not the floor
+    assert ta.relay_media(7, _inner(6), now=now)
+    assert len(ta._tx_queue) == 1
+    # heal: the next answered heartbeat flips up and flushes the queue
+    ch.drop = lambda data: False
+    tb._send = orig
+    for _ in range(400):
+        now += 0.01
+        ta.pump(now)
+        tb.pump(now)
+        ch.deliver(now)
+        if ta.state == "up" and delivered:
+            break
+    assert ta.state == "up" and ups
+    assert delivered == [_inner(6)]
+
+
+def test_speakers_roster_echo_guard_and_claim():
+    ta, tb, ch = _trunk_pair()
+    flips, rosters = [], []
+    tb.on_speakers = lambda conf, ssrcs: flips.append((conf, ssrcs))
+    tb.on_roster = rosters.append
+    ta.cascade_conference(7)
+    tb.cascade_conference(7)
+    now = _run(ta, tb, ch, 0.0, 3)
+    # top-K flip propagates: both ends restrict the same legs
+    ta.set_speakers(7, [0x111, 0x222], now=now)
+    now = _run(ta, tb, ch, now, 2)
+    assert tb._confs[7] == {0x111, 0x222}
+    assert flips and flips[-1][0] == 7
+    assert ta.wants(7, 0x111) and not ta.wants(7, 0x333)
+    # roster sync: B learns A's members and marks them peer-homed
+    ta.set_roster({7: [{"ssrc": 0x111, "rx": ["aa", "bb"],
+                        "tx": ["cc", "dd"]}]})
+    now = _run(ta, tb, ch, now, 2)
+    assert rosters and 7 in tb.remote_roster
+    assert 0x111 in tb._remote_ssrcs
+    # echo-loop guard: the peer-homed member is never relayed BACK
+    tb.set_speakers(7, [0x111], now=now)
+    assert not tb.wants(7, 0x111)
+    # failover adoption commits -> ownership transfer lifts the guard
+    tb.claim_member(7, 0x111)
+    assert 0x111 not in tb._remote_ssrcs
+    assert tb.wants(7, 0x111)
+    assert 7 not in tb.remote_roster
+
+
+# ------------------------------------------------- loss recovery span
+
+def test_nack_rtx_recovers_gilbert_elliott_loss():
+    """E2E across the hop: media under bursty GE loss, the receive side
+    NACKs trunk seqs, the send side serves RTX from its cache, and the
+    residual loss after the recovery window is ZERO."""
+    cfg = TrunkConfig(fec_k=0)             # isolate the NACK/RTX path
+    ta, tb, ch = _trunk_pair(cfg)
+    delivered = []
+    tb.deliver = lambda conf, inner: delivered.append(inner)
+    ta.cascade_conference(7)
+
+    rng = np.random.default_rng(11)
+    state = {"bad": False}
+
+    def ge_drop(_data):
+        # Gilbert–Elliott: p(good->bad)=.12, p(bad->good)=.4,
+        # loss .75 in bad, .02 in good
+        if state["bad"]:
+            if rng.random() < 0.4:
+                state["bad"] = False
+        elif rng.random() < 0.12:
+            state["bad"] = True
+        return rng.random() < (0.75 if state["bad"] else 0.02)
+
+    now = _run(ta, tb, ch, 0.0, 5)
+    ch.drop = ge_drop
+    sent = []
+    for k in range(120):
+        inner = _inner(k % 251)
+        sent.append(inner)
+        assert ta.relay_media(7, inner, now=now)
+        now = _run(ta, tb, ch, now, 1)
+    ch.drop = lambda data: False           # tail: only recovery traffic
+    now = _run(ta, tb, ch, now, 30)
+    assert ch.dropped > 0, "GE channel never dropped — test is vacuous"
+    assert tb.nacks_sent_total > 0
+    assert ta.rtx_served_total > 0
+    residual = {bytes(s) for s in sent} - {bytes(d) for d in delivered}
+    assert not residual, f"unrecovered after NACK/RTX: {len(residual)}"
+
+
+def test_fec_recovers_single_loss_without_roundtrip():
+    ta, tb, ch = _trunk_pair(TrunkConfig(fec_k=4))
+    delivered = []
+    tb.deliver = lambda conf, inner: delivered.append(inner)
+    ta.cascade_conference(7)
+    now = _run(ta, tb, ch, 0.0, 3)
+    # drop exactly the second media frame of the 4-frame FEC group
+    seen = {"n": 0}
+
+    def drop_second(_data):
+        seen["n"] += 1
+        return seen["n"] == 2
+
+    ch.drop = drop_second
+    for k in range(4):
+        ta.relay_media(7, _inner(0x30 + k), now=now)
+    now = _run(ta, tb, ch, now, 2)
+    assert tb.fec_recovered_total == 1
+    assert _inner(0x31) in delivered       # the dropped frame, repaired
+
+
+def test_deadline_expired_loss_is_plc_not_renack():
+    """A trunk seq lost past `deadline_budget_s` is conceded to PLC
+    accounting and never re-NACKed — concealment on the destination
+    bridge, not a retransmission storm across the trunk."""
+    cfg = TrunkConfig(fec_k=0, deadline_budget_s=0.06)
+    ta, tb, ch = _trunk_pair(cfg)
+    ta.cascade_conference(7)
+    now = _run(ta, tb, ch, 0.0, 3)
+    # permanently drop the SECOND media frame (the first must arrive to
+    # seed the loss tracker) — original AND every RTX of it
+    doomed = {"seq": None, "n": 0}
+
+    def drop_doomed(data):
+        seq = int.from_bytes(data[2:4], "big")
+        if doomed["seq"] is None:
+            doomed["n"] += 1
+            if doomed["n"] == 2:
+                doomed["seq"] = seq
+                return True
+            return False
+        return seq == doomed["seq"]
+
+    ch.drop = drop_doomed
+    for k in range(4):
+        ta.relay_media(7, _inner(0x50 + k), now=now)
+        now = _run(ta, tb, ch, now, 1)
+    # run far past the deadline: the loss must expire, not re-NACK
+    now = _run(ta, tb, ch, now, 40)
+    assert tb.plc_fallthrough_total >= 1
+    expiry_nacks = [t for t, seqs in ch.nack_log
+                    if doomed["seq"] in seqs]
+    assert expiry_nacks, "the loss was never NACKed at all"
+    # every NACK naming the doomed seq predates the deadline
+    assert max(expiry_nacks) <= expiry_nacks[0] + cfg.deadline_budget_s
+    post = [seqs for t, seqs in ch.nack_log
+            if t > expiry_nacks[0] + cfg.deadline_budget_s]
+    assert all(doomed["seq"] not in seqs for seqs in post)
+
+
+# ------------------------------------------------- failover adjuncts
+
+def test_placer_bridge_axis_evacuate_and_adopt():
+    p = ConferencePlacer(n_shards=2)
+    p.enable_bridges(2)
+    assert p.place_bridge(1, 4) == 0       # least loaded
+    assert p.place_bridge(2, 4) == 1
+    assert p.place_bridge(1, 4) == 0       # sticky re-placement
+    # bridge 1 dies: its conferences are un-homed, then the failover
+    # plane adopts each explicitly as its adoption commits
+    orphans = p.evacuate_bridge(1)
+    assert orphans == [2] and p.bridge_of(2) is None
+    p.adopt_bridge(2, 0, 4)
+    assert p.bridge_of(2) == 0
+    # new placements avoid a dead peer when asked
+    assert p.place_bridge(3, 4, avoid=(1,)) == 0
+
+
+def test_sliced_slo_bridge_label_axis():
+    reg = MetricsRegistry()
+    slo = SloEngine(reg)
+    slo.register_metrics(reg)
+    good = {"0": 1000.0, "1": 1000.0}
+    bad = {"0": 0.0, "1": 0.0}
+    slo.add_sliced(SlicedSloSpec(
+        name="bridge_media", objective=0.999, label="bridge",
+        reader=lambda: ((k, good[k], bad[k]) for k in good)))
+    for _ in range(3):
+        good["0"] += 100.0
+        good["1"] += 100.0
+        slo.on_tick()
+    scrape = reg.render()
+    assert 'bridge="0"' in scrape and 'bridge="1"' in scrape
+    # bridge 1 starts burning its media budget: only ITS slice alerts
+    for _ in range(60):
+        good["0"] += 100.0
+        bad["1"] += 50.0
+        slo.on_tick()
+    assert slo.slice_state("bridge_media", "0") == "ok"
+    assert slo.slice_state("bridge_media", "1") != "ok"

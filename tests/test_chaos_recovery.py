@@ -13,6 +13,11 @@
    without disturbing the other participant, then re-admits it after
    the backoff and its legitimate media decodes again.
 
+3. Cascade double fault: bridge A dies mid-call AND the survivor
+   crashes while the orphan adoption is still in flight; recovery
+   resumes the failover from the checkpointed cascade control plane —
+   the orphan commits or rolls back and re-queues, never a torn row.
+
 The faulted wire is generated OFFLINE with a fixed seed and fed
 byte-identically to both universes: in-chain fault injection draws RNG
 per batch, so two runs that batch differently would diverge — the
@@ -27,11 +32,14 @@ import libjitsi_tpu
 from libjitsi_tpu.control.dtls import StubDtlsEndpoint
 from libjitsi_tpu.core.packet import PacketBatch
 from libjitsi_tpu.io import UdpEngine
+from libjitsi_tpu.mesh.cascade import CascadeTrunk, TrunkConfig
 from libjitsi_tpu.rtp import header as rtp_header
 from libjitsi_tpu.service.bridge import ConferenceBridge
-from libjitsi_tpu.service.lifecycle import StreamLifecycleManager
+from libjitsi_tpu.service.lifecycle import (LifecycleConfig,
+                                            StreamLifecycleManager)
 from libjitsi_tpu.service.sfu_bridge import SfuBridge
 from libjitsi_tpu.service.supervisor import (BridgeSupervisor,
+                                             CascadeSupervisor,
                                              SupervisorConfig)
 from libjitsi_tpu.transform.srtp import SrtpStreamTable
 
@@ -344,6 +352,160 @@ def test_quarantine_isolates_auth_storm_then_readmits():
     for e in (eng0, eng1, atk):
         e.close()
     bridge.close()
+
+
+def _ck(b):
+    """Deterministic (master key, master salt) from one byte seed."""
+    return (bytes([b & 0xFF]) * 16, bytes([(b + 1) & 0xFF]) * 14)
+
+
+def _no_torn(bridge):
+    return [sid for sid in bridge._ssrc_of
+            if sid not in bridge._tx_keys and sid not in bridge._staged]
+
+
+def test_survivor_crash_mid_failover_adopts_or_rolls_back(tmp_path):
+    """3. The double fault: bridge A dies mid-call, and the SURVIVOR
+    crashes while the orphan adoption is still in flight (queued or
+    staged pre-commit).  The adoption rides `cascade_snapshot` on the
+    checkpoint spine; `CascadeSupervisor.recover` must RESUME it — the
+    orphan either commits on the recovered bridge (fresh deadline) or
+    rolls back and re-queues, and at no tick does the bridge hold a
+    torn row (keyed-or-staged, never half)."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    TK = (_ck(0xA0), _ck(0xB0))             # A->B, B->A trunk keys
+    CONF = 7
+    dt = 0.01
+
+    def mk(bid, pid, txk, rxk):
+        b = SfuBridge(cfg, port=0, capacity=16, recv_window_ms=0)
+        tr = CascadeTrunk(txk, rxk, TrunkConfig(), port=0, seed=bid)
+        sup = CascadeSupervisor(
+            b, tr, SupervisorConfig(deadline_ms=1000.0),
+            metrics=b.loop.metrics, bridge_id=bid, peer_bridge_id=pid)
+        lc = StreamLifecycleManager(b, supervisor=sup,
+                                    metrics=b.loop.metrics,
+                                    config=LifecycleConfig())
+        lc.enable_placement(1)
+        lc.placer.enable_bridges(2)
+        tr.attach(b.loop)
+        return b, tr, sup, lc
+
+    bA, tA, supA, lcA = mk(0, 1, TK[0], TK[1])
+    bB, tB, supB, lcB = mk(1, 0, TK[1], TK[0])
+    now = 100.0
+    tA.connect("127.0.0.1", tB.port, now=now)
+    tB.connect("127.0.0.1", tA.port, now=now)
+    supA.cascade_conference(CONF)
+    supB.cascade_conference(CONF, remote=True)
+
+    # one speaker on A (the orphan-to-be), one receiver on B
+    orphan_ssrc, orx, otx = 0x1000, _ck(0x10), _ck(0x12)
+    ok, why = lcA.request_join(orphan_ssrc, orx, otx,
+                               name="spk", conference=CONF)
+    assert ok, f"speaker join refused: {why}"
+    ok, why = lcB.request_join(0x2000, _ck(0x80), _ck(0x82),
+                               name="rcv", conference=CONF)
+    assert ok, f"receiver join refused: {why}"
+
+    # trunks up, roster synced: B pre-installs the remote speaker
+    for _ in range(400):
+        supA.tick(now=now)
+        supB.tick(now=now)
+        now += dt
+        if (tA.state == tB.state == "up"
+                and bB._sid_of_ssrc(orphan_ssrc) is not None):
+            break
+    assert tA.state == tB.state == "up", "trunk never came up"
+    assert bB._sid_of_ssrc(orphan_ssrc) is not None, \
+        "roster sync never installed the remote speaker"
+
+    # kill A; evict the speaker's row on B mid-outage (nothing can
+    # reinstall it — its home bridge is dead) — a genuine orphan
+    bA.close()
+    tA.close()
+    for _ in range(4):
+        supB.tick(now=now)
+        now += dt
+    lcB.request_leave(ssrc=orphan_ssrc)
+    for _ in range(2):
+        supB.tick(now=now)
+        now += dt
+    assert bB._sid_of_ssrc(orphan_ssrc) is None, \
+        "orphan eviction did not take"
+    for _ in range(400):
+        supB.tick(now=now)
+        now += dt
+        if tB.state == "down":
+            break
+    assert tB.state == "down" and supB.trunk_failovers_total == 1
+    assert supB.adopting, "failover queued no adoption"
+
+    # crash the SURVIVOR with the adoption still in flight
+    ckpt = str(tmp_path / "cascade.ckpt")
+    supB.save_checkpoint(ckpt)
+    blob = CascadeSupervisor.load_checkpoint(ckpt)
+    cas = blob["cascade"]
+    assert cas["adopting"], "checkpoint lost the failover-in-progress"
+    mid_flight = [e for e in cas["adopt_q"] + cas["pending_commit"]
+                  if e.get("promote")]
+    assert mid_flight and any(int(e["m"]["ssrc"]) == orphan_ssrc
+                              for e in mid_flight), \
+        "checkpoint lost the in-flight orphan adoption"
+    bB.close()
+    tB.close()
+
+    # recover: fresh trunk (sockets don't survive), control plane and
+    # the adoption queue come back from the checkpoint
+    tr2 = CascadeTrunk(TK[1], TK[0], TrunkConfig(), port=0, seed=9)
+    sup2 = CascadeSupervisor.recover(
+        cfg, ckpt, SfuBridge, trunk=tr2,
+        supervisor_config=SupervisorConfig(deadline_ms=1000.0),
+        bridge_id=1, peer_bridge_id=0, recv_window_ms=0)
+    b2 = sup2.bridge
+    assert sup2.adopting, "recover dropped the failover-in-progress"
+    assert _no_torn(b2) == [], "recovered bridge rose with a torn row"
+    # the constructor consumes pending_lifecycle: placement comes back
+    # from the checkpoint (re-enabling it here would discard the
+    # reconciled placer along with the re-queued adoption's placement)
+    lc2 = StreamLifecycleManager(b2, supervisor=sup2,
+                                 metrics=b2.loop.metrics,
+                                 config=LifecycleConfig())
+    assert lc2.placer is not None, \
+        "reconciliation did not restore placement"
+    lc2.placer.enable_bridges(2)
+    tr2.attach(b2.loop)
+
+    # the receiver's committed row survived the crash bit-for-bit
+    assert b2._sid_of_ssrc(0x2000) is not None
+
+    # drive the recovered supervisor: adoption must complete through
+    # the commit barrier (or roll back and retry — never tear); the
+    # commit-deadline requeue path needs >1s of model time
+    for _ in range(400):
+        sup2.tick(now=now)
+        now += dt
+        assert _no_torn(b2) == [], "torn row during resumed adoption"
+        if not sup2.adopting and sup2.orphans_adopted >= 1:
+            break
+    assert sup2.orphans_adopted >= 1, \
+        "resumed adoption never committed the orphan"
+    sid = b2._sid_of_ssrc(orphan_ssrc)
+    assert sid is not None and sid in b2._tx_keys, \
+        "adopted orphan is not a committed keyed row"
+    assert orphan_ssrc not in tr2._remote_ssrcs, \
+        "adoption did not claim the orphan from the dead peer"
+    assert not sup2._adopt_q and not sup2._pending_commit \
+        and not sup2._conf_outstanding, "adoption queues did not drain"
+
+    # the crash-restart post-mortem names the checkpoint it rose from
+    pm = next(p for p in sup2.postmortems
+              if p["trigger"] == "checkpoint_recover")
+    assert pm["event"]["path"] == ckpt
+    b2.close()
+    tr2.close()
 
 
 def test_recover_with_half_installed_streams_completes_or_rolls_back(

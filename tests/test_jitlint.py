@@ -420,6 +420,63 @@ def test_drift_metrics_dangling_registration_fires():
     assert any("hits_cuont" in f.message for f in found)
 
 
+def test_drift_trunk_counters_partial_coverage_fires():
+    """Seeded from the cascade trunk (mesh/cascade.py): a relay class
+    that grows a recovery counter without exporting it — the failover
+    dashboard would silently under-report trunk RTX."""
+    src = """
+    class Relay:
+        def __init__(self):
+            self.relay_frames_total = 0
+            self.rtx_served_total = 0
+            self.plc_fallthrough_total = 0
+
+        def relay(self):
+            self.relay_frames_total += 1
+
+        def serve_nack(self):
+            self.rtx_served_total += 1
+
+        def expire(self):
+            self.plc_fallthrough_total += 1
+
+        def register_metrics(self, registry):
+            registry.register_counters(self, (
+                ("relay_frames_total", "frames relayed"),
+                ("plc_fallthrough_total", "losses conceded to PLC"),
+            ), prefix="trunk")
+    """
+    ctx = ctx_of(src)
+    found = check_metrics_drift({ctx.relpath: ctx})
+    assert len(found) == 1
+    assert "rtx_served_total" in found[0].message
+
+
+def test_drift_trunk_counters_full_coverage_clean():
+    """The same relay with every counter registered (the shape
+    mesh/cascade.py actually ships) must not fire."""
+    src = """
+    class Relay:
+        def __init__(self):
+            self.relay_frames_total = 0
+            self.rtx_served_total = 0
+
+        def relay(self):
+            self.relay_frames_total += 1
+
+        def serve_nack(self):
+            self.rtx_served_total += 1
+
+        def register_metrics(self, registry):
+            registry.register_counters(self, (
+                ("relay_frames_total", "frames relayed"),
+                ("rtx_served_total", "RTX served from cache"),
+            ), prefix="trunk")
+    """
+    ctx = ctx_of(src)
+    assert check_metrics_drift({ctx.relpath: ctx}) == []
+
+
 def test_drift_slospec_unregistered_metric_fires():
     """An SloSpec naming a family no registration defines burns
     against a permanently-absent signal — the SLO can never fire."""

@@ -641,3 +641,29 @@ def test_reconnect_soak_invariants():
     assert report["torn_rows"] == []
     assert report["handshakes_completed"] == report["key_installs_staged"]
     assert report["refusals"].get("handshake_backlog", 0) > 0
+
+
+@pytest.mark.slow
+def test_cascade_soak_invariants():
+    """Small-config twin of `churn_soak.py --cascade --smoke`: a
+    two-bridge cascade carrying the speaker bus over the trunk, bridge
+    A killed mid-call — the survivor must detect the failover, adopt
+    the evicted orphan through the commit barrier, restore media within
+    the p99 bound with zero data-path recompiles, refuse only with
+    typed `trunk_down` (retry-after honored), and reconcile every row
+    — committed-with-keys or staged, never torn."""
+    spec = importlib.util.spec_from_file_location("churn_soak", _SOAK)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    report = mod.run_cascade_soak(
+        n_senders=3, n_receivers=2, pre_rounds=10, post_rounds=60,
+        restore_p99_bound_s=2.0, seed=0, verbose=False)
+    failed = {k: v for k, v in report.items()
+              if k.startswith("ok_") and not v}
+    assert not failed, (failed, report)
+    assert report["window_recompiles"] == 0
+    assert report["torn_rows"] == []
+    assert report["failovers"] == 1
+    assert report["orphans_adopted"] >= 1
+    assert report["refusals"].get("trunk_down", 0) > 0
+    assert report["conf_bridge_home"] == 1

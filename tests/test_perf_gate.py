@@ -112,6 +112,79 @@ def test_write_baseline_pins_mesh_agg_floor(tmp_path):
     assert on_disk["mesh_agg_pps_ratio"]["floor"] == 4.0
 
 
+def test_ref_floor_resolves_against_same_run(tmp_path):
+    """The box-calibration fix (ISSUE 17): a floor of
+    {"ref": "protect_small_pps", "mult": 2.0} is judged against the
+    SAME-RUN stock result — a slow box where the 2x ratio holds
+    passes, even though the old constant floor (stamped on a faster
+    machine) would have failed it."""
+    baseline = {
+        "protect_small_pps": {"value": 44619.1, "tolerance": 0.6},
+        "protect_cached_pps": {
+            "value": 123927.5, "tolerance": 0.6,
+            "floor": {"ref": "protect_small_pps", "mult": 2.0}},
+    }
+    # slow box: both scenarios at ~58% of the stamped values, ratio
+    # 2.35x intact -> green (the old 89238.2 constant would fail)
+    failures, rows = perf_gate.compare(
+        {"protect_small_pps": 27498.7, "protect_cached_pps": 64589.3},
+        baseline)
+    assert failures == []
+    # ratio actually broken (cache path regressed) -> red, and the
+    # failure names the ratio, not a bare number
+    failures, rows = perf_gate.compare(
+        {"protect_small_pps": 27498.7, "protect_cached_pps": 48000.0},
+        baseline)
+    assert [n for n, _ in failures] == ["protect_cached_pps"]
+    detail = dict((n, d) for n, _s, d in rows)["protect_cached_pps"]
+    assert "2x protect_small_pps" in detail
+
+
+def test_ref_floor_falls_back_to_baseline_value():
+    """`--scenarios protect_cached_pps` alone: the referenced sibling
+    wasn't re-run, so the bar resolves from the baseline's recorded
+    value instead of silently vanishing."""
+    baseline = {
+        "protect_small_pps": {"value": 40000.0, "tolerance": 0.6},
+        "protect_cached_pps": {
+            "value": 123927.5, "tolerance": 0.6,
+            "floor": {"ref": "protect_small_pps", "mult": 2.0}},
+    }
+    failures, _rows = perf_gate.compare(
+        {"protect_cached_pps": 79000.0}, baseline)   # < 2x 40000
+    assert [n for n, _ in failures] == ["protect_cached_pps"]
+    failures, _rows = perf_gate.compare(
+        {"protect_cached_pps": 81000.0}, baseline)   # >= 2x 40000
+    assert failures == []
+
+
+def test_resolve_bar_passthrough_and_unresolvable():
+    # numeric bars pass through untouched (mesh_agg / bcast ratios)
+    assert perf_gate.resolve_bar(4.0, {}, {}) == (4.0, None)
+    assert perf_gate.resolve_bar(None, {}, {}) == (None, None)
+    # unresolvable ref (no same-run result, no baseline entry): the
+    # bar is skipped, not crashed on
+    floor, label = perf_gate.resolve_bar(
+        {"ref": "nope", "mult": 2.0}, {}, {})
+    assert floor is None and label is None
+
+
+def test_write_baseline_cannot_ratchet_ref_floor(tmp_path):
+    """Re-stamping emits the REFERENCE floor with the pinned mult
+    regardless of what was measured: the mult lives in code, so an
+    honest re-baseline on any box can never relax the bar."""
+    path = tmp_path / "b.json"
+    doc = perf_gate.write_baseline(
+        str(path), {"protect_cached_pps": 64589.3,
+                    "protect_small_pps": 27498.7,
+                    "bcast_fanout_pps": 3.7})
+    assert doc["protect_cached_pps"]["floor"] == {
+        "ref": "protect_small_pps", "mult": 1.5}
+    assert doc["bcast_fanout_pps"]["floor"] == 2.5
+    on_disk = json.loads(path.read_text())
+    assert on_disk["protect_cached_pps"]["floor"]["mult"] == 1.5
+
+
 def test_compare_passes_ceiling_through():
     baseline = {"h": {"value": 0.5, "tolerance": 0.6,
                       "higher_is_better": False, "ceiling": 0.35}}
