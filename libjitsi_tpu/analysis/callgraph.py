@@ -1,0 +1,258 @@
+"""Module-resolved call graph over the whole linted tree.
+
+The graph is built from per-file *facts* (see ``index.py``) — plain
+JSON-able dicts, so a warm run reconstructs the graph from the disk
+cache without re-parsing a single file.  Functions are identified by
+``fid`` strings ``"<relpath>::<qualname>"``; call sites carry a
+receiver spelling and a short name, and ``CallGraph.resolve`` maps
+them to a callee fid with four deliberately conservative rules:
+
+1. bare name      -> nested def in the caller, else a top-level
+                     function/class of the same module, else an
+                     imported function/class (``from m import f``);
+2. ``self.m()``   -> method ``m`` on the caller's class or its bases
+                     (bases resolved by name, same module first);
+3. ``alias.f()``  -> top-level ``f`` of the module ``alias`` imports;
+4. anything else  -> *unique* method name across every class in the
+                     tree, else **unresolved** (dynamic dispatch with
+                     several candidates gets no edge and no summary —
+                     a missed edge is a missed finding, never a false
+                     one).
+
+Plane annotations: ``# jitlint: plane=tick|off_tick|dual`` on (or one
+line above) a ``def`` line declares which execution plane the function
+is an entry point for.  ``dual`` marks a function that legitimately
+runs on its caller's plane (the legacy inline-DTLS path) — the
+plane-affinity checker cuts traversal there without flagging.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from libjitsi_tpu.analysis.core import node_name
+
+PLANE_RE = re.compile(r"#\s*jitlint:\s*plane=([a-z_]+)")
+
+PLANES = ("tick", "off_tick", "dual")
+
+
+def module_name(relpath: str) -> str:
+    """"libjitsi_tpu/io/loop.py" -> "libjitsi_tpu.io.loop"."""
+    p = relpath.replace("\\", "/")
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+def extract_imports(tree: ast.AST, module: str) -> Dict[str, str]:
+    """{local name: dotted target}.  ``import a.b as c`` -> {c: a.b};
+    ``import a.b`` -> {a: a}; ``from .x import f as g`` -> {g:
+    pkg.x.f} with relative levels resolved against `module`."""
+    out: Dict[str, str] = {}
+    pkg_parts = module.split(".")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.asname:
+                    out[alias.asname] = alias.name
+                else:
+                    out[alias.name.split(".")[0]] = alias.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                base = pkg_parts[: len(pkg_parts) - node.level]
+            else:
+                base = []
+            mod = ".".join(base + ([node.module] if node.module else []))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                out[local] = f"{mod}.{alias.name}" if mod else alias.name
+    return out
+
+
+def _plane_of(lines: List[str], def_line: int) -> Optional[str]:
+    """Plane annotation on the def line or the line above it."""
+    for probe in (def_line, def_line - 1):
+        if 0 < probe <= len(lines):
+            m = PLANE_RE.search(lines[probe - 1])
+            if m and m.group(1) in PLANES:
+                return m.group(1)
+    return None
+
+
+def extract_defs(ctx) -> Tuple[Dict[str, dict], Dict[str, dict]]:
+    """(functions, classes) symbol tables for one FileContext.
+
+    functions: {qual: {"name", "cls", "params", "line", "end_line",
+                       "plane", "nested"}}
+    classes:   {name: {"bases": [...], "methods": [...], "line"}}
+    """
+    functions: Dict[str, dict] = {}
+    classes: Dict[str, dict] = {}
+
+    def visit(node: ast.AST, prefix: str, cls: Optional[str],
+              depth: int) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                a = child.args
+                params = [p.arg for p in
+                          a.posonlyargs + a.args + a.kwonlyargs]
+                functions[qual] = {
+                    "name": child.name, "cls": cls, "params": params,
+                    "line": child.lineno,
+                    "end_line": child.end_lineno or child.lineno,
+                    "plane": _plane_of(ctx.lines, child.lineno),
+                    "nested": depth > (1 if cls else 0),
+                }
+                visit(child, qual + ".", cls, depth + 1)
+            elif isinstance(child, ast.ClassDef):
+                classes[child.name] = {
+                    "bases": [b for b in
+                              (node_name(x) for x in child.bases) if b],
+                    "methods": [n.name for n in child.body
+                                if isinstance(n, (ast.FunctionDef,
+                                                  ast.AsyncFunctionDef))],
+                    "line": child.lineno,
+                }
+                visit(child, f"{child.name}.", child.name, depth + 1)
+            else:
+                visit(child, prefix, cls, depth)
+
+    visit(ctx.tree, "", None, 0)
+    return functions, classes
+
+
+class CallGraph:
+    """Whole-tree resolution index over per-file facts dicts (the
+    ``data`` attribute of ``index.FileFacts``)."""
+
+    def __init__(self, facts: Dict[str, dict]):
+        self.facts = facts
+        #: dotted module -> relpath
+        self.modules: Dict[str, str] = {}
+        #: method name -> [(relpath, qual)] across every class
+        self._methods: Dict[str, List[Tuple[str, str]]] = {}
+        #: class name -> [(relpath, class dict)]
+        self._classes: Dict[str, List[Tuple[str, dict]]] = {}
+        for rel, f in facts.items():
+            self.modules[f["module"]] = rel
+            for cname, c in f["classes"].items():
+                self._classes.setdefault(cname, []).append((rel, c))
+            for qual, fn in f["functions"].items():
+                if fn["cls"] and qual == f'{fn["cls"]}.{fn["name"]}':
+                    self._methods.setdefault(fn["name"], []).append(
+                        (rel, qual))
+
+    # ------------------------------------------------------------ lookup
+
+    def function(self, fid: str) -> Optional[dict]:
+        rel, _, qual = fid.partition("::")
+        f = self.facts.get(rel)
+        return f["functions"].get(qual) if f else None
+
+    def find(self, path_suffix: str, qual: str) -> Optional[str]:
+        """fid of `qual` in the file whose relpath ends with
+        `path_suffix`, or None."""
+        for rel, f in self.facts.items():
+            if rel.endswith(path_suffix) and qual in f["functions"]:
+                return f"{rel}::{qual}"
+        return None
+
+    def dotted(self, rel: str, cs: dict) -> str:
+        """Best-effort dotted target of a call site: the receiver's
+        first segment mapped through the file's imports —
+        ``time.sleep``, ``pickle.dump`` — used for the blocking-call
+        and stdlib-sink tables."""
+        imports = self.facts[rel]["imports"]
+        recv = cs.get("r")
+        if recv is None:
+            return imports.get(cs["n"], cs["n"])
+        parts = recv.split(".")
+        parts[0] = imports.get(parts[0], parts[0])
+        return ".".join(parts + [cs["n"]])
+
+    # ---------------------------------------------------------- resolve
+
+    def _module_func(self, rel: str, name: str) -> Optional[str]:
+        f = self.facts[rel]
+        fn = f["functions"].get(name)
+        if fn is not None and fn["cls"] is None and not fn["nested"]:
+            return f"{rel}::{name}"
+        if name in f["classes"]:
+            init = f"{name}.__init__"
+            if init in f["functions"]:
+                return f"{rel}::{init}"
+        return None
+
+    def _import_target(self, rel: str, name: str) -> Optional[str]:
+        dotted = self.facts[rel]["imports"].get(name)
+        if not dotted or "." not in dotted:
+            return None
+        mod, _, leaf = dotted.rpartition(".")
+        target_rel = self.modules.get(mod)
+        if target_rel is None:
+            return None
+        return self._module_func(target_rel, leaf)
+
+    def _class_method(self, rel: str, cname: str, name: str,
+                      seen: Optional[Set[str]] = None) -> Optional[str]:
+        seen = seen or set()
+        if cname in seen:
+            return None
+        seen.add(cname)
+        for crel, c in self._candidates(rel, cname):
+            if name in c["methods"]:
+                return f"{crel}::{cname}.{name}"
+            for base in c["bases"]:
+                hit = self._class_method(crel, base, name, seen)
+                if hit:
+                    return hit
+        return None
+
+    def _candidates(self, rel: str, cname: str
+                    ) -> List[Tuple[str, dict]]:
+        cands = self._classes.get(cname, [])
+        same = [(r, c) for r, c in cands if r == rel]
+        return same or cands
+
+    def resolve(self, rel: str, caller_qual: str, cs: dict
+                ) -> Optional[str]:
+        """fid of the callee, or None (unresolved / ambiguous)."""
+        name = cs["n"]
+        recv = cs.get("r")
+        f = self.facts[rel]
+        if recv is None:
+            nested = f"{caller_qual}.{name}"
+            if nested in f["functions"]:
+                return f"{rel}::{nested}"
+            hit = self._module_func(rel, name)
+            if hit:
+                return hit
+            return self._import_target(rel, name)
+        if recv in ("self", "cls"):
+            caller = f["functions"].get(caller_qual)
+            if caller and caller["cls"]:
+                hit = self._class_method(rel, caller["cls"], name)
+                if hit:
+                    return hit
+            # fall through: `self.x(...)` where x is a stored callback
+            # resolves like any dynamic receiver (unique-name rule)
+        elif "." not in recv:
+            dotted = f["imports"].get(recv)
+            if dotted:
+                target_rel = self.modules.get(dotted)
+                if target_rel is not None:
+                    return self._module_func(target_rel, name)
+        # dynamic dispatch: resolve only a tree-unique method name
+        cands = self._methods.get(name, [])
+        if len(cands) == 1:
+            crel, qual = cands[0]
+            return f"{crel}::{qual}"
+        return None

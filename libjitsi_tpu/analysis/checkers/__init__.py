@@ -1,6 +1,9 @@
-"""Checker registry.  Per-file checkers run in the parallel driver;
-global checkers run once over the whole parsed index (cross-file
-facts: metric registrations vs counter definitions)."""
+"""Checker registry.  Per-file checkers run in the parallel driver
+(and their findings are cached per file); global checkers run once
+over the whole-tree facts index (cross-file facts: metric
+registrations vs counter definitions); graph checkers run over the
+TreeIndex's module-resolved call graph (interprocedural taint and
+plane reachability)."""
 
 from libjitsi_tpu.analysis.checkers.drift import (check_snapshot_drift,
                                                   check_metrics_drift)
@@ -8,7 +11,10 @@ from libjitsi_tpu.analysis.checkers.hotalloc import check_hotpath_alloc
 from libjitsi_tpu.analysis.checkers.hotpath import check_hotpath_purity
 from libjitsi_tpu.analysis.checkers.meshcollective import (
     check_mesh_collectives)
+from libjitsi_tpu.analysis.checkers.planeaffinity import (
+    check_plane_affinity)
 from libjitsi_tpu.analysis.checkers.rtpmod16 import check_rtp_mod16
+from libjitsi_tpu.analysis.checkers.secretflow import check_secret_flow
 from libjitsi_tpu.analysis.checkers.secrets import check_secret_taint
 
 #: checker(ctx) -> [Finding]
@@ -20,11 +26,17 @@ PER_FILE_CHECKERS = (
     check_snapshot_drift,
 )
 
-#: checker({relpath: ctx}) -> [Finding]
+#: checker({relpath: facts-or-ctx}) -> [Finding]
 GLOBAL_CHECKERS = (
     check_metrics_drift,
     check_mesh_collectives,
 )
 
+#: checker(TreeIndex) -> [Finding] — need the resolved call graph
+GRAPH_CHECKERS = (
+    check_secret_flow,
+    check_plane_affinity,
+)
+
 RULES = ("hotpath-purity", "hotpath-alloc", "secret-taint", "rtp-mod16",
-         "drift", "mesh-collective")
+         "drift", "mesh-collective", "secret-flow", "plane-affinity")

@@ -148,76 +148,139 @@ def _is_array_ctor(node: ast.AST) -> bool:
 
 # ------------------------------------------------------------- metrics half
 
-def _registered_attrs(ctx: FileContext) -> Set[str]:
-    """Attribute names exported through MetricsRegistry in this file."""
-    out: Set[str] = set()
+#: SloSpec kwargs that reference metric-family names
+SLO_REF_KWARGS = ("metric", "bad_metric", "total_metric")
+
+
+def file_facts(ctx: FileContext) -> dict:
+    """Everything the global metrics-drift pass needs from one file,
+    gathered in ONE tree walk and JSON-serializable (the whole-tree
+    checker used to re-walk every AST eight times per run — the
+    dominant cost of a warm lint; facts make it a set intersection)."""
+    reg_attrs: Set[str] = set()
+    hist_reg: Set[str] = set()
+    metric_exact: Set[str] = set()
+    metric_suffixes: Set[str] = set()
+    slo_refs: List[List] = []
+    ex_hists: List[List] = []
+    ex_observed: Set[str] = set()
+    attr_names: Set[str] = set()
+    reg_counter_names: List[List] = []
+
     for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Attribute):
+            attr_names.add(node.attr)
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            vname = call_func_name(node.value)
+            if vname == "histogram":
+                for tgt in node.targets:
+                    nm = node_name(tgt)
+                    if nm:
+                        hist_reg.add(nm)
+            if vname in ("histogram", "Histogram") and any(
+                    kw.arg == "exemplars" and
+                    isinstance(kw.value, ast.Constant) and
+                    kw.value.value is True
+                    for kw in node.value.keywords):
+                for tgt in node.targets:
+                    nm = node_name(tgt)
+                    if nm:
+                        ex_hists.append([nm, node.lineno,
+                                         node.col_offset])
         if not isinstance(node, ast.Call):
             continue
         fname = call_func_name(node)
         if fname == "register_counters" and len(node.args) >= 2:
             for n in ast.walk(node.args[1]):
-                if isinstance(n, ast.Constant) and isinstance(n.value, str):
-                    # pairs are (attr, help): help texts contain spaces,
-                    # attribute names never do
-                    if " " not in n.value:
-                        out.add(n.value)
+                if isinstance(n, ast.Constant) and \
+                        isinstance(n.value, str) and " " not in n.value:
+                    # pairs are (attr, help): help texts contain
+                    # spaces, attribute names never do
+                    reg_attrs.add(n.value)
+                    metric_suffixes.add(n.value)
+                    reg_counter_names.append(
+                        [n.value, n.lineno, n.col_offset])
         elif fname in ("register_scalar", "register_array"):
             # the reading closure names the attribute: lambda: self.x
             for n in ast.walk(node):
                 if isinstance(n, ast.Lambda):
                     for leaf in ast.walk(n.body):
                         if isinstance(leaf, ast.Attribute):
-                            out.add(leaf.attr)
+                            reg_attrs.add(leaf.attr)
                 elif isinstance(n, ast.Attribute):
-                    out.add(n.attr)
-    return out
-
-
-def _registered_hist_attrs(ctx: FileContext) -> Set[str]:
-    """Histogram attribute names that reach the exporter in this file:
-    mentioned inside a ``register_histogram(...)`` call, or assigned
-    from the ``registry.histogram(...)`` factory (which registers on
-    creation, so the factory form has no drift window)."""
-    out: Set[str] = set()
-    for node in ast.walk(ctx.tree):
-        if isinstance(node, ast.Call) and \
-                call_func_name(node) == "register_histogram":
+                    reg_attrs.add(n.attr)
+        elif fname == "register_histogram":
             for n in ast.walk(node):
                 if isinstance(n, ast.Attribute):
-                    out.add(n.attr)
-        elif isinstance(node, ast.Assign) and \
-                isinstance(node.value, ast.Call) and \
-                call_func_name(node.value) == "histogram":
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Attribute):
-                    out.add(tgt.attr)
-                elif isinstance(tgt, ast.Name):
-                    out.add(tgt.id)
-    return out
+                    hist_reg.add(n.attr)
+        elif fname in ("observe", "observe_same", "observe_array") and \
+                isinstance(node.func, ast.Attribute) and \
+                any(kw.arg == "exemplar" for kw in node.keywords):
+            nm = node_name(node.func.value)
+            if nm:
+                ex_observed.add(nm)
+        elif fname == "SloSpec":
+            slo_name = ""
+            if node.args and isinstance(node.args[0], ast.Constant):
+                slo_name = str(node.args[0].value)
+            for kw in node.keywords:
+                if kw.arg == "name" and \
+                        isinstance(kw.value, ast.Constant):
+                    slo_name = str(kw.value.value)
+            for kw in node.keywords:
+                if kw.arg in SLO_REF_KWARGS and \
+                        isinstance(kw.value, ast.Constant) and \
+                        isinstance(kw.value.value, str) and \
+                        kw.value.value:
+                    slo_refs.append([slo_name, kw.value.value,
+                                     kw.value.lineno,
+                                     kw.value.col_offset])
+        if fname in ("register_scalar", "register_array",
+                     "register_multi", "register_histogram",
+                     "histogram") and node.args:
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and \
+                    isinstance(arg.value, str):
+                metric_exact.add(arg.value)
+            elif isinstance(arg, ast.JoinedStr) and arg.values:
+                tail = arg.values[-1]
+                if isinstance(tail, ast.Constant) and \
+                        isinstance(tail.value, str):
+                    metric_suffixes.add(tail.value.lstrip("_"))
 
-
-def _class_histograms(ctx: FileContext
-                      ) -> List[Tuple[str, ast.AST, Set[str], Set[str]]]:
-    """(class, node, ctor-assigned hist attrs, observed hist attrs) for
-    every class that constructs a bare ``Histogram(...)``.  Anchoring on
-    the constructor assignment keeps `.observe` calls on non-histogram
-    objects (Watchdog.observe, LossTracker.observe) out of scope."""
-    out = []
+    class_counters: List[List] = []
+    class_hists: List[List] = []
     for node in ast.walk(ctx.tree):
         if not isinstance(node, ast.ClassDef):
             continue
+        zeroed: Set[str] = set()
+        bumped: Set[str] = set()
         created: Set[str] = set()
         observed: Set[str] = set()
         for n in ast.walk(node):
-            if isinstance(n, ast.Assign) and \
-                    isinstance(n.value, ast.Call) and \
-                    call_func_name(n.value) == "Histogram":
-                for tgt in n.targets:
-                    if isinstance(tgt, ast.Attribute) and \
-                            isinstance(tgt.value, ast.Name) and \
-                            tgt.value.id == "self":
-                        created.add(tgt.attr)
+            if isinstance(n, ast.Assign):
+                if isinstance(n.value, ast.Constant) and \
+                        n.value.value == 0 and \
+                        not isinstance(n.value.value, bool):
+                    for tgt in n.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            zeroed.add(tgt.attr)
+                elif isinstance(n.value, ast.Call) and \
+                        call_func_name(n.value) == "Histogram":
+                    for tgt in n.targets:
+                        if isinstance(tgt, ast.Attribute) and \
+                                isinstance(tgt.value, ast.Name) and \
+                                tgt.value.id == "self":
+                            created.add(tgt.attr)
+            elif isinstance(n, ast.AugAssign) and \
+                    isinstance(n.op, ast.Add) and \
+                    isinstance(n.target, ast.Attribute) and \
+                    isinstance(n.target.value, ast.Name) and \
+                    n.target.value.id == "self":
+                bumped.add(n.target.attr)
             elif isinstance(n, ast.Call) and \
                     isinstance(n.func, ast.Attribute) and \
                     n.func.attr in ("observe", "observe_array") and \
@@ -225,147 +288,30 @@ def _class_histograms(ctx: FileContext
                     isinstance(n.func.value.value, ast.Name) and \
                     n.func.value.value.id == "self":
                 observed.add(n.func.value.attr)
-        if created:
-            out.append((node.name, node, created, observed))
-    return out
-
-
-def _class_counters(ctx: FileContext) -> List[Tuple[str, str, ast.AST,
-                                                    Set[str]]]:
-    """(class, file, node, counter-attrs) for every class that both
-    initializes integer counters and increments them."""
-    out = []
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.ClassDef):
-            continue
-        zeroed: Dict[str, ast.AST] = {}
-        bumped: Set[str] = set()
-        for n in ast.walk(node):
-            if isinstance(n, ast.Assign) and \
-                    isinstance(n.value, ast.Constant) and \
-                    n.value.value == 0 and \
-                    not isinstance(n.value.value, bool):
-                for tgt in n.targets:
-                    if isinstance(tgt, ast.Attribute) and \
-                            isinstance(tgt.value, ast.Name) and \
-                            tgt.value.id == "self":
-                        zeroed[tgt.attr] = n
-            elif isinstance(n, ast.AugAssign) and \
-                    isinstance(n.op, ast.Add) and \
-                    isinstance(n.target, ast.Attribute) and \
-                    isinstance(n.target.value, ast.Name) and \
-                    n.target.value.id == "self":
-                bumped.add(n.target.attr)
-        counters = {a for a in zeroed if a in bumped
-                    and COUNTER_NAME_RE.search(a)}
+        counters = sorted(a for a in zeroed & bumped
+                          if COUNTER_NAME_RE.search(a))
         if counters:
-            out.append((node.name, ctx.relpath, node, counters))
-    return out
+            class_counters.append([node.name, node.lineno,
+                                   node.col_offset, counters])
+        if created:
+            class_hists.append([node.name, node.lineno,
+                                node.col_offset, sorted(created),
+                                sorted(observed)])
 
-
-#: SloSpec kwargs that reference metric-family names
-SLO_REF_KWARGS = ("metric", "bad_metric", "total_metric")
-
-
-def _registered_metric_names(ctx: FileContext
-                             ) -> Tuple[Set[str], Set[str]]:
-    """(exact family names, name suffixes) this file hands to the
-    registry.  Exact names come from constant first args
-    (register_scalar/array/multi/histogram + the ``registry.histogram``
-    factory); suffixes come from ``register_counters`` attribute lists
-    (full name = ``{prefix}_{attr}`` with a call-site prefix) and from
-    f-string names whose constant tail survives prefix
-    parameterization (``f"{prefix}_fec_k"`` -> ``fec_k``)."""
-    exact: Set[str] = set()
-    suffixes: Set[str] = set()
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.Call):
-            continue
-        fname = call_func_name(node)
-        if fname in ("register_scalar", "register_array",
-                     "register_multi", "register_histogram",
-                     "histogram") and node.args:
-            arg = node.args[0]
-            if isinstance(arg, ast.Constant) and \
-                    isinstance(arg.value, str):
-                exact.add(arg.value)
-            elif isinstance(arg, ast.JoinedStr) and arg.values:
-                tail = arg.values[-1]
-                if isinstance(tail, ast.Constant) and \
-                        isinstance(tail.value, str):
-                    suffixes.add(tail.value.lstrip("_"))
-        elif fname == "register_counters" and len(node.args) >= 2:
-            for n in ast.walk(node.args[1]):
-                if isinstance(n, ast.Constant) and \
-                        isinstance(n.value, str) and " " not in n.value:
-                    suffixes.add(n.value)
-    return exact, suffixes
-
-
-def _slo_metric_refs(ctx: FileContext
-                     ) -> List[Tuple[str, str, ast.AST]]:
-    """(slo name, referenced family name, node) for every constant
-    metric kwarg of an ``SloSpec(...)`` construction."""
-    out: List[Tuple[str, str, ast.AST]] = []
-    for node in ast.walk(ctx.tree):
-        if not (isinstance(node, ast.Call) and
-                call_func_name(node) == "SloSpec"):
-            continue
-        slo_name = ""
-        if node.args and isinstance(node.args[0], ast.Constant):
-            slo_name = str(node.args[0].value)
-        for kw in node.keywords:
-            if kw.arg == "name" and isinstance(kw.value, ast.Constant):
-                slo_name = str(kw.value.value)
-        for kw in node.keywords:
-            if kw.arg in SLO_REF_KWARGS and \
-                    isinstance(kw.value, ast.Constant) and \
-                    isinstance(kw.value.value, str) and kw.value.value:
-                out.append((slo_name, kw.value.value, kw.value))
-    return out
-
-
-def _exemplar_hists(ctx: FileContext) -> List[Tuple[str, ast.AST]]:
-    """(attr/name, node) assigned from a histogram constructor called
-    with a literal ``exemplars=True``."""
-    out: List[Tuple[str, ast.AST]] = []
-    for node in ast.walk(ctx.tree):
-        if not (isinstance(node, ast.Assign) and
-                isinstance(node.value, ast.Call) and
-                call_func_name(node.value) in ("histogram",
-                                               "Histogram")):
-            continue
-        if not any(kw.arg == "exemplars" and
-                   isinstance(kw.value, ast.Constant) and
-                   kw.value.value is True
-                   for kw in node.value.keywords):
-            continue
-        for tgt in node.targets:
-            if isinstance(tgt, ast.Attribute):
-                out.append((tgt.attr, node))
-            elif isinstance(tgt, ast.Name):
-                out.append((tgt.id, node))
-    return out
-
-
-def _exemplar_observed(ctx: FileContext) -> Set[str]:
-    """attr/local names whose observe/observe_same/observe_array call
-    passes an ``exemplar=`` keyword."""
-    out: Set[str] = set()
-    for node in ast.walk(ctx.tree):
-        if not (isinstance(node, ast.Call) and
-                isinstance(node.func, ast.Attribute) and
-                node.func.attr in ("observe", "observe_same",
-                                   "observe_array")):
-            continue
-        if not any(kw.arg == "exemplar" for kw in node.keywords):
-            continue
-        holder = node.func.value
-        if isinstance(holder, ast.Attribute):
-            out.add(holder.attr)
-        elif isinstance(holder, ast.Name):
-            out.add(holder.id)
-    return out
+    return {
+        "abspath": os.path.abspath(ctx.path),
+        "reg_attrs": sorted(reg_attrs),
+        "hist_reg": sorted(hist_reg),
+        "class_counters": class_counters,
+        "class_hists": class_hists,
+        "metric_exact": sorted(metric_exact),
+        "metric_suffixes": sorted(metric_suffixes),
+        "slo_refs": slo_refs,
+        "ex_hists": ex_hists,
+        "ex_observed": sorted(ex_observed),
+        "attr_names": sorted(attr_names),
+        "reg_counter_names": reg_counter_names,
+    }
 
 
 # -------------------------------------------------------- perf-baseline half
@@ -438,14 +384,24 @@ def _perf_gate_scenario_ids(script_path: str) -> Optional[Set[str]]:
     return None
 
 
-def _perf_baseline_findings(index: Dict[str, FileContext]
-                            ) -> List[Finding]:
+def check_baseline_justifications(entries: Dict[str, str]) -> List[str]:
+    """Messages for lint-baseline entries with no one-line `why` —
+    the grandfathering contract is that every surviving entry is
+    justified in the file, not silently parked."""
+    return [
+        f"baseline entry `{key}` has no justification — add a "
+        "one-line `why` to libjitsi_tpu/analysis/baseline.json or "
+        "fix and prune the entry"
+        for key, why in sorted(entries.items()) if not why.strip()]
+
+
+def _perf_baseline_findings(abspaths: List[str]) -> List[Finding]:
     """Disk wiring: lint only indexes .py files under the linted tree,
     so the baseline json and the scripts/ gate are read from disk,
     located by walking up from any indexed file."""
     root = None
-    for ctx in index.values():
-        d = os.path.dirname(os.path.abspath(ctx.path))
+    for p in abspaths:
+        d = os.path.dirname(p)
         for _ in range(6):
             if os.path.exists(os.path.join(d, "PERF_BASELINE.json")):
                 root = d
@@ -477,55 +433,48 @@ def _perf_baseline_findings(index: Dict[str, FileContext]
             for msg in msgs]
 
 
-def check_metrics_drift(index: Dict[str, FileContext]) -> List[Finding]:
+class _CtxFinder:
+    """FileFacts-shaped `.finding()` over a raw FileContext — keeps
+    the direct `{relpath: FileContext}` calling convention of the
+    fixture tests working against the facts-based global pass."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+
+    def finding(self, rule: str, line: int, col: int, message: str,
+                trace=None) -> Optional[Finding]:
+        shim = ast.Pass()
+        shim.lineno, shim.col_offset = line, col
+        return self.ctx.finding(rule, shim, message)
+
+
+def _facts_view(index) -> List[Tuple[str, dict, object]]:
+    """[(relpath, drift facts, finder)] from either a legacy
+    {relpath: FileContext} dict or an index of facts objects."""
+    out = []
+    for rel, v in sorted(index.items()):
+        if isinstance(v, FileContext):
+            out.append((rel, file_facts(v), _CtxFinder(v)))
+        else:
+            out.append((rel, v.data["drift"], v))
+    return out
+
+
+def check_metrics_drift(index) -> List[Finding]:
+    views = _facts_view(index)
     registered: Set[str] = set()
-    for ctx in index.values():
-        registered |= _registered_attrs(ctx)
-
-    findings: List[Optional[Finding]] = []
-    all_counter_attrs: Set[str] = set()
-    all_attr_names: Set[str] = set()
-    for ctx in index.values():
-        for n in ast.walk(ctx.tree):
-            if isinstance(n, ast.Attribute):
-                all_attr_names.add(n.attr)
-        for cls_name, _rel, node, counters in _class_counters(ctx):
-            all_counter_attrs |= counters
-            covered = counters & registered
-            missing = counters - registered
-            if covered and missing:
-                for attr in sorted(missing):
-                    findings.append(ctx.finding(
-                        RULE, node,
-                        f"counter `{cls_name}.{attr}` is incremented "
-                        "but never registered with MetricsRegistry "
-                        "while sibling counters "
-                        f"({', '.join(sorted(covered)[:3])}) are — "
-                        "invisible in production"))
-
-    # histogram half: a Histogram constructed and fed but never handed
-    # to the registry records distributions nobody can scrape
     hist_registered: Set[str] = set()
-    for ctx in index.values():
-        hist_registered |= _registered_hist_attrs(ctx)
-    for ctx in index.values():
-        for cls_name, node, created, observed in _class_histograms(ctx):
-            for attr in sorted((created & observed) - hist_registered):
-                findings.append(ctx.finding(
-                    RULE, node,
-                    f"histogram `{cls_name}.{attr}` is observed but "
-                    "never registered with MetricsRegistry (use "
-                    "register_histogram or the registry.histogram "
-                    "factory) — invisible in production"))
-
-    # SLO half: a spec naming a family no registration defines burns
-    # against a permanently-missing signal
     metric_exact: Set[str] = set()
     metric_suffixes: Set[str] = set()
-    for ctx in index.values():
-        exact, sufs = _registered_metric_names(ctx)
-        metric_exact |= exact
-        metric_suffixes |= sufs
+    exemplar_fed: Set[str] = set()
+    all_attr_names: Set[str] = set()
+    for _rel, d, _f in views:
+        registered |= set(d["reg_attrs"])
+        hist_registered |= set(d["hist_reg"])
+        metric_exact |= set(d["metric_exact"])
+        metric_suffixes |= set(d["metric_suffixes"])
+        exemplar_fed |= set(d["ex_observed"])
+        all_attr_names |= set(d["attr_names"])
 
     def _family_known(ref: str) -> bool:
         if ref in metric_exact:
@@ -533,50 +482,67 @@ def check_metrics_drift(index: Dict[str, FileContext]) -> List[Finding]:
         return any(ref == s or ref.endswith("_" + s)
                    for s in metric_suffixes)
 
-    for ctx in index.values():
-        for slo_name, ref, node in _slo_metric_refs(ctx):
+    findings: List[Optional[Finding]] = []
+    for _rel, d, finder in views:
+        for cls_name, line, col, counters in d["class_counters"]:
+            covered = set(counters) & registered
+            missing = set(counters) - registered
+            if covered and missing:
+                for attr in sorted(missing):
+                    findings.append(finder.finding(
+                        RULE, line, col,
+                        f"counter `{cls_name}.{attr}` is incremented "
+                        "but never registered with MetricsRegistry "
+                        "while sibling counters "
+                        f"({', '.join(sorted(covered)[:3])}) are — "
+                        "invisible in production"))
+
+        # histogram half: a Histogram constructed and fed but never
+        # handed to the registry is recorded but unscrapeable
+        for cls_name, line, col, created, observed in d["class_hists"]:
+            for attr in sorted((set(created) & set(observed))
+                               - hist_registered):
+                findings.append(finder.finding(
+                    RULE, line, col,
+                    f"histogram `{cls_name}.{attr}` is observed but "
+                    "never registered with MetricsRegistry (use "
+                    "register_histogram or the registry.histogram "
+                    "factory) — invisible in production"))
+
+        # SLO half: a spec naming a family no registration defines
+        # burns against a permanently-missing signal
+        for slo_name, ref, line, col in d["slo_refs"]:
             if not _family_known(ref):
-                findings.append(ctx.finding(
-                    RULE, node,
+                findings.append(finder.finding(
+                    RULE, line, col,
                     f"SloSpec `{slo_name}` references metric `{ref}` "
                     "that no MetricsRegistry registration defines — "
                     "the burn-rate engine reads an absent family "
                     "forever and this SLO can never fire"))
 
-    # exemplar half: an exemplars=True histogram nobody ever feeds an
-    # exemplar ships empty exemplar slots in every OpenMetrics scrape
-    exemplar_fed: Set[str] = set()
-    for ctx in index.values():
-        exemplar_fed |= _exemplar_observed(ctx)
-    for ctx in index.values():
-        for attr, node in _exemplar_hists(ctx):
+        # exemplar half: an exemplars=True histogram nobody ever feeds
+        # ships empty exemplar slots in every OpenMetrics scrape
+        for attr, line, col in d["ex_hists"]:
             if attr not in exemplar_fed:
-                findings.append(ctx.finding(
-                    RULE, node,
+                findings.append(finder.finding(
+                    RULE, line, col,
                     f"histogram `{attr}` is created with "
                     "exemplars=True but no observe call ever passes "
                     "exemplar= — its exemplar slots stay empty in "
                     "every OpenMetrics scrape"))
 
+        # vice versa: registered attribute names that exist nowhere
+        for name, line, col in d["reg_counter_names"]:
+            if name not in all_attr_names:
+                findings.append(finder.finding(
+                    RULE, line, col,
+                    f"register_counters names `{name}` but no "
+                    "class defines that attribute (typo -> "
+                    "AttributeError at scrape time)"))
+
     # perf-baseline half: PERF_BASELINE.json vs perf_gate SCENARIOS —
     # a stale baseline key silently gates nothing; a scenario with no
     # baseline entry silently never gates
-    findings.extend(_perf_baseline_findings(index))
-
-    # vice versa: registered attribute names that exist nowhere
-    for ctx in index.values():
-        for node in ast.walk(ctx.tree):
-            if not (isinstance(node, ast.Call) and
-                    call_func_name(node) == "register_counters" and
-                    len(node.args) >= 2):
-                continue
-            for n in ast.walk(node.args[1]):
-                if isinstance(n, ast.Constant) and \
-                        isinstance(n.value, str) and " " not in n.value \
-                        and n.value not in all_attr_names:
-                    findings.append(ctx.finding(
-                        RULE, n,
-                        f"register_counters names `{n.value}` but no "
-                        "class defines that attribute (typo -> "
-                        "AttributeError at scrape time)"))
+    findings.extend(_perf_baseline_findings(
+        [d["abspath"] for _r, d, _f in views]))
     return [f for f in findings if f is not None]

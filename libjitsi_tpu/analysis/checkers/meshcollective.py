@@ -39,34 +39,51 @@ def _in_mesh_module(relpath: str) -> bool:
     return "/mesh/" in relpath or relpath.startswith("mesh/")
 
 
-def _sanctioned_sites(index: Dict[str, FileContext]
-                      ) -> Optional[Set[Tuple[str, str]]]:
+def _parse_sanctioned(ctx: FileContext) -> Optional[List[List[str]]]:
     """(path, function) pairs from placement.py's module-level
     ``SANCTIONED_COLLECTIVE_SITES`` tuple literal (AST only)."""
-    for relpath, ctx in index.items():
-        if not relpath.endswith(_PLACEMENT_SUFFIX):
-            continue
-        for node in ast.walk(ctx.tree):
-            if not (isinstance(node, ast.AnnAssign) and
-                    isinstance(node.target, ast.Name) and
-                    node.target.id == "SANCTIONED_COLLECTIVE_SITES"):
-                if not (isinstance(node, ast.Assign) and any(
-                        isinstance(t, ast.Name) and
-                        t.id == "SANCTIONED_COLLECTIVE_SITES"
-                        for t in node.targets)):
-                    continue
-            value = getattr(node, "value", None)
-            if not isinstance(value, (ast.Tuple, ast.List)):
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.AnnAssign) and
+                isinstance(node.target, ast.Name) and
+                node.target.id == "SANCTIONED_COLLECTIVE_SITES"):
+            if not (isinstance(node, ast.Assign) and any(
+                    isinstance(t, ast.Name) and
+                    t.id == "SANCTIONED_COLLECTIVE_SITES"
+                    for t in node.targets)):
                 continue
-            sites = set()
-            for elt in value.elts:
-                if isinstance(elt, (ast.Tuple, ast.List)) and \
-                        len(elt.elts) == 2 and all(
-                            isinstance(e, ast.Constant) and
-                            isinstance(e.value, str) for e in elt.elts):
-                    sites.add((elt.elts[0].value, elt.elts[1].value))
-            return sites
+        value = getattr(node, "value", None)
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            continue
+        sites = []
+        for elt in value.elts:
+            if isinstance(elt, (ast.Tuple, ast.List)) and \
+                    len(elt.elts) == 2 and all(
+                        isinstance(e, ast.Constant) and
+                        isinstance(e.value, str) for e in elt.elts):
+                sites.append([elt.elts[0].value, elt.elts[1].value])
+        return sites
     return None
+
+
+def file_facts(ctx: FileContext) -> dict:
+    """Per-file mesh facts (JSON-able): collective call sites with
+    their enclosing function names, plus the sanction list when this
+    is placement.py itself."""
+    facts: dict = {}
+    if ctx.relpath.endswith(_PLACEMENT_SUFFIX):
+        facts["sanctioned"] = _parse_sanctioned(ctx)
+    if _in_mesh_module(ctx.relpath):
+        sites = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            coll = _collective_name(node)
+            if coll is not None:
+                sites.append([coll, node.lineno, node.col_offset,
+                              sorted(_enclosing_functions(node))])
+        if sites:
+            facts["collectives"] = sites
+    return facts
 
 
 def _collective_name(call: ast.Call) -> Optional[str]:
@@ -92,32 +109,38 @@ def _enclosing_functions(node: ast.AST) -> Set[str]:
     return names
 
 
-def check_mesh_collectives(index: Dict[str, FileContext]
-                           ) -> List[Finding]:
-    sanctioned = _sanctioned_sites(index)
-    if sanctioned is None:
-        sanctioned = set()
+def _views(index) -> List[Tuple[str, dict, object]]:
+    from libjitsi_tpu.analysis.checkers.drift import _CtxFinder
+    out = []
+    for rel, v in sorted(index.items()):
+        if isinstance(v, FileContext):
+            out.append((rel, file_facts(v), _CtxFinder(v)))
+        else:
+            out.append((rel, v.data["mesh"], v))
+    return out
+
+
+def check_mesh_collectives(index) -> List[Finding]:
+    views = _views(index)
+    sanctioned: Set[Tuple[str, str]] = set()
+    for rel, facts, _f in views:
+        if rel.endswith(_PLACEMENT_SUFFIX):
+            sanctioned = {(p, fn)
+                          for p, fn in facts.get("sanctioned") or ()}
     out: List[Optional[Finding]] = []
-    for relpath, ctx in index.items():
-        if not _in_mesh_module(relpath):
-            continue
+    for relpath, facts, finder in views:
         site_funcs = {fn for path, fn in sanctioned
                       if relpath.endswith(path)}
-        for node in ast.walk(ctx.tree):
-            if not isinstance(node, ast.Call):
-                continue
-            coll = _collective_name(node)
-            if coll is None:
-                continue
+        for coll, line, col, enclosing in facts.get("collectives", ()):
             if relpath.endswith(_PLACEMENT_SUFFIX):
                 # placement module itself defines the sanction list;
                 # a collective THERE would be the steady-state tick
                 # regressing — never sanctioned
                 pass
-            elif _enclosing_functions(node) & site_funcs:
+            elif set(enclosing) & site_funcs:
                 continue
-            out.append(ctx.finding(
-                RULE, node,
+            out.append(finder.finding(
+                RULE, line, col,
                 f"cross-chip collective `{coll}` outside the "
                 "sanctioned escape hatches "
                 "(mesh/placement.py SANCTIONED_COLLECTIVE_SITES): "

@@ -1,0 +1,280 @@
+"""plane-affinity: static proof of the tick/off-tick plane split.
+
+PR 16 split the bridge into two execution planes: the MediaLoop tick
+(per-packet datapath, hard deadline) and the off-tick lifecycle window
+(DTLS handshakes, OpenSSL, keystream refill, commits).  The runtime
+invariant is ``handshake_tick_thread_feeds == 0``; this rule is its
+static twin — call-graph reachability from the declared plane roots.
+
+Roots are declared two ways: the built-in tables below (the known
+entry points), and ``# jitlint: plane=tick|off_tick|dual`` annotations
+on ``def`` lines.  Traversal from the tick root flags:
+
+- any off-tick plane ENTRY point it can reach (``poll``, ``drain``,
+  ``process``, ``fill`` — tick code scheduling lifecycle work inline);
+- any handshake/OpenSSL-class function (``feed``, ``do_handshake``,
+  direct ``_lib``/OpenSSL FFI work) not declared as a plane boundary;
+- keystream ``fill`` work (serving cached slots on tick is the design;
+  FILLING them is off-tick only);
+- blocking calls (``time.sleep``, ``pickle.dump/load``) anywhere in
+  tick-reachable code.
+
+``plane=dual`` marks a function that legitimately runs on its
+caller's plane — the legacy inline-DTLS path (`_process_one` in
+non-deferred standalone-bridge mode) — traversal cuts there without
+flagging; the deferred flag plus the runtime counter keep the managed
+path honest, and the annotation makes the exception reviewable.
+
+Second rule, any plane: a raw SRTP table ``add_stream``/``add_streams``
+key install reachable outside the staged commit barrier
+(``stage_endpoints`` / ``stage_dtls_keys`` / ``commit_endpoints`` /
+the sanctioned legacy ``_install_dtls``) bypasses the epoch the
+barrier exists to provide — keys must land through staging.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from libjitsi_tpu.analysis.core import Finding
+
+RULE = "plane-affinity"
+
+#: (relpath suffix, qualname) built-in plane roots
+TICK_ROOTS = (("io/loop.py", "MediaLoop.tick"),)
+OFF_TICK_ROOTS = (
+    ("service/lifecycle.py", "StreamLifecycleManager.run_between_ticks"),
+    ("service/lifecycle.py", "StreamLifecycleManager.poll"),
+    ("service/lifecycle.py", "HandshakeQueue.drain"),
+    ("control/dtls.py", "DtlsAssociationTable.process"),
+    ("transform/srtp/keystream.py", "KeystreamCache.fill"),
+)
+
+#: handshake/OpenSSL-class work: these must never be tick-reachable
+HANDSHAKE_FUNCS = {"feed", "do_handshake", "handshake"}
+HANDSHAKE_SCOPE = "control/"
+
+#: dotted call targets that block the caller's thread
+BLOCKING_CALLS = {"time.sleep", "pickle.dump", "pickle.dumps",
+                  "pickle.load", "pickle.loads"}
+
+#: the sanctioned key-install surfaces (staged commit barrier + the
+#: documented legacy inline twin)
+BARRIER_FUNCS = {"stage_endpoints", "stage_dtls_keys",
+                 "commit_endpoints", "_install_dtls"}
+
+INSTALL_CALLS = {"add_stream", "add_streams"}
+
+#: receiver spelling fragments that make an install call an SRTP
+#: table install (vs ReceiveBank bookkeeping etc.)
+_TABLE_TOKENS = ("table", "_rx", "_tx", "rx_", "tx_")
+
+
+def _pkg_rel(relpath: str) -> str:
+    return relpath.replace("\\", "/").split("libjitsi_tpu/")[-1]
+
+
+def _fn_work_class(graph, fid: str, fn: dict) -> Optional[str]:
+    """Work category of `fid` that must never run on the tick plane,
+    or None for ordinary datapath code."""
+    rel, _, qual = fid.partition("::")
+    p = _pkg_rel(rel)
+    name = fn["name"]
+    if p.startswith(HANDSHAKE_SCOPE) and name in HANDSHAKE_FUNCS:
+        return "handshake/OpenSSL work"
+    if name == "fill" and p.startswith("transform/srtp/"):
+        return "keystream fill work"
+    for cs in fn.get("calls", ()):
+        dotted = graph.dotted(rel, cs)
+        recv = cs.get("r") or ""
+        if dotted.startswith("_openssl.") or "._lib." in f".{recv}." \
+                or recv.endswith("._lib") or recv == "_lib":
+            return "direct OpenSSL FFI work"
+        # a control/ function driving `ep.feed(...)`-style handshake
+        # dispatch is handshake work even when the receiver's class
+        # cannot be resolved (association tables hold mixed endpoints)
+        if p.startswith(HANDSHAKE_SCOPE) and recv \
+                and cs["n"] in HANDSHAKE_FUNCS:
+            return "handshake/OpenSSL work"
+    return None
+
+
+def _roots(graph, table, plane: str) -> Dict[str, str]:
+    """{fid: plane} for built-in roots present in the tree plus any
+    annotated functions of that plane."""
+    out: Dict[str, str] = {}
+    for suffix, qual in table:
+        fid = graph.find(suffix, qual)
+        if fid is not None:
+            out[fid] = plane
+    for rel, f in graph.facts.items():
+        for qual, fn in f["functions"].items():
+            if fn.get("plane") == plane:
+                out[f"{rel}::{qual}"] = plane
+    return out
+
+
+def _trace(parents: Dict[str, Tuple[Optional[str], int]], fid: str,
+           graph, extra_line: Optional[int] = None) -> List[dict]:
+    """Root -> ... -> fid hop list from BFS parent pointers."""
+    hops = []
+    cur: Optional[str] = fid
+    line = extra_line
+    while cur is not None:
+        rel, _, qual = cur.partition("::")
+        fn = graph.function(cur)
+        hops.append({"path": rel,
+                     "line": line if line is not None
+                     else (fn or {}).get("line", 1),
+                     "symbol": qual, "note": ""})
+        cur, line = parents.get(cur, (None, None))
+    hops.reverse()
+    hops[0]["note"] = "plane root"
+    return hops
+
+
+def check_plane_affinity(index) -> List[Finding]:
+    graph = index.graph
+    tick_roots = _roots(graph, TICK_ROOTS, "tick")
+    off_roots = _roots(graph, OFF_TICK_ROOTS, "off_tick")
+
+    def finding(rel: str, line: int, message: str,
+                trace: Optional[List[dict]] = None
+                ) -> Optional[Finding]:
+        facts = index.facts.get(rel)
+        if facts is None:
+            return None
+        return facts.finding(RULE, line, 0, message, trace=trace)
+
+    out: List[Finding] = []
+
+    # ---- rule 1: BFS from the tick root; flag off-tick entries and
+    # work-class functions, cut at declared plane boundaries
+    visited: Set[str] = set()
+    parents: Dict[str, Tuple[Optional[str], int]] = {}
+    work = [fid for fid in tick_roots]
+    flagged: Set[Tuple[str, str]] = set()
+    while work:
+        fid = work.pop(0)
+        if fid in visited:
+            continue
+        visited.add(fid)
+        fn = graph.function(fid)
+        if fn is None:
+            continue
+        rel, _, qual = fid.partition("::")
+        for i, cs in enumerate(fn.get("calls", ())):
+            dotted = graph.dotted(rel, cs)
+            if dotted in BLOCKING_CALLS:
+                tr = _trace(parents, fid, graph)
+                tr.append({"path": rel, "line": cs["l"],
+                           "symbol": qual,
+                           "note": f"blocking call {dotted}(...)"})
+                f = finding(
+                    rel, cs["l"],
+                    f"blocking call `{dotted}` is reachable from the "
+                    f"tick root {'/'.join(q for _, q in TICK_ROOTS)} — "
+                    "the tick thread must never block (move it to the "
+                    "off-tick lifecycle window)", trace=tr)
+                if f is not None and ("blk", f"{rel}:{cs['l']}") \
+                        not in flagged:
+                    flagged.add(("blk", f"{rel}:{cs['l']}"))
+                    out.append(f)
+            callee = graph.resolve(rel, qual, cs)
+            if callee is None or callee in visited:
+                continue
+            cfn = graph.function(callee)
+            if cfn is None:
+                continue
+            plane = cfn.get("plane")
+            is_off_root = callee in off_roots
+            if plane == "dual":
+                continue  # declared boundary: cut, no flag
+            if plane == "off_tick" or is_off_root:
+                crel, _, cqual = callee.partition("::")
+                tr = _trace(parents, fid, graph)
+                tr.append({"path": crel, "line": cfn["line"],
+                           "symbol": cqual,
+                           "note": "off-tick plane entry"})
+                f = finding(
+                    rel, cs["l"],
+                    f"off-tick plane entry `{cqual}` is reachable "
+                    "from the tick root — lifecycle/handshake/fill "
+                    "work belongs in run_between_ticks, not the "
+                    "packet tick", trace=tr)
+                if f is not None and ("off", callee) not in flagged:
+                    flagged.add(("off", callee))
+                    out.append(f)
+                continue  # do not traverse into the other plane
+            wc = _fn_work_class(graph, callee, cfn)
+            if wc is not None:
+                crel, _, cqual = callee.partition("::")
+                tr = _trace(parents, fid, graph)
+                tr.append({"path": crel, "line": cfn["line"],
+                           "symbol": cqual, "note": wc})
+                f = finding(
+                    rel, cs["l"],
+                    f"`{cqual}` ({wc}) is reachable from the tick "
+                    "root — the static twin of "
+                    "handshake_tick_thread_feeds == 0 (defer to the "
+                    "handshake queue / off-tick window)", trace=tr)
+                if f is not None and ("work", callee) not in flagged:
+                    flagged.add(("work", callee))
+                    out.append(f)
+                continue
+            parents[callee] = (fid, cs["l"])
+            work.append(callee)
+
+    # ---- rule 2: raw SRTP key installs outside the commit barrier,
+    # reachable from ANY plane root without traversing a barrier fn
+    reach: Set[str] = set()
+    parents2: Dict[str, Tuple[Optional[str], int]] = {}
+    work = list(tick_roots) + list(off_roots)
+    while work:
+        fid = work.pop(0)
+        if fid in reach:
+            continue
+        reach.add(fid)
+        fn = graph.function(fid)
+        if fn is None:
+            continue
+        rel, _, qual = fid.partition("::")
+        for cs in fn.get("calls", ()):
+            callee = graph.resolve(rel, qual, cs)
+            if callee is None or callee in reach:
+                continue
+            cfn = graph.function(callee)
+            if cfn is None or cfn["name"] in BARRIER_FUNCS:
+                continue  # the barrier is the sanctioned surface
+            parents2[callee] = (fid, cs["l"])
+            work.append(callee)
+
+    for fid in sorted(reach):
+        fn = graph.function(fid)
+        if fn is None or fn["name"] in BARRIER_FUNCS:
+            continue
+        rel, _, qual = fid.partition("::")
+        for cs in fn.get("calls", ()):
+            recv = (cs.get("r") or "").lower()
+            if cs["n"] not in INSTALL_CALLS:
+                continue
+            if not any(tok in recv for tok in _TABLE_TOKENS):
+                continue
+            # warmup installs land dummy keys in throwaway scratch
+            # tables to pre-compile kernels — not live key state
+            if "scratch" in recv or fn["name"].startswith("warmup"):
+                continue
+            tr = _trace(parents2, fid, graph, extra_line=cs["l"])
+            tr[-1]["note"] = f"raw {recv}.{cs['n']}(...) key install"
+            f = finding(
+                rel, cs["l"],
+                f"SRTP key install `{recv}.{cs['n']}` is reachable "
+                "from a plane root without passing the staged commit "
+                "barrier (stage_endpoints/stage_dtls_keys/"
+                "commit_endpoints) — keys must land through staging",
+                trace=tr)
+            if f is not None:
+                out.append(f)
+
+    out.sort(key=lambda f: (f.path, f.line, f.message))
+    return out

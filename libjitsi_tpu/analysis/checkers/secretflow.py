@@ -1,0 +1,117 @@
+"""secret-flow: interprocedural key-material leak detection.
+
+The intra-file ``secret-taint`` rule guards *timing* (branches and
+table lookups inside the crypto kernels).  This rule guards
+*exposure*: key material must never reach an observability or
+serialization surface, no matter how many helper calls it crosses.
+
+Sources (seeded by ``summaries`` during fact extraction):
+- reads of secret-named values (``is_secret_name``) inside the
+  key-material modules listed in ``SOURCE_SCOPES`` — DTLS exported
+  keys in the lifecycle/handshake plane, KDF outputs and keystream
+  slot tables under ``transform/srtp/``, trunk keys in
+  ``mesh/cascade.py``, raw key schedules in ``kernels/``;
+- return values of the exporter functions in
+  ``summaries.SOURCE_FUNCS`` (``srtp_keys``,
+  ``export_keying_material``, ``derive_session_keys*``) anywhere in
+  the tree.
+
+Sinks: structured-log calls, ``FlightRecorder.record`` payloads,
+``MetricsRegistry`` label values (``set_stream_name``), ``/debug/*``
+endpoint JSON in ``service/obs_server.py``, plaintext checkpoint
+serialization (``pickle.dump``), and exception payloads.
+
+Structure-only access stays legal exactly as in the intra-file rule:
+``len(key)``, ``key.shape``, ``key is None`` and boolean verdicts
+carry no taint.  Each finding anchors at the SINK line and carries the
+full source -> hops -> sink trace; suppression pragmas work at either
+end of the flow (sink side or source side).
+
+Real findings here are fixed, never baselined — this is the rule the
+ROADMAP's E2EE item names as its prerequisite ("inner keys never
+reach SFU-side code"): inner-key sources will ride the same engine.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from libjitsi_tpu.analysis import summaries as _summaries
+from libjitsi_tpu.analysis.core import Finding
+
+RULE = "secret-flow"
+
+#: package-relative prefixes whose secret-NAMED values are taint
+#: sources (the modules that hold real key material)
+SOURCE_SCOPES = ("kernels/", "transform/srtp/", "control/dtls.py",
+                 "control/zrtp.py", "service/lifecycle.py",
+                 "service/sfu_bridge.py", "mesh/cascade.py")
+
+
+def in_source_scope(relpath: str) -> bool:
+    p = relpath.replace("\\", "/").split("libjitsi_tpu/")[-1]
+    return any(p.startswith(pre) for pre in SOURCE_SCOPES)
+
+
+def _source_hop(engine, ground) -> Optional[dict]:
+    """Trace hop describing where a ground source atom was read."""
+    kind, fid, which = ground
+    fn = engine.fns.get(fid)
+    if fn is None:
+        return None
+    rel, _, qual = fid.partition("::")
+    if kind == "SRC":
+        src = fn["sources"][int(which)]
+        return {"path": rel, "line": src["l"], "symbol": qual,
+                "note": f"secret-named value `{src['n']}`"}
+    if kind == "SRCCALL":
+        cs = fn["calls"][int(which)]
+        return {"path": rel, "line": cs["l"], "symbol": qual,
+                "note": f"key material from {cs['n']}(...)"}
+    return None
+
+
+def check_secret_flow(index) -> List[Finding]:
+    """`index` is a TreeIndex (facts + call graph)."""
+    engine = _summaries.TaintEngine(index.graph)
+    sinks = engine.solve_sinks()
+
+    out: List[Finding] = []
+    seen = set()
+    for fid, per_atom in sinks.items():
+        for ground, entries in per_atom.items():
+            if ground[0] not in ("SRC", "SRCCALL"):
+                continue
+            src_hop = _source_hop(engine, ground)
+            if src_hop is None:
+                continue
+            for e in entries:
+                sink_hop = e["path"][-1]
+                key = (ground, e["kind"], sink_hop["path"],
+                       sink_hop["line"])
+                if key in seen:
+                    continue
+                seen.add(key)
+                trace = [src_hop] + e["path"]
+                sink_facts = index.facts.get(sink_hop["path"])
+                src_facts = index.facts.get(src_hop["path"])
+                # pragma scope: either end of the flow may waive it
+                if src_facts is not None and src_facts.suppressed(
+                        RULE, src_hop["line"]):
+                    continue
+                if sink_facts is None:
+                    continue
+                f = sink_facts.finding(
+                    RULE, sink_hop["line"], 0,
+                    f"key material ({src_hop['note']} in "
+                    f"{src_hop['path']}:{src_hop['line']}) reaches "
+                    f"{e['kind']} sink after "
+                    f"{len(e['path']) - 1} call hop(s) — secrets "
+                    "must never reach logs, flight payloads, metrics "
+                    "labels, debug endpoints, checkpoints, or "
+                    "exception text",
+                    trace=trace)
+                if f is not None:
+                    out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.message))
+    return out

@@ -16,13 +16,19 @@ import hashlib
 import re
 from typing import Dict, Iterable, List, Optional, Set, Tuple
 
+#: one hop of an interprocedural taint/reachability path:
+#: {"path": relpath, "line": int, "symbol": str, "note": str}
+TraceHop = Dict[str, object]
+
 PRAGMA_RE = re.compile(r"#\s*jitlint:\s*disable=([a-z0-9_,\-]+|all)")
 PRAGMA_FILE_RE = re.compile(r"#\s*jitlint:\s*disable-file=([a-z0-9_,\-]+|all)")
 
 #: attribute/function accesses through which a traced or secret value
 #: yields only STATIC (shape/dtype) information — never data
-SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "nbytes"}
-SHAPE_CALLS = {"len", "isinstance", "hasattr", "getattr", "type", "id"}
+SHAPE_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "nbytes",
+               "batch_size"}
+SHAPE_CALLS = {"len", "isinstance", "hasattr", "getattr", "type", "id",
+               "range", "bool"}
 
 
 @dataclasses.dataclass
@@ -35,6 +41,10 @@ class Finding:
     snippet: str           # stripped source of the flagged line
     symbol: str            # enclosing qualname, "" at module level
     occurrence: int = 0    # nth identical finding in this symbol
+    #: interprocedural source->hops->sink path (secret-flow /
+    #: plane-affinity); not part of content_key — the same logical
+    #: finding keeps its baseline key when an intermediate hop moves
+    trace: Optional[List[TraceHop]] = None
 
     @property
     def content_key(self) -> str:
@@ -47,14 +57,25 @@ class Finding:
                 f"{h}:{self.occurrence}")
 
     def to_dict(self) -> dict:
-        return {"rule": self.rule, "path": self.path, "line": self.line,
-                "col": self.col, "message": self.message,
-                "snippet": self.snippet, "symbol": self.symbol,
-                "key": self.content_key}
+        out = {"rule": self.rule, "path": self.path, "line": self.line,
+               "col": self.col, "message": self.message,
+               "snippet": self.snippet, "symbol": self.symbol,
+               "key": self.content_key}
+        if self.trace:
+            out["trace"] = self.trace
+        return out
 
     def render(self) -> str:
-        return (f"{self.path}:{self.line}:{self.col}: "
+        base = (f"{self.path}:{self.line}:{self.col}: "
                 f"[{self.rule}] {self.message}\n    {self.snippet}")
+        if self.trace:
+            hops = "\n".join(
+                f"    {'source' if i == 0 else '  hop' if i < len(self.trace) - 1 else ' sink'}"
+                f" {h['path']}:{h['line']} ({h['symbol'] or '<module>'})"
+                f" {h.get('note', '')}".rstrip()
+                for i, h in enumerate(self.trace))
+            base += "\n" + hops
+        return base
 
 
 def _parse_pragmas(lines: List[str]) -> Tuple[Dict[int, Set[str]], Set[str]]:

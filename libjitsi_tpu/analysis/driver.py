@@ -1,11 +1,17 @@
-"""Per-file parallel lint driver + human/JSON rendering.
+"""Whole-tree lint driver + human/JSON rendering.
 
-`run_lint(paths)` discovers ``.py`` files, parses and runs the per-file
-checkers across a thread pool (one task per file — parse plus four
-visitors is microseconds per file, the pool exists so a cold cache of
-~200 files clears the tier-1 <10 s gate with headroom to grow), then
-runs the cross-file checkers on the assembled index, assigns
-occurrence indices, and applies the committed baseline.
+`run_lint(paths)` discovers ``.py`` files and builds the facts index
+(``index.build_index``): files whose content sha matches the disk
+cache skip parsing and per-file checking entirely; the rest are
+parsed, checked, and fact-extracted across a thread pool.  The global
+checkers (drift, mesh) and the interprocedural graph checkers
+(secret-flow, plane-affinity) then run over facts — never over ASTs —
+so a warm run's cost is hashing sources plus pure set/graph work.
+
+``changed_only`` (scripts/lint.py --changed) narrows the re-check set
+further: git names the changed files, the cached import graph gives
+their reverse-dependency closure, and every file outside that closure
+is trusted from the cache without even re-reading its source.
 
 Exit-code contract (scripts/lint.py): 0 clean, 1 findings, 2 internal
 error — an unparseable file is an internal error, not a finding, so a
@@ -14,16 +20,20 @@ syntax-broken tree fails loudly rather than linting clean.
 
 from __future__ import annotations
 
-import concurrent.futures
 import dataclasses
 import json
 import os
+import subprocess
+import time
 from collections import defaultdict
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 from libjitsi_tpu.analysis import baseline as baseline_mod
+from libjitsi_tpu.analysis import index as index_mod
 from libjitsi_tpu.analysis.checkers import (GLOBAL_CHECKERS,
+                                            GRAPH_CHECKERS,
                                             PER_FILE_CHECKERS)
+from libjitsi_tpu.analysis.checkers import drift as drift_mod
 from libjitsi_tpu.analysis.core import FileContext, Finding
 
 SKIP_DIRS = {"__pycache__", ".git", "build", "dist"}
@@ -36,12 +46,20 @@ class LintResult:
     stale_baseline: List[str]
     files_checked: int
     errors: List[str]                # internal errors (parse failures)
+    cache_hits: int = 0
+    cache_misses: int = 0
+    wall_s: float = 0.0
 
     @property
     def exit_code(self) -> int:
         if self.errors:
             return 2
         return 1 if self.findings else 0
+
+    @property
+    def cache_stats(self) -> str:
+        return (f"index cache {self.cache_hits} hit / "
+                f"{self.cache_misses} miss")
 
     def to_json(self) -> str:
         return json.dumps({
@@ -50,6 +68,9 @@ class LintResult:
             "grandfathered": [f.to_dict() for f in self.grandfathered],
             "stale_baseline": self.stale_baseline,
             "errors": self.errors,
+            "cache_hits": self.cache_hits,
+            "cache_misses": self.cache_misses,
+            "wall_s": round(self.wall_s, 3),
             "exit_code": self.exit_code,
         }, indent=1)
 
@@ -91,21 +112,6 @@ def discover_files(paths: Sequence[str]) -> List[Tuple[str, str]]:
     return out
 
 
-def _lint_one(path: str, relpath: str
-              ) -> Tuple[Optional[FileContext], List[Finding],
-                         Optional[str]]:
-    try:
-        with open(path, "r", encoding="utf-8") as fh:
-            source = fh.read()
-        ctx = FileContext(path, relpath, source)
-    except (SyntaxError, UnicodeDecodeError, OSError) as exc:
-        return None, [], f"{relpath}: {exc}"
-    findings: List[Finding] = []
-    for checker in PER_FILE_CHECKERS:
-        findings.extend(checker(ctx))
-    return ctx, findings, None
-
-
 def _assign_occurrences(findings: List[Finding]) -> None:
     """Identical (rule, path, symbol, snippet) findings get stable
     ordinal suffixes in line order so each can be baselined
@@ -119,32 +125,101 @@ def _assign_occurrences(findings: List[Finding]) -> None:
             f.occurrence = i
 
 
+def _git_changed_files() -> Optional[Set[str]]:
+    """Absolute paths of files git reports modified/added/untracked
+    vs HEAD, or None when git is unavailable (fall back to a full
+    sha-checked run)."""
+    try:
+        top = subprocess.run(
+            ["git", "rev-parse", "--show-toplevel"],
+            capture_output=True, text=True, timeout=10)
+        if top.returncode != 0:
+            return None
+        root = top.stdout.strip()
+        diff = subprocess.run(
+            ["git", "-C", root, "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, timeout=10)
+        untracked = subprocess.run(
+            ["git", "-C", root, "ls-files", "--others",
+             "--exclude-standard"],
+            capture_output=True, text=True, timeout=10)
+        if diff.returncode != 0 or untracked.returncode != 0:
+            return None
+        names = (diff.stdout.splitlines()
+                 + untracked.stdout.splitlines())
+        return {os.path.abspath(os.path.join(root, n))
+                for n in names if n.strip()}
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def _trusted_set(files: Sequence[Tuple[str, str]],
+                 cache: Dict[str, dict]) -> Set[str]:
+    """--changed mode: relpaths that may be served from the cache
+    without re-reading — everything OUTSIDE the changed set's
+    reverse-dependency closure (computed over cached import facts)."""
+    changed_abs = _git_changed_files()
+    if changed_abs is None:
+        return set()
+    rel_of = {os.path.abspath(p): rel.replace("\\", "/")
+              for p, rel in files}
+    changed_rels = {rel_of[p] for p in changed_abs if p in rel_of}
+    # reverse-dep closure over the cached import graph
+    tindex = index_mod.TreeIndex()
+    for rel, entry in cache.items():
+        tindex.facts[rel] = index_mod.FileFacts(entry["facts"])
+    closure = tindex.reverse_deps(changed_rels) | changed_rels
+    return {rel for rel in (r for _, r in files)
+            if rel.replace("\\", "/") not in closure}
+
+
 def run_lint(paths: Sequence[str],
              baseline_path: Optional[str] = None,
-             jobs: Optional[int] = None) -> LintResult:
+             jobs: Optional[int] = None,
+             use_cache: bool = True,
+             changed_only: bool = False,
+             cache_path: Optional[str] = None) -> LintResult:
+    t0 = time.perf_counter()
     files = discover_files(paths)
-    index: Dict[str, FileContext] = {}
-    findings: List[Finding] = []
-    errors: List[str] = []
+    # the cache lives beside the baseline in use, so fixture runs
+    # against a tmp baseline never touch the committed tree's cache
+    cpath = cache_path or os.path.join(
+        os.path.dirname(os.path.abspath(
+            baseline_path or baseline_mod.DEFAULT_BASELINE)),
+        ".jitlint_index.json")
+    cache = index_mod.load_cache(cpath) if use_cache else {}
+    trusted = _trusted_set(files, cache) if (changed_only and cache) \
+        else set()
 
-    workers = jobs or min(32, (os.cpu_count() or 4))
-    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
-        for ctx, file_findings, err in ex.map(
-                lambda pr: _lint_one(*pr), files):
-            if err is not None:
-                errors.append(err)
-                continue
-            assert ctx is not None
-            index[ctx.relpath] = ctx
-            findings.extend(file_findings)
+    tindex, per_file = index_mod.build_index(
+        files, PER_FILE_CHECKERS, jobs=jobs, cache=cache,
+        trusted=trusted)
+    findings = list(tindex.findings)
 
-    for checker in GLOBAL_CHECKERS:
-        findings.extend(checker(index))
+    if not tindex.errors:
+        for checker in GLOBAL_CHECKERS:
+            findings.extend(checker(tindex.facts))
+        for checker in GRAPH_CHECKERS:
+            findings.extend(checker(tindex))
 
-    _assign_occurrences(findings)
     base = baseline_mod.load_baseline(
         baseline_path or baseline_mod.DEFAULT_BASELINE)
+    for msg in drift_mod.check_baseline_justifications(base):
+        findings.append(Finding(
+            rule="drift", path="libjitsi_tpu/analysis/baseline.json",
+            line=1, col=0, message=msg, snippet=msg.split("—")[0].strip(),
+            symbol=""))
+
+    _assign_occurrences(findings)
     new, old, stale = baseline_mod.split_by_baseline(findings, base)
+
+    if use_cache and not tindex.errors:
+        index_mod.save_cache(tindex, per_file, cpath, prior=cache)
+
     return LintResult(findings=new, grandfathered=old,
-                      stale_baseline=stale, files_checked=len(index),
-                      errors=errors)
+                      stale_baseline=stale,
+                      files_checked=len(tindex.facts),
+                      errors=tindex.errors,
+                      cache_hits=tindex.cache_hits,
+                      cache_misses=tindex.cache_misses,
+                      wall_s=time.perf_counter() - t0)

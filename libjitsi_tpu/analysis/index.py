@@ -1,0 +1,266 @@
+"""Whole-tree facts index with a content-keyed disk cache.
+
+Parsing + per-file checking is the expensive half of a lint run, and
+it is perfectly file-local — so every file's derived *facts* (symbol
+tables, imports, taint summaries, drift/mesh facts, pragma tables,
+per-file findings) are JSON-serializable and cached to disk beside
+``baseline.json``, keyed by the sha1 of the file's source.  A warm run
+re-reads sources only to hash them, reconstructs everything else from
+the cache, and the global/interprocedural checkers run over facts —
+never over ASTs — so they are cache-warm too.
+
+The cache self-invalidates on analysis changes: its ``version`` field
+is a hash over the ``analysis/`` package's own sources, so editing any
+checker throws the whole cache away (facts shapes may have changed).
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import hashlib
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from libjitsi_tpu.analysis import callgraph as cg
+from libjitsi_tpu.analysis.checkers import drift as drift_mod
+from libjitsi_tpu.analysis.checkers import meshcollective as mesh_mod
+from libjitsi_tpu.analysis.core import FileContext, Finding, TraceHop
+
+DEFAULT_CACHE = os.path.join(os.path.dirname(__file__),
+                             ".jitlint_index.json")
+
+_version_cache: Optional[str] = None
+
+
+def analysis_version() -> str:
+    """Hash of the analysis package's own sources — the cache format
+    version.  Any checker edit invalidates every cached fact."""
+    global _version_cache
+    if _version_cache is None:
+        h = hashlib.sha1()
+        base = os.path.dirname(os.path.abspath(__file__))
+        for dirpath, dirnames, filenames in os.walk(base):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    with open(os.path.join(dirpath, fn), "rb") as fh:
+                        h.update(fh.read())
+        _version_cache = h.hexdigest()[:12]
+    return _version_cache
+
+
+class FileFacts:
+    """JSON facts for one file + the FileContext-shaped helpers the
+    fact-consuming checkers need (suppression, symbols, findings)."""
+
+    def __init__(self, data: dict):
+        self.data = data
+        self.relpath: str = data["relpath"]
+
+    # --------------------------------------------------- construction
+
+    @classmethod
+    def from_ctx(cls, ctx: FileContext, sha: str) -> "FileFacts":
+        from libjitsi_tpu.analysis import summaries
+        from libjitsi_tpu.analysis.checkers import secretflow
+        functions, classes = cg.extract_defs(ctx)
+        summaries.extract_summaries(
+            ctx, functions,
+            seed_secrets=secretflow.in_source_scope(ctx.relpath))
+        module = cg.module_name(ctx.relpath)
+        data = {
+            "relpath": ctx.relpath,
+            "abspath": os.path.abspath(ctx.path),
+            "module": module,
+            "sha": sha,
+            "lines": ctx.lines,
+            "pragma_lines": {str(k): sorted(v)
+                             for k, v in ctx.line_pragmas.items()},
+            "pragma_file": sorted(ctx.file_pragmas),
+            "scopes": [list(s) for s in ctx._scopes],
+            "imports": cg.extract_imports(ctx.tree, module),
+            "functions": functions,
+            "classes": classes,
+            "drift": drift_mod.file_facts(ctx),
+            "mesh": mesh_mod.file_facts(ctx),
+        }
+        return cls(data)
+
+    # ------------------------------------------------ context helpers
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if {"all", rule} & set(self.data["pragma_file"]):
+            return True
+        probes = [line, line - 1]
+        for start, end, _qual, def_line in self.data["scopes"]:
+            if start <= line <= end:
+                probes.append(def_line)
+        pragmas = self.data["pragma_lines"]
+        for probe in probes:
+            rules = pragmas.get(str(probe))
+            if rules and {"all", rule} & set(rules):
+                return True
+        return False
+
+    def symbol_at(self, line: int) -> str:
+        best, best_span = "", None
+        for start, end, qual, _ in self.data["scopes"]:
+            if start <= line <= end:
+                span = end - start
+                if best_span is None or span <= best_span:
+                    best, best_span = qual, span
+        return best
+
+    def finding(self, rule: str, line: int, col: int, message: str,
+                trace: Optional[List[TraceHop]] = None
+                ) -> Optional[Finding]:
+        if self.suppressed(rule, line):
+            return None
+        lines = self.data["lines"]
+        snippet = (lines[line - 1].strip()
+                   if 0 < line <= len(lines) else "")
+        return Finding(rule=rule, path=self.relpath, line=line, col=col,
+                       message=message, snippet=snippet,
+                       symbol=self.symbol_at(line), trace=trace)
+
+
+class TreeIndex:
+    """All facts + per-file findings for one lint run."""
+
+    def __init__(self) -> None:
+        self.facts: Dict[str, FileFacts] = {}
+        self.findings: List[Finding] = []
+        self.errors: List[str] = []
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self._graph: Optional[cg.CallGraph] = None
+
+    @property
+    def graph(self) -> cg.CallGraph:
+        if self._graph is None:
+            self._graph = cg.CallGraph(
+                {rel: f.data for rel, f in self.facts.items()})
+        return self._graph
+
+    def reverse_deps(self, rels: Iterable[str]) -> Set[str]:
+        """`rels` plus every file importing one of them, transitively
+        (module-level imports only) — the re-lint closure of a change."""
+        mod_of = {f.data["module"]: rel
+                  for rel, f in self.facts.items()}
+        importers: Dict[str, Set[str]] = {}
+        for rel, f in self.facts.items():
+            for target in f.data["imports"].values():
+                for probe in (target, target.rpartition(".")[0]):
+                    dep = mod_of.get(probe)
+                    if dep is not None:
+                        importers.setdefault(dep, set()).add(rel)
+        out: Set[str] = set()
+        work = [r for r in rels if r in self.facts]
+        while work:
+            r = work.pop()
+            if r in out:
+                continue
+            out.add(r)
+            work.extend(importers.get(r, ()))
+        return out
+
+
+def _finding_from_dict(d: dict) -> Finding:
+    return Finding(rule=d["rule"], path=d["path"], line=d["line"],
+                   col=d["col"], message=d["message"],
+                   snippet=d["snippet"], symbol=d["symbol"],
+                   trace=d.get("trace"))
+
+
+def load_cache(path: str = DEFAULT_CACHE) -> Dict[str, dict]:
+    """{relpath: {"sha", "facts", "findings"}} or {} when absent,
+    unreadable, or written by a different analysis version."""
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            doc = json.load(fh)
+    except (OSError, ValueError):
+        return {}
+    if doc.get("version") != analysis_version():
+        return {}
+    files = doc.get("files")
+    return files if isinstance(files, dict) else {}
+
+
+def save_cache(index: TreeIndex, per_file: Dict[str, List[Finding]],
+               path: str = DEFAULT_CACHE,
+               prior: Optional[Dict[str, dict]] = None) -> None:
+    """Merge-write: a partial-scope run (one file, --changed) must not
+    evict the rest of the tree's entries."""
+    files = dict(prior or {})
+    for rel, facts in index.facts.items():
+        files[rel] = {
+            "sha": facts.data["sha"],
+            "facts": facts.data,
+            "findings": [f.to_dict() for f in per_file.get(rel, [])],
+        }
+    tmp = path + ".tmp"
+    try:
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"version": analysis_version(), "files": files},
+                      fh, separators=(",", ":"))
+        os.replace(tmp, path)
+    except OSError:
+        pass  # cache is an optimization; a read-only checkout still lints
+
+
+def build_index(files: Sequence[Tuple[str, str]],
+                checkers: Sequence,
+                jobs: Optional[int] = None,
+                cache: Optional[Dict[str, dict]] = None,
+                trusted: Optional[Set[str]] = None
+                ) -> Tuple[TreeIndex, Dict[str, List[Finding]]]:
+    """Parse/check every file not served by `cache`.  `trusted`
+    relpaths skip even the source read + sha check (--changed mode:
+    git already said they are unchanged).  Returns the index plus the
+    per-file findings map (for cache writing)."""
+    cache = cache or {}
+    trusted = trusted or set()
+    index = TreeIndex()
+    per_file: Dict[str, List[Finding]] = {}
+
+    def process(pair: Tuple[str, str]):
+        path, rel = pair
+        rel = rel.replace("\\", "/")
+        entry = cache.get(rel)
+        if entry is not None and rel in trusted:
+            return rel, "hit", FileFacts(entry["facts"]), \
+                [_finding_from_dict(d) for d in entry["findings"]], None
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except (OSError, UnicodeDecodeError) as exc:
+            return rel, "err", None, [], f"{rel}: {exc}"
+        sha = hashlib.sha1(source.encode()).hexdigest()
+        if entry is not None and entry.get("sha") == sha:
+            return rel, "hit", FileFacts(entry["facts"]), \
+                [_finding_from_dict(d) for d in entry["findings"]], None
+        try:
+            ctx = FileContext(path, rel, source)
+        except SyntaxError as exc:
+            return rel, "err", None, [], f"{rel}: {exc}"
+        findings: List[Finding] = []
+        for checker in checkers:
+            findings.extend(checker(ctx))
+        return rel, "miss", FileFacts.from_ctx(ctx, sha), findings, None
+
+    workers = jobs or min(32, (os.cpu_count() or 4))
+    with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as ex:
+        for rel, kind, facts, findings, err in ex.map(process, files):
+            if kind == "err":
+                index.errors.append(err)
+                continue
+            if kind == "hit":
+                index.cache_hits += 1
+            else:
+                index.cache_misses += 1
+            index.facts[rel] = facts
+            per_file[rel] = findings
+            index.findings.extend(findings)
+    return index, per_file

@@ -1,0 +1,570 @@
+"""Function taint summaries + the interprocedural fixpoint engine.
+
+Per function we extract a JSON-able *summary*: every call site (with
+per-argument **atom** sets), the atoms its return value may carry,
+``self.attr`` writes, ``raise`` payloads, and the secret *sources*
+read in its body.  Atoms are strings:
+
+    ``P:name``   the function's own parameter `name`
+    ``A:Cls.x``  attribute ``self.x`` of class Cls (flow-insensitive)
+    ``C:7``      the return value of call site #7 in this function
+    ``S:2``      source #2 — a read of a secret-named value in a
+                 key-material module (see ``secretflow.SOURCE_SCOPES``)
+
+``TaintEngine`` resolves every call site through the module call
+graph, then runs two monotone fixpoints over *ground* atoms
+(params + sources): which ground atoms each function's RETURN may
+carry, and which sinks each ground atom transitively REACHES —
+recording one source-to-sink hop path per (atom, sink) so a finding
+prints the whole flow without re-running.
+
+Sanitizers match the intra-file rule: shape/len/dtype reads,
+``is``-comparisons and boolean verdicts carry no atoms.  Unresolved
+calls get **no summary** — taint neither enters nor escapes a callee
+the graph cannot name — but their *value* conservatively carries its
+receiver's and arguments' atoms (``key.hex()`` stays hot; a helper
+with six implementations contributes no phantom flows).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from libjitsi_tpu.analysis.callgraph import CallGraph
+from libjitsi_tpu.analysis.core import (NEVER_TAINT, SHAPE_ATTRS,
+                                        SHAPE_CALLS, node_name)
+from libjitsi_tpu.analysis.checkers.secrets import is_secret_name
+
+#: functions whose RETURN VALUE is key material wherever they appear
+SOURCE_FUNCS = {"srtp_keys", "export_keying_material",
+                "derive_session_keys", "derive_session_keys_batch"}
+
+#: tuple elements of a source call's return that are NOT key material
+#: (srtp_keys -> (profile, tk, tsalt, rk, rsalt): the negotiated
+#: profile enum is public signaling state)
+SOURCE_ELEM_EXEMPT = {"srtp_keys": {0}}
+
+#: declassification boundary: the protect/unprotect AEAD surface.
+#: Outputs of these calls are wire ciphertext, app plaintext, or auth
+#: verdicts — DERIVED from key material but not key material, so taint
+#: stops at the transform.  Matched on the call's terminal name.
+_DECLASSIFY_TOKENS = ("protect",)
+
+#: logger method names (the repo idiom is `_log = get_logger(...)`)
+LOG_METHODS = {"debug", "info", "warn", "warning", "error", "exception",
+               "critical", "log"}
+
+#: dotted call targets that serialize state to disk in plaintext
+CHECKPOINT_SINKS = {"pickle.dump", "pickle.dumps", "np.save", "np.savez"}
+
+
+def _dotted_text(node: ast.AST) -> Optional[str]:
+    """"self.rx_table" for an Attribute/Name chain, None for computed
+    receivers (calls, subscripts)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _dotted_text(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _classify_sink(relpath: str, recv: Optional[str], name: str,
+                   dotted: str) -> Optional[str]:
+    """Sink kind of a call site, or None.  `dotted` is the
+    import-resolved target ("pickle.dump"); `recv` the literal
+    receiver spelling ("self.flight")."""
+    low = (recv or "").lower()
+    if name in ("record", "record_headers") and \
+            ("flight" in low or "recorder" in low):
+        return "flight-payload"
+    if name in LOG_METHODS and "log" in low.rsplit(".", 1)[-1]:
+        return "log"
+    if name == "set_stream_name":
+        return "metrics-label"
+    if dotted in CHECKPOINT_SINKS:
+        return "checkpoint-plaintext"
+    if relpath.endswith("service/obs_server.py") and \
+            dotted in ("json.dumps", "json.dump"):
+        return "debug-endpoint"
+    return None
+
+
+class _FnExtractor:
+    """One function body -> summary dict (see module docstring)."""
+
+    def __init__(self, fn: ast.AST, cls: Optional[str],
+                 relpath: str, seed_secrets: bool):
+        self.fn = fn
+        self.cls = cls
+        self.relpath = relpath
+        self.seed = seed_secrets
+        a = fn.args
+        self.params = {p.arg for p in
+                       a.posonlyargs + a.args + a.kwonlyargs}
+        # locally-assigned names: secret-NAME seeding is skipped for
+        # these (their taint is whatever dataflow says — `key =
+        # self._conf_key(...)` is a dict key, not key material); reads
+        # of params and free names still seed on name alone
+        self.assigned: Set[str] = set()
+        self.env: Dict[str, Set[str]] = {}
+        self.sources: List[dict] = []
+        self._src_ids: Dict[str, int] = {}
+        # call sites in deterministic walk order; nested defs belong
+        # to their own summaries, so stop at inner function boundaries
+        self.calls: List[ast.Call] = []
+        self.call_id: Dict[int, int] = {}
+        for node in self._walk(fn):
+            if isinstance(node, ast.Call):
+                self.call_id[id(node)] = len(self.calls)
+                self.calls.append(node)
+            tgts: List[ast.AST] = []
+            if isinstance(node, ast.Assign):
+                tgts = list(node.targets)
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                                   ast.NamedExpr, ast.For)):
+                tgts = [node.target]
+            elif isinstance(node, ast.withitem) and node.optional_vars:
+                tgts = [node.optional_vars]
+            for t in tgts:
+                self.assigned |= self._bound_names(t)
+
+    @staticmethod
+    def _bound_names(tgt: ast.AST) -> Set[str]:
+        """Names REBOUND by an assignment target (plain/tuple/starred
+        only — `x[i] = v` mutates x, it does not rebind it)."""
+        if isinstance(tgt, ast.Name):
+            return {tgt.id}
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            out: Set[str] = set()
+            for el in tgt.elts:
+                out |= _FnExtractor._bound_names(el)
+            return out
+        if isinstance(tgt, ast.Starred):
+            return _FnExtractor._bound_names(tgt.value)
+        return set()
+
+    def _walk(self, root: ast.AST):
+        stack = list(ast.iter_child_nodes(root))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.ClassDef)):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    # ------------------------------------------------------------ atoms
+
+    def _src(self, name: str, line: int) -> str:
+        if name not in self._src_ids:
+            self._src_ids[name] = len(self.sources)
+            self.sources.append({"n": name, "l": line})
+        return f"S:{self._src_ids[name]}"
+
+    def atoms(self, node: Optional[ast.AST]) -> Set[str]:
+        if node is None:
+            return set()
+        if isinstance(node, ast.Name):
+            if node.id in NEVER_TAINT:
+                return set()
+            out = set(self.env.get(node.id, ()))
+            if node.id in self.params:
+                out.add(f"P:{node.id}")
+            if self.seed and is_secret_name(node.id) and \
+                    (node.id in self.params
+                     or node.id not in self.assigned):
+                out.add(self._src(node.id, node.lineno))
+            return out
+        if isinstance(node, ast.Attribute):
+            if node.attr in SHAPE_ATTRS:
+                return set()
+            base = self.atoms(node.value)
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id in ("self", "cls"):
+                base = set()
+                if self.cls:
+                    base.add(f"A:{self.cls}.{node.attr}")
+            if self.seed and is_secret_name(node.attr):
+                base.add(self._src(node.attr, node.lineno))
+            return base
+        if isinstance(node, ast.Call):
+            fname = node_name(node.func)
+            if isinstance(node.func, ast.Name) and \
+                    node.func.id in SHAPE_CALLS:
+                return set()
+            if fname in SHAPE_CALLS:
+                return set()
+            if fname and any(tok in fname for tok in _DECLASSIFY_TOKENS):
+                return set()
+            i = self.call_id.get(id(node))
+            return {f"C:{i}"} if i is not None else set()
+        if isinstance(node, (ast.Compare, ast.Constant, ast.Lambda)):
+            return set()
+        if isinstance(node, ast.Subscript):
+            return self.atoms(node.value) | self.atoms(node.slice)
+        out: Set[str] = set()
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.keyword):
+                out |= self.atoms(child.value)
+            elif isinstance(child, ast.comprehension):
+                out |= self.atoms(child.iter)
+            elif isinstance(child, ast.expr):
+                out |= self.atoms(child)
+        return out
+
+    # ----------------------------------------------------- environment
+
+    def _targets(self, tgt: ast.AST) -> Tuple[Set[str], List[str]]:
+        """(local names, self-attrs) receiving a value."""
+        names: Set[str] = set()
+        attrs: List[str] = []
+        if isinstance(tgt, ast.Name):
+            names.add(tgt.id)
+        elif isinstance(tgt, ast.Attribute):
+            if isinstance(tgt.value, ast.Name) and \
+                    tgt.value.id in ("self", "cls"):
+                attrs.append(tgt.attr)
+        elif isinstance(tgt, ast.Subscript):
+            n, a = self._targets(tgt.value)
+            names |= n
+            attrs += a
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                n, a = self._targets(el)
+                names |= n
+                attrs += a
+        elif isinstance(tgt, ast.Starred):
+            n, a = self._targets(tgt.value)
+            names |= n
+            attrs += a
+        return names - NEVER_TAINT, attrs
+
+    def _env_pass(self) -> bool:
+        changed = False
+
+        def assign(tgt: ast.AST, atoms: Set[str], line: int) -> None:
+            nonlocal changed
+            names, attrs = self._targets(tgt)
+            for nm in names:
+                cur = self.env.setdefault(nm, set())
+                if not atoms <= cur:
+                    cur |= atoms
+                    changed = True
+            for at in attrs:
+                self.writes.append((at, atoms, line))
+
+        self.writes: List[Tuple[str, Set[str], int]] = \
+            getattr(self, "writes", [])
+        self.writes.clear()
+        def assign_unpack(tgt: ast.AST, value: ast.AST,
+                          atoms: Set[str], line: int) -> bool:
+            """Element-exempt tuple unpack of a source call:
+            `profile, tk, ... = ep.srtp_keys()` must not taint the
+            public elements.  Returns True when handled."""
+            if not (isinstance(value, ast.Call)
+                    and isinstance(tgt, ast.Tuple)
+                    and not any(isinstance(e, ast.Starred)
+                                for e in tgt.elts)):
+                return False
+            exempt = SOURCE_ELEM_EXEMPT.get(node_name(value.func))
+            if not exempt:
+                return False
+            for k, el in enumerate(tgt.elts):
+                assign(el, set() if k in exempt else atoms, line)
+            return True
+
+        for node in self._walk(self.fn):
+            if isinstance(node, ast.Assign):
+                atoms = self.atoms(node.value)
+                for tgt in node.targets:
+                    if not assign_unpack(tgt, node.value, atoms,
+                                         node.lineno):
+                        assign(tgt, atoms, node.lineno)
+            elif isinstance(node, ast.AnnAssign) and node.value:
+                assign(node.target, self.atoms(node.value), node.lineno)
+            elif isinstance(node, ast.AugAssign):
+                assign(node.target, self.atoms(node.value), node.lineno)
+            elif isinstance(node, ast.For):
+                assign(node.target, self.atoms(node.iter), node.lineno)
+            elif isinstance(node, ast.NamedExpr):
+                assign(node.target, self.atoms(node.value), node.lineno)
+            elif isinstance(node, ast.withitem) and \
+                    node.optional_vars is not None:
+                assign(node.optional_vars, self.atoms(node.context_expr),
+                       getattr(node.context_expr, "lineno", 1))
+        return changed
+
+    # ----------------------------------------------------------- drive
+
+    def run(self) -> dict:
+        for _ in range(4):
+            if not self._env_pass():
+                break
+
+        calls_out: List[dict] = []
+        for i, call in enumerate(self.calls):
+            func = call.func
+            name = node_name(func) or "<computed>"
+            recv = None
+            if isinstance(func, ast.Attribute):
+                recv = _dotted_text(func.value) or "<expr>"
+            cs: dict = {"n": name, "r": recv, "l": call.lineno}
+            args = [sorted(self.atoms(a)) for a in call.args]
+            kwargs = {kw.arg or "**": sorted(self.atoms(kw.value))
+                      for kw in call.keywords}
+            if any(args) or any(kwargs.values()):
+                cs["a"] = args
+                cs["kw"] = {k: v for k, v in kwargs.items() if v}
+            if isinstance(func, ast.Attribute):
+                rv = sorted(self.atoms(func.value))
+                if rv:
+                    cs["rv"] = rv
+            if name in SOURCE_FUNCS:
+                cs["sc"] = True
+            calls_out.append(cs)
+
+        ret: Set[str] = set()
+        raises: List[dict] = []
+        for node in self._walk(self.fn):
+            if isinstance(node, ast.Return) and node.value is not None:
+                ret |= self.atoms(node.value)
+            elif isinstance(node, ast.Raise) and node.exc is not None:
+                at = sorted(self.atoms(node.exc))
+                if at:
+                    raises.append({"l": node.lineno, "at": at})
+            elif isinstance(node, ast.Yield) and node.value is not None:
+                ret |= self.atoms(node.value)
+
+        return {
+            "calls": calls_out,
+            "ret": sorted(ret),
+            "raises": raises,
+            "writes": [[a, sorted(at), ln]
+                       for a, at, ln in self.writes if at],
+            "sources": self.sources,
+        }
+
+
+def extract_summaries(ctx, functions: Dict[str, dict],
+                      seed_secrets: bool) -> None:
+    """Fill each entry of `functions` (from callgraph.extract_defs)
+    with its taint summary, matching defs to AST nodes by qualname."""
+    nodes: Dict[str, ast.AST] = {}
+
+    def collect(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                nodes[qual] = child
+                collect(child, qual + ".")
+            elif isinstance(child, ast.ClassDef):
+                collect(child, f"{child.name}.")
+            else:
+                collect(child, prefix)
+
+    collect(ctx.tree, "")
+    for qual, info in functions.items():
+        fn = nodes.get(qual)
+        if fn is None:
+            info.update(_FnExtractor(
+                ast.parse("def _stub(): pass").body[0], None,
+                ctx.relpath, False).run())
+            continue
+        info.update(_FnExtractor(
+            fn, info["cls"], ctx.relpath, seed_secrets).run())
+
+
+# ====================================================== fixpoint engine
+
+#: ground atoms are tuples: ("P", fid, param) | ("SRC", fid, i) |
+#: ("SRCCALL", fid, call_i)
+Ground = Tuple[str, str, str]
+
+MAX_PATH = 16
+MAX_ENTRIES = 3
+
+
+class TaintEngine:
+    """Two whole-tree fixpoints over ground atoms + path recording."""
+
+    def __init__(self, graph: CallGraph):
+        self.graph = graph
+        self.fns: Dict[str, dict] = {}
+        self.edges: Dict[str, List[Optional[str]]] = {}
+        for rel, f in graph.facts.items():
+            for qual, fn in f["functions"].items():
+                fid = f"{rel}::{qual}"
+                self.fns[fid] = fn
+                self.edges[fid] = [
+                    graph.resolve(rel, qual, cs)
+                    for cs in fn.get("calls", ())]
+        self.ret_g: Dict[str, Set[Ground]] = {f: set() for f in self.fns}
+        self.call_g: Dict[Tuple[str, int], Set[Ground]] = {}
+        self.attr_g: Dict[str, Set[Ground]] = {}
+        self._solve_values()
+
+    # ------------------------------------------------- value fixpoint
+
+    def _expand(self, fid: str, atoms: Sequence[str]) -> Set[Ground]:
+        out: Set[Ground] = set()
+        rel = fid.partition("::")[0]
+        for a in atoms:
+            kind, _, rest = a.partition(":")
+            if kind == "P":
+                out.add(("P", fid, rest))
+            elif kind == "S":
+                out.add(("SRC", fid, rest))
+            elif kind == "A":
+                out |= self.attr_g.get(f"{rel}::{rest}", set())
+            elif kind == "C":
+                out |= self.call_g.get((fid, int(rest)), set())
+        return out
+
+    def _solve_values(self) -> None:
+        for _ in range(30):
+            changed = False
+            for fid, fn in self.fns.items():
+                callees = self.edges[fid]
+                for i, cs in enumerate(fn.get("calls", ())):
+                    new = set()
+                    if cs.get("sc"):
+                        new.add(("SRCCALL", fid, str(i)))
+                    g = callees[i]
+                    if g is not None and g in self.fns:
+                        for ga in self.ret_g[g]:
+                            if ga[0] == "P" and ga[1] == g:
+                                new |= self._expand(
+                                    fid, self._args_for(cs, g, ga[2]))
+                            else:
+                                new.add(ga)
+                    else:
+                        passthru = list(cs.get("rv", ()))
+                        for arg in cs.get("a", ()):
+                            passthru += arg
+                        for v in cs.get("kw", {}).values():
+                            passthru += v
+                        new |= self._expand(fid, passthru)
+                    cur = self.call_g.setdefault((fid, i), set())
+                    if not new <= cur:
+                        cur |= new
+                        changed = True
+                rg = self._expand(fid, fn.get("ret", ()))
+                if not rg <= self.ret_g[fid]:
+                    self.ret_g[fid] |= rg
+                    changed = True
+                rel = fid.partition("::")[0]
+                for attr, atoms, _ln in fn.get("writes", ()):
+                    cls = fn.get("cls")
+                    if not cls:
+                        continue
+                    key = f"{rel}::{cls}.{attr}"
+                    ag = self._expand(fid, atoms)
+                    cur = self.attr_g.setdefault(key, set())
+                    if not ag <= cur:
+                        cur |= ag
+                        changed = True
+            if not changed:
+                break
+
+    def _args_for(self, cs: dict, callee_fid: str,
+                  param: str) -> List[str]:
+        """Atoms the caller passes for `param` of the callee at `cs`
+        (positional by index — shifted past `self` for methods — plus
+        the matching keyword)."""
+        callee = self.fns[callee_fid]
+        params = list(callee.get("params", ()))
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        out: List[str] = []
+        args = cs.get("a", ())
+        if param in params:
+            idx = params.index(param)
+            if idx < len(args):
+                out += args[idx]
+        out += cs.get("kw", {}).get(param, ())
+        return out
+
+    # -------------------------------------------------- sink fixpoint
+
+    def solve_sinks(self) -> Dict[str, Dict[Ground, List[dict]]]:
+        """{fid: {ground atom: [sink entries]}} where an entry is
+        {"kind", "path": [hop...]} — hop dicts per core.TraceHop."""
+        sinks: Dict[str, Dict[Ground, List[dict]]] = \
+            {f: {} for f in self.fns}
+
+        def hop(fid: str, line: int, note: str) -> dict:
+            rel, _, qual = fid.partition("::")
+            return {"path": rel, "line": line, "symbol": qual,
+                    "note": note}
+
+        def add(fid: str, g: Ground, kind: str,
+                path: List[dict]) -> bool:
+            if len(path) > MAX_PATH:
+                return False
+            entries = sinks[fid].setdefault(g, [])
+            sig = (kind, path[0]["path"], path[0]["line"],
+                   path[-1]["path"], path[-1]["line"])
+            for e in entries:
+                p = e["path"]
+                if (e["kind"], p[0]["path"], p[0]["line"],
+                        p[-1]["path"], p[-1]["line"]) == sig:
+                    return False
+            if len([e for e in entries if e["kind"] == kind]) \
+                    >= MAX_ENTRIES:
+                return False
+            entries.append({"kind": kind, "path": path})
+            return True
+
+        # direct sinks
+        for fid, fn in self.fns.items():
+            rel = fid.partition("::")[0]
+            for i, cs in enumerate(fn.get("calls", ())):
+                kind = _classify_sink(
+                    rel, cs.get("r"), cs["n"],
+                    self.graph.dotted(rel, cs))
+                if kind is None:
+                    continue
+                atoms: List[str] = []
+                for arg in cs.get("a", ()):
+                    atoms += arg
+                for v in cs.get("kw", {}).values():
+                    atoms += v
+                note = (f"{cs.get('r') + '.' if cs.get('r') else ''}"
+                        f"{cs['n']}(...) [{kind}]")
+                for g in self._expand(fid, atoms):
+                    add(fid, g, kind, [hop(fid, cs["l"], note)])
+            for rz in fn.get("raises", ()):
+                for g in self._expand(fid, rz["at"]):
+                    add(fid, g, "exception",
+                        [hop(fid, rz["l"], "raise with secret payload")])
+
+        # propagate through resolved calls: callee param reaches sink
+        # => caller's matching argument reaches it one hop further out
+        for _ in range(30):
+            changed = False
+            for fid, fn in self.fns.items():
+                callees = self.edges[fid]
+                for i, cs in enumerate(fn.get("calls", ())):
+                    g = callees[i]
+                    if g is None or g not in self.fns:
+                        continue
+                    for atom, entries in list(sinks[g].items()):
+                        if atom[0] != "P" or atom[1] != g:
+                            continue
+                        arg_atoms = self._args_for(cs, g, atom[2])
+                        if not arg_atoms:
+                            continue
+                        grounds = self._expand(fid, arg_atoms)
+                        note = (f"passed to {g.partition('::')[2]}"
+                                f"({atom[2]})")
+                        for ga in grounds:
+                            for e in entries:
+                                if add(fid, ga, e["kind"],
+                                       [hop(fid, cs["l"], note)]
+                                       + e["path"]):
+                                    changed = True
+            if not changed:
+                break
+        return sinks

@@ -619,7 +619,13 @@ class DtlsAssociationTable:
             return []
         return self._process_one(datagram, addr)
 
-    def _process_one(self, datagram: bytes, addr) -> list:
+    # plane=dual: in deferred mode this only ever runs from process()
+    # on the between-ticks window; standalone bridges (no lifecycle
+    # manager) run it inline from on_dtls, accepting the tick-thread
+    # OpenSSL cost.  The runtime twin of this exception is the
+    # handshake_tick_thread_feeds counter, which stays 0 whenever a
+    # lifecycle manager is attached.
+    def _process_one(self, datagram: bytes, addr) -> list:  # jitlint: plane=dual
         sid = self.addr_of.get(addr)
         if sid is None:
             sid = self._claim(addr)

@@ -3,7 +3,11 @@
 
 Usage:
     python scripts/lint.py libjitsi_tpu              # human output
-    python scripts/lint.py --json libjitsi_tpu       # machine output
+    python scripts/lint.py --format=json libjitsi_tpu
+    python scripts/lint.py --changed libjitsi_tpu    # git-aware:
+        re-check only changed files + their reverse-dependency
+        closure, trust the content-keyed index cache for the rest
+    python scripts/lint.py --no-cache libjitsi_tpu   # cold run
     python scripts/lint.py --update-baseline ...     # grandfather all
     python scripts/lint.py --prune-baseline ...      # drop stale keys
 
@@ -24,7 +28,10 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("paths", nargs="+", help="files or package dirs")
-    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="alias for --format=json")
+    ap.add_argument("--format", choices=("human", "json"),
+                    default="human")
     ap.add_argument("--baseline", default=None,
                     help="baseline file (default: the committed "
                          "libjitsi_tpu/analysis/baseline.json)")
@@ -34,6 +41,11 @@ def main(argv=None) -> int:
                          "edit the file) and exit 0")
     ap.add_argument("--prune-baseline", action="store_true",
                     help="drop baseline entries that no longer fire")
+    ap.add_argument("--changed", action="store_true",
+                    help="git-aware incremental mode: re-check only "
+                         "changed files + reverse-dependency closure")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="ignore and do not write the facts cache")
     ap.add_argument("--jobs", type=int, default=None)
     args = ap.parse_args(argv)
 
@@ -43,7 +55,9 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     try:
         result = run_lint(args.paths, baseline_path=args.baseline,
-                          jobs=args.jobs)
+                          jobs=args.jobs,
+                          use_cache=not args.no_cache,
+                          changed_only=args.changed)
     except Exception as exc:  # noqa: BLE001 — contract: crash = exit 2
         print(f"jitlint internal error: {type(exc).__name__}: {exc}",
               file=sys.stderr)
@@ -70,11 +84,12 @@ def main(argv=None) -> int:
               f"pruned {len(result.stale_baseline)} stale entries")
         return 0
 
-    if args.as_json:
+    if args.as_json or args.format == "json":
         print(result.to_json())
     else:
         print(result.render_human())
-        print(f"jitlint: {result.files_checked} files in {elapsed:.2f}s")
+        print(f"jitlint: {result.files_checked} files in "
+              f"{elapsed:.2f}s ({result.cache_stats})")
     return result.exit_code
 
 

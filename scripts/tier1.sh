@@ -4,9 +4,13 @@
 # pytest's status.
 #
 # The jitlint gate runs FIRST and is hard: any new static-analysis
-# finding (hotpath-purity, secret-taint, rtp-mod16, drift) fails the
-# tier before a single test runs.  Grandfathered findings live in
-# libjitsi_tpu/analysis/baseline.json; see README "Static analysis".
+# finding (hotpath-purity, hotpath-alloc, secret-taint, rtp-mod16,
+# drift, mesh-collective, plus the interprocedural secret-flow and
+# plane-affinity rules) fails the tier before a single test runs.
+# The gate line prints wall time and index-cache hit/miss stats — a
+# warm content-keyed index lints the tree in ~2 s.  Grandfathered
+# findings live in libjitsi_tpu/analysis/baseline.json; see README
+# "Static analysis".
 cd "$(dirname "$0")/.."
 echo "== jitlint gate =="
 python scripts/lint.py libjitsi_tpu || { echo "TIER1 FAIL: jitlint gate"; exit 1; }
