@@ -24,7 +24,12 @@ defines burns against a permanently-absent signal (the engine reads
 with ``exemplars=True`` whose ``observe``/``observe_same`` calls never
 pass ``exemplar=`` ships empty exemplar slots in every OpenMetrics
 scrape — both are silent-at-runtime wiring bugs, which is exactly what
-a static gate is for.
+a static gate is for.  ``HistogramVec`` families (one label axis, e.g.
+the hop-labeled ``packet_journey_seconds``) get the same treatment:
+``registry.histogram_vec(...)`` registers the family name, a chained
+``vec.labels(x).observe(..., exemplar=...)`` feeds the vec's exemplar
+slots, and a child bound via ``h = vec.labels(x)`` aliases its
+observes back to the parent vec.
 
 **Perf-baseline drift** (global, disk-backed): ``PERF_BASELINE.json``
 keys must match the ``SCENARIOS`` ids in ``scripts/perf_gate.py`` both
@@ -164,6 +169,7 @@ def file_facts(ctx: FileContext) -> dict:
     slo_refs: List[List] = []
     ex_hists: List[List] = []
     ex_observed: Set[str] = set()
+    labels_alias: List[List] = []
     attr_names: Set[str] = set()
     reg_counter_names: List[List] = []
 
@@ -171,14 +177,24 @@ def file_facts(ctx: FileContext) -> dict:
         if isinstance(node, ast.Attribute):
             attr_names.add(node.attr)
         if isinstance(node, ast.Assign) and \
+                isinstance(node.value, (ast.Attribute, ast.Name)):
+            # plain alias (`vec = self._journey_vec`): exemplar feeds
+            # through the local name credit the attribute it came from
+            src = node_name(node.value)
+            for tgt in node.targets:
+                nm = node_name(tgt)
+                if nm and src and nm != src:
+                    labels_alias.append([nm, src])
+        if isinstance(node, ast.Assign) and \
                 isinstance(node.value, ast.Call):
             vname = call_func_name(node.value)
-            if vname == "histogram":
+            if vname in ("histogram", "histogram_vec"):
                 for tgt in node.targets:
                     nm = node_name(tgt)
                     if nm:
                         hist_reg.add(nm)
-            if vname in ("histogram", "Histogram") and any(
+            if vname in ("histogram", "Histogram", "histogram_vec",
+                         "HistogramVec") and any(
                     kw.arg == "exemplars" and
                     isinstance(kw.value, ast.Constant) and
                     kw.value.value is True
@@ -188,6 +204,15 @@ def file_facts(ctx: FileContext) -> dict:
                     if nm:
                         ex_hists.append([nm, node.lineno,
                                          node.col_offset])
+            if vname == "labels" and \
+                    isinstance(node.value.func, ast.Attribute):
+                # h = vec.labels("local"): observes through `h` feed
+                # the PARENT vec's exemplar slots
+                parent = node_name(node.value.func.value)
+                for tgt in node.targets:
+                    nm = node_name(tgt)
+                    if nm and parent:
+                        labels_alias.append([nm, parent])
         if not isinstance(node, ast.Call):
             continue
         fname = call_func_name(node)
@@ -217,7 +242,14 @@ def file_facts(ctx: FileContext) -> dict:
         elif fname in ("observe", "observe_same", "observe_array") and \
                 isinstance(node.func, ast.Attribute) and \
                 any(kw.arg == "exemplar" for kw in node.keywords):
-            nm = node_name(node.func.value)
+            base = node.func.value
+            # vec.labels("hop").observe(..., exemplar=...): the chain
+            # feeds the vec itself, so credit the vec's name
+            if isinstance(base, ast.Call) and \
+                    isinstance(base.func, ast.Attribute) and \
+                    base.func.attr == "labels":
+                base = base.func.value
+            nm = node_name(base)
             if nm:
                 ex_observed.add(nm)
         elif fname == "SloSpec":
@@ -238,7 +270,7 @@ def file_facts(ctx: FileContext) -> dict:
                                      kw.value.col_offset])
         if fname in ("register_scalar", "register_array",
                      "register_multi", "register_histogram",
-                     "histogram") and node.args:
+                     "histogram", "histogram_vec") and node.args:
             arg = node.args[0]
             if isinstance(arg, ast.Constant) and \
                     isinstance(arg.value, str):
@@ -309,6 +341,7 @@ def file_facts(ctx: FileContext) -> dict:
         "slo_refs": slo_refs,
         "ex_hists": ex_hists,
         "ex_observed": sorted(ex_observed),
+        "labels_alias": labels_alias,
         "attr_names": sorted(attr_names),
         "reg_counter_names": reg_counter_names,
     }
@@ -468,6 +501,7 @@ def check_metrics_drift(index) -> List[Finding]:
     metric_suffixes: Set[str] = set()
     exemplar_fed: Set[str] = set()
     all_attr_names: Set[str] = set()
+    alias_parents: Dict[str, Set[str]] = {}
     for _rel, d, _f in views:
         registered |= set(d["reg_attrs"])
         hist_registered |= set(d["hist_reg"])
@@ -475,6 +509,17 @@ def check_metrics_drift(index) -> List[Finding]:
         metric_suffixes |= set(d["metric_suffixes"])
         exemplar_fed |= set(d["ex_observed"])
         all_attr_names |= set(d["attr_names"])
+        for child, parent in d.get("labels_alias", ()):
+            alias_parents.setdefault(child, set()).add(parent)
+    # a fed vec child (or local alias) feeds its parent's exemplar
+    # slots too — fixpoint over the alias edges
+    changed = True
+    while changed:
+        changed = False
+        for child in sorted(set(alias_parents) & exemplar_fed):
+            if not alias_parents[child] <= exemplar_fed:
+                exemplar_fed |= alias_parents[child]
+                changed = True
 
     def _family_known(ref: str) -> bool:
         if ref in metric_exact:

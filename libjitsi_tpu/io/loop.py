@@ -38,8 +38,13 @@ _log = get_logger("io.loop")
 PACKET_SIZE_BUCKETS = (64, 128, 256, 512, 768, 1024, 1280, 1500)
 
 #: end-to-end packet journey (ingress arrival -> egress send), seconds;
-#: 0.02 is the default tick/ptime budget the journey_p99 SLO keys on
-JOURNEY_BUCKETS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1)
+#: 0.02 is the default tick/ptime budget the journey_p99 SLO keys on.
+#: The tail buckets past 0.1 exist for the cross-bridge hop children
+#: (PR 19): a trunk hop legitimately spans scheduler + wire time well
+#: beyond one tick, and the soak's cross-hop p99 gate needs the tail
+#: resolved instead of collapsed into +Inf.
+JOURNEY_BUCKETS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05,
+                   0.1, 0.25, 1.0, 5.0)
 
 
 def _is_rtcp(data: np.ndarray, length: np.ndarray) -> np.ndarray:
@@ -134,11 +139,16 @@ class MediaLoop:
         # monotonic trace id + arrival time; egress observes the
         # end-to-end latency with an OpenMetrics exemplar carrying the
         # trace id, so a tail-latency bucket links straight to the
-        # FlightRecorder `hdr` events recorded under the same trace
-        self.journey_hist = self.metrics.histogram(
-            "packet_journey_seconds", JOURNEY_BUCKETS,
+        # FlightRecorder `hdr` events recorded under the same trace.
+        # One family, labeled by hop: this loop's own egress fills the
+        # "local" child; a cascaded peer's ingest fills "b<i>-b<j>"
+        # children from the trunk trace extension (mesh/cascade.py),
+        # so one histogram tells the whole cross-bridge story
+        self.journey_vec = self.metrics.histogram_vec(
+            "packet_journey_seconds", JOURNEY_BUCKETS, "hop",
             help_="ingress-arrival to egress-send packet latency",
             exemplars=True)
+        self.journey_hist = self.journey_vec.labels("local")
         self.trace_id = 0
         self._trace_t0: Optional[float] = None
         self.recv_window_ms = recv_window_ms

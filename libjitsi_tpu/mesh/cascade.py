@@ -47,6 +47,17 @@ cadence, `heartbeat_miss_down` misses flip the trunk ``down`` (the
 admission refuses with typed ``trunk_down`` / ``trunk_backlog``
 reasons plus a jittered-exponential retry-after hint, and refused
 senders back off exactly like PR 16's reconnect clients.
+
+**Journey trace extension**: a media frame may carry an OPTIONAL RFC
+5285 header extension (profile `TRACE_EXT_PROFILE`) on the trunk RTP
+header: origin bridge id, hop count, the origin loop's journey trace
+id, and the origin monotonic stamp — all public observability data
+(no key material, no participant payload; secret-flow clean).  The
+extension lives in the header region, so `parse().payload_off` skips
+it: a legacy peer slicing the payload at `payload_off` recovers
+``conf || inner`` bit-exactly and simply never sees the trace, while
+a trace-aware peer stitches the journey across the hop
+(`packet_journey_seconds{hop=...}` on the far bridge).
 """
 
 from __future__ import annotations
@@ -82,6 +93,50 @@ KIND_NACK = 3
 KIND_SPEAKERS = 4
 KIND_ROSTER = 5
 KIND_FEC = 6
+
+#: RFC 5285 profile id of the trunk journey-trace extension
+TRACE_EXT_PROFILE = 0x6A54
+#: extension body: bridge_id:u16 hop:u8 ver:u8 trace_id:u32 stamp_us:u64
+_TRACE_FMT = ">HBBIQ"
+TRACE_EXT_LEN = struct.calcsize(_TRACE_FMT)      # 16 bytes = 4 words
+#: full on-wire extension block cost (4B RFC 5285 header + body)
+TRACE_WIRE_LEN = 4 + TRACE_EXT_LEN
+
+
+@dataclass(frozen=True)
+class TrunkTrace:
+    """Journey context crossing the trunk: which bridge originated the
+    packet, how many trunk hops it has taken, the origin loop's journey
+    trace id, and the origin's monotonic ingress stamp (seconds).  The
+    stamp is only directly comparable on a shared clock; cross-machine
+    readers correct it against the trunk RTT ring (see
+    `CascadeSupervisor._deliver_remote`)."""
+
+    bridge_id: int
+    hop: int
+    trace_id: int
+    t0: float
+
+
+def pack_trace(trace: TrunkTrace) -> bytes:
+    return struct.pack(_TRACE_FMT,
+                       int(trace.bridge_id) & 0xFFFF,
+                       int(trace.hop) & 0xFF, 0,
+                       int(trace.trace_id) & 0xFFFFFFFF,
+                       max(0, int(trace.t0 * 1e6)) & 0xFFFFFFFFFFFFFFFF)
+
+
+def unpack_trace(body: bytes) -> Optional[TrunkTrace]:
+    """Decode a trace extension body; None on anything malformed — an
+    unreadable trace degrades to an untraced frame, never a drop."""
+    if len(body) < TRACE_EXT_LEN:
+        return None
+    bridge_id, hop, ver, trace_id, stamp_us = struct.unpack(
+        _TRACE_FMT, body[:TRACE_EXT_LEN])
+    if ver != 0:
+        return None
+    return TrunkTrace(bridge_id=bridge_id, hop=hop, trace_id=trace_id,
+                      t0=stamp_us / 1e6)
 
 
 @dataclass
@@ -136,17 +191,25 @@ class TrunkRelay:
         self._fec_base: Optional[int] = None
 
     # ------------------------------------------------------------ media
-    def frame_media(self, conf: int, inner: bytes,
-                    now: float) -> Optional[Tuple[int, bytes]]:
+    def frame_media(self, conf: int, inner: bytes, now: float,
+                    trace: Optional[TrunkTrace] = None
+                    ) -> Optional[Tuple[int, bytes]]:
         """Wrap + trunk-protect one inner wire packet; returns
         (trunk_seq, protected frame bytes), or None when the inner
-        packet cannot fit the trunk MTU alongside its framing."""
+        packet cannot fit the trunk MTU alongside its framing.  An
+        optional `trace` rides as an RTP header extension — inside the
+        trunk-authenticated header region, outside the payload a
+        legacy peer slices at `payload_off`."""
         payload = struct.pack(">I", int(conf) & 0xFFFFFFFF) + inner
-        if len(payload) + 64 > 1504:           # header + auth headroom
+        overhead = 64 + (TRACE_WIRE_LEN if trace is not None else 0)
+        if len(payload) + overhead > 1504:     # header + auth headroom
             return None
+        ext = None if trace is None else \
+            [(TRACE_EXT_PROFILE, pack_trace(trace))]
         seq = self.tx_seq & 0xFFFF
         b = rtp_header.build([payload], [seq], [self.tx_ts],
-                             [TRUNK_SSRC], [TRUNK_PT], stream=[0])
+                             [TRUNK_SSRC], [TRUNK_PT], stream=[0],
+                             ext=ext)
         self.tx_seq = (self.tx_seq + 1) & 0xFFFF
         self.tx_ts += 1
         wire = self._tx.protect_rtp(b).to_bytes(0)
@@ -194,11 +257,15 @@ class TrunkRelay:
         mi = missing[0]
         return (base + mi) & 0xFFFF, xor[: lens[mi]].tobytes()
 
-    def open_media(self, wire: bytes,
-                   now: float) -> Optional[Tuple[int, int, bytes]]:
+    def open_media(self, wire: bytes, now: float
+                   ) -> Optional[Tuple[int, int, bytes,
+                                       Optional[TrunkTrace]]]:
         """Unprotect one trunk media frame -> (trunk_seq, conf, inner
-        wire bytes), tracking loss/NACK/FEC state.  None on auth
-        failure or replay."""
+        wire bytes, journey trace or None), tracking loss/NACK/FEC
+        state.  None on auth failure or replay.  The trace slot is
+        None for legacy frames (no extension), foreign extension
+        profiles, and malformed trace bodies — graceful degrade, the
+        media path is identical either way."""
         hdr_seq = struct.unpack(">H", wire[2:4])[0]
         batch = PacketBatch.from_payloads([wire], stream=[0])
         dec, ok = self._rx.unprotect_rtp(batch)
@@ -213,9 +280,16 @@ class TrunkRelay:
             self.nacks.on_losses(TRUNK_SSRC, fresh, now,
                                  deadline=now + self.cfg.deadline_budget_s)
         hdr = rtp_header.parse(dec)
-        body = dec.to_bytes(0)[int(hdr.payload_off[0]):]
+        raw = dec.to_bytes(0)
+        trace = None
+        if (int(hdr.extension[0]) == 1
+                and int(hdr.ext_profile[0]) == TRACE_EXT_PROFILE):
+            ext_off = 12 + 4 * int(hdr.cc[0]) + 4
+            trace = unpack_trace(
+                raw[ext_off: ext_off + 4 * int(hdr.ext_words[0])])
+        body = raw[int(hdr.payload_off[0]):]
         conf = struct.unpack(">I", body[:4])[0]
-        return hdr_seq, conf, body[4:]
+        return hdr_seq, conf, body[4:], trace
 
     def serve_nack(self, seqs, now: float) -> List[bytes]:
         """Sender side of a trunk NACK: cached frames, RTX-budgeted."""
@@ -260,6 +334,12 @@ class CascadeTrunk:
         self._hb_miss_streak = 0
         self.attempts = 0                 # reconnect attempts while down
         self.rtt = self.cfg.rtt_init_s
+        # journey tracing: who we are on the trace extension, and a
+        # zero-arg hook yielding the loop's (trace_id, ingress_t0) —
+        # wired by attach()/CascadeSupervisor; None = relay untraced
+        self.bridge_id = 0
+        self._journey_origin: Optional[
+            Callable[[], Tuple[int, Optional[float]]]] = None
         # cascaded conferences: conf -> speaker ssrc set (None = all)
         self._confs: Dict[int, Optional[set]] = {}
         self.local_roster: Dict[int, list] = {}
@@ -273,9 +353,12 @@ class CascadeTrunk:
         self.on_up: Optional[Callable[[float], None]] = None
         self.on_speakers: Optional[Callable[[int, list], None]] = None
         self.on_roster: Optional[Callable[[dict], None]] = None
-        self.deliver: Optional[Callable[[int, bytes], None]] = None
+        # deliver(conf, inner_wire, trace_or_None)
+        self.deliver: Optional[
+            Callable[[int, bytes, Optional[TrunkTrace]], None]] = None
         # counters (all registered in register_metrics)
         self.heartbeats_total = 0
+        self.heartbeat_misses_total = 0
         self.relay_frames_total = 0
         self.relay_bytes_total = 0
         self.nacks_sent_total = 0
@@ -304,6 +387,10 @@ class CascadeTrunk:
         path."""
         loop.add_ring(self.engine, sink=self.on_batch)
         self._attached = True
+        # journey stamps cross the trunk: relayed frames carry the
+        # loop's current (trace_id, ingress_t0) in the trace extension
+        if hasattr(loop, "journey_origin"):
+            self._journey_origin = loop.journey_origin
 
     def admit_reason(self) -> Optional[str]:
         """Typed relay admission (the PR 16 refusal surface): None when
@@ -379,13 +466,17 @@ class CascadeTrunk:
         """Relay one participant wire packet across the trunk; returns
         False on a typed refusal (caller may consult `admit_reason` /
         `retry_after`)."""
+        # refresh liveness before admitting: a storm that starves
+        # pump() must not keep relaying into a trunk that is dead
+        self._refresh_liveness(now)
         reason = self.admit_reason()
         if reason == "trunk_backlog" or (reason == "trunk_down"
                                          and len(self._tx_queue)
                                          >= self.cfg.backlog_bound):
             self.refusals_total += 1
             return False
-        framed = self.relay.frame_media(conf, inner, now)
+        framed = self.relay.frame_media(conf, inner, now,
+                                        trace=self._mk_trace())
         if framed is None:
             self.oversize_drops_total += 1
             return False
@@ -400,6 +491,19 @@ class CascadeTrunk:
         else:                              # down but under backlog bound
             self._tx_queue.append(wire)
         return True
+
+    def _mk_trace(self) -> Optional[TrunkTrace]:
+        """Journey trace for a frame relayed NOW: the loop's current
+        trace id + ingress stamp under this bridge's id, hop 0 (the
+        origin).  None when no journey source is wired (bare trunks,
+        legacy assemblies) — the frame goes out extension-free."""
+        if self._journey_origin is None:
+            return None
+        trace_id, t0 = self._journey_origin()
+        if t0 is None:
+            return None
+        return TrunkTrace(bridge_id=self.bridge_id, hop=0,
+                          trace_id=trace_id, t0=t0)
 
     def relay_pps(self) -> float:
         """Relayed frames/s over a sliding ~2 s window (gauge)."""
@@ -436,6 +540,27 @@ class CascadeTrunk:
                and now - self._pps_window[0][0] > 2.0):
             self._pps_window.popleft()
 
+    def _refresh_liveness(self, now: float) -> None:
+        """Age unanswered heartbeats into misses and convict the trunk
+        down when the streak crosses the bound.  Split out of
+        `_liveness` so `relay_media`/`on_datagram`/`_send` refresh the
+        control-channel stats too — during a storm that starves
+        `pump()`, /metrics must not serve a stale miss streak (and
+        relay admission must not trust a dead trunk)."""
+        stale = [s for s, t in self._hb_sent_at.items()
+                 if now - t > self.cfg.heartbeat_interval_s]
+        for s in stale:
+            del self._hb_sent_at[s]
+        if stale:
+            self._hb_miss_streak += len(stale)
+            self.heartbeat_misses_total += len(stale)
+        if (self.state == "up"
+                and self._hb_miss_streak >= self.cfg.heartbeat_miss_down):
+            self.state = "down"
+            _log.info("trunk_down", misses=self._hb_miss_streak)
+            if self.on_down is not None:
+                self.on_down(now)
+
     def _liveness(self, now: float) -> None:
         if self.peer is None:
             return
@@ -446,18 +571,7 @@ class CascadeTrunk:
         else:
             self.attempts += 1
             self._hb_next = now + self.retry_after()
-        # unanswered heartbeats older than one interval are misses
-        stale = [s for s, t in self._hb_sent_at.items()
-                 if now - t > self.cfg.heartbeat_interval_s]
-        for s in stale:
-            del self._hb_sent_at[s]
-        self._hb_miss_streak += len(stale)
-        if (self.state == "up"
-                and self._hb_miss_streak >= self.cfg.heartbeat_miss_down):
-            self.state = "down"
-            _log.info("trunk_down", misses=self._hb_miss_streak)
-            if self.on_down is not None:
-                self.on_down(now)
+        self._refresh_liveness(now)
         self.hb_seq = (self.hb_seq + 1) & 0xFFFF
         self._hb_sent_at[self.hb_seq] = now
         self.heartbeats_total += 1
@@ -477,6 +591,9 @@ class CascadeTrunk:
             return
         if data[0] == MAGIC_CONTROL:
             self._on_control(data[1], data[2:], now)
+            # refresh AFTER control handling: an ACK settles its own
+            # heartbeat entry before the entry could age into a miss
+            self._refresh_liveness(now)
             return
         if (len(data) < 12
                 or int.from_bytes(data[8:12], "big") != TRUNK_SSRC):
@@ -488,9 +605,9 @@ class CascadeTrunk:
         if opened is None:
             self.unprotect_drops_total += 1
             return
-        _seq, conf, inner = opened
+        _seq, conf, inner, trace = opened
         if self.deliver is not None:
-            self.deliver(conf, inner)
+            self.deliver(conf, inner, trace)
 
     def _on_control(self, kind: int, body: bytes, now: float) -> None:
         if kind == KIND_FEC:
@@ -501,7 +618,7 @@ class CascadeTrunk:
                 self.relay.nacks.on_arrival(TRUNK_SSRC, seq)
                 opened = self.relay.open_media(wire, now)
                 if opened is not None and self.deliver is not None:
-                    self.deliver(opened[1], opened[2])
+                    self.deliver(opened[1], opened[2], opened[3])
             return
         msg = json.loads(body.decode("utf-8"))
         if kind == KIND_HEARTBEAT:
@@ -547,6 +664,9 @@ class CascadeTrunk:
     def _send(self, data: bytes) -> None:
         if self.peer is None:
             return
+        # keep the miss streak / state gauges current on every send,
+        # not just on pump() (idempotent for an already-aged clock)
+        self._refresh_liveness(self.now)
         self.engine.send_batch(PacketBatch.from_payloads([data]),
                                self.peer[0], self.peer[1])
 
@@ -558,6 +678,8 @@ class CascadeTrunk:
     def register_metrics(self, registry, prefix: str = "trunk") -> None:
         registry.register_counters(self, [
             ("heartbeats_total", "trunk heartbeats sent"),
+            ("heartbeat_misses_total",
+             "trunk heartbeats that aged out unanswered"),
             ("relay_frames_total", "media frames relayed across trunk"),
             ("relay_bytes_total", "relayed trunk bytes"),
             ("nacks_sent_total", "trunk-seq NACKs sent"),
@@ -579,6 +701,11 @@ class CascadeTrunk:
         registry.register_scalar(
             f"{prefix}_tx_backlog", lambda: float(len(self._tx_queue)),
             help_="frames queued while the trunk is down")
+        registry.register_scalar(
+            f"{prefix}_heartbeat_miss_streak",
+            lambda: float(self._hb_miss_streak),
+            help_="consecutive unanswered heartbeats (refreshed on "
+                  "send/ingress, not just pump)")
         self._rtt_ring = registry.timing(f"{prefix}_rtt")
 
     # --------------------------------------------------------- lifecycle

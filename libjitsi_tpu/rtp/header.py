@@ -137,10 +137,16 @@ def build(
     csrcs=None,
     capacity: int = 1504,
     stream=None,
+    ext=None,
 ) -> PacketBatch:
     """Build a batch of RTP packets (host-side; used by tests/fixtures/packetizers).
 
     `payloads` is a list of bytes; other args broadcast over the batch.
+    `ext` is None or a per-row list of `(profile_u16, body_bytes)` /
+    None entries: a present entry sets the X bit and emits an RFC 5285
+    extension block after the CSRCs, body zero-padded to a 32-bit word
+    boundary — `parse()` folds it into `header_len`/`payload_off`, so
+    readers that slice at `payload_off` skip it transparently.
     Reference analog: FMJ's RTP packetization + RawPacket header writes.
     """
     n = len(payloads)
@@ -154,6 +160,7 @@ def build(
         else np.broadcast_to(np.asarray(marker, dtype=np.int64), (n,))
     )
     csrc_lists = csrcs if csrcs is not None else [[]] * n
+    ext_list = ext if ext is not None else [None] * n
 
     pkts = []
     for i, p in enumerate(payloads):
@@ -166,6 +173,15 @@ def build(
         hdr[8:12] = int(ssrc[i] & 0xFFFFFFFF).to_bytes(4, "big")
         for j, c in enumerate(cl):
             hdr[12 + 4 * j : 16 + 4 * j] = int(c & 0xFFFFFFFF).to_bytes(4, "big")
+        if ext_list[i] is not None:
+            profile, body = ext_list[i]
+            body = bytes(body)
+            if len(body) % 4:
+                body += b"\x00" * (4 - len(body) % 4)
+            hdr[0] |= 0x10
+            hdr += int(profile & 0xFFFF).to_bytes(2, "big")
+            hdr += (len(body) // 4).to_bytes(2, "big")
+            hdr += body
         pkts.append(bytes(hdr) + bytes(p))
     return PacketBatch.from_payloads(pkts, capacity, stream)
 

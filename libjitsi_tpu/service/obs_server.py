@@ -16,6 +16,12 @@ A threaded `http.server` (no framework, no new deps) serving:
                         (utils/profiling.device_memory)
   /debug/streams/<sid>  flight-recorder dump for one stream
   /debug/postmortems    supervisor's bounded post-mortem list
+  /debug/fleet          cross-bridge journey view: scrapes every
+                        registered peer's /metrics (OpenMetrics) and
+                        stitches hop-labeled packet_journey_seconds
+                        exemplars by trace id — one packet's path
+                        across the cascade, bridged by the trunk's
+                        trace extension (mesh/cascade.py)
 
 The server binds an ephemeral port by default (`port=0`; read `.port`
 after `start()`), runs on a daemon thread, and never touches the data
@@ -27,14 +33,17 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Dict, List, Optional
 
 import numpy as np
 
 from libjitsi_tpu.utils.logging import get_logger
 from libjitsi_tpu.utils.metrics import (CONTENT_TYPE_OPENMETRICS,
-                                        CONTENT_TYPE_PROM)
+                                        CONTENT_TYPE_PROM,
+                                        _parse_labels, _split_exemplar,
+                                        parse_exposition)
 
 _log = get_logger("service.obs")
 
@@ -52,11 +61,106 @@ def _jsonable(obj):
     return str(obj)
 
 
+JOURNEY_FAMILY = "packet_journey_seconds"
+
+
+def _journey_exemplars(text: str) -> List[dict]:
+    """Hop-labeled journey exemplars out of one OpenMetrics scrape:
+    `{trace_id, hop, seconds, origin}` per `_bucket` exemplar.  The
+    trace id is the stitch key — the origin bridge stamps it on the
+    trunk trace extension, so the SAME id shows up under `hop="local"`
+    on the origin and `hop="bX-bY"` on the destination."""
+    out: List[dict] = []
+    seen = set()
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        sample, ex = _split_exemplar(line)
+        if ex is None or not ex.startswith("{"):
+            continue
+        brace, close = sample.find("{"), sample.rfind("}")
+        name = sample[:brace] if brace >= 0 else sample.split()[0]
+        # family names carry the registry namespace prefix
+        if not name.endswith(f"{JOURNEY_FAMILY}_bucket"):
+            continue
+        labels = (_parse_labels(sample[brace + 1: close])
+                  if 0 <= brace < close else None) or {}
+        eclose = ex.rfind("}")
+        elabels = (_parse_labels(ex[1:eclose])
+                   if eclose > 0 else None) or {}
+        tail = ex[eclose + 1:].split()
+        tid = elabels.get("trace_id")
+        if tid is None or not tail:
+            continue
+        try:
+            seconds = float(tail[0])
+        except ValueError:
+            continue
+        hop = labels.get("hop", "")
+        key = (tid, hop, seconds)
+        if key in seen:                 # same exemplar, +Inf slot
+            continue
+        seen.add(key)
+        out.append({"trace_id": tid, "hop": hop, "seconds": seconds,
+                    "origin": elabels.get("origin")})
+    return out
+
+
+def stitch_journeys(scrapes: Dict[str, str]) -> dict:
+    """Merge several bridges' OpenMetrics scrapes into one fleet
+    journey view.  `scrapes` maps bridge name -> exposition text; the
+    result groups hop-labeled journey exemplars by trace id and marks
+    the ids observed on more than one bridge as STITCHED — the packet
+    demonstrably crossed the trunk and kept its trace.  Shared by
+    `/debug/fleet` (live) and `scripts/trace_report.py
+    --merge-bridges` (offline twin)."""
+    bridges: Dict[str, dict] = {}
+    journeys: Dict[str, dict] = {}
+    for name, text in sorted(scrapes.items()):
+        _types, samples, _errs = parse_exposition(text)
+        hops = {
+            labels["hop"]: value
+            for sname, labels, value in samples
+            if sname.endswith(f"{JOURNEY_FAMILY}_count")
+            and "hop" in labels}
+        exs = _journey_exemplars(text)
+        bridges[name] = {"hops": hops, "exemplars": len(exs)}
+        for e in exs:
+            j = journeys.setdefault(e["trace_id"], {
+                "trace_id": e["trace_id"], "spans": []})
+            j["spans"].append({"bridge": name, "hop": e["hop"],
+                               "seconds": e["seconds"],
+                               "origin": e["origin"]})
+    for j in journeys.values():
+        j["bridges"] = sorted({s["bridge"] for s in j["spans"]})
+        j["stitched"] = len(j["bridges"]) > 1
+    stitched = sorted(t for t, j in journeys.items() if j["stitched"])
+    return {
+        "bridges": bridges,
+        "journeys": sorted(journeys.values(),
+                           key=lambda j: (-len(j["bridges"]),
+                                          j["trace_id"])),
+        "stitched_trace_ids": stitched,
+    }
+
+
+def fetch_metrics(base_url: str, timeout: float = 1.0) -> str:
+    """One peer scrape, OpenMetrics negotiated (exemplars ride only on
+    the OM content type)."""
+    req = urllib.request.Request(
+        base_url.rstrip("/") + "/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.read().decode("utf-8")
+
+
 class ObservabilityServer:
     """Serve /metrics, /healthz and flight-recorder debug dumps."""
 
     def __init__(self, metrics=None, supervisor=None, flight=None,
-                 slo=None, host: str = "127.0.0.1", port: int = 0):
+                 slo=None, host: str = "127.0.0.1", port: int = 0,
+                 name: str = "local",
+                 peers: Optional[Dict[str, str]] = None):
         self.metrics = metrics
         self.supervisor = supervisor
         # explicit flight wins; else follow the supervisor's recorder
@@ -65,8 +169,15 @@ class ObservabilityServer:
         self._slo = slo
         self.host = host
         self.port = int(port)
+        # fleet axis: this bridge's name plus peer name -> base URL,
+        # scraped (OpenMetrics) by /debug/fleet for journey stitching
+        self.name = str(name)
+        self.peers: Dict[str, str] = dict(peers or {})
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
+
+    def add_peer(self, name: str, base_url: str) -> None:
+        self.peers[str(name)] = str(base_url)
 
     @property
     def flight(self):
@@ -158,6 +269,26 @@ class ObservabilityServer:
             pms = list(getattr(self.supervisor, "postmortems", ()))
             return (200, "application/json",
                     json.dumps(pms, default=_jsonable).encode("utf-8"))
+        if path == "/debug/fleet":
+            # own registry renders in-process (no self-scrape over
+            # HTTP); peers are scraped best-effort — a dead peer shows
+            # up under `errors`, it doesn't 500 the fleet view
+            scrapes = {self.name: self._metrics_text(openmetrics=True)}
+            errors: Dict[str, str] = {}
+            for pname, base in sorted(self.peers.items()):
+                try:
+                    scrapes[pname] = fetch_metrics(base)
+                except Exception as exc:
+                    errors[pname] = repr(exc)
+            doc = stitch_journeys(scrapes)
+            doc["self"] = self.name
+            doc["peers"] = sorted(self.peers)
+            doc["errors"] = errors
+            sup = self.supervisor
+            if sup is not None and hasattr(sup, "trunk_owd_s"):
+                doc["trunk_owd_s"] = float(sup.trunk_owd_s)
+            return (200, "application/json",
+                    json.dumps(doc, default=_jsonable).encode("utf-8"))
         return (404, "application/json", b'{"error": "not found"}')
 
     # ----------------------------------------------------------- control

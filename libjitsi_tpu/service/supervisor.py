@@ -437,6 +437,15 @@ class BridgeSupervisor:
                         and self.slo.slice_state(spec.name, shard)
                         == "fast_burn"):
                     return False, "shard_burn"
+        if self.slo is not None:
+            for spec in getattr(self.slo, "sliced", ()):
+                # per-hop journey burn (cascade tracing): a trunk hop
+                # whose journey tail is burning fast means more members
+                # would land on a degraded cross-bridge path — refuse
+                # typed, like shard_burn, rather than brown out
+                if (spec.label == "hop"
+                        and self.slo.burning_slices(spec.name)):
+                    return False, "hop_burn"
         if (handshake_bound and handshake_backlog is not None
                 and handshake_backlog >= handshake_bound):
             return False, "handshake_backlog"
@@ -829,12 +838,19 @@ class CascadeSupervisor(BridgeSupervisor):
         trunk.on_roster = self._on_roster
         trunk.on_speakers = self._apply_remote_speakers
         trunk.deliver = self._deliver_remote
+        trunk.bridge_id = int(bridge_id)   # stamped on trace extensions
         if hasattr(trunk, "flight"):
             trunk.flight = self.flight
         self.trunk_failovers_total = 0
         self.orphans_adopted = 0
         self.orphans_requeued = 0
         self.remote_delivered = 0
+        # cross-bridge journey tracing: hop-labeled children of
+        # packet_journey_seconds (register_metrics binds the vec; falls
+        # back to the bridge loop's own vec when none is registered),
+        # plus the rtt-ring-corrected trunk one-way-delay estimate
+        self._journey_vec = None
+        self.trunk_owd_s = 0.0
         self.adopting = False            # failover in progress
         self._now = 0.0                  # model clock from tick()
         self._adopt_q: deque = deque()   # entries awaiting request_join
@@ -920,16 +936,57 @@ class CascadeSupervisor(BridgeSupervisor):
 
     # -------------------------------------------------- trunk hooks
 
-    def _deliver_remote(self, conf: int, inner: bytes) -> None:
+    def _deliver_remote(self, conf: int, inner: bytes,
+                        trace=None) -> None:
         """Re-inject a trunk-delivered participant packet into the
         local bridge's primary socket: the remote speaker is a regular
         keyed row here (roster sync installed it), so the inner SRTP
         authenticates and routes through the stock data path — zero
-        cascade-specific shapes, zero recompiles."""
+        cascade-specific shapes, zero recompiles.
+
+        When the frame carried a journey trace extension, the hop is
+        recorded here (host side, off the jit path): a hop-labeled
+        `packet_journey_seconds` observation whose exemplar carries the
+        ORIGIN bridge's trace id — the stitch point /debug/fleet and
+        `trace_report.py --merge-bridges` join on."""
+        if trace is not None:
+            self._note_hop(trace)
         self.trunk.engine.send_batch(
             PacketBatch.from_payloads([inner]),
             "127.0.0.1", self.bridge.port)
         self.remote_delivered += 1
+
+    def _note_hop(self, trace) -> None:
+        """Observe one cross-bridge journey segment: origin ingress
+        stamp -> local trunk ingest, under a `b<origin>-b<me>` hop
+        label.  The origin stamp is a FOREIGN monotonic clock; the
+        trunk RTT ring corrects it — the wire can't be faster than
+        half the measured round trip, so the raw delta is floored at
+        owd (and a cross-machine, incomparable-clock delta degrades to
+        the rtt-derived estimate instead of garbage)."""
+        ring = getattr(self.trunk, "_rtt_ring", None)
+        rtt = (ring.percentile(50) if ring is not None and ring.count
+               else float(self.trunk.rtt))
+        owd = max(rtt / 2.0, 0.0)
+        self.trunk_owd_s = owd
+        raw = time.perf_counter() - float(trace.t0)
+        # plausibility window: floor at the wire delay, and treat a
+        # multi-second delta (incomparable clocks) as wire-delay-only
+        dt = raw if owd <= raw <= 10.0 else owd
+        vec = self._journey_vec
+        if vec is None:
+            vec = getattr(getattr(self.bridge, "loop", None),
+                          "journey_vec", None)
+            if vec is None:
+                return
+        hop = f"b{int(trace.bridge_id)}-b{self.bridge_id}"
+        tail = vec.labels(hop).observe(
+            dt, exemplar={"trace_id": str(int(trace.trace_id)),
+                          "origin": str(int(trace.bridge_id))})
+        if tail:
+            self.flight.record("hop_tail", tick=self.ticks,
+                               hop=hop, trace=int(trace.trace_id),
+                               seconds=dt)
 
     def _apply_remote_speakers(self, conf: int, ssrcs) -> None:
         """Speaker bus crossing the trunk: map the peer's active-speaker
@@ -978,8 +1035,16 @@ class CascadeSupervisor(BridgeSupervisor):
         roster member for adoption through the commit barrier."""
         self.trunk_failovers_total += 1
         self.adopting = True
-        self.flight.record("trunk_failover", tick=self.ticks,
-                           peer=self.peer_bridge_id)
+        ev = self.flight.record("trunk_failover", tick=self.ticks,
+                                peer=self.peer_bridge_id,
+                                inflight=self._journey_inflight())
+        # post-mortem at conviction, mirroring quarantine/shed/recover:
+        # the in-flight journey set names exactly which trace ids were
+        # mid-hop when the trunk died — the per-hop attribution for
+        # time-to-media-restored in churn_soak --cascade
+        self.postmortems.append({
+            "trigger": "trunk_failover", "tick": self.ticks,
+            "event": ev, "dump": self.flight.dump_all()})
         lc = self.lifecycle
         placer = getattr(lc, "placer", None) if lc is not None else None
         if placer is not None and getattr(placer, "n_bridges", 0):
@@ -1067,8 +1132,16 @@ class CascadeSupervisor(BridgeSupervisor):
             self.orphans_adopted += 1
             ssrc = int(ent["m"]["ssrc"])
             self.trunk.claim_member(conf, ssrc)
-            self.flight.record("orphan_adopted", sid=sid,
-                               tick=self.ticks, conf=conf, ssrc=ssrc)
+            ev = self.flight.record("orphan_adopted", sid=sid,
+                                    tick=self.ticks, conf=conf,
+                                    ssrc=ssrc)
+            # adoption-commit post-mortem: second half of the failover
+            # story (conviction is the first), per adopted stream
+            self.postmortems.append({
+                "trigger": "trunk_failover", "sid": sid,
+                "tick": self.ticks, "event": ev,
+                "dump": self.flight.dump(sid) if sid is not None
+                else self.flight.dump_all()})
             # an orphan that was on the conference's top-K speaker bus
             # resumes speaking HERE: its fresh row landed as a listener
             # (the broadcast speaker set holds the dead row's sid)
@@ -1095,6 +1168,21 @@ class CascadeSupervisor(BridgeSupervisor):
 
     # ------------------------------------------------- observability
 
+    def _journey_inflight(self) -> List[int]:
+        """Trace ids currently mid-journey on this bridge's loop: the
+        live tick's trace plus every pipelined dispatch still holding
+        an origin stamp.  Captured into the trunk-down post-mortem —
+        these are the packets whose journey the failover cut."""
+        lp = getattr(self.bridge, "loop", None)
+        if lp is None:
+            return []
+        ids = {int(getattr(lp, "trace_id", 0))}
+        for ent in getattr(lp, "_inflight", ()):
+            ids.add(int(ent[2][0]))          # (pend, mask, origin, tick)
+        for e in getattr(lp, "_rx_inflight", ()):
+            ids.add(int(e["origin"][0]))
+        return sorted(ids)
+
     def _register_bridge_slo(self) -> None:
         from libjitsi_tpu.utils.slo import SlicedSloSpec
         tr = self.trunk
@@ -1112,6 +1200,35 @@ class CascadeSupervisor(BridgeSupervisor):
             description="per-bridge trunk media continuity: frames "
                         "relayed/delivered vs concealed, dropped or "
                         "refused"))
+        self._register_hop_slo()
+
+    def _register_hop_slo(self) -> None:
+        """Per-hop journey burn (`label="hop"`): each hop-labeled
+        child of packet_journey_seconds is one slice; an observation
+        within the trunk's deadline budget is good, past it is bad.
+        `admission_decision` refuses `hop_burn` while any hop slice is
+        fast-burning — the cross-bridge twin of shard_burn."""
+        from libjitsi_tpu.utils.slo import SlicedSloSpec
+        budget = float(self.trunk.cfg.deadline_budget_s)
+
+        def _read():
+            vec = self._journey_vec
+            if vec is None:
+                vec = getattr(getattr(self.bridge, "loop", None),
+                              "journey_vec", None)
+            if vec is None:
+                return
+            for lv, h in vec.children():
+                j = int(np.searchsorted(h.uppers, budget,
+                                        side="right")) - 1
+                good = float(h.cumulative()[j]) if j >= 0 else 0.0
+                yield (lv, good, float(h.count) - good)
+
+        self.slo.add_sliced(SlicedSloSpec(
+            name="hop_journey", objective=0.99, label="hop",
+            reader=_read,
+            description="per-hop packet journey tail vs the trunk "
+                        "deadline budget"))
 
     def register_metrics(self, registry,
                          prefix: str = "supervisor") -> None:
@@ -1134,6 +1251,14 @@ class CascadeSupervisor(BridgeSupervisor):
             "cascade_remote_delivered", lambda: self.remote_delivered,
             help_="trunk-delivered remote packets re-injected locally",
             kind="counter")
+        registry.register_scalar(
+            "trunk_one_way_delay_seconds", lambda: self.trunk_owd_s,
+            help_="rtt-ring-corrected trunk one-way-delay estimate")
+        from libjitsi_tpu.io.loop import JOURNEY_BUCKETS
+        self._journey_vec = registry.histogram_vec(
+            "packet_journey_seconds", JOURNEY_BUCKETS, "hop",
+            help_="ingress-arrival to egress-send packet latency",
+            exemplars=True)
 
     # ------------------------------------------------- checkpointing
 

@@ -474,11 +474,21 @@ class MetricsRegistry:
             for lv, hist in vec.children():
                 pre = f'{vec.label}="{escape_label_value(lv)}",'
                 cum = hist.cumulative()
-                for upper, c in zip(hist.uppers, cum[:-1]):
-                    out.append(f'{full}_bucket{{{pre}le='
-                               f'"{_fmt_le(upper)}"}} {int(c)}')
-                out.append(f'{full}_bucket{{{pre}le="+Inf"}} '
-                           f"{hist.count}")
+                ex = hist.exemplars if (openmetrics and
+                                        hist.exemplars is not None) \
+                    else None
+                for i, (upper, c) in enumerate(zip(hist.uppers,
+                                                   cum[:-1])):
+                    line = (f'{full}_bucket{{{pre}le='
+                            f'"{_fmt_le(upper)}"}} {int(c)}')
+                    if ex is not None and ex[i] is not None:
+                        line += self._fmt_exemplar(*ex[i])
+                    out.append(line)
+                line = (f'{full}_bucket{{{pre}le="+Inf"}} '
+                        f"{hist.count}")
+                if ex is not None and ex[-1] is not None:
+                    line += self._fmt_exemplar(*ex[-1])
+                out.append(line)
                 lbl = f'{vec.label}="{escape_label_value(lv)}"'
                 out.append(f"{full}_sum{{{lbl}}} {_fmt(hist.sum)}")
                 out.append(f"{full}_count{{{lbl}}} {hist.count}")

@@ -226,7 +226,20 @@ class SloEngine:
             if spec.kind == "latency":
                 hist = self.registry.get_histogram(spec.metric)
                 if hist is None:
-                    return None
+                    # hop-labeled families (HistogramVec): the
+                    # fleet-wide objective aggregates all children —
+                    # per-slice burn is SlicedSloSpec territory
+                    vec = self.registry.get_histogram_vec(spec.metric)
+                    if vec is None:
+                        return None
+                    good = total = 0.0
+                    for _lv, h in vec.children():
+                        j = int(np.searchsorted(h.uppers, spec.budget_s,
+                                                side="right")) - 1
+                        good += float(h.cumulative()[j]) if j >= 0 \
+                            else 0.0
+                        total += float(h.count)
+                    return good, total - good
                 j = int(np.searchsorted(hist.uppers, spec.budget_s,
                                         side="right")) - 1
                 good = float(hist.cumulative()[j]) if j >= 0 else 0.0

@@ -1092,6 +1092,7 @@ def run_cascade_soak(dt: float = 0.01, n_senders: int = 3,
                      n_receivers: int = 2,
                      pre_rounds: int = 30, post_rounds: int = 150,
                      restore_p99_bound_s: float = 2.0,
+                     hop_p99_bound_s: float = 1.0,
                      refusal_bound: int = 80, seed: int = 0,
                      verbose: bool = True, report_path=None) -> dict:
     """Bridge-cascade failover chaos: one conference spans two bridges
@@ -1112,6 +1113,12 @@ def run_cascade_soak(dt: float = 0.01, n_senders: int = 3,
       both bridges — failover rides warm shapes;
     - every refusal TYPED (`trunk_down` observed with a retry-after
       hint the joiner honors via exponential backoff) and bounded;
+    - cross-hop journey tracing held (PR 19): the trunk trace
+      extension produced hop-labeled `packet_journey_seconds`
+      observations on B with a bounded p99, the rtt-corrected trunk
+      one-way-delay estimate is live, and the trunk-down conviction
+      captured a `trunk_failover` post-mortem naming the in-flight
+      journey set;
     - full reconciliation, never torn: every row on the survivor is
       committed-with-keys or still staged/queued, the adoption queue
       drains, and the placer re-homes the conference on the survivor's
@@ -1274,6 +1281,12 @@ def run_cascade_soak(dt: float = 0.01, n_senders: int = 3,
     tick_both(4)
     _media_rounds(6, flipped, bA.port)
     w0A, w0B = lcA.datapath_recompiles, lcB.datapath_recompiles
+    # hop-journey baseline at the same boundary: priming rounds carry
+    # the compile stalls, and the cross-hop p99 gate must judge the
+    # warm window only (same exclusion the recompile gate applies)
+    hop0 = ({h: np.asarray(c.bucket_counts, dtype=np.int64).copy()
+             for h, c in supB._journey_vec.children()}
+            if supB._journey_vec is not None else {})
     for r in receivers:
         r["got"].clear()
 
@@ -1415,7 +1428,42 @@ def run_cascade_soak(dt: float = 0.01, n_senders: int = 3,
     scrape = bB.loop.metrics.render()
     ok_metrics = all(m in scrape for m in (
         "trunk_heartbeats_total", "trunk_relay_pps", "trunk_rtt",
-        "trunk_failovers_total", "cascade_orphans_adopted"))
+        "trunk_failovers_total", "cascade_orphans_adopted",
+        "trunk_one_way_delay_seconds"))
+
+    # ---- cross-hop journey gate: every trunk-delivered frame carried
+    # the trace extension, so B's journey vec must hold a b0-b1 child
+    # with a bounded p99 (wall time A-ingress -> B-trunk-ingest,
+    # same-host clocks here so the raw delta is honest).  p99 is
+    # computed over the post-priming window via the hop0 baseline.
+    def _hop_window(h, c):
+        wc = np.asarray(c.bucket_counts, dtype=np.int64).copy()
+        base = hop0.get(h)
+        if base is not None:
+            wc -= base
+        cum = np.cumsum(wc)
+        n = int(cum[-1])
+        if n <= 0:
+            return 0, None
+        k = int(np.searchsorted(cum, 0.99 * n, side="left"))
+        p99 = (float(c.uppers[k]) if k < len(c.uppers)
+               else float("inf"))
+        return n, p99
+
+    vec = supB._journey_vec
+    cross_hops = {h: c for h, c in (vec.children() if vec is not None
+                                    else []) if h != "local"}
+    hop_win = {h: _hop_window(h, c)
+               for h, c in sorted(cross_hops.items())}
+    hop_p99s = {h: p for h, (_, p) in hop_win.items()}
+    ok_cross_hop = (bool(cross_hops)
+                    and all(n > 0 for n, _ in hop_win.values())
+                    and all(p is not None and p <= hop_p99_bound_s
+                            for p in hop_p99s.values()))
+    ok_trunk_owd = supB.trunk_owd_s > 0.0
+    ok_failover_pm = any(p.get("trigger") == "trunk_failover"
+                         for p in supB.postmortems)
+    ok_hop_exported = 'hop="b0-b1"' in scrape
 
     report = {
         "mode": "cascade",
@@ -1443,6 +1491,10 @@ def run_cascade_soak(dt: float = 0.01, n_senders: int = 3,
                           if p99_restore != float("inf") else None),
         "priming_recompiles": w0A + w0B,
         "window_recompiles": window_recompiles,
+        "hop_journeys": {h: n for h, (n, _) in hop_win.items()},
+        "hop_p99_s": {h: (round(p, 4) if p not in (None, float("inf"))
+                          else p) for h, p in hop_p99s.items()},
+        "trunk_owd_s": round(supB.trunk_owd_s, 5),
         "torn_rows": torn,
         "flight_kinds": sorted(kinds & {"trunk_failover",
                                         "orphan_adopted", "trunk_up"}),
@@ -1459,6 +1511,10 @@ def run_cascade_soak(dt: float = 0.01, n_senders: int = 3,
         "ok_typed_refusals": ok_typed_refusals,
         "ok_reconciled": ok_reconciled,
         "ok_metrics_exported": ok_metrics,
+        "ok_cross_hop_journeys": ok_cross_hop,
+        "ok_trunk_owd": ok_trunk_owd,
+        "ok_failover_postmortem": ok_failover_pm,
+        "ok_hop_exported": ok_hop_exported,
     }
     for s in senders:
         s["eng"].close()

@@ -11,6 +11,10 @@ Drives media + a NACK through the bridge for N ticks, then asserts:
 - an OpenMetrics scrape (Accept negotiation) carries at least one
   VALID exemplar on packet_journey_seconds buckets plus the `# EOF`
   terminator, and the default scrape stays exemplar-free;
+- packet_journey_seconds is hop-labeled (`hop="local"` on the bridge's
+  own journeys), and /debug/fleet on two peered ObservabilityServers
+  stitches at least one trace id across bridges after a trunk frame
+  carries the trace extension from A to B;
 - the SLO engine exports slo_burn_rate gauges and serves /debug/slo;
 - a hostile SDES stream name round-trips escaped, not raw;
 - /healthz reports ok and /debug/streams serves a flight dump;
@@ -44,6 +48,73 @@ def _get(port, path, accept=None):
     with urllib.request.urlopen(req, timeout=5) as r:
         return r.status, r.read().decode("utf-8"), \
             r.headers.get("Content-Type", "")
+
+
+def _fleet_smoke(srv_a, om_a: str, exemplar_line: str) -> None:
+    """Stand up bridge B as a second registry + ObservabilityServer,
+    relay one trunk frame from A carrying a trace id A's scrape
+    already exemplifies, record the hop on B, and assert the peered
+    /debug/fleet stitches that id across both bridges."""
+    import re
+    import time
+
+    from libjitsi_tpu.io.loop import JOURNEY_BUCKETS
+    from libjitsi_tpu.mesh.cascade import TrunkRelay, TrunkTrace
+    from libjitsi_tpu.service.obs_server import ObservabilityServer
+    from libjitsi_tpu.utils.metrics import MetricsRegistry
+
+    m = re.search(r'trace_id="(\d+)"', exemplar_line)
+    assert m, f"unparseable exemplar line: {exemplar_line}"
+    tid = int(m.group(1))
+
+    # bridge B: its own registry with a hop-labeled journey vec (the
+    # shape CascadeSupervisor.register_metrics installs)
+    reg_b = MetricsRegistry()
+    vec_b = reg_b.histogram_vec("packet_journey_seconds",
+                                JOURNEY_BUCKETS, "hop",
+                                help_="journey latency", exemplars=True)
+
+    # the trunk wire actually carries the trace: frame on A's relay,
+    # open on B's — the extension survives the SRTP-protected hop
+    key_ab = (b"\xa0" * 16, b"\xa1" * 14)
+    key_ba = (b"\xb0" * 16, b"\xb1" * 14)
+    relay_a = TrunkRelay(key_ab, key_ba)
+    relay_b = TrunkRelay(key_ba, key_ab)
+    trace = TrunkTrace(bridge_id=0, hop=0, trace_id=tid,
+                       t0=time.perf_counter())
+    _seq, wire = relay_a.frame_media(
+        7, bytes([0x80, 96]) + b"\x00" * 60, now=0.0, trace=trace)
+    opened = relay_b.open_media(wire, now=0.0)
+    assert opened is not None and opened[3] is not None, \
+        "trace extension did not survive the trunk hop"
+    rtr = opened[3]
+    assert rtr.trace_id == tid, f"trace id mangled: {rtr}"
+    vec_b.labels(f"b{rtr.bridge_id}-b1").observe(
+        max(time.perf_counter() - rtr.t0, 1e-4),
+        exemplar={"trace_id": str(rtr.trace_id),
+                  "origin": str(rtr.bridge_id)})
+
+    srv_b = ObservabilityServer(metrics=reg_b, name="bridge-b").start()
+    try:
+        srv_a.name = "bridge-a"
+        srv_a.add_peer("bridge-b", f"http://127.0.0.1:{srv_b.port}")
+        srv_b.add_peer("bridge-a", f"http://127.0.0.1:{srv_a.port}")
+        for port in (srv_a.port, srv_b.port):
+            code, body, _ = _get(port, "/debug/fleet")
+            assert code == 200, f"/debug/fleet -> {code}"
+            fleet = json.loads(body)
+            assert not fleet["errors"], f"peer scrape failed: {fleet}"
+            assert str(tid) in fleet["stitched_trace_ids"], \
+                (f"trace {tid} not stitched across bridges: "
+                 f"{fleet['stitched_trace_ids']}")
+            spans = [j for j in fleet["journeys"]
+                     if j["trace_id"] == str(tid)][0]["spans"]
+            hops = {s["hop"] for s in spans}
+            assert "local" in hops and "b0-b1" in hops, \
+                f"journey lacks origin+remote spans: {spans}"
+    finally:
+        srv_a.peers.clear()
+        srv_b.stop()
 
 
 def run(ticks: int = 40) -> None:
@@ -130,6 +201,16 @@ def run(ticks: int = 40) -> None:
             f"exemplar lacks trace_id: {ex_lines[0]}"
         assert count_exemplars(text) == 0, \
             "default (non-OpenMetrics) scrape leaked exemplars"
+        # the journey family is hop-labeled: local journeys land under
+        # hop="local"; cross-bridge ingests add hop="bX-bY" children
+        assert f'{journey}_count{{hop="local"}}' in om, \
+            "packet_journey_seconds lost its hop label axis"
+
+        # ---- cross-bridge fleet view: a trunk frame carries one of
+        # this bridge's REAL trace ids (pulled from its own exemplars)
+        # to a second bridge's registry; the peered /debug/fleet must
+        # stitch that id across both scrapes
+        _fleet_smoke(srv, om, ex_lines[0])
 
         # SLO engine: burn-rate gauges in the scrape + /debug/slo JSON
         assert f"# TYPE {ns}_slo_burn_rate gauge" in text, \

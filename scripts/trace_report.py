@@ -11,6 +11,16 @@ the top kernels by accumulated device time.
 
   python scripts/trace_report.py /tmp/libjitsi_tpu_trace
   python scripts/trace_report.py --capture-loop-echo
+  python scripts/trace_report.py --merge-bridges a.om b.om
+  python scripts/trace_report.py --merge-bridges \\
+      http://127.0.0.1:9101 http://127.0.0.1:9102
+
+`--merge-bridges` is the offline twin of `/debug/fleet`: each source
+is either a saved OpenMetrics exposition file or a live bridge base
+URL; the hop-labeled `packet_journey_seconds` exemplars from every
+source are stitched by trace id (service/obs_server.stitch_journeys),
+and the report lists each cross-bridge journey's spans — the packet's
+path across the cascade trunk.
 
 The capture mode runs the small loop-echo scenario (perf_gate's
 `loop_echo_pps` twin) under both `jax.profiler.trace` and an
@@ -231,18 +241,77 @@ def capture_loop_echo(log_dir: str) -> dict:
             "trace": report}
 
 
+def merge_bridges(sources: list) -> dict:
+    """Fleet journey stitch over offline scrapes and/or live bridges.
+    Each source is a file holding an OpenMetrics exposition or an
+    http(s) base URL (its /metrics is fetched with the OM Accept
+    header).  Returns the same document /debug/fleet serves."""
+    from libjitsi_tpu.service.obs_server import (fetch_metrics,
+                                                 stitch_journeys)
+    scrapes, errors = {}, {}
+    for src in sources:
+        name = src
+        try:
+            if src.startswith(("http://", "https://")):
+                scrapes[name] = fetch_metrics(src)
+            else:
+                name = os.path.basename(src)
+                with open(src, "r") as f:
+                    scrapes[name] = f.read()
+        except Exception as exc:
+            errors[name] = repr(exc)
+    doc = stitch_journeys(scrapes)
+    doc["errors"] = errors
+    return doc
+
+
+def format_fleet(doc: dict) -> str:
+    lines = ["== cross-bridge journey report =="]
+    for name, b in sorted(doc["bridges"].items()):
+        hops = ", ".join(f"{h}={int(c)}"
+                         for h, c in sorted(b["hops"].items()))
+        lines.append(f"  {name}: {b['exemplars']} journey exemplars"
+                     + (f"  [{hops}]" if hops else ""))
+    for name, err in sorted(doc.get("errors", {}).items()):
+        lines.append(f"  {name}: SCRAPE FAILED {err}")
+    stitched = doc["stitched_trace_ids"]
+    lines.append(f"  stitched journeys (seen on >1 bridge): "
+                 f"{len(stitched)}")
+    for j in doc["journeys"]:
+        if not j["stitched"]:
+            continue
+        lines.append(f"  trace {j['trace_id']}:")
+        for s in j["spans"]:
+            lines.append(f"    {s['bridge']:>16s}  hop={s['hop']:<12s}"
+                         f" {s['seconds'] * 1e3:8.3f} ms")
+    return "\n".join(lines)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("path", nargs="?",
-                    default="/tmp/libjitsi_tpu_trace",
-                    help="trace dir or *.trace.json[.gz] file")
+    ap.add_argument("path", nargs="*",
+                    default=["/tmp/libjitsi_tpu_trace"],
+                    help="trace dir or *.trace.json[.gz] file; with "
+                         "--merge-bridges, two+ exposition files or "
+                         "bridge base URLs")
     ap.add_argument("--capture-loop-echo", action="store_true",
                     help="capture a fresh loop-echo trace first")
+    ap.add_argument("--merge-bridges", action="store_true",
+                    help="stitch cross-bridge journeys from the given "
+                         "scrapes/URLs instead of reading a trace")
     ap.add_argument("--json", action="store_true",
                     help="emit the raw report dict as JSON")
     args = ap.parse_args(argv)
+    if args.merge_bridges:
+        doc = merge_bridges(args.path)
+        if args.json:
+            print(json.dumps(doc, indent=2, default=str))
+        else:
+            print(format_fleet(doc))
+        return 0 if doc["bridges"] and not doc.get("errors") else 1
+    path = args.path[0] if args.path else "/tmp/libjitsi_tpu_trace"
     if args.capture_loop_echo:
-        doc = capture_loop_echo(args.path)
+        doc = capture_loop_echo(path)
         if args.json:
             print(json.dumps(doc, indent=2, default=str))
             return 0
@@ -261,7 +330,7 @@ def main(argv=None) -> int:
               f"overhead depresses this vs the perf-gate number): "
               f"{doc['loop_echo_pps']}")
         return 0
-    report = build_report(load_events(find_trace_file(args.path)))
+    report = build_report(load_events(find_trace_file(path)))
     if args.json:
         print(json.dumps(report, indent=2, default=str))
     else:

@@ -21,8 +21,9 @@ import numpy as np
 import pytest
 
 from libjitsi_tpu.mesh.cascade import (CascadeTrunk, MAGIC_CONTROL,
-                                       KIND_NACK, TRUNK_SSRC,
-                                       TrunkConfig, TrunkRelay)
+                                       KIND_NACK, TRACE_WIRE_LEN,
+                                       TRUNK_SSRC, TrunkConfig,
+                                       TrunkRelay, TrunkTrace)
 from libjitsi_tpu.mesh.placement import ConferencePlacer
 from libjitsi_tpu.utils.metrics import MetricsRegistry
 from libjitsi_tpu.utils.slo import SlicedSloSpec, SloEngine
@@ -48,8 +49,42 @@ def test_trunk_frame_roundtrip():
     seq, wire = a.frame_media(7, _inner(1), now=0.0)
     got = b.open_media(wire, now=0.0)
     assert got is not None
-    rseq, conf, inner = got
+    rseq, conf, inner, trace = got
     assert rseq == seq and conf == 7 and inner == _inner(1)
+    assert trace is None                   # legacy frame carries none
+
+
+def test_trace_extension_roundtrip_and_legacy_interop():
+    """The journey trace rides an RTP header extension on the trunk
+    frame: a traced frame opens to the same (conf, inner) BIT-EXACT as
+    an untraced one (the extension lives in the header, outside the
+    payload slice an old peer takes), and an untraced frame opens on a
+    new peer with `trace=None` — interop both directions."""
+    a, b = _relay_pair()
+    _s, plain = a.frame_media(7, _inner(1), now=0.0)
+    tr = TrunkTrace(bridge_id=2, hop=1, trace_id=0xDEADBEEF, t0=12.5)
+    _s2, traced = a.frame_media(7, _inner(1), now=0.0, trace=tr)
+    assert len(traced) == len(plain) + TRACE_WIRE_LEN
+    got = b.open_media(plain, now=0.0)
+    assert got is not None and got[3] is None
+    got_t = b.open_media(traced, now=0.0)
+    assert got_t is not None
+    _rseq, conf, inner, rtr = got_t
+    assert (conf, inner) == (7, _inner(1))     # inner bit-exact
+    assert rtr == tr                           # µs stamp roundtrips
+
+
+def test_trunk_seq_wraps_with_trace_extension():
+    a, b = _relay_pair()
+    tr = TrunkTrace(bridge_id=0, hop=0, trace_id=1, t0=0.0)
+    a.tx_seq = 0xFFFF
+    s1, w1 = a.frame_media(7, _inner(3), now=0.0, trace=tr)
+    s2, w2 = a.frame_media(7, _inner(4), now=0.0, trace=tr)
+    assert (s1, s2) == (0xFFFF, 0)
+    g1 = b.open_media(w1, now=0.0)
+    g2 = b.open_media(w2, now=0.0)
+    assert g1 is not None and g1[3] == tr
+    assert g2 is not None and g2[2] == _inner(4)
 
 
 def test_trunk_layer_authenticates_independently():
@@ -163,7 +198,7 @@ def test_heartbeat_down_detection_and_backlog_flush():
     ta.on_down = downs.append
     ta.on_up = ups.append
     delivered = []
-    tb.deliver = lambda conf, inner: delivered.append(inner)
+    tb.deliver = lambda conf, inner, trace=None: delivered.append(inner)
     ta.cascade_conference(7)
     now = _run(ta, tb, ch, 0.0, 20)
     assert ta.state == tb.state == "up"
@@ -235,7 +270,7 @@ def test_nack_rtx_recovers_gilbert_elliott_loss():
     cfg = TrunkConfig(fec_k=0)             # isolate the NACK/RTX path
     ta, tb, ch = _trunk_pair(cfg)
     delivered = []
-    tb.deliver = lambda conf, inner: delivered.append(inner)
+    tb.deliver = lambda conf, inner, trace=None: delivered.append(inner)
     ta.cascade_conference(7)
 
     rng = np.random.default_rng(11)
@@ -271,7 +306,7 @@ def test_nack_rtx_recovers_gilbert_elliott_loss():
 def test_fec_recovers_single_loss_without_roundtrip():
     ta, tb, ch = _trunk_pair(TrunkConfig(fec_k=4))
     delivered = []
-    tb.deliver = lambda conf, inner: delivered.append(inner)
+    tb.deliver = lambda conf, inner, trace=None: delivered.append(inner)
     ta.cascade_conference(7)
     now = _run(ta, tb, ch, 0.0, 3)
     # drop exactly the second media frame of the 4-frame FEC group
@@ -368,3 +403,138 @@ def test_sliced_slo_bridge_label_axis():
         slo.on_tick()
     assert slo.slice_state("bridge_media", "0") == "ok"
     assert slo.slice_state("bridge_media", "1") != "ok"
+
+
+# ------------------------------------------- journey tracing plumbing
+
+def test_trunk_stamps_and_delivers_trace():
+    """The trunk's origin hook (latched from the loop on attach)
+    stamps hop-0 traces on every relayed frame; the receiving trunk
+    hands the decoded trace to `deliver` alongside the inner bytes."""
+    ta, tb, ch = _trunk_pair()
+    ta.bridge_id = 3
+    ta._journey_origin = lambda: (0xABC, 123.0)
+    got = []
+    tb.deliver = lambda conf, inner, trace: got.append(
+        (conf, inner, trace))
+    ta.cascade_conference(7)
+    now = _run(ta, tb, ch, 0.0, 3)
+    assert ta.relay_media(7, _inner(9), now=now)
+    now = _run(ta, tb, ch, now, 2)
+    conf, inner, trace = got[-1]
+    assert (conf, inner) == (7, _inner(9))
+    assert trace is not None
+    assert trace.bridge_id == 3 and trace.hop == 0
+    assert trace.trace_id == 0xABC and trace.t0 == 123.0
+
+
+class _StubLoop:
+    """Just enough loop for BridgeSupervisor: a registry with a
+    capacity, plus the journey-origin surface `_journey_inflight`
+    reads (trace id + pipelined dispatch origins)."""
+
+    def __init__(self):
+        self.registry = type("_R", (), {"capacity": 4})()
+        self.trace_id = 40
+        self._inflight = [(None, None, (41, 0.0), 0)]
+        self._rx_inflight = [{"origin": (42, 0.0)}]
+
+
+class _StubBridge:
+    def __init__(self):
+        self.loop = _StubLoop()
+        self.port = 0
+        self._bcast_speakers = {}
+        self._trunks = {}
+
+    def _sid_of_ssrc(self, ssrc):
+        return None
+
+    def attach_trunk(self, trunk, conf, speakers=None):
+        self._trunks[int(conf)] = trunk
+
+
+def _stub_cascade_sup(slo=None):
+    from libjitsi_tpu.service.supervisor import (CascadeSupervisor,
+                                                 SupervisorConfig)
+    tr = CascadeTrunk(KEY_AB, KEY_BA, TrunkConfig(), seed=5)
+    tr._send = lambda data: None           # no socket, no peer
+    sup = CascadeSupervisor(_StubBridge(), tr,
+                            SupervisorConfig(deadline_ms=1000.0),
+                            bridge_id=1, peer_bridge_id=0, slo=slo)
+    return sup, tr
+
+
+def test_trunk_down_conviction_captures_failover_postmortem():
+    """Trunk-down conviction writes a `trunk_failover` post-mortem —
+    {trigger, event, dump} like quarantine/shed/recover — whose event
+    names the in-flight journey set at the moment of failure."""
+    sup, tr = _stub_cascade_sup()
+    try:
+        tr.connect("127.0.0.1", 1, now=0.0)
+        now = 0.0
+        for _ in range(400):
+            now += 0.05
+            tr.pump(now)                   # heartbeats never answered
+            if tr.state == "down":
+                break
+        assert tr.state == "down"
+        pms = [p for p in sup.postmortems
+               if p["trigger"] == "trunk_failover"]
+        assert len(pms) == 1
+        pm = pms[0]
+        assert pm["event"]["kind"] == "trunk_failover"
+        assert pm["event"]["peer"] == 0
+        # the loop's live trace + both pipelined dispatch origins
+        assert pm["event"]["inflight"] == [40, 41, 42]
+        assert pm["dump"]
+        assert tr.heartbeat_misses_total > 0
+    finally:
+        tr.close()
+
+
+def test_adoption_commit_captures_failover_postmortem():
+    """The second half of the failover story: every orphan adoption
+    COMMIT appends its own `trunk_failover` post-mortem carrying the
+    `orphan_adopted` event and the adopted stream's flight dump."""
+    sup, tr = _stub_cascade_sup()
+    try:
+        tr.cascade_conference(7)
+        sup._conf_outstanding[7] = 1
+        sup._adopt_done({"conf": 7, "m": {"ssrc": 0x111}, "n": 1,
+                         "attempts": 0, "promote": True}, sid=3)
+        pms = [p for p in sup.postmortems
+               if p["trigger"] == "trunk_failover"]
+        assert len(pms) == 1
+        assert pms[0]["sid"] == 3
+        assert pms[0]["event"]["kind"] == "orphan_adopted"
+        assert pms[0]["event"]["ssrc"] == 0x111
+        assert sup.orphans_adopted == 1
+    finally:
+        tr.close()
+
+
+def test_hop_slo_burn_gates_admission():
+    """`SlicedSloSpec(label="hop")` over the hop-labeled journey
+    children: a hop whose tail blows the trunk deadline budget drives
+    its slice to fast_burn, and `admission_decision` refuses joins
+    with the typed `hop_burn` — per-hop, like shard_burn."""
+    reg = MetricsRegistry()
+    slo = SloEngine(reg)
+    sup, tr = _stub_cascade_sup(slo=slo)
+    try:
+        assert any(s.name == "hop_journey" and s.label == "hop"
+                   for s in slo.sliced)
+        from libjitsi_tpu.io.loop import JOURNEY_BUCKETS
+        vec = reg.histogram_vec("packet_journey_seconds",
+                                JOURNEY_BUCKETS, "hop", exemplars=True)
+        sup._journey_vec = vec
+        assert sup.admission_decision() == (True, "ok")
+        h = vec.labels("b0-b1")
+        for _ in range(80):                # every journey past budget
+            h.observe(1.0)
+            slo.on_tick()
+        assert "b0-b1" in slo.burning_slices("hop_journey")
+        assert sup.admission_decision() == (False, "hop_burn")
+    finally:
+        tr.close()

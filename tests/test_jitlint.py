@@ -574,6 +574,69 @@ def test_drift_exemplar_histogram_fed_anywhere_clean():
     assert check_metrics_drift({a.relpath: a, b.relpath: b}) == []
 
 
+def test_drift_exemplar_histogram_vec_never_fed_fires():
+    """A hop-labeled HistogramVec created with exemplars=True whose
+    children only ever observe WITHOUT exemplar= ships empty exemplar
+    slots on every label — same bug as the plain-histogram case, one
+    label axis over."""
+    src = """
+    class Sup:
+        def __init__(self, registry):
+            self.journey_vec = registry.histogram_vec(
+                "packet_journey_seconds", (0.001, 0.01), "hop",
+                exemplars=True)
+
+        def note_hop(self, hop, dt):
+            self.journey_vec.labels(hop).observe(dt)
+    """
+    ctx = ctx_of(src)
+    found = check_metrics_drift({ctx.relpath: ctx})
+    assert len(found) == 1
+    assert "exemplar" in found[0].message
+    assert "journey_vec" in found[0].message
+
+
+def test_drift_exemplar_histogram_vec_chained_labels_feed_clean():
+    """The chained `vec.labels(hop).observe(..., exemplar=...)` idiom
+    feeds the vec's exemplar slots — must not false-positive; the same
+    for a bound child (`h = vec.labels("local")`) fed through its
+    local name."""
+    src = """
+    class Sup:
+        def __init__(self, registry):
+            self.journey_vec = registry.histogram_vec(
+                "packet_journey_seconds", (0.001, 0.01), "hop",
+                exemplars=True)
+            self.local_hist = self.journey_vec.labels("local")
+
+        def note_hop(self, hop, dt, trace):
+            self.journey_vec.labels(hop).observe(
+                dt, exemplar={"trace_id": str(trace)})
+    """
+    ctx = ctx_of(src)
+    assert check_metrics_drift({ctx.relpath: ctx}) == []
+
+
+def test_drift_exemplar_vec_fed_via_bound_child_alias_clean():
+    """A vec fed ONLY through a bound child histogram
+    (`h = vec.labels(x)` then `h.observe(..., exemplar=...)`) is fed —
+    the labels() alias edge credits the parent vec."""
+    src = """
+    class Loop:
+        def __init__(self, registry):
+            self.journey_vec = registry.histogram_vec(
+                "packet_journey_seconds", (0.001, 0.01), "hop",
+                exemplars=True)
+            self.journey_hist = self.journey_vec.labels("local")
+
+        def on_egress(self, dt, trace):
+            self.journey_hist.observe(
+                dt, exemplar={"trace_id": str(trace)})
+    """
+    ctx = ctx_of(src)
+    assert check_metrics_drift({ctx.relpath: ctx}) == []
+
+
 def test_drift_histogram_observed_but_never_registered_fires():
     """A Histogram constructed and fed but never handed to the
     registry records distributions nobody can scrape."""
