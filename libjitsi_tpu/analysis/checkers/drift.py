@@ -31,6 +31,23 @@ the hop-labeled ``packet_journey_seconds``) get the same treatment:
 slots, and a child bound via ``h = vec.labels(x)`` aliases its
 observes back to the parent vec.
 
+**Admission-reason drift** (global, cross-file): every refusal the
+admission plane can hand a caller is TYPED — the string lives in the
+``ADMIT_REASONS`` tuple in ``service/lifecycle.py``, and metrics
+(``lifecycle_admit_rejected{reason=...}``), flight events, retry-after
+hints and the soak gates' ``refused ⊆ ADMIT_REASONS`` assertions all
+key off it.  A refusal site that returns a literal NOT in the tuple
+(``return False, "mystery"``) ships an untyped reason: the smoke gates
+fail it as an unknown key and dashboards can't label it.  We collect
+the tuple literal plus every string a function named ``*admit*`` /
+``*admission*`` refuses with (both the ``(False, "reason")`` pair and
+the bare ``return "reason"`` form; ``"ok"`` is the accept token, not a
+reason) and flag undeclared literals.  The same pass pins the
+``capacity_forecast`` reason to its observability contract: a tree
+that declares it must also register the ``capacity_*`` families
+(headroom / bottleneck / confidence / forecast-refusals), else the
+forecast refuses joins with no scrapeable explanation.
+
 **Perf-baseline drift** (global, disk-backed): ``PERF_BASELINE.json``
 keys must match the ``SCENARIOS`` ids in ``scripts/perf_gate.py`` both
 ways — a stale key gates nothing, and a scenario without a baseline
@@ -172,8 +189,39 @@ def file_facts(ctx: FileContext) -> dict:
     labels_alias: List[List] = []
     attr_names: Set[str] = set()
     reg_counter_names: List[List] = []
+    admit_decl: List[List] = []
+    admit_refusals: List[List] = []
 
     for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "ADMIT_REASONS"
+                for t in node.targets) and \
+                isinstance(node.value, (ast.Tuple, ast.List)):
+            admit_decl.append(
+                [sorted(e.value for e in node.value.elts
+                        if isinstance(e, ast.Constant) and
+                        isinstance(e.value, str)),
+                 node.lineno, node.col_offset])
+        if isinstance(node, ast.FunctionDef) and \
+                ("admit" in node.name or "admission" in node.name):
+            for n in ast.walk(node):
+                if not isinstance(n, ast.Return) or n.value is None:
+                    continue
+                lit = None
+                if isinstance(n.value, ast.Tuple) and \
+                        len(n.value.elts) == 2:
+                    ok, reason = n.value.elts
+                    if isinstance(ok, ast.Constant) and \
+                            ok.value is False and \
+                            isinstance(reason, ast.Constant) and \
+                            isinstance(reason.value, str):
+                        lit = reason.value
+                elif isinstance(n.value, ast.Constant) and \
+                        isinstance(n.value.value, str):
+                    lit = n.value.value
+                if lit is not None and lit != "ok":
+                    admit_refusals.append(
+                        [lit, node.name, n.lineno, n.col_offset])
         if isinstance(node, ast.Attribute):
             attr_names.add(node.attr)
         if isinstance(node, ast.Assign) and \
@@ -344,6 +392,8 @@ def file_facts(ctx: FileContext) -> dict:
         "labels_alias": labels_alias,
         "attr_names": sorted(attr_names),
         "reg_counter_names": reg_counter_names,
+        "admit_decl": admit_decl,
+        "admit_refusals": admit_refusals,
     }
 
 
@@ -502,6 +552,7 @@ def check_metrics_drift(index) -> List[Finding]:
     exemplar_fed: Set[str] = set()
     all_attr_names: Set[str] = set()
     alias_parents: Dict[str, Set[str]] = {}
+    declared_reasons: Set[str] = set()
     for _rel, d, _f in views:
         registered |= set(d["reg_attrs"])
         hist_registered |= set(d["hist_reg"])
@@ -511,6 +562,8 @@ def check_metrics_drift(index) -> List[Finding]:
         all_attr_names |= set(d["attr_names"])
         for child, parent in d.get("labels_alias", ()):
             alias_parents.setdefault(child, set()).add(parent)
+        for names, _l, _c in d.get("admit_decl", ()):
+            declared_reasons |= set(names)
     # a fed vec child (or local alias) feeds its parent's exemplar
     # slots too — fixpoint over the alias edges
     changed = True
@@ -575,6 +628,45 @@ def check_metrics_drift(index) -> List[Finding]:
                     "exemplars=True but no observe call ever passes "
                     "exemplar= — its exemplar slots stay empty in "
                     "every OpenMetrics scrape"))
+
+        # admission-reason half: a refusal literal outside the typed
+        # ADMIT_REASONS tuple is an untyped reason — the
+        # admit_rejected{reason=...} label set, the flight recorder
+        # and the soak gates' `refused <= ADMIT_REASONS` subset
+        # assertions all miss it.  Only active once some file in the
+        # tree declares the tuple (fixture trees without an admission
+        # plane are out of scope).
+        if declared_reasons:
+            for lit, fn, line, col in d.get("admit_refusals", ()):
+                if lit not in declared_reasons:
+                    findings.append(finder.finding(
+                        RULE, line, col,
+                        f"`{fn}` refuses admission with reason "
+                        f"`{lit}` that ADMIT_REASONS never declares "
+                        "— untyped refusal: the admit_rejected "
+                        "metric grows an unknown label and the "
+                        "churn/global-day gates fail their subset "
+                        "check (declare it in service/lifecycle.py)"))
+
+        # capacity contract: declaring the forecast refusal without
+        # registering the capacity_* families leaves the forecast
+        # refusing joins with no scrapeable explanation
+        for names, line, col in d.get("admit_decl", ()):
+            if "capacity_forecast" not in names:
+                continue
+            for fam_name in ("capacity_headroom_users",
+                             "capacity_bottleneck",
+                             "capacity_estimate_confidence",
+                             "capacity_forecast_refusals"):
+                if not _family_known(fam_name):
+                    findings.append(finder.finding(
+                        RULE, line, col,
+                        "ADMIT_REASONS declares `capacity_forecast` "
+                        f"but no registration defines the `{fam_name}` "
+                        "family — the forecast would refuse joins "
+                        "with no scrapeable headroom explanation "
+                        "(register the CapacityModel gauges or drop "
+                        "the reason)"))
 
         # vice versa: registered attribute names that exist nowhere
         for name, line, col in d["reg_counter_names"]:

@@ -248,7 +248,7 @@ class MediaLoop:
         self.perf = PhaseProfiler(
             metrics=self.metrics, sample_every=phase_sample_every,
             tracer=self.tracer,
-            inflight_fn=lambda: self.dispatch_inflight_ticks)
+            inflight_fn=lambda: self._inflight_age())
 
     # ------------------------------------------------------ drain rings
     @property
@@ -632,15 +632,21 @@ class MediaLoop:
         self._release_token(token, eng)
 
     # --------------------------------------------------- deep pipeline
-    def _note_inflight_age(self) -> None:
+    def _inflight_age(self) -> int:
         """Age (ticks) of the oldest un-materialized dispatch, across
         both the egress (`_inflight`) and reverse (`_rx_inflight`)
-        pipelines — the depth the phase profiler reports."""
-        self.dispatch_inflight_ticks = max(
+        pipelines — computed LIVE so a scrape of a parked loop sees
+        the current pipeline depth (e.g. zero after a drain), not the
+        value frozen at the last tick."""
+        return max(
             max((self.ticks - t for _p, _m, _o, t in self._inflight),
                 default=0),
             max((self.ticks - e["tick"] for e in self._rx_inflight),
                 default=0))
+
+    def _note_inflight_age(self) -> None:
+        """Per-tick snapshot the phase ledger consumers read."""
+        self.dispatch_inflight_ticks = self._inflight_age()
 
     def _release_token(self, token, eng=None) -> None:
         if token is not None:

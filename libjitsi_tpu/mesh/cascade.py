@@ -308,6 +308,21 @@ class TrunkRelay:
         return (nacks.get(TRUNK_SSRC, []), expired.get(TRUNK_SSRC, []))
 
 
+class _TrunkView:
+    """Scrape-time indirection for trunk metrics: forwards every
+    attribute read to the owner's CURRENT `.trunk`, so registered
+    callables survive the trunk instance being replaced (recovery
+    constructs a fresh one — sockets don't outlive a crash)."""
+
+    __slots__ = ("_owner",)
+
+    def __init__(self, owner):
+        self._owner = owner
+
+    def __getattr__(self, name):
+        return getattr(self._owner.trunk, name)
+
+
 class CascadeTrunk:
     """One end of a bridge-to-bridge trunk: socket, liveness state
     machine, typed relay admission, and the conference/speaker/roster
@@ -675,8 +690,19 @@ class CascadeTrunk:
         self._send(bytes([MAGIC_CONTROL, kind]) + body)
 
     # ----------------------------------------------------------- metrics
-    def register_metrics(self, registry, prefix: str = "trunk") -> None:
-        registry.register_counters(self, [
+    def register_metrics(self, registry, prefix: str = "trunk",
+                         owner=None) -> None:
+        """`owner`: an object whose `.trunk` attribute names the
+        CURRENT trunk (CascadeSupervisor passes itself).  Every gauge
+        and counter then resolves through it AT SCRAPE TIME, so a
+        trunk replaced under the supervisor (failover recovery hands
+        the restored supervisor a fresh trunk — sockets don't survive
+        a crash) keeps the metrics live instead of frozen on the dead
+        instance's closures."""
+        live = (lambda: owner.trunk) if owner is not None \
+            else (lambda: self)
+        target = self if owner is None else _TrunkView(owner)
+        registry.register_counters(target, [
             ("heartbeats_total", "trunk heartbeats sent"),
             ("heartbeat_misses_total",
              "trunk heartbeats that aged out unanswered"),
@@ -691,19 +717,21 @@ class CascadeTrunk:
             ("unprotect_drops_total", "trunk frames failing SRTP auth"),
             ("oversize_drops_total", "inner packets over trunk MTU"),
         ], prefix=prefix)
-        registry.register_scalar(f"{prefix}_relay_pps", self.relay_pps,
+        registry.register_scalar(f"{prefix}_relay_pps",
+                                 lambda: float(live().relay_pps()),
                                  help_="relayed frames/s (sliding 2s)",
                                  kind="gauge")
         registry.register_scalar(
             f"{prefix}_state_up",
-            lambda: 1.0 if self.state == "up" else 0.0,
+            lambda: 1.0 if live().state == "up" else 0.0,
             help_="1 while the trunk liveness state is up")
         registry.register_scalar(
-            f"{prefix}_tx_backlog", lambda: float(len(self._tx_queue)),
+            f"{prefix}_tx_backlog",
+            lambda: float(len(live()._tx_queue)),
             help_="frames queued while the trunk is down")
         registry.register_scalar(
             f"{prefix}_heartbeat_miss_streak",
-            lambda: float(self._hb_miss_streak),
+            lambda: float(live()._hb_miss_streak),
             help_="consecutive unanswered heartbeats (refreshed on "
                   "send/ingress, not just pump)")
         self._rtt_ring = registry.timing(f"{prefix}_rtt")

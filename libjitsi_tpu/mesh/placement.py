@@ -464,6 +464,15 @@ class ConferencePlacer:
         """Per-shard (cost, rows, conferences) — /debug + metrics."""
         return [(ld.cost, ld.rows, ld.confs) for ld in self._loads]
 
+    def shard_utilization(self) -> List[float]:
+        """Per-shard row-range fullness in [0, 1] — the capacity
+        plane's forecast-exhaustion signal (utils/capacity.py steers
+        placement away from shards past its exhaustion fraction the
+        way `shard_burn` steering avoids burning ones)."""
+        if not self.rows_per_shard:
+            return [0.0] * self.n_shards
+        return [ld.rows / self.rows_per_shard for ld in self._loads]
+
     def plan_rebalance(self) -> List[PlacementMove]:
         """Propose up to `max_moves` conference moves that shrink the
         max-shard cost.  Pure planning: accounting updates when the

@@ -68,7 +68,8 @@ _log = get_logger("lifecycle")
 #: (typed: metrics, flight events and callers all share these strings)
 ADMIT_REASONS = ("capacity", "backlog", "duplicate", "fast_burn",
                  "stalled", "shedding", "host_bound", "shard_burn",
-                 "handshake_backlog", "trunk_down", "trunk_backlog")
+                 "hop_burn", "handshake_backlog", "trunk_down",
+                 "trunk_backlog", "capacity_forecast")
 
 
 @dataclass
@@ -395,7 +396,7 @@ class StreamLifecycleManager:
         if role == "speaker":
             if self.supervisor is not None:
                 ok, r = self.supervisor.admission_decision(shard=home)
-                if not ok and r == "shard_burn":
+                if not ok and r in ("shard_burn", "capacity_forecast"):
                     return None, r
             if not self.placer.try_grow(conf):
                 return None, "capacity"
@@ -436,6 +437,11 @@ class StreamLifecycleManager:
         trunk's jittered-exponential backoff."""
         if reason == "handshake_backlog" and self.handshakes is not None:
             return self.handshakes.retry_after
+        if reason == "capacity_forecast":
+            cap = getattr(self.supervisor, "capacity", None) \
+                if self.supervisor is not None else None
+            if cap is not None:
+                return float(cap.retry_after())
         if reason in ("trunk_down", "trunk_backlog"):
             trunk = None
             if conference is not None:
@@ -491,14 +497,21 @@ class StreamLifecycleManager:
         return None
 
     def _burning_shards(self) -> set:
+        """Shards placement must steer around: fast-burning per-shard
+        SLO slices, plus shards the capacity forecast already calls
+        exhausted (utils/capacity.py) — same avoidance surface, one
+        reactive signal and one predictive."""
         sup = self.supervisor
-        slo = getattr(sup, "slo", None) if sup is not None else None
-        if slo is None:
-            return set()
         out: set = set()
-        for spec in getattr(slo, "sliced", ()):
-            if spec.label == "shard":
-                out |= {int(k) for k in slo.burning_slices(spec.name)}
+        slo = getattr(sup, "slo", None) if sup is not None else None
+        if slo is not None:
+            for spec in getattr(slo, "sliced", ()):
+                if spec.label == "shard":
+                    out |= {int(k)
+                            for k in slo.burning_slices(spec.name)}
+        cap = getattr(sup, "capacity", None) if sup is not None else None
+        if cap is not None:
+            out |= {int(s) for s in cap.exhausted_shards()}
         return out
 
     def _place_join(self, ssrc: int, conference) -> Tuple[Optional[int],
@@ -514,7 +527,7 @@ class StreamLifecycleManager:
         if shard is not None:
             if self.supervisor is not None:
                 ok, r = self.supervisor.admission_decision(shard=shard)
-                if not ok and r == "shard_burn":
+                if not ok and r in ("shard_burn", "capacity_forecast"):
                     return conf, r
             if not self.placer.try_grow(conf):
                 return conf, "capacity"

@@ -12,6 +12,9 @@ A threaded `http.server` (no framework, no new deps) serving:
   /debug/slo            SloEngine status: per-SLO burn rates over the
                         four windows, states, thresholds; plus the
                         supervisor's host/device phase attribution
+  /debug/capacity       CapacityModel status: per-resource utilization
+                        fits, users-per-chip headroom, bottleneck,
+                        forecast-refusal state (utils/capacity.py)
   /debug/device         live device-memory stats per device
                         (utils/profiling.device_memory)
   /debug/streams/<sid>  flight-recorder dump for one stream
@@ -33,6 +36,7 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
@@ -43,7 +47,8 @@ from libjitsi_tpu.utils.logging import get_logger
 from libjitsi_tpu.utils.metrics import (CONTENT_TYPE_OPENMETRICS,
                                         CONTENT_TYPE_PROM,
                                         _parse_labels, _split_exemplar,
-                                        parse_exposition)
+                                        parse_exposition,
+                                        process_families_text)
 
 _log = get_logger("service.obs")
 
@@ -158,8 +163,8 @@ class ObservabilityServer:
     """Serve /metrics, /healthz and flight-recorder debug dumps."""
 
     def __init__(self, metrics=None, supervisor=None, flight=None,
-                 slo=None, host: str = "127.0.0.1", port: int = 0,
-                 name: str = "local",
+                 slo=None, capacity=None, host: str = "127.0.0.1",
+                 port: int = 0, name: str = "local",
                  peers: Optional[Dict[str, str]] = None):
         self.metrics = metrics
         self.supervisor = supervisor
@@ -167,6 +172,8 @@ class ObservabilityServer:
         self._flight = flight
         # explicit slo engine wins; else follow the supervisor's
         self._slo = slo
+        # explicit capacity model wins; else follow the supervisor's
+        self._capacity = capacity
         self.host = host
         self.port = int(port)
         # fleet axis: this bridge's name plus peer name -> base URL,
@@ -191,11 +198,26 @@ class ObservabilityServer:
             return self._slo
         return getattr(self.supervisor, "slo", None)
 
+    @property
+    def capacity(self):
+        if self._capacity is not None:
+            return self._capacity
+        return getattr(self.supervisor, "capacity", None)
+
     # ---------------------------------------------------------- handlers
     def _metrics_text(self, openmetrics: bool = False) -> str:
         if self.metrics is None:
             return "# EOF\n" if openmetrics else "\n"
-        return self.metrics.render(openmetrics=openmetrics)
+        # standard process families ride every scrape, un-namespaced
+        # (stock Prometheus `up`/restart detection); scrape_duration is
+        # THIS response's registry render wall time.  The OpenMetrics
+        # `# EOF` terminator must stay last, so splice before it.
+        t0 = time.perf_counter()
+        text = self.metrics.render(openmetrics=openmetrics)
+        extra = process_families_text(time.perf_counter() - t0)
+        if openmetrics and text.endswith("# EOF\n"):
+            return text[:-len("# EOF\n")] + extra + "# EOF\n"
+        return text + extra
 
     def _health(self) -> dict:
         if self.supervisor is None:
@@ -229,6 +251,14 @@ class ObservabilityServer:
                 doc["attribution"] = sup.phase_attribution()
             return (200, "application/json",
                     json.dumps(doc,
+                               default=_jsonable).encode("utf-8"))
+        if path == "/debug/capacity":
+            cap = self.capacity
+            if cap is None:
+                return (404, "application/json",
+                        b'{"error": "no capacity model attached"}')
+            return (200, "application/json",
+                    json.dumps(cap.status(),
                                default=_jsonable).encode("utf-8"))
         if path == "/debug/device":
             # live device-memory stats (utils/profiling.device_memory):

@@ -163,6 +163,11 @@ class BridgeSupervisor:
         # cadence; the recv_window rung clamps its window writes so the
         # ladder and the tuner never fight over the same knob
         self.batcher = None
+        # optional CapacityModel (utils/capacity.py): fed each tick,
+        # consulted by admission_decision (capacity_forecast) and the
+        # lifecycle plane's placement steering / retry-after hints
+        self.capacity = None
+        self.last_tick_s = 0.0
         self._quarantined: Dict[int, int] = {}  # sid -> release tick
         self._q_strikes: Dict[int, int] = {}    # sid -> conviction count
         self.quarantine_total = 0
@@ -193,7 +198,8 @@ class BridgeSupervisor:
         t0 = self.clock()
         result = (self.bridge.tick(now=now) if now is not None
                   else self.bridge.tick())
-        over = self.watchdog.observe(self.clock() - t0)
+        self.last_tick_s = self.clock() - t0
+        over = self.watchdog.observe(self.last_tick_s)
         if lc is not None:
             lc.tick_end()
         if self.tracer is not None:
@@ -209,6 +215,8 @@ class BridgeSupervisor:
             self.slo.on_tick()
         if self.batcher is not None:
             self.batcher.on_tick()
+        if self.capacity is not None:
+            self.capacity.on_tick(self)
         self._update_quarantine()
         if over:
             self._good = 0
@@ -464,6 +472,14 @@ class BridgeSupervisor:
             _phase, _s, share, bound = self._phase_attr()
             if bound == "host" and share >= self.cfg.stage_share_threshold:
                 return False, "host_bound"
+        if self.capacity is not None and \
+                self.capacity.should_refuse(shard=shard):
+            # forecast refusal (utils/capacity.py): every hard signal
+            # above is still green, but a confident headroom fit says
+            # this join won't fit before one of them fires — refuse
+            # NOW, typed and with a retry-after hint, instead of
+            # admitting into a forecast brown-out
+            return False, "capacity_forecast"
         return True, "ok"
 
     # ------------------------------------------------------ quarantine
@@ -1233,7 +1249,9 @@ class CascadeSupervisor(BridgeSupervisor):
     def register_metrics(self, registry,
                          prefix: str = "supervisor") -> None:
         super().register_metrics(registry, prefix)
-        self.trunk.register_metrics(registry)
+        # owner indirection: gauges follow THIS supervisor's current
+        # trunk, so a recovery-supplied replacement stays observable
+        self.trunk.register_metrics(registry, owner=self)
         registry.register_scalar(
             "trunk_failovers_total",
             lambda: self.trunk_failovers_total,

@@ -699,6 +699,33 @@ def count_exemplars(text: str) -> int:
                if not _validate_exemplar(lineno, name, raw))
 
 
+#: unix time this process imported the metrics plane — the standard
+#: `process_start_time_seconds` export (stock Prometheus compares it
+#: across scrapes for restart detection; import time is within
+#: milliseconds of exec for any real bridge process)
+_PROCESS_START_S = time.time()
+
+
+def process_families_text(scrape_duration_s: float,
+                          start_time_s: Optional[float] = None) -> str:
+    """Exposition text for the standard (un-namespaced) Prometheus
+    process families the ObservabilityServer appends to every
+    `/metrics` response: `process_start_time_seconds` (restart
+    detection) and `scrape_duration_seconds` (this scrape's render
+    wall time).  Appended BEFORE the OpenMetrics `# EOF` terminator by
+    the caller."""
+    start = _PROCESS_START_S if start_time_s is None else start_time_s
+    return (
+        "# HELP process_start_time_seconds unix time the exporting "
+        "process started\n"
+        "# TYPE process_start_time_seconds gauge\n"
+        f"process_start_time_seconds {float(start):.3f}\n"
+        "# HELP scrape_duration_seconds wall time spent rendering "
+        "this scrape\n"
+        "# TYPE scrape_duration_seconds gauge\n"
+        f"scrape_duration_seconds {_fmt(float(scrape_duration_s))}\n")
+
+
 def validate_exposition(text: str, openmetrics: bool = False
                         ) -> List[str]:
     """Return a list of format violations (empty == valid): every
@@ -801,4 +828,15 @@ def validate_exposition(text: str, openmetrics: bool = False
                 errors.append(f"summary {fam}: missing _sum")
             if not any(s[0] == fam + "_count" for s in fam_samples):
                 errors.append(f"summary {fam}: missing _count")
+    # standard process families (un-namespaced, appended by the
+    # ObservabilityServer): stock Prometheus derives `up`/restart
+    # detection from these, so nonsense values are format violations
+    for _n, _l, value in by_family.get("process_start_time_seconds", ()):
+        if value <= 0.0:
+            errors.append("process_start_time_seconds must be a "
+                          f"positive unix time, got {value:g}")
+    for _n, _l, value in by_family.get("scrape_duration_seconds", ()):
+        if value < 0.0:
+            errors.append("scrape_duration_seconds must be "
+                          f">= 0, got {value:g}")
     return errors

@@ -1,0 +1,646 @@
+#!/usr/bin/env python
+"""Global-day scenario matrix: a compressed "day of the world" against
+one bridge, validating the live capacity-headroom estimator
+(utils/capacity.py) against measured saturation.
+
+The matrix composes the traffic shapes the other soaks exercise in
+isolation — meetings (small conferences), 1:1 calls, one broadcast with
+listeners, talk-spurt probe media under Gilbert–Elliott mobile loss,
+and a mid-day reconnect storm — into a diurnal sweep across placement
+shards, then drives the bridge into overload with the CapacityModel
+attached and finally measures TRUE saturation with the model detached
+(the estimator must never grade its own homework).
+
+Acceptance gates (every `ok_*` must hold):
+
+- the frozen `predicted_saturation` (taken while forecast admission was
+  still holding the population BELOW the wall) lands within
+  `--error-bound` (25%) of the measured hard-saturation population;
+- `capacity_forecast` refusals fire BEFORE hard overload: the first
+  overload-phase refusal is the forecast, and zero SLO fast-burn
+  windows occur while forecast refusals are active;
+- every refusal is TYPED (in ADMIT_REASONS, visible in the metrics
+  scrape) and carries a retry-after hint the storm/overload clients
+  honor with exponential backoff — and every storm client gets back in;
+- ZERO data-path recompiles after priming, across the whole sweep
+  (day, storm, overload AND the detached-model measure phase: growth
+  to full capacity rides the pre-warmed bucket ladder);
+- probe media survives the day: residual loss after NACK recovery
+  under `--residual-bound` despite the bursty GE channel.
+
+The measured users-per-chip lands in a meta-stamped CAPACITY.json at
+the repo root, regression-gated like PERF_BASELINE.json (same `_meta`
+discipline via perf_gate.baseline_meta, same engine-mode guard, same
+dirty-tree refusal on `--write-baseline`).
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/global_day.py            # full
+    JAX_PLATFORMS=cpu python scripts/global_day.py --smoke    # tier-1
+    JAX_PLATFORMS=cpu python scripts/global_day.py --smoke \
+        --write-baseline                                # re-baseline
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import libjitsi_tpu  # noqa: E402
+from libjitsi_tpu.service.lifecycle import (  # noqa: E402
+    ADMIT_REASONS, StreamLifecycleManager)
+from libjitsi_tpu.service.sfu_bridge import SfuBridge  # noqa: E402
+from libjitsi_tpu.service.supervisor import (  # noqa: E402
+    BridgeSupervisor, SupervisorConfig)
+from libjitsi_tpu.utils.capacity import (  # noqa: E402
+    CapacityConfig, CapacityModel, predicted_saturation)
+from libjitsi_tpu.utils.faults import (  # noqa: E402
+    ChurnModel, DiurnalProfile, GilbertElliott, TalkSpurtModel)
+from libjitsi_tpu.utils.slo import SloEngine, default_slos  # noqa: E402
+
+from churn_soak import _keys, _Probe  # noqa: E402
+from perf_gate import (  # noqa: E402
+    _engine_mode, _git_dirty_files, baseline_meta)
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__),
+                                    os.pardir))
+BASELINE = os.path.join(REPO, "CAPACITY.json")
+BCAST_CONF = 9                    # the day's one broadcast conference
+PROBE_CONF = 8                    # persistent media probes' meeting
+DEFAULT_TOLERANCE = 0.25
+
+
+class _GeWire:
+    """Numpy-rng adapter that turns `_Probe.drain`'s uniform drop draw
+    into a Gilbert–Elliott bursty channel: `drain` computes
+    `rng.random(n) < drop_rate`, so returning 0.0 for packets the GE
+    chain drops (and 1.0 otherwise) maps the burst mask through any
+    drop_rate in (0, 1] unchanged — and drop_rate=0.0 still disables
+    loss entirely (settle phases)."""
+
+    def __init__(self, ge: GilbertElliott, seed: int):
+        self.ge = ge
+        self._rng = np.random.default_rng(seed)
+
+    def random(self, n=None):
+        if n is None:
+            return self._rng.random()
+        return np.where(self.ge.losses(int(n), self._rng), 0.0, 1.0)
+
+
+class _Matrix:
+    """Scenario composition: maps each churn join event onto a meeting,
+    a 1:1 call, or a broadcast-listener join, and tracks who is alive
+    so leaves hit a random committed participant."""
+
+    def __init__(self, lc, bridge, seed: int, meeting_size: int = 8):
+        self.lc = lc
+        self.bridge = bridge
+        self.rng = np.random.default_rng(seed)
+        self.meeting_size = meeting_size
+        self.meetings: dict = {}       # conf -> population
+        self.waiting_call = None       # 1:1 conf with one leg so far
+        self.next_conf = 100
+        self.next_ssrc = 0x10000
+        self.alive: dict = {}          # ssrc -> conf
+        self.refusals: list = []       # (reason, retry_after_hint)
+        self.by_kind = {"meeting": 0, "call": 0, "bcast_listener": 0}
+
+    def _pick_conference(self):
+        r = float(self.rng.random())
+        if r < 0.55:                                  # meeting
+            open_ = [c for c, n in self.meetings.items()
+                     if n < self.meeting_size]
+            if open_:
+                conf = open_[int(self.rng.integers(len(open_)))]
+            else:
+                conf = self.next_conf
+                self.next_conf += 1
+                self.meetings[conf] = 0
+            return conf, "meeting"
+        if r < 0.80:                                  # 1:1 call
+            if self.waiting_call is not None:
+                conf, self.waiting_call = self.waiting_call, None
+            else:
+                conf = self.next_conf
+                self.next_conf += 1
+                self.waiting_call = conf
+            return conf, "call"
+        return BCAST_CONF, "bcast_listener"           # broadcast
+
+    def join(self, conference=None, kind=None):
+        """One join attempt; returns (ok, reason, ssrc, conf)."""
+        if conference is None:
+            conference, kind = self._pick_conference()
+        ssrc = self.next_ssrc
+        self.next_ssrc += 1
+        ok, reason = self.lc.request_join(
+            ssrc, _keys(ssrc & 0xFF), _keys((ssrc + 2) & 0xFF),
+            conference=conference)
+        if ok:
+            self.alive[ssrc] = conference
+            if conference in self.meetings:
+                self.meetings[conference] += 1
+            self.by_kind[kind or "call"] = \
+                self.by_kind.get(kind or "call", 0) + 1
+        else:
+            self.refusals.append(
+                (reason, self.lc.retry_after_hint(reason)))
+        return ok, reason, ssrc, conference
+
+    def leave(self, n: int) -> int:
+        committed = set(self.bridge._ssrc_of.values())
+        pool = [s for s in self.alive if s in committed]
+        self.rng.shuffle(pool)
+        left = 0
+        for ssrc in pool[:n]:
+            self.lc.request_leave(ssrc=ssrc)
+            conf = self.alive.pop(ssrc)
+            if conf in self.meetings:
+                self.meetings[conf] = max(0, self.meetings[conf] - 1)
+            left += 1
+        return left
+
+    def room(self) -> int:
+        """Joins the pending queue can absorb this tick without
+        tripping the backlog bar (the broadcast soak's pacing rule)."""
+        lc = self.lc
+        pending = len(lc._join_q) + len(lc._staged)
+        return max(0, min(lc.cfg.max_pending - pending - 1,
+                          lc.cfg.install_batch))
+
+
+def run_global_day(dt: float = 0.02, capacity: int = 512,
+                   n_shards: int = 4, probes: int = 3,
+                   day_s: float = 10.0, join_rate_hz: float = 150.0,
+                   mean_hold_s: float = 1.5, storm_size: int = 96,
+                   overload_ticks: int = 300,
+                   measure_ticks: int = 800,
+                   error_bound: float = 0.25,
+                   residual_bound: float = 0.05,
+                   drop_rate: float = 0.5, seed: int = 0,
+                   verbose: bool = True, report_path=None) -> dict:
+    """Run the matrix; returns the report dict (every `ok_*` must
+    hold).  `drop_rate` only scales which GE-dropped packets count
+    (see `_GeWire`); the loss process itself is the bursty chain."""
+    import jax
+
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    if capacity % n_shards:
+        capacity += n_shards - capacity % n_shards
+    cfg = libjitsi_tpu.configuration_service()
+    bridge = SfuBridge(cfg, port=0, capacity=capacity,
+                       recv_window_ms=0)
+    reg = bridge.loop.metrics
+    # journey budget covers one NACK recovery cycle (detect at the
+    # next odd-tick nack_round, RTX the tick after): with mobile loss
+    # in the matrix, recovered packets legitimately take 3-4 ticks,
+    # and the default one-tick budget would read healthy recovery as
+    # an SLO burn and slam the admission door on the whole day
+    slo = SloEngine(reg, default_slos(tick_budget_s=4 * dt))
+    sup = BridgeSupervisor(
+        bridge,
+        SupervisorConfig(deadline_ms=1000.0,
+                         quarantine_auth_threshold=1 << 30,
+                         quarantine_replay_threshold=1 << 30),
+        metrics=reg, slo=slo)
+    lc = StreamLifecycleManager(bridge, supervisor=sup, metrics=reg)
+    lc.enable_placement(n_shards)
+    # forecast guard sized to the fleet: refuse while a burst of ~15%
+    # of capacity could still land, so the forecast wall stands well
+    # clear of the hard row wall (and of the per-shard exhaustion bar)
+    # ewma_alpha raised from the default: overload pushes population
+    # several users per tick, and a sluggish utilization average would
+    # overstate headroom until the wall is already at the door
+    model = CapacityModel(
+        CapacityConfig(guard_users=max(2.0, 0.15 * capacity),
+                       min_samples=16, min_pop_spread=4.0,
+                       ewma_alpha=0.5),
+        fit_every=4).attach(sup, registry=reg)
+
+    now = 100.0
+    t0_wall = time.perf_counter()
+    matrix = _Matrix(lc, bridge, seed + 5)
+
+    # ---- broadcast skeleton: declared up front, two speakers
+    lc.declare_broadcast(BCAST_CONF)
+    for k in range(2):
+        ok, why = lc.request_join(0x100 + k, _keys(k), _keys(k + 2),
+                                  conference=BCAST_CONF,
+                                  role="speaker")
+        assert ok, f"broadcast speaker refused: {why}"
+
+    # ---- probes join through the lifecycle plane like anyone else,
+    # downlink loss rides a bursty GE channel (mobile profile)
+    plist = [_Probe(0x50 + 11 * k, bridge.port, probes, seed + 10 + k)
+             for k in range(probes)]
+    for i, p in enumerate(plist):
+        # ~3% long-run loss in bursts of ~2 (mobile downlink): lossy
+        # enough to exercise NACK/RTX recovery all day, mild enough
+        # that recovered-packet journeys stay a tail, not the body
+        p.rng = _GeWire(GilbertElliott(p_gb=0.015, p_bg=0.5),
+                        seed + 40 + i)
+        ok, why = lc.request_join(p.ssrc, p.rx_key, p.tx_key,
+                                  name=f"probe-{p.ssrc:#x}",
+                                  conference=PROBE_CONF)
+        assert ok, f"probe admission refused: {why}"
+    while any(p.ssrc not in bridge._ssrc_of.values() for p in plist):
+        sup.tick(now=now)
+        now += dt
+    sid_of = {s: v for v, s in bridge._ssrc_of.items()}
+    for p in plist:
+        p.sid = sid_of[p.ssrc]
+        for other in plist:
+            if other is not p:
+                p.expect_sender(other.ssrc)
+
+    # address latch (see churn_soak): fan-out toward a receiver is
+    # filtered until its source address latches, so accounting floors
+    # at the post-latch seq
+    for _ in range(6):
+        for p in plist:
+            p.send_media(1)
+        sup.tick(now=now)
+        now += dt
+        for p in plist:
+            p.drain(0.0)
+    floor = {p.ssrc: p.seq for p in plist}
+    for p in plist:
+        for other in plist:
+            if other is not p:
+                p.scanned_to[other.ssrc] = floor[other.ssrc]
+
+    spurt = TalkSpurtModel(probes, seed=seed + 1)
+
+    def media_tick(t: int, lossy: bool = True) -> None:
+        speaking = spurt.advance(dt)
+        if t % 2 == 0:
+            for i, p in enumerate(plist):
+                if speaking[i]:
+                    p.send_media(2)
+        sup.tick(now=now)
+        for p in plist:
+            p.drain(drop_rate if lossy else 0.0)
+        if t % 2 == 1:
+            for p in plist:
+                p.nack_round(plist)
+
+    # ---- priming: a first wave of matrix joins warms the bucket
+    # ladder and the placer before the measured window opens.  Media
+    # runs LOSSLESS here so the journey SLO's windows fill with clean
+    # samples first — its cold start must not read the day's first
+    # RTX burst as a 30% bad fraction and fast-burn the door shut.
+    for t in range(40):
+        if t % 2 == 0:
+            for _ in range(min(2, matrix.room())):
+                matrix.join()
+        media_tick(t, lossy=False)
+        now += dt
+    w0_recompiles = lc.datapath_recompiles
+
+    # ================================================= phase 1: the day
+    period = 2.0 * day_s
+    cm = ChurnModel(join_rate_hz, mean_hold_s, seed=seed,
+                    diurnal=DiurnalProfile(period_s=period, depth=0.4,
+                                           peak_t=now + day_s / 2.0))
+    day_ticks = int(round(day_s / dt))
+    day_peak = len(bridge._ssrc_of)
+    for t in range(day_ticks):
+        joins, leaves = cm.step(dt, now, len(matrix.alive))
+        for _ in range(min(joins, matrix.room())):
+            matrix.join()
+        if leaves:
+            matrix.leave(leaves)
+        media_tick(t)
+        day_peak = max(day_peak, len(bridge._ssrc_of))
+        now += dt
+
+    # ====================================== phase 2: reconnect storm
+    # a network blip drops `storm_size` participants at once; they all
+    # come back together, honoring typed refusals' retry-after hints
+    # with jittered exponential backoff
+    storm_size = min(storm_size, len(matrix.alive))
+    victims = [(s, matrix.alive[s])
+               for s in list(matrix.alive)[:storm_size]]
+    for ssrc, _conf in victims:
+        lc.request_leave(ssrc=ssrc)
+        matrix.alive.pop(ssrc)
+    for _ in range(4):                 # evictions commit at the barrier
+        media_tick(0, lossy=False)
+        now += dt
+    rejoin = [{"conf": conf, "retry_at": now, "attempts": 0,
+               "ssrc": None} for _ssrc, conf in victims]
+    storm_refusals: list = []
+    storm_rng = np.random.default_rng(seed + 7)
+    storm_restored = 0
+    for t in range(int(round(20.0 / dt))):
+        for c in rejoin:
+            if c["ssrc"] is not None or now < c["retry_at"]:
+                continue
+            ok, reason, ssrc, _conf = matrix.join(conference=c["conf"],
+                                                  kind="call")
+            if ok:
+                c["ssrc"] = ssrc
+                storm_restored += 1
+            else:
+                hint = lc.retry_after_hint(reason)
+                storm_refusals.append((reason, hint))
+                c["attempts"] += 1
+                base = hint if hint > 0 else dt
+                c["retry_at"] = now + base \
+                    * (2 ** min(c["attempts"] - 1, 6)) \
+                    * (1.0 + 0.25 * float(storm_rng.random()))
+        media_tick(t)
+        now += dt
+        if storm_restored == len(rejoin):
+            break
+
+    # ============================================= phase 3: overload
+    # push hard with the model ATTACHED: the forecast must refuse
+    # before any hard signal trips, and no SLO may enter fast burn
+    # while forecast refusals are holding the door
+    overload_refusals: list = []
+    first_overload_reason = None
+    burn_while_forecast = 0
+    pressure = [{"retry_at": now, "attempts": 0} for _ in range(8)]
+    for t in range(overload_ticks):
+        # growth capped at 3 joins/tick: pressure, not a step function
+        # — the estimator must see the approach, not wake up at the wall
+        room = min(3, matrix.room())
+        for c in pressure:
+            if now < c["retry_at"] or room <= 0:
+                continue
+            ok, reason, _ssrc, _conf = matrix.join()
+            if ok:
+                room -= 1
+                c["attempts"] = 0
+                continue
+            hint = lc.retry_after_hint(reason)
+            overload_refusals.append((reason, hint))
+            if first_overload_reason is None:
+                first_overload_reason = reason
+            c["attempts"] += 1
+            base = hint if hint > 0 else dt
+            c["retry_at"] = now + base \
+                * (2 ** min(c["attempts"] - 1, 6))
+        media_tick(t)
+        if (model.forecast_refusals > 0
+                and slo.state() == "fast_burn"):
+            burn_while_forecast += 1
+        now += dt
+
+    # freeze the prediction while the forecast still holds the
+    # population below the wall — measured saturation must not leak
+    # into the estimate
+    frozen = {
+        "predicted_saturation": predicted_saturation(model),
+        "population": model.population,
+        "headroom_users": model.headroom_users(),
+        "bottleneck": model.bottleneck(),
+        "confidence": model.confidence(),
+        "forecast_refusals": model.forecast_refusals,
+    }
+    scrape = reg.render()
+
+    # ============================== phase 4: measured hard saturation
+    # DETACH the model from admission (sup.capacity = None): joins now
+    # run to the true row wall, and the estimator never grades its own
+    # homework.  Growth stays paced so the pre-warmed bucket ladder
+    # keeps ahead (zero recompiles even here).
+    sup.capacity = None
+    measured_peak = len(bridge._ssrc_of)
+    hard_reasons: dict = {}
+    for t in range(measure_ticks):
+        for _ in range(matrix.room()):
+            ok, reason, _ssrc, _conf = matrix.join()
+            if not ok:
+                hard_reasons[reason] = hard_reasons.get(reason, 0) + 1
+                break
+        media_tick(t, lossy=False)
+        measured_peak = max(measured_peak, len(bridge._ssrc_of))
+        now += dt
+        if (bridge.registry.free_slots == 0
+                and not lc._join_q and not lc._staged):
+            break
+    for t in range(10):                # settle: commit staged rows
+        media_tick(t, lossy=False)
+        now += dt
+    measured_peak = max(measured_peak, len(bridge._ssrc_of))
+
+    # ---- probe loss accounting (NACK-recovered residual)
+    expected = missing = 0
+    for p in plist:
+        for other in plist:
+            if other is p:
+                continue
+            lo, hi = floor[other.ssrc], other.seq
+            expected += hi - lo
+            missing += sum(1 for s in range(lo, hi)
+                           if (other.ssrc, s) not in p.got)
+    residual = missing / expected if expected else 0.0
+
+    window_recompiles = lc.datapath_recompiles - w0_recompiles
+    all_refusals = (matrix.refusals + storm_refusals
+                    + overload_refusals)
+    n_dev = jax.device_count()
+    pred = frozen["predicted_saturation"]
+    err = (abs(pred - measured_peak) / measured_peak
+           if pred is not None and measured_peak else None)
+    forecast_refused = sum(1 for r, _h in overload_refusals
+                           if r == "capacity_forecast")
+
+    report = {
+        "mode": "global_day",
+        "wall_s": round(time.perf_counter() - t0_wall, 3),
+        "model_time_s": round(now - 100.0, 3),
+        "devices": n_dev,
+        "capacity_rows": capacity,
+        "n_shards": n_shards,
+        "day_peak_population": int(day_peak),
+        "scenario_mix": dict(matrix.by_kind),
+        "meetings": len(matrix.meetings),
+        "storm_size": len(rejoin),
+        "storm_restored": storm_restored,
+        "storm_refusals": len(storm_refusals),
+        "overload_refusals": len(overload_refusals),
+        "first_overload_reason": first_overload_reason,
+        "forecast_refusals_overload": forecast_refused,
+        "burn_windows_while_forecast": burn_while_forecast,
+        "frozen_estimate": {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in frozen.items()},
+        "measured_saturation": int(measured_peak),
+        "hard_refusal_reasons": hard_reasons,
+        "estimate_error": (round(err, 4) if err is not None else None),
+        "users_per_chip": round(measured_peak / n_dev, 1),
+        "admit_rejected": dict(lc.admit_rejected),
+        "probe_expected": expected,
+        "probe_missing": missing,
+        "residual_loss_ratio": round(residual, 5),
+        "priming_recompiles": w0_recompiles,
+        "window_recompiles": window_recompiles,
+        # ---- invariants
+        "ok_estimate_within_bound": (err is not None
+                                     and err <= error_bound),
+        "ok_forecast_before_hard": (
+            forecast_refused > 0
+            and first_overload_reason == "capacity_forecast"),
+        "ok_no_fast_burn_while_forecast": (
+            frozen["forecast_refusals"] > 0
+            and burn_while_forecast == 0),
+        "ok_hints_honored": (
+            len(all_refusals) > 0
+            and all(h > 0 for _r, h in all_refusals)
+            and storm_restored == len(rejoin)),
+        "ok_typed_refusals": (
+            set(lc.admit_rejected) <= set(ADMIT_REASONS)
+            and '_admit_rejected{reason="capacity_forecast"' in scrape),
+        "ok_capacity_metrics": (
+            "capacity_headroom_users" in scrape
+            and "capacity_bottleneck{resource=" in scrape
+            and "capacity_estimate_confidence" in scrape),
+        "ok_zero_datapath_recompiles": window_recompiles == 0,
+        "ok_media_flowed": (expected > 0
+                            and residual <= residual_bound),
+    }
+    for p in plist:
+        p.close()
+    bridge.close()
+    libjitsi_tpu.stop()
+    if verbose:
+        print("---- global day report ----")
+        for k, v in report.items():
+            print(f"{k:32s} {v}")
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    return report
+
+
+# ------------------------------------------------- CAPACITY.json gate
+
+def compare_baseline(report: dict, path: str, mode: str) -> dict:
+    """Gate measured users-per-chip against the checked-in baseline,
+    PERF_BASELINE.json style: refuse regressions beyond the entry's
+    tolerance, but never compare numbers across ingest engine modes
+    (the `_meta` guard)."""
+    key = f"users_per_chip_{mode}"
+    out = {"key": key, "ok": True, "status": "no_baseline"}
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return out
+    entry = doc.get(key)
+    if entry is None:
+        return out
+    meta = doc.get("_meta", {})
+    mode_now = _engine_mode()
+    if meta.get("engine_mode") not in (None, mode_now):
+        out["status"] = (f"skipped: baseline engine_mode="
+                         f"{meta.get('engine_mode')} != {mode_now}")
+        return out
+    base = float(entry["value"])
+    tol = float(entry.get("tolerance", DEFAULT_TOLERANCE))
+    floor_v = base * (1.0 - tol)
+    measured = float(report["users_per_chip"])
+    out.update(baseline=base, tolerance=tol, floor=round(floor_v, 1),
+               measured=measured, ok=measured >= floor_v,
+               status="compared")
+    return out
+
+
+def write_baseline(path: str, report: dict, mode: str) -> dict:
+    """(Re)write CAPACITY.json for this mode's entry, carrying over
+    the other mode's untouched entry (perf_gate's partial-rebaseline
+    rule) under a fresh shared `_meta` stamp."""
+    try:
+        with open(path) as f:
+            old = json.load(f)
+    except (OSError, ValueError):
+        old = {}
+    doc = {"_meta": baseline_meta(
+        "users-per-chip capacity baseline from the global-day matrix; "
+        "re-baseline honestly (quiet machine, explain the delta)")}
+    for k, v in old.items():
+        if not k.startswith("_"):
+            doc[k] = v
+    doc[f"users_per_chip_{mode}"] = {
+        "value": report["users_per_chip"],
+        "tolerance": DEFAULT_TOLERANCE,
+        "higher_is_better": True,
+        "capacity_rows": report["capacity_rows"],
+        "devices": report["devices"],
+        "estimate_error": report["estimate_error"],
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tier-1 sizing: small bridge, short day")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--error-bound", type=float, default=0.25,
+                    help="max |predicted - measured| / measured")
+    ap.add_argument("--report", default=None,
+                    help="also dump the report JSON here")
+    ap.add_argument("--baseline", default=BASELINE)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="(re)write this mode's CAPACITY.json entry")
+    ap.add_argument("--no-compare", action="store_true",
+                    help="skip the CAPACITY.json regression gate")
+    args = ap.parse_args(argv)
+
+    if args.write_baseline:
+        dirty = _git_dirty_files()
+        if dirty and not os.environ.get("PERF_GATE_ALLOW_DIRTY"):
+            print("refusing --write-baseline on a dirty tree "
+                  f"({len(dirty)} modified files): commit first so "
+                  "_meta.git identifies the measured code, or set "
+                  "PERF_GATE_ALLOW_DIRTY=1 to stamp _meta.tree=dirty")
+            return 2
+
+    mode = "smoke" if args.smoke else "full"
+    kw = dict(seed=args.seed, error_bound=args.error_bound,
+              report_path=args.report)
+    if args.smoke:
+        kw.update(capacity=64, n_shards=2, probes=2, day_s=2.0,
+                  join_rate_hz=30.0, mean_hold_s=1.2, storm_size=16,
+                  overload_ticks=80, measure_ticks=300)
+    report = run_global_day(**kw)
+
+    failed = [k for k, v in report.items()
+              if k.startswith("ok_") and not v]
+    if args.write_baseline and not failed:
+        doc = write_baseline(args.baseline, report, mode)
+        print(f"baseline written: {args.baseline} "
+              f"(_meta.tree={doc['_meta']['tree']})")
+    elif not args.no_compare:
+        gate = compare_baseline(report, args.baseline, mode)
+        print(f"baseline gate [{gate['key']}]: {gate['status']} "
+              + (f"measured={gate.get('measured')} "
+                 f"floor={gate.get('floor')}"
+                 if gate["status"] == "compared" else ""))
+        if not gate["ok"]:
+            failed.append(f"baseline_{gate['key']}")
+    if failed:
+        print(f"FAILED: {', '.join(failed)}")
+        return 1
+    print("global day: all gates passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,6 +21,10 @@ Drives media + a NACK through the bridge for N ticks, then asserts:
 - the phase profiler's tick_phase_seconds histogram carries sampled
   ticks, dispatch_inflight_ticks and the h2d/d2h byte counters are
   live, and /debug/device serves device-memory stats;
+- the capacity model exports capacity_headroom_users /
+  capacity_bottleneck / capacity_estimate_confidence and serves
+  /debug/capacity; process_start_time_seconds and
+  scrape_duration_seconds ride every scrape un-namespaced;
 - a synthetic host-dominant overload escalates with the HOST phase
   named on the ladder_escalate event and /debug/slo attribution.
 
@@ -137,6 +141,8 @@ def run(ticks: int = 40) -> None:
     slo = SloEngine(sfu.loop.metrics, default_slos())
     sup = BridgeSupervisor(sfu, SupervisorConfig(deadline_ms=1000.0),
                            metrics=sfu.loop.metrics, slo=slo)
+    from libjitsi_tpu.utils.capacity import CapacityModel
+    CapacityModel().attach(sup, registry=sfu.loop.metrics)
     srv = ObservabilityServer(metrics=sfu.loop.metrics,
                               supervisor=sup).start()
     try:
@@ -268,6 +274,35 @@ def run(ticks: int = 40) -> None:
         devices = json.loads(body)["devices"]
         assert devices and "device" in devices[0], \
             f"bad /debug/device doc: {devices}"
+
+        # capacity model: headroom/bottleneck/confidence gauges in the
+        # scrape and the /debug/capacity JSON document
+        assert f"# TYPE {ns}_capacity_headroom_users gauge" in text, \
+            "capacity_headroom_users gauge missing"
+        assert f'{ns}_capacity_bottleneck{{resource="rows"}}' in text, \
+            "capacity_bottleneck resource axis missing"
+        assert f"# TYPE {ns}_capacity_estimate_confidence gauge" \
+            in text, "capacity_estimate_confidence gauge missing"
+        code, body, _ = _get(srv.port, "/debug/capacity")
+        assert code == 200, f"/debug/capacity -> {code}"
+        cap_doc = json.loads(body)
+        assert cap_doc["ticks"] > 0, "capacity model never ticked"
+        assert set(cap_doc["resources"]) >= {"rows", "host",
+                                             "tick_budget"}, \
+            f"capacity resources missing: {set(cap_doc['resources'])}"
+
+        # process-level families ride every scrape UN-namespaced (the
+        # Prometheus convention) and the validator vouches for them
+        start_lines = [ln for ln in text.splitlines()
+                       if ln.startswith("process_start_time_seconds ")]
+        assert start_lines and float(start_lines[0].split()[1]) > 1e9, \
+            f"process_start_time_seconds missing/bogus: {start_lines}"
+        dur = [ln for ln in text.splitlines()
+               if ln.startswith("scrape_duration_seconds ")]
+        assert dur and float(dur[0].split()[1]) >= 0, \
+            f"scrape_duration_seconds missing: {dur}"
+        assert "# TYPE process_start_time_seconds gauge" in text
+        assert "# TYPE scrape_duration_seconds gauge" in text
 
         # host-bound overload drill: feed the supervisor a synthetic
         # host-dominant phase ledger while the watchdog is overrun —

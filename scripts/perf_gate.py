@@ -893,6 +893,24 @@ def append_trend(path: str, results: dict) -> None:
         f.write(json.dumps(row) + "\n")
 
 
+def baseline_meta(note: str) -> dict:
+    """The `_meta` stamp shared by every checked-in measurement
+    baseline (PERF_BASELINE.json here, CAPACITY.json in global_day):
+    wall time, HEAD sha, tree cleanliness, and the ingest engine the
+    numbers were measured with — perf numbers must never be compared
+    across engine modes silently."""
+    return {
+        "written": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "git": _git_sha(),
+        # cleanliness at stamp time: callers refuse dirty trees (see
+        # main() here), so "dirty" can only mean PERF_GATE_ALLOW_DIRTY=1
+        # — and the jitlint drift checker flags it
+        "tree": ("dirty" if os.environ.get("PERF_GATE_ALLOW_DIRTY")
+                 and _git_dirty_files() else "clean"),
+        "engine_mode": _engine_mode(),
+        "note": note}
+
+
 def write_baseline(path: str, results: dict,
                    old: dict | None = None) -> dict:
     """(Re)write the baseline: fresh `_meta` stamped at the CURRENT
@@ -902,19 +920,9 @@ def write_baseline(path: str, results: dict,
     re-baseline can never silently drop the rest of the suite (the
     drift checker cross-checks baseline keys against SCENARIOS)."""
     tol = {"loop_echo_pps": 0.75}           # loopback UDP is noisiest
-    doc = {"_meta": {
-        "written": time.strftime("%Y-%m-%dT%H:%M:%S"),
-        "git": _git_sha(),
-        # cleanliness at stamp time: main() refuses dirty trees (see
-        # there), so "dirty" can only mean PERF_GATE_ALLOW_DIRTY=1 —
-        # and the jitlint drift checker flags it
-        "tree": ("dirty" if os.environ.get("PERF_GATE_ALLOW_DIRTY")
-                 and _git_dirty_files() else "clean"),
-        # ingest engine the numbers were measured with — perf numbers
-        # must never be compared across engine modes silently
-        "engine_mode": _engine_mode(),
-        "note": "fast perf-gate baseline; re-baseline honestly "
-                "(quiet machine, explain the delta in the commit)"}}
+    doc = {"_meta": baseline_meta(
+        "fast perf-gate baseline; re-baseline honestly "
+        "(quiet machine, explain the delta in the commit)")}
     for name, entry in (old or {}).items():
         if not name.startswith("_") and name not in results:
             doc[name] = entry

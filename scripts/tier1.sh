@@ -13,7 +13,16 @@
 # "Static analysis".
 cd "$(dirname "$0")/.."
 echo "== jitlint gate =="
-python scripts/lint.py libjitsi_tpu || { echo "TIER1 FAIL: jitlint gate"; exit 1; }
+# clean working tree: --changed lints only files whose content differs
+# from the warm index (typically nothing after a fresh commit — the
+# content-keyed cache answers in milliseconds).  Any local edits fall
+# back to the full-tree walk so the gate never under-lints.
+if git diff --quiet 2>/dev/null && git diff --cached --quiet 2>/dev/null; then
+    LINT_ARGS="--changed libjitsi_tpu"
+else
+    LINT_ARGS="libjitsi_tpu"
+fi
+python scripts/lint.py $LINT_ARGS || { echo "TIER1 FAIL: jitlint gate"; exit 1; }
 echo "== io engine probe =="
 env JAX_PLATFORMS=cpu python -c "
 from libjitsi_tpu.io.udp import probe_engine_mode, uring_available
@@ -30,6 +39,8 @@ echo "== reconnect-storm smoke (handshake plane) =="
 env JAX_PLATFORMS=cpu python scripts/churn_soak.py --reconnect --smoke || { echo "TIER1 FAIL: reconnect smoke"; exit 1; }
 echo "== cascade failover smoke (bridge-to-bridge trunk) =="
 env JAX_PLATFORMS=cpu python scripts/churn_soak.py --cascade --smoke || { echo "TIER1 FAIL: cascade smoke"; exit 1; }
+echo "== global-day smoke (capacity estimator vs measured saturation) =="
+timeout -k 10 420 env JAX_PLATFORMS=cpu python scripts/global_day.py --smoke || { echo "TIER1 FAIL: global-day smoke"; exit 1; }
 echo "== core test tier =="
 t0=$SECONDS
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); echo "TIER1_WALL_SECONDS=$((SECONDS - t0))"; exit $rc

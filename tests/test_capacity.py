@@ -1,0 +1,215 @@
+"""CapacityModel unit + integration tests: the per-resource linear
+fit (rows fit exactly: slope = 1/capacity), the forecast refusal and
+its exponential retry-after streak, forecast-exhausted shard steering,
+the capacity_* metric families, and the /debug/capacity endpoint —
+the live half of what scripts/global_day.py validates end-to-end
+against measured saturation."""
+
+import json
+import types
+import urllib.error
+import urllib.request
+
+import libjitsi_tpu
+from libjitsi_tpu.mesh.placement import ConferencePlacer
+from libjitsi_tpu.service.lifecycle import StreamLifecycleManager
+from libjitsi_tpu.service.obs_server import ObservabilityServer
+from libjitsi_tpu.service.sfu_bridge import SfuBridge
+from libjitsi_tpu.service.supervisor import (BridgeSupervisor,
+                                             SupervisorConfig)
+from libjitsi_tpu.utils.capacity import (RESOURCES, CapacityConfig,
+                                         CapacityModel,
+                                         predicted_saturation)
+from libjitsi_tpu.utils.metrics import (MetricsRegistry,
+                                        validate_exposition)
+
+CAP = 64
+
+
+def _fake_sup(capacity=CAP):
+    """The exact attribute surface `CapacityModel._signals` reads,
+    with a registry whose occupancy the test moves by hand."""
+    reg = types.SimpleNamespace(capacity=capacity, free_slots=capacity)
+    bridge = types.SimpleNamespace(registry=reg)
+    sup = types.SimpleNamespace(
+        cfg=types.SimpleNamespace(deadline_ms=1000.0),
+        last_tick_s=0.0, last_phases={}, bridge=bridge,
+        lifecycle=None, slo=None, capacity=None)
+    return sup, reg
+
+
+def _grow(model, sup, reg, populations):
+    for pop in populations:
+        reg.free_slots = reg.capacity - pop
+        model.on_tick(sup)
+
+
+def test_rows_fit_predicts_the_row_wall():
+    """Rows are deterministic — occupancy/capacity — so the fit must
+    recover slope 1/capacity and predict saturation at `capacity`
+    users (alpha 1.0: no EWMA lag, the fit is exact)."""
+    model = CapacityModel(CapacityConfig(ewma_alpha=1.0), fit_every=1)
+    sup, reg = _fake_sup()
+    model.attach(sup)
+    assert sup.capacity is model
+    _grow(model, sup, reg, range(0, 49))
+    assert model.bottleneck() == "rows"
+    rows = model.tracks["rows"]
+    assert abs(rows.slope - 1.0 / CAP) < 1e-9
+    assert rows.r2 > 0.999
+    # at population 48 of 64 the wall is 16 users away
+    assert abs(model.headroom_users() - 16.0) < 0.5
+    assert model.confidence() > 0.9
+    assert abs(predicted_saturation(model) - CAP) < 0.5
+
+
+def test_no_fit_means_infinite_headroom_and_zero_confidence():
+    model = CapacityModel()
+    sup, reg = _fake_sup()
+    model.attach(sup)
+    _grow(model, sup, reg, [5] * 4)      # too few samples, no spread
+    assert model.headroom_users() == float("inf")
+    assert model.confidence() == 0.0
+    assert predicted_saturation(model) is None
+    assert not model.should_refuse()
+
+
+def test_forecast_refusal_streak_backs_retry_after():
+    """Near the wall a confident fit refuses; consecutive refusals
+    double the retry-after hint (capped), and one green tick resets
+    the streak."""
+    cfg = CapacityConfig(ewma_alpha=1.0, guard_users=1.0,
+                         retry_base_s=0.1, retry_cap_doublings=4)
+    model = CapacityModel(cfg, fit_every=1)
+    sup, reg = _fake_sup()
+    model.attach(sup)
+    _grow(model, sup, reg, range(0, 41))
+    assert not model.should_refuse()     # 24 users of headroom
+    _grow(model, sup, reg, [63])         # one row left: below guard+1
+    assert model.should_refuse()
+    assert model.forecast_refusals == 1
+    assert model.retry_after() == 0.1    # streak 1 -> base
+    assert model.should_refuse() and model.should_refuse()
+    assert model.retry_after() == 0.4    # streak 3 -> base * 4
+    for _ in range(10):
+        model.should_refuse()
+    assert model.retry_after() == 0.1 * (2 ** 4)   # cap holds
+    _grow(model, sup, reg, [30])         # load drains
+    assert not model.should_refuse()
+    assert model.retry_after() == 0.1    # streak reset
+
+
+def test_capacity_families_render_and_validate():
+    reg = MetricsRegistry()
+    model = CapacityModel(CapacityConfig(ewma_alpha=1.0), fit_every=1)
+    sup, sreg = _fake_sup()
+    model.attach(sup, registry=reg)
+    _grow(model, sup, sreg, range(0, 30))
+    text = reg.render()
+    assert validate_exposition(text) == []
+    assert "# TYPE libjitsi_tpu_capacity_headroom_users gauge" in text
+    assert ("# TYPE libjitsi_tpu_capacity_estimate_confidence gauge"
+            in text)
+    assert ("# TYPE libjitsi_tpu_capacity_forecast_refusals counter"
+            in text)
+    # the bottleneck family is complete from the first scrape: one
+    # labeled sample per resource, fit or no fit
+    for r in RESOURCES:
+        assert (f'libjitsi_tpu_capacity_bottleneck{{resource="{r}"}}'
+                in text)
+
+
+def test_exhausted_shards_steer_placement():
+    """A shard whose row range is `shard_exhaust_frac` full is
+    forecast-exhausted: it shows up in the lifecycle plane's avoidance
+    set next to burning shards, BEFORE it is actually full."""
+    placer = ConferencePlacer(2, rows_per_shard=8)
+    assert placer.place(1, 8) == 0       # shard 0 now 100% occupied
+    model = CapacityModel()
+    model.supervisor = types.SimpleNamespace(
+        lifecycle=types.SimpleNamespace(placer=placer))
+    assert model.exhausted_shards() == [0]
+    # the lifecycle avoidance surface merges it with SLO burn steering
+    lc = StreamLifecycleManager.__new__(StreamLifecycleManager)
+    lc.supervisor = types.SimpleNamespace(slo=None, capacity=model)
+    assert lc._burning_shards() == {0}
+    # and the forecast refuses joins targeting the exhausted shard
+    # while a join elsewhere stays green (no confident global fit here)
+    assert model.should_refuse(shard=0)
+    assert not model.should_refuse(shard=1)
+
+
+def test_forecast_refuses_join_end_to_end():
+    """Real bridge, supervisor and lifecycle: grow to near the row
+    wall one user per tick, then assert the next join is refused
+    `capacity_forecast` (typed, before any hard signal) with a
+    positive retry-after hint from the model's streak."""
+    libjitsi_tpu.stop()
+    libjitsi_tpu.init()
+    cfg = libjitsi_tpu.configuration_service()
+    bridge = SfuBridge(cfg, port=0, capacity=16, recv_window_ms=0)
+    try:
+        sup = BridgeSupervisor(bridge,
+                               SupervisorConfig(deadline_ms=1000.0))
+        lc = StreamLifecycleManager(bridge, supervisor=sup)
+        lc._warm_bucket = 1 << 30        # warm cadence tested elsewhere
+        model = CapacityModel(
+            CapacityConfig(ewma_alpha=1.0, min_samples=8,
+                           min_pop_spread=4.0, guard_users=4.0),
+            fit_every=1).attach(sup)
+        t = 100.0
+        for i in range(12):
+            rx = (bytes([i]) * 16, bytes([i + 1]) * 14)
+            tx = (bytes([i + 2]) * 16, bytes([i + 3]) * 14)
+            ok, reason = lc.request_join(0x900 + i, rx, tx)
+            assert ok, reason
+            for _ in range(4):
+                sup.tick(now=t)
+                t += 0.02
+        assert len(bridge._ssrc_of) == 12
+        # headroom 4 < guard 4 + 1: the forecast bars the door while
+        # 4 hard rows are still free
+        assert bridge.registry.free_slots == 4
+        assert model.confidence() >= 0.5
+        ok, reason = lc.request_join(
+            0xA00, (b"\x70" * 16, b"\x71" * 14),
+            (b"\x72" * 16, b"\x73" * 14))
+        assert (ok, reason) == (False, "capacity_forecast")
+        assert lc.admit_rejected.get("capacity_forecast") == 1
+        assert lc.retry_after_hint("capacity_forecast") > 0.0
+        assert model.forecast_refusals >= 1
+    finally:
+        bridge.close()
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+            return r.status, r.read().decode("utf-8")
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode("utf-8")
+
+
+def test_debug_capacity_endpoint():
+    """/debug/capacity mirrors CapacityModel.status(); without a model
+    attached anywhere the endpoint 404s instead of serving junk."""
+    model = CapacityModel(CapacityConfig(ewma_alpha=1.0), fit_every=1)
+    sup, reg = _fake_sup()
+    model.attach(sup)
+    _grow(model, sup, reg, range(0, 30))
+    sup.health = lambda: {"state": "healthy"}
+    sup.flight, sup.postmortems = None, []
+    with ObservabilityServer(supervisor=sup) as srv:
+        code, body = _get(srv.port, "/debug/capacity")
+        doc = json.loads(body)
+        assert code == 200
+        assert doc["ticks"] == 30 and doc["bottleneck"] == "rows"
+        assert set(doc["resources"]) == set(RESOURCES)
+        assert doc["resources"]["rows"]["slope_per_user"] is not None
+    bare = types.SimpleNamespace(
+        health=lambda: {"state": "healthy"}, flight=None,
+        postmortems=[])
+    with ObservabilityServer(supervisor=bare) as srv:
+        code, body = _get(srv.port, "/debug/capacity")
+        assert code == 404 and "no capacity model" in body

@@ -538,3 +538,34 @@ def test_hop_slo_burn_gates_admission():
         assert sup.admission_decision() == (False, "hop_burn")
     finally:
         tr.close()
+
+
+def test_trunk_metrics_follow_replaced_trunk_instance():
+    """Failover-recovery regression (the stale-trunk twin of the
+    stale-array bug): metrics registered with `owner=` must resolve
+    through the owner's CURRENT `.trunk` at scrape time — recovery
+    constructs a fresh trunk (sockets don't survive a crash) and the
+    scrape has to follow it, not stay frozen on the dead instance."""
+    import types
+
+    reg = MetricsRegistry()
+    t1 = CascadeTrunk(KEY_AB, KEY_BA, TrunkConfig(), seed=11)
+    t2 = CascadeTrunk(KEY_AB, KEY_BA, TrunkConfig(), seed=12)
+    try:
+        owner = types.SimpleNamespace(trunk=t1)
+        t1.register_metrics(reg, owner=owner)
+        t1.heartbeats_total = 5
+        t1.state = "up"
+        text = reg.render()
+        assert "libjitsi_tpu_trunk_heartbeats_total 5" in text
+        # recovery: a whole new trunk object under the same owner
+        t2.heartbeats_total = 9
+        t2.state = "down"
+        owner.trunk = t2
+        text = reg.render()
+        assert "libjitsi_tpu_trunk_heartbeats_total 9" in text, \
+            "scrape kept reading the dead pre-failover trunk"
+        assert "libjitsi_tpu_trunk_heartbeats_total 5" not in text
+    finally:
+        t1.close()
+        t2.close()

@@ -693,6 +693,106 @@ def test_drift_histogram_registered_forms_are_clean():
     assert check_metrics_drift({c3.relpath: c3}) == []
 
 
+def test_drift_undeclared_admit_reason_fires():
+    """A refusal literal outside the ADMIT_REASONS tuple is an untyped
+    reason — the soak gates' `refused <= ADMIT_REASONS` assertions and
+    the admit_rejected{reason=...} label set never heard of it.  The
+    cross-file shape mirrors the real tree: the tuple lives in
+    lifecycle, the refusal site in the supervisor."""
+    decl = """
+    ADMIT_REASONS = ("capacity", "fast_burn", "trunk_down")
+    """
+    refuse = """
+    class Supervisor:
+        def admission_decision(self):
+            if self.burning:
+                return False, "fast_burn"
+            if self.haunted:
+                return False, "mystery"
+            return True, "ok"
+    """
+    c1 = ctx_of(decl, "libjitsi_tpu/service/lifecycle.py")
+    c2 = ctx_of(refuse, "libjitsi_tpu/service/supervisor.py")
+    found = check_metrics_drift({c1.relpath: c1, c2.relpath: c2})
+    assert len(found) == 1
+    assert "mystery" in found[0].message
+    assert "ADMIT_REASONS" in found[0].message
+    assert found[0].path == "libjitsi_tpu/service/supervisor.py"
+
+
+def test_drift_declared_admit_reasons_are_clean():
+    """Declared refusal literals clear the check in both shapes — the
+    `(False, "reason")` pair and the bare-string `admit_reason` form —
+    and the `"ok"` accept token is never read as a reason.  A tree
+    with no ADMIT_REASONS declaration at all is out of scope (fixture
+    trees without an admission plane)."""
+    decl = """
+    ADMIT_REASONS = ("capacity", "fast_burn", "trunk_down",
+                     "trunk_backlog")
+    """
+    refuse = """
+    class Supervisor:
+        def admission_decision(self):
+            if self.burning:
+                return False, "fast_burn"
+            return True, "ok"
+
+    class Trunk:
+        def admit_reason(self):
+            if self.state != "up":
+                return "trunk_down"
+            if self.backlog:
+                return "trunk_backlog"
+            return None
+    """
+    c1 = ctx_of(decl, "libjitsi_tpu/service/lifecycle.py")
+    c2 = ctx_of(refuse, "libjitsi_tpu/service/supervisor.py")
+    assert check_metrics_drift({c1.relpath: c1, c2.relpath: c2}) == []
+    # no declaration anywhere -> the refusal site alone is out of scope
+    assert check_metrics_drift({c2.relpath: c2}) == []
+
+
+def test_drift_capacity_forecast_without_families_fires():
+    """Declaring the `capacity_forecast` reason contracts the tree to
+    export the capacity_* families — a forecast that refuses joins
+    with no scrapeable headroom explanation is exactly the silent
+    wiring bug the drift rule exists for."""
+    decl = """
+    ADMIT_REASONS = ("capacity", "capacity_forecast")
+    """
+    ctx = ctx_of(decl, "libjitsi_tpu/service/lifecycle.py")
+    found = check_metrics_drift({ctx.relpath: ctx})
+    fams = {f.message.split("`")[3] for f in found}
+    assert fams == {"capacity_headroom_users", "capacity_bottleneck",
+                    "capacity_estimate_confidence",
+                    "capacity_forecast_refusals"}
+
+
+def test_drift_capacity_forecast_with_families_clean():
+    """The real wiring — CapacityModel registering all four families
+    (in another file, like utils/capacity.py does) — clears the
+    contract."""
+    decl = """
+    ADMIT_REASONS = ("capacity", "capacity_forecast")
+    """
+    model = """
+    class CapacityModel:
+        def register_metrics(self, registry):
+            registry.register_scalar(
+                "capacity_headroom_users", lambda: self.headroom)
+            registry.register_multi(
+                "capacity_bottleneck", self._bottleneck_samples)
+            registry.register_scalar(
+                "capacity_estimate_confidence", self.confidence)
+            registry.register_scalar(
+                "capacity_forecast_refusals",
+                lambda: self.forecast_refusals)
+    """
+    c1 = ctx_of(decl, "libjitsi_tpu/service/lifecycle.py")
+    c2 = ctx_of(model, "libjitsi_tpu/utils/capacity.py")
+    assert check_metrics_drift({c1.relpath: c1, c2.relpath: c2}) == []
+
+
 def _perf_tree(tmp_path, baseline_keys, scenario_ids):
     """Fake repo: PERF_BASELINE.json + scripts/perf_gate.py + one
     indexed file whose path anchors the disk walk-up."""

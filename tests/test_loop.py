@@ -222,3 +222,33 @@ def test_send_media_async_flush_matches_sync():
     assert pipe_eng.sent == sync_eng.sent
     # idempotent: nothing left in flight
     assert pipe_loop.flush_sends() == 0
+
+
+def test_scrape_sees_live_inflight_age_not_last_tick_note():
+    """Staleness regression for the deep pipeline's age gauge: the
+    exporter reads `_inflight_age()` LIVE, so a scrape between tick
+    boundaries sees the dispatch aging (and sees zero right after a
+    drain) instead of the value frozen at the last per-tick note."""
+    reg = _registry()
+    tx = SrtpStreamTable(capacity=16)
+    tx.add_stream(2, MK, MS)
+    rx = SrtpStreamTable(capacity=16)
+    rx.add_stream(2, MK2, MS2)
+    chain = TransformEngineChain([SrtpTransformEngine(tx, rx)])
+    loop = MediaLoop(UdpEngine(port=0, max_batch=16), reg,
+                     chain=chain, pipelined=True)
+    loop.addr_ip[2] = 0x7F000001
+    loop.addr_port[2] = 9                # discard; nothing listens
+    batch = rtp_header.build([b"inflight-x"], [1], [0], [0xF00D],
+                             [96], stream=[2])
+    assert loop.send_media_async(batch) == 1
+    loop.ticks += 3                      # ticks pass, no flush, no note
+    assert loop._inflight_age() == 3
+    assert loop.dispatch_inflight_ticks == 0, \
+        "per-tick note is only taken at tick boundaries"
+    assert "libjitsi_tpu_dispatch_inflight_ticks 3" \
+        in loop.metrics.render()
+    loop.flush_sends()
+    # live again after the drain, still before any tick boundary
+    assert "libjitsi_tpu_dispatch_inflight_ticks 0" \
+        in loop.metrics.render()

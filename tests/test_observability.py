@@ -528,6 +528,98 @@ def test_obs_server_healthz_503_when_stalled_and_404s():
         assert code == 404
 
 
+# --------------------------------------------------- process families
+
+def test_process_families_render_and_validator_bounds():
+    """The un-namespaced process families every /metrics response
+    carries: a well-formed pair validates; a zero/negative start time
+    (the classic uninitialized-clock bug Prometheus restart detection
+    would silently swallow) and a negative scrape duration are format
+    violations."""
+    from libjitsi_tpu.utils.metrics import process_families_text
+
+    good = process_families_text(0.002)
+    assert validate_exposition(good) == []
+    assert "# TYPE process_start_time_seconds gauge" in good
+    assert "# TYPE scrape_duration_seconds gauge" in good
+    # default start stamp is this process's import time: a real epoch
+    line = [ln for ln in good.splitlines()
+            if ln.startswith("process_start_time_seconds ")][0]
+    assert float(line.split()[1]) > 1e9
+
+    bad_start = process_families_text(0.002, start_time_s=0.0)
+    errors = validate_exposition(bad_start)
+    assert any("positive unix time" in e for e in errors)
+
+    bad_dur = process_families_text(-0.5)
+    errors = validate_exposition(bad_dur)
+    assert any("scrape_duration_seconds" in e and ">= 0" in e
+               for e in errors)
+
+
+# ------------------------------------------------- histogram vec render
+
+def test_histogram_vec_zero_observation_child_renders_valid():
+    """A labeled child created but never observed (a hop that carried
+    no traffic yet) must still render a complete, validator-clean
+    bucket/sum/count triple of zeros under the family's single # TYPE
+    line — not a half-family the scraper chokes on."""
+    m = MetricsRegistry()
+    vec = m.histogram_vec("hop_seconds", (0.01, 0.1), "hop")
+    vec.labels("local").observe(0.005)
+    vec.labels("b1-b2")                  # created, zero observations
+    text = m.render()
+    assert validate_exposition(text) == []
+    assert text.count("# TYPE libjitsi_tpu_hop_seconds histogram") == 1
+    assert ('libjitsi_tpu_hop_seconds_bucket{hop="b1-b2",le="+Inf"} 0'
+            in text)
+    assert 'libjitsi_tpu_hop_seconds_count{hop="b1-b2"} 0' in text
+    assert 'libjitsi_tpu_hop_seconds_count{hop="local"} 1' in text
+    # OpenMetrics rendering of the empty child is also clean
+    assert validate_exposition(m.render(openmetrics=True),
+                               openmetrics=True) == []
+
+
+# -------------------------------------------------- offline fleet merge
+
+def test_trace_report_merges_saved_bridge_scrapes(tmp_path):
+    """scripts/trace_report.py --merge-bridges over SAVED exposition
+    files (the offline twin of /debug/fleet): a trace id whose journey
+    exemplars appear on two bridges' scrapes is stitched; a bridge-local
+    id is not."""
+    import sys
+    sys.path.insert(0, "scripts")
+    import trace_report
+
+    def scrape(hop, observes):
+        m = MetricsRegistry()
+        vec = m.histogram_vec("packet_journey_seconds", (0.01, 0.1),
+                              "hop", exemplars=True)
+        for tid, seconds in observes:
+            vec.labels(hop).observe(seconds,
+                                    exemplar={"trace_id": tid})
+        return m.render(openmetrics=True)
+
+    a, b = tmp_path / "a.om", tmp_path / "b.om"
+    # distinct buckets: exemplar slots are per-bucket, last wins
+    a.write_text(scrape("local", [("77", 0.004), ("88", 0.05)]))
+    b.write_text(scrape("b1-b2", [("77", 0.004)]))
+    doc = trace_report.merge_bridges([str(a), str(b)])
+    assert doc["errors"] == {}
+    assert set(doc["bridges"]) == {"a.om", "b.om"}
+    assert doc["bridges"]["a.om"]["exemplars"] == 2
+    assert doc["stitched_trace_ids"] == ["77"]
+    by_id = {j["trace_id"]: j for j in doc["journeys"]}
+    assert by_id["77"]["stitched"]
+    assert {s["hop"] for s in by_id["77"]["spans"]} \
+        == {"local", "b1-b2"}
+    assert not by_id["88"]["stitched"]
+    text = trace_report.format_fleet(doc)
+    assert "stitched journeys (seen on >1 bridge): 1" in text
+    # the CLI exit contract: merged scrapes with no errors -> 0
+    assert trace_report.main(["--merge-bridges", str(a), str(b)]) == 0
+
+
 # ------------------------------------------------------------ dashboards
 
 def test_checked_in_dashboards_are_fresh():
